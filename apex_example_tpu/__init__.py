@@ -1,0 +1,36 @@
+"""apex_example_tpu — a TPU-native training framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities exercised by the
+CUDA/NCCL reference ``enijkamp/apex_example`` (NVIDIA Apex mixed precision +
+distributed data-parallel training; see SURVEY.md for the full reference
+analysis).  Nothing here is a port: the compute path is jit/shard_map over a
+named device mesh with XLA collectives, precision is a policy applied at trace
+time (not monkey-patching), and the fused CUDA extensions are Pallas TPU
+kernels.
+
+Public surface (mirrors the reference's import points, SURVEY.md §2):
+
+- ``apex_example_tpu.amp``       — O0–O3 precision policies + loss scaling
+  (reference: ``apex/amp/`` frontend.py/scaler.py).
+- ``apex_example_tpu.parallel``  — mesh-based data parallelism, SyncBatchNorm,
+  LARC (reference: ``apex/parallel/``).
+- ``apex_example_tpu.optim``     — FusedAdam / FusedLAMB / FusedSGD as optax
+  gradient transformations backed by Pallas kernels (reference:
+  ``apex/optimizers/``).
+- ``apex_example_tpu.ops``       — Pallas kernels + XLA reference impls
+  (reference: ``csrc/``).
+- ``apex_example_tpu.normalization`` — FusedLayerNorm module (reference:
+  ``apex/normalization/fused_layer_norm.py``).
+- ``apex_example_tpu.models``    — ResNet-18/50, BERT-base, Transformer-XL in
+  Flax (imported, not implemented, by the reference).
+- ``apex_example_tpu.data``      — synthetic data pipelines (no datasets or
+  network in this environment; see SURVEY.md §5).
+"""
+
+__version__ = "0.1.0"
+
+from apex_example_tpu import amp  # noqa: F401
+from apex_example_tpu import parallel  # noqa: F401
+from apex_example_tpu import optim  # noqa: F401
+
+optimizers = optim  # apex-compatible alias: ``apex.optimizers``

@@ -1,0 +1,39 @@
+"""apex.amp-shaped frontend over the TPU-native policy engine.
+
+``amp.initialize`` in the reference (apex/amp/frontend.py) mutates a torch
+model/optimizer in place.  Here it returns the immutable pieces the jitted
+train step consumes: a :class:`Policy` and a :class:`ScalerState`.  The rest
+of the reference surface (``scale_loss``, ``state_dict``/``load_state_dict``,
+``master_params``) maps onto the functions below.
+"""
+
+from apex_example_tpu.amp.policy import Policy, get_policy, opt_level_table
+from apex_example_tpu.amp.scaler import (
+    ScalerState, all_finite, load_state_dict, make_scaler, scale_loss,
+    select_tree, state_dict, unscale_grads, update as update_scaler)
+
+__all__ = [
+    "Policy", "get_policy", "opt_level_table", "ScalerState", "all_finite",
+    "initialize", "load_state_dict", "make_scaler", "scale_loss",
+    "select_tree", "state_dict", "unscale_grads", "update_scaler",
+]
+
+
+def initialize(opt_level: str = "O0", loss_scale=None,
+               keep_batchnorm_fp32=None, half_dtype=None,
+               init_scale: float = 2.0 ** 16, growth_interval: int = 2000):
+    """apex-parity entry point: returns ``(policy, scaler_state)``.
+
+    Reference: ``amp.initialize(model, optimizer, opt_level=..., ...)``.
+    JAX models are pure, so there is no model/optimizer object to patch; the
+    caller threads the policy into model construction (``compute_dtype`` etc.)
+    and the scaler state into the train step.  See harness/train.py for the
+    end-to-end wiring.
+    """
+    import jax.numpy as jnp
+    policy = get_policy(opt_level, loss_scale=loss_scale,
+                        keep_batchnorm_fp32=keep_batchnorm_fp32,
+                        half_dtype=half_dtype or jnp.bfloat16)
+    scaler = make_scaler(policy, init_scale=init_scale,
+                         growth_interval=growth_interval)
+    return policy, scaler

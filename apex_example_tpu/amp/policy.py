@@ -1,0 +1,139 @@
+"""Precision policies: the TPU-native restatement of apex.amp opt levels.
+
+Reference semantics (apex/amp/frontend.py opt-level property table; SURVEY.md
+§3.1): each of O0–O3 is a bundle of properties — ``cast_model_type``,
+``patch_torch_functions``, ``keep_batchnorm_fp32``, ``master_weights``,
+``loss_scale``.  The reference realizes them by mutating a torch model
+(``.half()``), monkey-patching torch functions, and patching the optimizer.
+
+TPU-native realization: JAX programs are pure functions traced once, so a
+precision policy is *data threaded into the trace*, not a mutation.  A
+:class:`Policy` carries the dtypes; models receive ``compute_dtype`` /
+``param_dtype`` / ``bn_dtype`` at construction, the train step scales the loss
+by ``scaler.scale`` and unscales grads.  There is nothing to patch — the policy
+IS the configuration of the traced program.
+
+dtype mapping (SURVEY.md §3.1 "TPU mapping"): fp16-on-GPU becomes bf16-on-TPU.
+bf16 has fp32's exponent range, so overflow-driven *dynamic* loss scaling is
+unnecessary for bf16 — O1/O2 default to static scale 1.0 on TPU.  The dynamic
+scaler is still fully implemented (scaler.py) for API parity and for fp16
+experiments; pass ``loss_scale="dynamic"`` to enable it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Bundle of precision properties for one opt level.
+
+    Attributes:
+      opt_level: "O0" | "O1" | "O2" | "O3" (apex names, kept for CLI parity).
+      param_dtype: storage dtype of the *model* params.  When
+        ``master_weights`` is True this is the dtype params are cast to at
+        application time while fp32 masters are kept — in JAX we invert the
+        arrangement: params are stored fp32 (they ARE the masters) and cast to
+        ``compute_dtype`` inside the forward pass.  ``param_dtype`` therefore
+        only drops below fp32 for O3 (no master weights).
+      compute_dtype: dtype of matmuls/convs/activations (the MXU dtype).
+      bn_dtype: dtype BatchNorm/LayerNorm statistics run in
+        (``keep_batchnorm_fp32`` in the reference).
+      master_weights: whether fp32 copies back the updates (O2).  With the
+        fp32-params-as-masters arrangement this decides whether ``param_dtype``
+        stays fp32.
+      loss_scale: None => static 1.0; a float => static that value; "dynamic"
+        => dynamic loss scaling (scaler.py).
+      cast_at_call_sites: O1's per-op white/blacklist semantics.  JAX has no
+        torch-function interception point; the honest equivalent is
+        boundary-level casting — compute-heavy modules (conv/dense/attention)
+        run in ``compute_dtype`` while numerically sensitive ops (softmax,
+        norms, losses) run fp32.  Our models implement exactly that split when
+        this flag is set, and the semantic delta vs per-call patching is
+        documented here rather than hidden.
+    """
+
+    opt_level: str
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+    bn_dtype: jnp.dtype
+    master_weights: bool
+    loss_scale: Union[None, float, str]
+    cast_at_call_sites: bool = False
+
+    @property
+    def uses_dynamic_scaling(self) -> bool:
+        return self.loss_scale == "dynamic"
+
+    @property
+    def static_scale(self) -> float:
+        if self.loss_scale is None:
+            return 1.0
+        if self.loss_scale == "dynamic":
+            raise ValueError("dynamic policy has no static scale")
+        return float(self.loss_scale)
+
+    def replace(self, **kw) -> "Policy":
+        return dataclasses.replace(self, **kw)
+
+
+def _mk(opt_level, param_dtype, compute_dtype, bn_dtype, master_weights,
+        loss_scale, cast_at_call_sites=False):
+    return Policy(opt_level, jnp.dtype(param_dtype), jnp.dtype(compute_dtype),
+                  jnp.dtype(bn_dtype), master_weights, loss_scale,
+                  cast_at_call_sites)
+
+
+# The opt-level table (reference: apex/amp/frontend.py O0..O3 property dicts).
+# half_dtype picks the reduced dtype: bf16 is TPU-native; fp16 kept selectable
+# for parity experiments.
+def opt_level_table(half_dtype=jnp.bfloat16):
+    h = jnp.dtype(half_dtype)
+    f = jnp.dtype(jnp.float32)
+    return {
+        # O0: pure fp32 no-op.
+        "O0": _mk("O0", f, f, f, master_weights=False, loss_scale=None),
+        # O1: params fp32, per-boundary casts, numerically-sensitive ops fp32.
+        # Dynamic scaling in the reference; static 1.0 for bf16 (see module
+        # docstring), dynamic when half_dtype is fp16.
+        "O1": _mk("O1", f, h, f, master_weights=False,
+                  loss_scale="dynamic" if h == jnp.float16 else None,
+                  cast_at_call_sites=True),
+        # O2: model compute in half except BN; fp32 master weights.
+        "O2": _mk("O2", f, h, f, master_weights=True,
+                  loss_scale="dynamic" if h == jnp.float16 else None),
+        # O3: everything half, static scale 1.0 (speed ceiling / debugging).
+        "O3": _mk("O3", h, h, h, master_weights=False, loss_scale=1.0),
+    }
+
+
+def get_policy(opt_level: str,
+               loss_scale: Union[None, str, float] = None,
+               keep_batchnorm_fp32: Optional[bool] = None,
+               half_dtype=jnp.bfloat16) -> Policy:
+    """Look up an opt level and apply the same overrides amp.initialize takes.
+
+    Mirrors ``amp.initialize(opt_level=..., loss_scale=...,
+    keep_batchnorm_fp32=...)`` (reference: apex/amp/frontend.py).  String
+    "dynamic" or a number for ``loss_scale``; ``keep_batchnorm_fp32`` flips
+    ``bn_dtype``.
+    """
+    table = opt_level_table(half_dtype)
+    key = opt_level.upper()
+    if key not in table:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r}; options are "
+            f"'O0', 'O1', 'O2', 'O3'.")
+    p = table[key]
+    if loss_scale is not None:
+        if isinstance(loss_scale, str) and loss_scale != "dynamic":
+            loss_scale = float(loss_scale)
+        p = p.replace(loss_scale=loss_scale)
+    if keep_batchnorm_fp32 is not None:
+        p = p.replace(bn_dtype=jnp.dtype(jnp.float32) if keep_batchnorm_fp32
+                      else p.compute_dtype)
+    return p

@@ -1,0 +1,122 @@
+"""Loss scaling as traced state (reference: apex/amp/scaler.py LossScaler).
+
+The reference's dynamic scaler lives on the host: it launches a CUDA kernel to
+detect inf/nan, syncs the flag back, and python-side halves the scale / skips
+``optimizer.step()``.  On TPU that host sync would stall the pipeline, so the
+entire protocol — scale, finite-check, skip, backoff, growth — runs *inside*
+the jitted step on traced values:
+
+- ``ScalerState`` is a pytree carried in the train state.
+- ``scale_loss``   multiplies the loss before ``jax.grad``.
+- ``unscale_grads`` multiplies grads by 1/scale and returns an all-finite flag
+  (the ``amp_C.multi_tensor_scale`` + overflow-check path, SURVEY.md §4.3).
+- ``update``       applies the apex schedule: on overflow scale *= 0.5 and the
+  step is skipped by the caller (select old params); after ``growth_interval``
+  consecutive clean steps scale *= 2.
+
+Defaults match the reference: init scale 2**16, growth interval 2000.  A
+static scaler is the degenerate case (``dynamic=False``): scale is constant
+and the finite check is elided so it costs nothing under bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from apex_example_tpu.amp.policy import Policy
+
+
+@struct.dataclass
+class ScalerState:
+    """Pytree state of the loss scaler; lives inside the train state."""
+    scale: jnp.ndarray            # f32 scalar
+    growth_counter: jnp.ndarray   # i32 scalar: consecutive finite steps
+    dynamic: bool = struct.field(pytree_node=False, default=False)
+    growth_interval: int = struct.field(pytree_node=False, default=2000)
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+
+
+def make_scaler(policy: Policy,
+                init_scale: float = 2.0 ** 16,
+                growth_interval: int = 2000) -> ScalerState:
+    if policy.uses_dynamic_scaling:
+        return ScalerState(scale=jnp.asarray(init_scale, jnp.float32),
+                           growth_counter=jnp.asarray(0, jnp.int32),
+                           dynamic=True, growth_interval=growth_interval)
+    return ScalerState(scale=jnp.asarray(policy.static_scale, jnp.float32),
+                       growth_counter=jnp.asarray(0, jnp.int32),
+                       dynamic=False)
+
+
+def scale_loss(loss: jnp.ndarray, scaler: ScalerState) -> jnp.ndarray:
+    """``with amp.scale_loss(loss, opt) as scaled_loss`` — the enter half."""
+    return loss * scaler.scale.astype(loss.dtype)
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """True iff every element of every leaf is finite."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(
+        [jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+
+
+def unscale_grads(grads: Any, scaler: ScalerState
+                  ) -> Tuple[Any, jnp.ndarray]:
+    """The ``scale_loss.__exit__`` half: grads /= scale, inf/nan check.
+
+    Returns (unscaled_grads, grads_finite).  For a static scale of exactly 1.0
+    the multiply still appears in the trace but XLA folds it away; the finite
+    check is only materialized for dynamic scalers (callers gate on
+    ``scaler.dynamic``).
+    """
+    inv = (1.0 / scaler.scale)
+    grads = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+    if scaler.dynamic:
+        finite = all_finite(grads)
+    else:
+        finite = jnp.asarray(True)
+    return grads, finite
+
+
+def update(scaler: ScalerState, grads_finite: jnp.ndarray) -> ScalerState:
+    """Apex growth/backoff schedule, fully traced (no host sync)."""
+    if not scaler.dynamic:
+        return scaler
+    counter = jnp.where(grads_finite, scaler.growth_counter + 1,
+                        jnp.zeros_like(scaler.growth_counter))
+    grow = counter >= scaler.growth_interval
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(grow, scaler.scale * scaler.growth_factor, scaler.scale),
+        scaler.scale * scaler.backoff_factor)
+    counter = jnp.where(grow, jnp.zeros_like(counter), counter)
+    return scaler.replace(scale=new_scale, growth_counter=counter)
+
+
+def select_tree(pred: jnp.ndarray, on_true: Any, on_false: Any) -> Any:
+    """Per-leaf ``where`` used for the skip-step path (apex: overflow =>
+    optimizer.step() is skipped; here: select old state when not finite)."""
+    return jax.tree_util.tree_map(
+        lambda t, f: jnp.where(pred, t, f), on_true, on_false)
+
+
+def state_dict(scaler: ScalerState) -> dict:
+    """Serializable scaler state (reference: amp.state_dict(); the loss-scale
+    survives checkpoint/resume — upstream tests this in test_checkpointing)."""
+    return {"scale": float(scaler.scale),
+            "growth_counter": int(scaler.growth_counter),
+            "dynamic": scaler.dynamic}
+
+
+def load_state_dict(scaler: ScalerState, d: dict) -> ScalerState:
+    return scaler.replace(
+        scale=jnp.asarray(d["scale"], jnp.float32),
+        growth_counter=jnp.asarray(d["growth_counter"], jnp.int32))

@@ -1,0 +1,5 @@
+from apex_example_tpu.data.synthetic import (
+    CIFAR10, IMAGENET, SyntheticLoader, image_batch, lm_batch, mlm_batch)
+
+__all__ = ["CIFAR10", "IMAGENET", "SyntheticLoader", "image_batch",
+           "lm_batch", "mlm_batch"]

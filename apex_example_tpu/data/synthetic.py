@@ -1,0 +1,137 @@
+"""Synthetic, procedurally generated datasets.
+
+This environment has no datasets on disk and no network (SURVEY.md §5 item 6),
+so every workload runs on deterministic synthetic data shaped like its real
+counterpart:
+
+- :func:`image_batch` — CIFAR-/ImageNet-shaped classification batches.  Images
+  are a class-dependent low-frequency pattern plus noise, so models genuinely
+  learn (loss curves fall, accuracy rises) and convergence tests are
+  meaningful, while generation stays cheap enough for 1 CPU core.
+- :func:`lm_batch` — token streams with affine bigram structure
+  (``t+1 = (a·t + b) mod V`` with noise) for Transformer-XL style causal LM.
+- :func:`mlm_batch` — BERT-style masked-LM batches (15% masking: 80/10/10)
+  over the same learnable streams.
+
+All generators are pure ``jax`` functions of ``(seed, step)`` — they can run
+jitted *on device*, which is how the benchmark harness isolates device
+throughput from the (single-core) host input pipeline, mirroring the
+reference's CUDA-stream prefetcher intent (SURVEY.md §3.5) the TPU way.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Shapes for the reference's workload matrix (BASELINE.json configs).
+CIFAR10 = dict(image_size=32, channels=3, num_classes=10)
+IMAGENET = dict(image_size=224, channels=3, num_classes=1000)
+
+
+def _class_patterns(num_classes: int, image_size: int, channels: int,
+                    seed: int) -> jnp.ndarray:
+    """Fixed low-res per-class patterns, upsampled — the learnable signal."""
+    key = jax.random.PRNGKey(seed)
+    low = jax.random.normal(key, (num_classes, 8, 8, channels), jnp.float32)
+    return jax.image.resize(
+        low, (num_classes, image_size, image_size, channels), "bilinear")
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "batch_size", "image_size", "channels", "num_classes", "seed"))
+def image_batch(step: jnp.ndarray, *, batch_size: int, image_size: int = 32,
+                channels: int = 3, num_classes: int = 10, seed: int = 0,
+                noise: float = 0.5) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (images NHWC f32 ~N(0,1)-ish, labels i32)."""
+    pats = _class_patterns(num_classes, image_size, channels, seed)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    k1, k2 = jax.random.split(key)
+    labels = jax.random.randint(k1, (batch_size,), 0, num_classes)
+    imgs = pats[labels] + noise * jax.random.normal(
+        k2, (batch_size, image_size, image_size, channels), jnp.float32)
+    return imgs, labels
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "batch_size", "seq_len", "vocab_size", "seed"))
+def lm_batch(step: jnp.ndarray, *, batch_size: int, seq_len: int,
+             vocab_size: int, seed: int = 0,
+             noise_p: float = 0.1) -> jnp.ndarray:
+    """Token sequences (B, L+1) with affine-bigram structure; callers slice
+    inputs = [:, :-1], targets = [:, 1:]."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed ^ 0x5eed), step)
+    k0, kn, kr = jax.random.split(key, 3)
+    a, b = 31, 17  # coprime with typical vocab sizes → full-cycle bigram map
+    t0 = jax.random.randint(k0, (batch_size,), 0, vocab_size)
+
+    def next_tok(t, k):
+        clean = (a * t + b) % vocab_size
+        rand = jax.random.randint(k, t.shape, 0, vocab_size)
+        flip = jax.random.bernoulli(jax.random.fold_in(k, 1), noise_p,
+                                    t.shape)
+        nxt = jnp.where(flip, rand, clean)
+        return nxt, nxt
+
+    keys = jax.random.split(kn, seq_len)
+    _, toks = jax.lax.scan(next_tok, t0, keys)
+    del kr
+    return jnp.concatenate([t0[:, None], toks.T], axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "batch_size", "seq_len", "vocab_size", "seed", "mask_token_id"))
+def mlm_batch(step: jnp.ndarray, *, batch_size: int, seq_len: int,
+              vocab_size: int, mask_token_id: int, seed: int = 0,
+              mask_prob: float = 0.15):
+    """BERT-style MLM batch: (input_ids, labels, weights).
+
+    labels hold the original token everywhere; weights are 1.0 at masked
+    positions (the only positions that contribute to the loss).  Masked
+    positions get [MASK] 80% / random 10% / unchanged 10%.
+    """
+    toks = lm_batch(step, batch_size=batch_size, seq_len=seq_len - 1,
+                    vocab_size=vocab_size, seed=seed)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed + 101), step)
+    k1, k2, k3 = jax.random.split(key, 3)
+    is_masked = jax.random.bernoulli(k1, mask_prob, toks.shape)
+    u = jax.random.uniform(k2, toks.shape)
+    rand_tok = jax.random.randint(k3, toks.shape, 0, vocab_size)
+    inputs = jnp.where(is_masked & (u < 0.8), mask_token_id, toks)
+    inputs = jnp.where(is_masked & (u >= 0.8) & (u < 0.9), rand_tok, inputs)
+    return (inputs.astype(jnp.int32), toks.astype(jnp.int32),
+            is_masked.astype(jnp.float32))
+
+
+class SyntheticLoader:
+    """Host-side iterator facade (DataLoader+DistributedSampler analog).
+
+    ``shard``/``num_shards`` reproduce DistributedSampler semantics: each
+    shard folds its index into the seed so replicas see disjoint streams.
+    Iteration yields device arrays; for peak throughput use the jitted batch
+    functions directly inside the step (see harness/bench).
+    """
+
+    def __init__(self, kind: str = "image", steps_per_epoch: int = 100,
+                 shard: int = 0, num_shards: int = 1, **kw):
+        self.kind, self.steps = kind, steps_per_epoch
+        self.kw = dict(kw)
+        self.kw["seed"] = self.kw.get("seed", 0) * num_shards + shard
+
+    def __iter__(self):
+        for i in range(self.steps):
+            step = jnp.asarray(i, jnp.int32)
+            if self.kind == "image":
+                yield image_batch(step, **self.kw)
+            elif self.kind == "lm":
+                yield lm_batch(step, **self.kw)
+            elif self.kind == "mlm":
+                yield mlm_batch(step, **self.kw)
+            else:
+                raise ValueError(self.kind)
+
+    def __len__(self):
+        return self.steps

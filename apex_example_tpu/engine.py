@@ -1,0 +1,249 @@
+"""The train-step engine: one jitted function per workload.
+
+This is the TPU-native restatement of the reference's hot loop (SURVEY.md
+§4.2–4.3): forward, scaled backward, gradient allreduce, unscale + finite
+check, (possibly skipped) optimizer step, scaler update — all of it a single
+traced program.  What the reference spreads across autograd hooks, patched
+optimizers and host-side scaler logic collapses here into data flow:
+
+    loss → grad → psum('data') → unscale/finite → fused update → where-select
+
+XLA overlaps the psum with backward computation (the bucketed-NCCL overlap,
+compiler-scheduled) and the where-select realizes apex's "overflow ⇒ skip
+optimizer.step()" without a host sync.
+
+Data parallelism wraps the same step in ``shard_map`` over the ``data`` mesh
+axis — the per-device function IS the single-device step plus collectives,
+which is how DDP semantics (identical replicated params, summed grads, synced
+BN stats) are preserved by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_example_tpu import amp as amp_lib
+from apex_example_tpu.amp.policy import Policy
+from apex_example_tpu.amp.scaler import ScalerState
+from apex_example_tpu.parallel.distributed import DDPConfig, allreduce_grads
+from apex_example_tpu.parallel.mesh import DATA_AXIS
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.7 spelling
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+@struct.dataclass
+class TrainState:
+    """Everything the step carries; a pure pytree (donatable)."""
+    step: jnp.ndarray
+    params: Any                 # fp32 masters (or half under O3)
+    batch_stats: Any            # BN running stats, {} for stat-free models
+    opt_state: Any
+    scaler: ScalerState
+
+
+def create_train_state(rng, model, optimizer, sample_batch, policy: Policy,
+                       scaler: Optional[ScalerState] = None,
+                       train_kwargs: Optional[dict] = None) -> TrainState:
+    """Initialize params/stats/optimizer for a model + policy.
+
+    Params are stored in ``policy.param_dtype`` — fp32 for O0–O2 (they double
+    as apex's "master weights"), half for O3.
+    """
+    variables = model.init(rng, sample_batch, **(train_kwargs or
+                                                 {"train": False}))
+    params = variables["params"]
+    if policy.param_dtype != jnp.float32:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(policy.param_dtype), params)
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=optimizer.init(params),
+        scaler=scaler if scaler is not None else amp_lib.make_scaler(policy))
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """Mean softmax-CE in fp32 (the reference computes criterion on
+    ``output.float()``)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels).mean()
+
+
+def _apply_model(model, params, batch_stats, x, train: bool):
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+        if train:
+            out, mut = model.apply(variables, x, train=True,
+                                   mutable=["batch_stats"])
+            return out, mut["batch_stats"]
+        return model.apply(variables, x, train=False), batch_stats
+    if train:
+        return model.apply(variables, x, train=True), batch_stats
+    return model.apply(variables, x, train=False), batch_stats
+
+
+def make_train_step(model, optimizer, policy: Policy,
+                    ddp: Optional[DDPConfig] = None,
+                    axis_name: Optional[str] = None,
+                    loss_fn: Callable = cross_entropy_loss,
+                    compute_accuracy: bool = True):
+    """Build the single-device (or per-shard) train step.
+
+    ``optimizer`` is a fused optimizer (init/apply) from
+    ``apex_example_tpu.optim``; optax GradientTransformations are adapted
+    automatically.  When ``axis_name`` is set the step must run inside
+    shard_map/pmap with that axis bound (see :func:`make_sharded_train_step`).
+    """
+    opt = _wrap_optimizer(optimizer)
+    ddp = ddp or DDPConfig()
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        x, y = batch
+
+        def scaled_loss_fn(params):
+            logits, new_stats = _apply_model(
+                model, params, state.batch_stats, x, train=True)
+            loss = loss_fn(logits, y)
+            # amp.scale_loss: multiply before backward (SURVEY.md §4.3).
+            return amp_lib.scale_loss(loss, state.scaler), (loss, logits,
+                                                            new_stats)
+
+        grads, (loss, logits, new_stats) = jax.grad(
+            scaled_loss_fn, has_aux=True)(state.params)
+
+        # DDP: reduce *scaled* grads, like the reference's backward-hook
+        # allreduce; then unscale + finite-check (scale_loss __exit__).
+        if axis_name is not None:
+            grads = allreduce_grads(grads, ddp, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
+        grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
+
+        new_params, new_opt_state = opt.apply(grads, state.opt_state,
+                                              state.params)
+        if policy.uses_dynamic_scaling:
+            # Overflow ⇒ the whole update is skipped (params and optimizer
+            # state keep their old values; BN stats are NOT rolled back —
+            # apex updates them during forward regardless).
+            new_params = amp_lib.select_tree(grads_finite, new_params,
+                                            state.params)
+            new_opt_state = amp_lib.select_tree(grads_finite, new_opt_state,
+                                                state.opt_state)
+        scaler = amp_lib.update_scaler(state.scaler, grads_finite)
+
+        metrics = {"loss": loss, "scale": scaler.scale,
+                   "grads_finite": grads_finite.astype(jnp.float32)}
+        # top1 only makes sense for integer-class labels; structured label
+        # pytrees (e.g. BERT's (labels, weights)) must not silently broadcast
+        # into a garbage metric.
+        if compute_accuracy and isinstance(y, jnp.ndarray):
+            top1 = jnp.mean((jnp.argmax(logits, -1) == y)
+                            .astype(jnp.float32)) * 100.0
+            if axis_name is not None:
+                top1 = jax.lax.pmean(top1, axis_name)
+            metrics["top1"] = top1
+
+        return TrainState(step=state.step + 1, params=new_params,
+                          batch_stats=new_stats, opt_state=new_opt_state,
+                          scaler=scaler), metrics
+
+    return train_step
+
+
+def make_eval_step(model, loss_fn: Callable = cross_entropy_loss,
+                   axis_name: Optional[str] = None):
+    def eval_step(state: TrainState, batch) -> Dict:
+        x, y = batch
+        logits, _ = _apply_model(model, state.params, state.batch_stats, x,
+                                 train=False)
+        loss = loss_fn(logits, y)
+        top1 = jnp.mean((jnp.argmax(logits, -1) == y)
+                        .astype(jnp.float32)) * 100.0
+        if axis_name is not None:
+            loss = jax.lax.pmean(loss, axis_name)
+            top1 = jax.lax.pmean(top1, axis_name)
+        return {"loss": loss, "top1": top1}
+    return eval_step
+
+
+def make_sharded_train_step(mesh: Mesh, model, optimizer, policy: Policy,
+                            ddp: Optional[DDPConfig] = None,
+                            loss_fn: Callable = cross_entropy_loss,
+                            compute_accuracy: bool = True,
+                            axis_name: str = DATA_AXIS,
+                            donate: bool = True):
+    """DDP train step: shard_map over the data axis, jitted, state donated.
+
+    State is replicated (P()), the batch is split on axis 0.  Inside the
+    shard, grads cross replicas via psum (allreduce_grads) so every replica
+    computes the identical update — exactly DDP's contract.
+    """
+    per_shard = make_train_step(model, optimizer, policy, ddp=ddp,
+                                axis_name=axis_name, loss_fn=loss_fn,
+                                compute_accuracy=compute_accuracy)
+
+    def step_and_sync(state, batch):
+        new_state, metrics = per_shard(state, batch)
+        # BN running stats: SyncBatchNorm already produced identical stats on
+        # every replica; plain (local) BatchNorm under DDP produces per-shard
+        # stats, which must not silently diverge on replicated state — average
+        # them (apex keeps rank-0's; the mean is the symmetric equivalent).
+        synced = _replicate_mean(new_state.batch_stats, axis_name)
+        return new_state.replace(batch_stats=synced), metrics
+
+    # NOTE: vma checking stays ON (default).  With check_vma=False, psum's
+    # transpose drops cross-replica cotangents and SyncBatchNorm's backward
+    # silently loses the terms the reference all-reduces (sum_dy/sum_dy_xmu,
+    # SURVEY.md §4.4) — verified by tests/test_parallel.py.
+    sharded = _shard_map(
+        step_and_sync, mesh=mesh,
+        in_specs=(P(), (P(axis_name), P(axis_name))),
+        out_specs=(P(), P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def _replicate_mean(tree, axis_name: str):
+    """pmean that accepts both replicated and shard-varying leaves."""
+    if not jax.tree_util.tree_leaves(tree):
+        return tree
+    world = jax.lax.axis_size(axis_name)
+
+    def f(x):
+        vma = getattr(jax.typeof(x), "vma", frozenset())
+        if axis_name not in vma:        # replicated leaf (SyncBN stats)
+            x = jax.lax.pcast(x, axis_name, to="varying")
+        return jax.lax.psum(x, axis_name) / world
+
+    return jax.tree_util.tree_map(f, tree)
+
+
+def _wrap_optimizer(optimizer):
+    """Accept fused optimizers (init/apply) or optax transforms."""
+    if hasattr(optimizer, "apply") and hasattr(optimizer, "init"):
+        return optimizer
+
+    class _OptaxAdapter:
+        def __init__(self, tx):
+            self.tx = tx
+
+        def init(self, params):
+            return self.tx.init(params)
+
+        def apply(self, grads, opt_state, params):
+            updates, new_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+    return _OptaxAdapter(optimizer)

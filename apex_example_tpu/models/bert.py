@@ -1,0 +1,146 @@
+"""BERT-base for masked-LM pretraining (workload C4, SURVEY.md §1).
+
+The reference imports BERT from an external repo and exercises apex on it
+(amp-O2 + FusedLAMB, BASELINE.json config 4); the parity target is the
+standard BERT-base architecture: learned word+position+type embeddings with
+post-embedding LayerNorm, 12 post-norm encoder layers (self-attention + GELU
+FFN, hidden 768, heads 12, FFN 3072), and an MLM head whose decoder is tied
+to the word embeddings.
+
+TPU-native specifics:
+- All LayerNorms are :class:`FusedLayerNorm` (the Pallas kernel — fp32 stats
+  regardless of compute dtype, the MixedFusedLayerNorm contract).
+- ``dtype``/``param_dtype`` thread the amp policy; attention logits and
+  softmax run in fp32 (the op-classification "blacklist" of amp O1/O2:
+  softmax is fp32; SURVEY.md §3.1).
+- Static shapes throughout; the attention mask is an additive bias, so the
+  whole step stays jit-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_example_tpu.normalization import FusedLayerNorm
+
+
+class BertSelfAttention(nn.Module):
+    hidden_size: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask_bias):
+        d = self.hidden_size
+        h = self.num_heads
+        hd = d // h
+        dense = lambda name: nn.Dense(d, dtype=self.dtype,
+                                      param_dtype=self.param_dtype,
+                                      name=name)
+        q = dense("query")(x).reshape(*x.shape[:-1], h, hd)
+        k = dense("key")(x).reshape(*x.shape[:-1], h, hd)
+        v = dense("value")(x).reshape(*x.shape[:-1], h, hd)
+        # Attention scores in fp32 (softmax is a blacklist op).
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        logits = logits / jnp.sqrt(hd).astype(jnp.float32)
+        if mask_bias is not None:
+            logits = logits + mask_bias
+        probs = nn.softmax(logits, axis=-1).astype(self.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        ctx = ctx.reshape(*x.shape[:-1], d)
+        return dense("output")(ctx)
+
+
+class BertLayer(nn.Module):
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask_bias):
+        attn = BertSelfAttention(self.hidden_size, self.num_heads,
+                                 self.dtype, self.param_dtype,
+                                 name="attention")(x, mask_bias)
+        x = FusedLayerNorm(dtype=self.dtype, name="attention_ln")(
+            (x + attn).astype(jnp.float32))
+        x = x.astype(self.dtype)
+        y = nn.Dense(self.intermediate_size, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="intermediate")(x)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="output")(y)
+        x = FusedLayerNorm(dtype=self.dtype, name="output_ln")(
+            (x + y).astype(jnp.float32))
+        return x.astype(self.dtype)
+
+
+class BertForMaskedLM(nn.Module):
+    """BERT encoder + tied-decoder MLM head; returns vocab logits (fp32)."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
+                 train: bool = True):
+        del train  # no dropout in the pretraining benchmark path
+        b, L = input_ids.shape
+        word_emb = nn.Embed(self.vocab_size, self.hidden_size,
+                            dtype=self.dtype, param_dtype=self.param_dtype,
+                            name="word_embeddings")
+        x = word_emb(input_ids)
+        pos = jnp.arange(L)[None, :]
+        x = x + nn.Embed(self.max_position, self.hidden_size,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="position_embeddings")(pos)
+        x = FusedLayerNorm(dtype=self.dtype, name="embeddings_ln")(
+            x.astype(jnp.float32)).astype(self.dtype)
+
+        if attention_mask is not None:
+            mask_bias = jnp.where(attention_mask[:, None, None, :] > 0,
+                                  0.0, -1e9).astype(jnp.float32)
+        else:
+            mask_bias = None
+
+        for i in range(self.num_layers):
+            x = BertLayer(self.hidden_size, self.num_heads,
+                          self.intermediate_size, self.dtype,
+                          self.param_dtype, name=f"layer_{i}")(x, mask_bias)
+
+        # MLM head: dense+gelu+LN, then tied decoder.
+        x = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlm_dense")(x)
+        x = nn.gelu(x, approximate=False)
+        x = FusedLayerNorm(dtype=self.dtype, name="mlm_ln")(
+            x.astype(jnp.float32)).astype(self.dtype)
+        logits = word_emb.attend(x)
+        logits = logits + self.param("mlm_bias", nn.initializers.zeros,
+                                     (self.vocab_size,), jnp.float32)
+        return logits.astype(jnp.float32)
+
+
+def bert_base(**kw) -> BertForMaskedLM:
+    return BertForMaskedLM(**kw)
+
+
+def bert_tiny(**kw) -> BertForMaskedLM:
+    """Test-scale configuration (same code path, CPU-friendly)."""
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position", 128)
+    return BertForMaskedLM(**kw)

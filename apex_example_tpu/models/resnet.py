@@ -1,0 +1,139 @@
+"""ResNet-18/50 in Flax (torchvision-architecture parity).
+
+The reference imports ``torchvision.models.resnet{18,50}`` rather than
+implementing them (SURVEY.md §3.5), so the parity target is the torchvision
+architecture: 7×7/2 stem + 3×3 maxpool, BasicBlock (18) / Bottleneck (50)
+stages [2,2,2,2] / [3,4,6,3], stride-2 downsample convs, final FC.
+
+TPU-native specifics:
+- NHWC layout throughout (TPU conv layout; torch is NCHW — the harness's data
+  generators produce NHWC directly).
+- ``dtype``/``param_dtype`` thread the amp policy: convs/dense run in
+  ``dtype`` (bf16 under O2), params stored in ``param_dtype`` (fp32 masters).
+- Normalization is :class:`SyncBatchNorm` with torch momentum/eps semantics;
+  ``bn_axis_name`` switches on cross-replica stats (the
+  ``convert_syncbn_model`` hook), and ``bn_dtype`` realizes
+  ``keep_batchnorm_fp32``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_example_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.float32          # compute dtype (policy)
+    param_dtype: jnp.dtype = jnp.float32
+    bn_dtype: Optional[jnp.dtype] = None    # None: follow dtype (O3)
+    bn_axis_name: Optional[str] = None      # "data" => SyncBatchNorm
+    bn_momentum: float = 0.1
+    small_stem: bool = False                # CIFAR-style 3x3 stem (optional)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, padding="SAME",
+                                 dtype=self.dtype,
+                                 param_dtype=self.param_dtype,
+                                 kernel_init=nn.initializers.he_normal())
+        norm = functools.partial(
+            SyncBatchNorm,
+            use_running_average=not train,
+            axis_name=self.bn_axis_name,
+            momentum=self.bn_momentum,
+            epsilon=1e-5,
+            dtype=self.bn_dtype or self.dtype,
+            param_dtype=jnp.float32)
+
+        x = x.astype(self.dtype)
+        if self.small_stem:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.num_filters * 2 ** i, strides,
+                                   conv=conv, norm=norm)(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="fc")(x)
+        # Classifier output in fp32 (loss is computed fp32 under every opt
+        # level; reference computes criterion on .float() output).
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck, **kw)
+
+
+ARCHS = {"resnet18": resnet18, "resnet50": resnet50}

@@ -1,0 +1,194 @@
+"""Transformer-XL for causal LM (workload C5, SURVEY.md §1/§6).
+
+The reference's long-sequence capability is *algorithmic*: Transformer-XL's
+segment-level recurrence (cached, stop-gradient hidden states as extended
+context) plus relative positional encodings — not sequence-dim communication
+(SURVEY.md §3.2: CP/ring absent in the reference family).  This module
+implements that algorithm natively in Flax:
+
+- The memory is a fixed-shape carry ``(num_layers, B, mem_len, d)`` threaded
+  through the step function — jit-stable, donatable, and it composes with the
+  DDP shard_map (memory is per-replica activation state, sharded on batch).
+- Relative attention uses the content/position bias decomposition with the
+  standard rel-shift realized via gather-free slicing (static shapes only).
+- FusedLayerNorm (Pallas) everywhere; softmax in fp32 per amp op rules.
+
+Architecture follows the canonical Transformer-XL base: pre-LN off (post-norm
+like the original), learnable per-head content/position biases shared across
+layers is a variant choice — we keep them per-layer (original paper setup).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_example_tpu.normalization import FusedLayerNorm
+
+
+def rel_shift(x: jnp.ndarray) -> jnp.ndarray:
+    """Relative-position shift: (..., qlen, klen) scores indexed by distance.
+
+    Standard TXL trick: pad one column, reshape, drop — converts position-
+    indexed logits into distance-indexed alignment with static shapes.
+    """
+    *lead, q, k = x.shape
+    x = jnp.pad(x, [(0, 0)] * len(lead) + [(0, 0), (1, 0)])
+    x = x.reshape(*lead, k + 1, q)
+    x = x[..., 1:, :]
+    return x.reshape(*lead, q, k)
+
+
+class RelMultiHeadAttn(nn.Module):
+    d_model: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mem, pos_emb):
+        """x: (B, q, d); mem: (B, m, d); pos_emb: (q+m, d) for distances
+        [q+m-1 ... 0]."""
+        b, qlen, d = x.shape
+        mlen = mem.shape[1]
+        klen = qlen + mlen
+        h, hd = self.num_heads, self.d_model // self.num_heads
+
+        cat = jnp.concatenate([mem.astype(x.dtype), x], axis=1)
+        q = nn.Dense(d, use_bias=False, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="q")(x)
+        k = nn.Dense(d, use_bias=False, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="k")(cat)
+        v = nn.Dense(d, use_bias=False, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="v")(cat)
+        r = nn.Dense(d, use_bias=False, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="r")(
+            pos_emb.astype(self.dtype))
+
+        q = q.reshape(b, qlen, h, hd)
+        k = k.reshape(b, klen, h, hd)
+        v = v.reshape(b, klen, h, hd)
+        r = r.reshape(klen, h, hd)
+
+        u = self.param("u_bias", nn.initializers.zeros, (h, hd),
+                       self.param_dtype).astype(self.dtype)
+        w = self.param("v_bias", nn.initializers.zeros, (h, hd),
+                       self.param_dtype).astype(self.dtype)
+
+        # content score AC: (q + u) · k ; position score BD: (q + v) · r
+        ac = jnp.einsum("bqhd,bkhd->bhqk", q + u, k)
+        bd = jnp.einsum("bqhd,khd->bhqk", q + w, r)
+        bd = rel_shift(bd)
+        logits = (ac + bd).astype(jnp.float32) / jnp.sqrt(hd)
+
+        # causal mask with memory: query i attends keys [0 .. mlen+i]
+        qi = jnp.arange(qlen)[:, None]
+        kj = jnp.arange(klen)[None, :]
+        causal = kj <= (qi + mlen)
+        logits = jnp.where(causal[None, None], logits, -1e30)
+
+        probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, qlen, d)
+        return nn.Dense(d, use_bias=False, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="o")(ctx)
+
+
+class TXLLayer(nn.Module):
+    d_model: int
+    num_heads: int
+    d_inner: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mem, pos_emb):
+        a = RelMultiHeadAttn(self.d_model, self.num_heads, self.dtype,
+                             self.param_dtype, name="attn")(x, mem, pos_emb)
+        x = FusedLayerNorm(dtype=self.dtype, name="attn_ln")(
+            (x + a).astype(jnp.float32)).astype(self.dtype)
+        y = nn.Dense(self.d_inner, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="ff1")(x)
+        y = nn.relu(y)
+        y = nn.Dense(self.d_model, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="ff2")(y)
+        x = FusedLayerNorm(dtype=self.dtype, name="ff_ln")(
+            (x + y).astype(jnp.float32)).astype(self.dtype)
+        return x
+
+
+class TransformerXL(nn.Module):
+    """Returns (logits, new_mems); mems: (num_layers, B, mem_len, d_model).
+
+    Call with ``mems=None`` to start a document (zeros); thread the returned
+    mems through subsequent segments.  New memories are stop-gradient (the
+    reference behavior: cached states receive no gradient).
+    """
+
+    vocab_size: int = 267735        # WikiText-103 vocab (synthetic runs use less)
+    d_model: int = 410
+    num_layers: int = 16
+    num_heads: int = 10
+    d_inner: int = 2100
+    mem_len: int = 150
+    clamp_len: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init_mems(self, batch_size: int) -> jnp.ndarray:
+        return jnp.zeros((self.num_layers, batch_size, self.mem_len,
+                          self.d_model), self.dtype)
+
+    @nn.compact
+    def __call__(self, input_ids, mems: Optional[jnp.ndarray] = None,
+                 train: bool = True
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        del train
+        b, qlen = input_ids.shape
+        if mems is None:
+            mems = self.init_mems(b)
+        mlen = mems.shape[2]
+        klen = qlen + mlen
+
+        emb = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name="word_emb")
+        x = emb(input_ids) * jnp.sqrt(self.d_model).astype(self.dtype)
+
+        # Sinusoidal relative position encodings for distances klen-1 .. 0.
+        pos_seq = jnp.arange(klen - 1, -1, -1.0)
+        if self.clamp_len > 0:
+            pos_seq = jnp.minimum(pos_seq, self.clamp_len)
+        inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, self.d_model, 2.0)
+                                      / self.d_model))
+        sinusoid = pos_seq[:, None] * inv_freq[None, :]
+        pos_emb = jnp.concatenate([jnp.sin(sinusoid), jnp.cos(sinusoid)],
+                                  axis=-1)
+
+        new_mems = []
+        for i in range(self.num_layers):
+            # Cache the layer INPUT (reference behavior), truncated to
+            # mem_len, gradient-stopped.
+            cat = jnp.concatenate([mems[i], x], axis=1)
+            new_mems.append(jax.lax.stop_gradient(cat[:, -self.mem_len:]))
+            x = TXLLayer(self.d_model, self.num_heads, self.d_inner,
+                         self.dtype, self.param_dtype,
+                         name=f"layer_{i}")(x, mems[i], pos_emb)
+
+        logits = emb.attend(x).astype(jnp.float32)
+        return logits, jnp.stack(new_mems)
+
+
+def transformer_xl_base(**kw) -> TransformerXL:
+    return TransformerXL(**kw)
+
+
+def transformer_xl_tiny(**kw) -> TransformerXL:
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("d_inner", 128)
+    kw.setdefault("mem_len", 16)
+    return TransformerXL(**kw)

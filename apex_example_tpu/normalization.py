@@ -1,0 +1,49 @@
+"""FusedLayerNorm / MixedFusedLayerNorm modules.
+
+Reference: apex/normalization/fused_layer_norm.py — an nn.LayerNorm drop-in
+whose forward/backward are the CUDA extension (SURVEY.md §3.4).  Here the
+module wraps the Pallas ``layer_norm`` op (ops/layer_norm.py), which carries
+its own custom VJP; on non-TPU backends it lowers to the XLA reference path.
+
+MixedFusedLayerNorm semantics (half in/out, fp32 params and statistics) are
+the ``dtype``/``param_dtype`` split: stats are always fp32 inside the kernel,
+params default to fp32, output matches the input dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from apex_example_tpu.ops.layer_norm import layer_norm
+
+
+class FusedLayerNorm(nn.Module):
+    """LayerNorm over the last axis, backed by the Pallas kernel."""
+
+    epsilon: float = 1e-5
+    dtype: Optional[jnp.dtype] = None       # output dtype (None: follow input)
+    param_dtype: jnp.dtype = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        feat = x.shape[-1]
+        if self.use_scale:
+            scale = self.param("scale", nn.initializers.ones, (feat,),
+                               self.param_dtype)
+        else:
+            scale = jnp.ones((feat,), self.param_dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (feat,),
+                              self.param_dtype)
+        else:
+            bias = jnp.zeros((feat,), self.param_dtype)
+        y = layer_norm(x, scale, bias, self.epsilon)
+        return y.astype(self.dtype) if self.dtype is not None else y
+
+
+MixedFusedLayerNorm = FusedLayerNorm

@@ -1,0 +1,15 @@
+"""Kernel-dispatch configuration shared by all ops."""
+
+import jax
+
+INTERPRET = False  # run Pallas kernels in interpreter mode (CPU tests)
+
+
+def interpret() -> bool:
+    return INTERPRET
+
+
+def use_pallas() -> bool:
+    """Pallas path on TPU (or under the interpreter); XLA reference
+    implementations elsewhere."""
+    return INTERPRET or jax.default_backend() in ("tpu", "axon")

@@ -1,0 +1,21 @@
+"""ShapeDtypeStruct construction that survives vma-checked shard_map.
+
+Inside ``shard_map(..., check_vma=True)`` (the default, and required for
+correct psum transposes — see engine.py), ``pallas_call`` demands that output
+avals declare how they vary over mesh axes.  Kernel outputs vary exactly as
+the union of their operands' variances, so every pallas_call in this package
+builds its ``out_shape`` through :func:`sds`.
+"""
+
+from __future__ import annotations
+
+import jax
+
+def sds(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
+    vma = frozenset()
+    for r in operands:
+        vma = vma | getattr(jax.typeof(r), "vma", frozenset())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax without vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)

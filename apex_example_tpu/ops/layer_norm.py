@@ -1,0 +1,253 @@
+"""Fused LayerNorm: Pallas TPU kernel with custom VJP + XLA reference.
+
+Reference (csrc/layer_norm_cuda.cpp + layer_norm_cuda_kernel.cu, exposed as
+apex.normalization.FusedLayerNorm; SURVEY.md §2.1): a CUDA kernel computes
+Welford mean/var per row and normalizes in one pass; the backward kernel
+produces dx and the dgamma/dbeta reductions.
+
+TPU-native design: one Pallas kernel per pass, gridded over row blocks.  Rows
+live in VMEM; mean/var are row reductions on the VPU; the affine transform is
+fused into the same kernel (one HBM round-trip, which is the entire point —
+LayerNorm is bandwidth-bound).  Stats are computed in fp32 regardless of the
+input dtype (the reference's MixedFusedLayerNorm behavior: bf16 in/out, fp32
+params and stats).  The backward recomputes x̂ from the saved fp32 (mean,
+rstd) instead of saving it — rematerialization trades a cheap VPU op for HBM.
+
+``layer_norm`` is the public entry: custom_vjp, Pallas on TPU, pure-XLA
+elsewhere (tests compare both against torch.nn.LayerNorm goldens).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_example_tpu.ops._vma import sds
+
+from apex_example_tpu.ops import _config as _cfg
+
+
+def _use_pallas(x) -> bool:
+    if _cfg.INTERPRET:
+        return True
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    # Lane-dim constraint: hidden must tile to 128 for a clean kernel.
+    return x.shape[-1] % 128 == 0 and x.shape[-1] >= 128
+
+
+# --------------------------------------------------------------------------
+# XLA reference path (also the golden for kernel tests).
+# --------------------------------------------------------------------------
+
+def layer_norm_reference(x, gamma, beta, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernels.
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    xf = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    y = xhat * g_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean[:, 0]
+    rstd_ref[:] = rstd[:, 0]
+
+
+def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
+                dx_ref, dg_ref, db_ref):
+    xf = x_ref[:].astype(jnp.float32)
+    dyf = dy_ref[:].astype(jnp.float32)
+    mean = mean_ref[:][:, None]
+    rstd = rstd_ref[:][:, None]
+    xhat = (xf - mean) * rstd
+    gamma = g_ref[:].astype(jnp.float32)
+
+    # dgamma/dbeta: partial sums per row-block, accumulated across the grid.
+    dg_ref[:] += jnp.sum(dyf * xhat, axis=0)
+    db_ref[:] += jnp.sum(dyf, axis=0)
+
+    # dx = rstd * (dy*g - mean(dy*g) - xhat * mean(dy*g*xhat))
+    wdy = dyf * gamma
+    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (wdy - c1 - xhat * c2)).astype(dx_ref.dtype)
+
+
+def _pick_block_rows(n_rows: int, hidden: int, dtype) -> int:
+    # Row blocks are multiples of 128: the rank-1 (mean/rstd) outputs tile at
+    # 128 elements for fp32, and 128 rows comfortably exceeds the 2-D sublane
+    # minimum.  Budget ~2 MB of VMEM for the x block.
+    bytes_per = jnp.dtype(dtype).itemsize
+    target = (2 * 1024 * 1024) // max(1, hidden * bytes_per)
+    block = max(128, (target // 128) * 128)
+    return min(block, max(128, ((n_rows + 127) // 128) * 128))
+
+
+def _layer_norm_fwd_pallas(x2d, gamma, beta, eps):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h = x2d.shape
+    block = _pick_block_rows(n, h, x2d.dtype)
+    pad = (-n) % block
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+    np_ = x2d.shape[0]
+
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(np_ // block,),
+        in_specs=[
+            pl.BlockSpec((block, h), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, h), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            sds((np_, h), x2d.dtype, x2d),
+            sds((np_,), jnp.float32, x2d),
+            sds((np_,), jnp.float32, x2d),
+        ],
+        interpret=_cfg.INTERPRET,
+    )(x2d, gamma, beta)
+    if pad:
+        y, mean, rstd = y[:n], mean[:n], rstd[:n]
+    return y, mean, rstd
+
+
+def _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h = x2d.shape
+    block = _pick_block_rows(n, h, x2d.dtype)
+    pad = (-n) % block
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+        dy2d = jnp.pad(dy2d, ((0, pad), (0, 0)))
+        mean = jnp.pad(mean, (0, pad))
+        rstd = jnp.pad(rstd, (0, pad))  # padded rows: rstd 0 => contribute 0
+    np_ = x2d.shape[0]
+
+    def bwd_with_init(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
+                      dx_ref, dg_ref, db_ref):
+        from jax.experimental import pallas as pl2
+        @pl2.when(pl2.program_id(0) == 0)
+        def _():
+            dg_ref[:] = jnp.zeros_like(dg_ref)
+            db_ref[:] = jnp.zeros_like(db_ref)
+        _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
+                    dx_ref, dg_ref, db_ref)
+
+    dx, dg, db = pl.pallas_call(
+        bwd_with_init,
+        grid=(np_ // block,),
+        in_specs=[
+            pl.BlockSpec((block, h), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, h), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, h), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            # dgamma/dbeta accumulate across sequential grid steps: every
+            # step maps to the same block (TPU grids are sequential).
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            sds((np_, h), x2d.dtype, x2d, dy2d),
+            sds((h,), jnp.float32, x2d, dy2d, gamma),
+            sds((h,), jnp.float32, x2d, dy2d, gamma),
+        ],
+        interpret=_cfg.INTERPRET,
+    )(x2d, gamma, mean, rstd, dy2d)
+    if pad:
+        dx = dx[:n]
+    return dx, dg, db
+
+
+# --------------------------------------------------------------------------
+# Public op with custom VJP.
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm(x, gamma, beta, eps: float = 1e-5):
+    """Fused LayerNorm over the last axis.  x: (..., H); gamma/beta: (H,)."""
+    y, _, _ = _layer_norm_fwd(x, gamma, beta, eps)
+    return y
+
+
+def _layer_norm_fwd(x, gamma, beta, eps):
+    shape = x.shape
+    h = shape[-1]
+    x2d = x.reshape(-1, h)
+    if _use_pallas(x2d):
+        y, mean, rstd = _layer_norm_fwd_pallas(x2d, gamma, beta, eps)
+    else:
+        xf = x2d.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1)
+        var = jnp.mean(jnp.square(xf - mean[:, None]), axis=-1)
+        rstd = lax.rsqrt(var + eps)
+        y = ((xf - mean[:, None]) * rstd[:, None]
+             * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+             ).astype(x.dtype)
+    return y.reshape(shape), mean, rstd
+
+
+def _layer_norm_fwd_vjp(x, gamma, beta, eps):
+    y, mean, rstd = _layer_norm_fwd(x, gamma, beta, eps)
+    return y, (x, gamma, mean, rstd)
+
+
+def _layer_norm_bwd_vjp(eps, res, dy):
+    del eps
+    x, gamma, mean, rstd = res
+    shape = x.shape
+    h = shape[-1]
+    x2d = x.reshape(-1, h)
+    dy2d = dy.reshape(-1, h)
+    if _use_pallas(x2d):
+        dx, dg, db = _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d)
+    else:
+        xf = x2d.astype(jnp.float32)
+        dyf = dy2d.astype(jnp.float32)
+        xhat = (xf - mean[:, None]) * rstd[:, None]
+        gf = gamma.astype(jnp.float32)
+        dg = jnp.sum(dyf * xhat, axis=0)
+        db = jnp.sum(dyf, axis=0)
+        wdy = dyf * gf
+        c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+        c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+        dx = (rstd[:, None] * (wdy - c1 - xhat * c2)).astype(x.dtype)
+    return (dx.reshape(shape), dg.astype(gamma.dtype), db.astype(gamma.dtype))
+
+
+layer_norm.defvjp(_layer_norm_fwd_vjp, _layer_norm_bwd_vjp)

@@ -1,0 +1,23 @@
+"""apex.parallel-shaped surface: DDP, SyncBatchNorm, LARC, mesh utilities.
+
+Reference: apex/parallel/__init__.py exports DistributedDataParallel,
+SyncBatchNorm, convert_syncbn_model, LARC (SURVEY.md §3.2).
+"""
+
+from apex_example_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, PIPE_AXIS, data_sharding,
+    initialize_model_parallel, make_data_mesh, replicated)
+from apex_example_tpu.parallel.distributed import (
+    DDPConfig, DistributedDataParallel, allreduce_grads, broadcast_from_zero,
+    reduce_mean)
+from apex_example_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm, convert_syncbn_model)
+from apex_example_tpu.parallel.larc import LARC, larc
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "DDPConfig",
+    "DistributedDataParallel", "LARC", "SyncBatchNorm", "allreduce_grads",
+    "broadcast_from_zero", "convert_syncbn_model", "data_sharding",
+    "initialize_model_parallel", "larc", "make_data_mesh", "reduce_mean",
+    "replicated",
+]

@@ -1,0 +1,154 @@
+"""Data-parallel gradient reduction — the DDP-equivalent layer.
+
+Reference (apex/parallel/distributed.py, SURVEY.md §3.2/§4.3): apex's
+``DistributedDataParallel`` registers per-param backward hooks that assemble
+~10M-element buckets in grad-ready order and fire ``ncclAllReduce`` overlapped
+with the rest of backward; ``delay_allreduce=True`` instead does one flat
+allreduce after backward.  The C++ ``apex_C`` flatten/unflatten extension
+exists purely to feed NCCL contiguous buffers.
+
+TPU-native design: the gradient allreduce is a ``lax.psum`` over the ``data``
+mesh axis *inside* the jitted step.  XLA's latency-hiding scheduler decomposes
+and overlaps the collective with the backward computation automatically, which
+subsumes the hand-built bucketing (bucket assembly, ready-order tracking, and
+the flatten extension have no TPU analog — the compiler owns buffer layout;
+this is the documented why for csrc/flatten_unflatten.cpp in SURVEY.md §2.1).
+``delay_allreduce`` semantics (single reduction at end of backward) are the
+*default* semantics of psum-at-step-end; hence the flag is accepted and
+recorded but changes nothing on TPU.
+
+What remains meaningful from the ctor surface is kept with identical names and
+faithful numerics:
+
+- ``gradient_average``            — divide the summed grads by world size.
+- ``gradient_predivide_factor``   — pre-divide locally by f, post-divide the
+  sum by world/f (overflow headroom for fp16 sums).
+- ``allreduce_always_fp32``       — upcast grads to fp32 for the reduction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_example_tpu.parallel.mesh import DATA_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class DDPConfig:
+    """Ctor-surface parity with apex.parallel.DistributedDataParallel."""
+    gradient_average: bool = True
+    gradient_predivide_factor: float = 1.0
+    allreduce_always_fp32: bool = False
+    # Accepted for CLI/API parity; no-ops on TPU (see module docstring):
+    delay_allreduce: bool = True
+    message_size: int = 10_000_000
+
+
+def allreduce_grads(grads: Any, config: DDPConfig = DDPConfig(),
+                    axis_name: str = DATA_AXIS,
+                    already_reduced: Optional[bool] = None) -> Any:
+    """psum gradients over the data axis with apex's averaging semantics.
+
+    Must run inside a ``shard_map``/``pmap`` context where ``axis_name`` is
+    bound.  Equivalent position in the reference call stack: the DDP backward
+    hooks / flat allreduce (SURVEY.md §4.3).
+
+    ``already_reduced``: under vma-checked shard_map (the default, and what
+    the engine uses) this is inferred per leaf from the aval — jax.grad wrt
+    replicated params yields already-psum'd (invariant) grads.  Under
+    ``check_vma=False`` vma information is absent, so callers must pass it
+    explicitly (False for raw per-shard grads).
+    """
+    world = lax.axis_size(axis_name)
+    pre = config.gradient_predivide_factor
+    post = (world / pre) if config.gradient_average else (1.0 / pre)
+
+    def reduce_one(g):
+        dt = g.dtype
+        if already_reduced is None:
+            vma = getattr(jax.typeof(g), "vma", frozenset())
+            reduced = axis_name not in vma
+        else:
+            reduced = already_reduced
+        if reduced:
+            # Already cross-replica-summed: under shard_map's vma semantics,
+            # jax.grad of a shard-local loss w.r.t. *replicated* params
+            # transposes the implicit replication into a psum — the allreduce
+            # has effectively happened inside backward (and XLA overlaps it
+            # there, exactly like the reference's bucketed hooks).  Only the
+            # averaging convention remains to apply.
+            if config.gradient_average:
+                g = (g.astype(jnp.float32) / world).astype(dt)
+            return g
+        if config.allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if pre != 1.0:
+            g = g / pre
+        g = lax.psum(g, axis_name)
+        if post != 1.0:
+            g = g / post
+        return g.astype(dt)
+
+    return jax.tree_util.tree_map(reduce_one, grads)
+
+
+def broadcast_from_zero(tree: Any, axis_name: str = DATA_AXIS) -> Any:
+    """Make replica 0's values authoritative on all replicas.
+
+    Reference: DDP's ctor broadcast of rank-0 params via flat_dist_call
+    (SURVEY.md §4.1 "first collective").  In JAX, jit with replicated sharding
+    already guarantees consistency, so this is only needed when state was
+    constructed per-replica (e.g. distinct RNG); implemented as a masked psum.
+    """
+    idx = lax.axis_index(axis_name)
+
+    def bcast(x):
+        masked = jnp.where(idx == 0, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis_name)
+
+    return jax.tree_util.tree_map(bcast, tree)
+
+
+def reduce_mean(x: jnp.ndarray, axis_name: str = DATA_AXIS) -> jnp.ndarray:
+    """Metric averaging (reference harness: reduce_tensor / allreduce-mean)."""
+    return lax.pmean(x, axis_name)
+
+
+class DistributedDataParallel:
+    """Thin apex-shaped facade: holds the config, exposes the grad reduction.
+
+    The reference version wraps the module and intercepts backward; pure
+    functions have no backward to intercept, so this class just pairs a
+    :class:`DDPConfig` with the functions above for callers that want the
+    apex ctor spelling::
+
+        ddp = DistributedDataParallel(delay_allreduce=True)
+        grads = ddp.allreduce(grads)          # inside shard_map
+    """
+
+    def __init__(self, module: Any = None, message_size: int = 10_000_000,
+                 delay_allreduce: bool = True, gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 allreduce_always_fp32: bool = False,
+                 allreduce_trigger_params: Optional[Any] = None):
+        del allreduce_trigger_params  # bucket tuning — no TPU analog
+        self.module = module
+        self.config = DDPConfig(
+            gradient_average=gradient_average,
+            gradient_predivide_factor=gradient_predivide_factor,
+            allreduce_always_fp32=allreduce_always_fp32,
+            delay_allreduce=delay_allreduce,
+            message_size=message_size)
+
+    def allreduce(self, grads: Any, axis_name: str = DATA_AXIS) -> Any:
+        return allreduce_grads(grads, self.config, axis_name)
+
+    def __call__(self, *args, **kwargs):
+        if self.module is None:
+            raise ValueError("no module wrapped")
+        return self.module(*args, **kwargs)

@@ -1,0 +1,82 @@
+"""LARC — layerwise adaptive rate clipping (reference: apex/parallel/LARC.py).
+
+The reference wraps a torch optimizer and rescales each param group's gradient
+by ``trust_coefficient * ||p|| / (||g|| + wd * ||p||)`` (clipped at 1.0 in
+"clip" mode) before the inner step.  Optax-native restatement: a
+GradientTransformation chained *before* the inner optimizer; "layerwise"
+means per-leaf of the param pytree, which matches torch's per-parameter
+granularity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LARCState(NamedTuple):
+    pass
+
+
+def larc(trust_coefficient: float = 0.02, clip: bool = True,
+         eps: float = 1e-8, weight_decay: float = 0.0,
+         lr: float = None) -> optax.GradientTransformation:
+    """Per-leaf adaptive LR scaling; chain as
+    ``optax.chain(larc(...), inner)``.
+
+    ``lr`` is the outer learning rate the inner transform will apply.  apex's
+    clip mode computes ``decay = min(adaptive_lr / lr, 1)`` so the effective
+    step is ``min(adaptive_lr, lr)``; since optax applies lr later in the
+    chain, clip mode needs lr here to reproduce that semantics.
+    """
+    if clip and lr is None:
+        raise ValueError("clip mode requires the outer lr "
+                         "(apex: decay = min(adaptive_lr / group_lr, 1))")
+
+    def init_fn(params):
+        del params
+        return LARCState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+
+        def scale_one(g, p):
+            pn = jnp.linalg.norm(p.astype(jnp.float32).ravel())
+            gn = jnp.linalg.norm(g.astype(jnp.float32).ravel())
+            adaptive = trust_coefficient * pn / (gn + weight_decay * pn + eps)
+            # Zero-param tensors (fresh biases): leave the update alone.
+            adaptive = jnp.where(pn > 0, adaptive, 1.0)
+            adaptive = jnp.where(gn > 0, adaptive, 1.0)
+            if clip:
+                # apex clip mode: effective step min(adaptive_lr, lr); the
+                # outer lr multiplies later in the chain, so clamp the RATIO.
+                adaptive = jnp.minimum(adaptive / lr, 1.0)
+            if weight_decay:
+                g = g + weight_decay * p
+            return (g.astype(jnp.float32) * adaptive).astype(g.dtype)
+
+        return jax.tree_util.tree_map(scale_one, updates, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class LARC:
+    """apex-shaped facade over :func:`larc` for ctor-surface parity."""
+
+    def __init__(self, optimizer: optax.GradientTransformation,
+                 trust_coefficient: float = 0.02, clip: bool = True,
+                 eps: float = 1e-8, lr: float = None):
+        self.transform = optax.chain(
+            larc(trust_coefficient=trust_coefficient, clip=clip, eps=eps,
+                 lr=lr),
+            optimizer)
+
+    def init(self, params):
+        return self.transform.init(params)
+
+    def update(self, grads, state, params=None):
+        return self.transform.update(grads, state, params)

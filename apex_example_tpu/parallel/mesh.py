@@ -1,0 +1,72 @@
+"""Device mesh construction (reference L0/L6: torch.distributed process groups).
+
+The reference binds one process per GPU and builds NCCL communicators keyed by
+env vars RANK/LOCAL_RANK/WORLD_SIZE (SURVEY.md §4.1).  On TPU the process
+boundary collapses into the runtime: one process per host, all devices visible,
+and parallelism is expressed as a named :class:`jax.sharding.Mesh` whose axes
+the compiler lowers to ICI/DCN collectives.
+
+Axis names used throughout the framework:
+
+- ``data``  — data parallelism (the reference's DDP world).
+- ``model`` — tensor parallelism (reference: apex.transformer parallel_state).
+- ``pipe``  — pipeline parallelism stages.
+
+``initialize_model_parallel`` mirrors apex.transformer.parallel_state's entry
+point: world = pipe × data × model, data axis gets the leftovers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+
+
+def make_data_mesh(num_devices: Optional[int] = None,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the ``data`` axis — the DDP-equivalent topology."""
+    if devices is None:
+        devices = jax.devices()[:num_devices] if num_devices else jax.devices()
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def initialize_model_parallel(tensor_parallel: int = 1,
+                              pipeline_parallel: int = 1,
+                              devices: Optional[Sequence] = None) -> Mesh:
+    """3-D mesh (pipe, data, model); data absorbs the remaining devices.
+
+    Reference: apex/transformer/parallel_state.py initialize_model_parallel
+    builds TP/PP/DP process groups by slicing the global rank grid; here the
+    same topology is one Mesh and the "groups" are its named axes.  TP is
+    innermost (fastest-varying devices => ICI neighbours), matching Megatron's
+    group layout where TP ranks are contiguous.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    denom = tensor_parallel * pipeline_parallel
+    if n % denom:
+        raise ValueError(
+            f"world size {n} not divisible by tp*pp = {denom}")
+    data = n // denom
+    arr = np.asarray(devices).reshape(pipeline_parallel, data, tensor_parallel)
+    return Mesh(arr, (PIPE_AXIS, DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, *batch_axes: int, ndim: int = None):
+    """NamedSharding that splits axis 0 (the batch) over ``data``."""
+    spec = [None] * (ndim if ndim is not None else max(batch_axes, default=0) + 1)
+    for a in batch_axes or (0,):
+        spec[a] = DATA_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

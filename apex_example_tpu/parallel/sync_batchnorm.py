@@ -1,0 +1,124 @@
+"""SyncBatchNorm: cross-replica batch normalization.
+
+Reference (apex/parallel/{sync_batchnorm,optimized_sync_batchnorm}.py +
+csrc/syncbn.cpp/welford.cu; SURVEY.md §4.4): local Welford statistics, an
+NCCL allreduce of (count, mean, M2) across the process group, normalization
+with the global stats, and a matching backward that allreduces the two
+gradient sums.
+
+TPU-native design: a Flax module whose statistics cross the ``data`` mesh axis
+via ``lax.psum`` *inside* the jitted step — the backward reductions come from
+differentiating psum (transpose of psum is psum), so no hand-written backward
+is needed.  The Welford merge across shards is exact:
+
+    global_mean = Σ_d sum_d / Σ_d n_d
+    global_M2   = Σ_d [ M2_d + n_d (mean_d − global_mean)² ]
+
+Numerics match torch.nn.BatchNorm2d semantics (the golden in our tests):
+normalization uses biased variance, running_var stores the unbiased estimate,
+``momentum`` is the *new-stat weight* (torch convention, default 0.1 — note
+flax's BatchNorm uses the opposite convention).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm with optional cross-replica stat reduction.
+
+    With ``axis_name=None`` this is plain BatchNorm (torch semantics).  With
+    ``axis_name="data"`` inside shard_map/pmap, batch statistics are the exact
+    global-batch statistics — the invariant the reference's two-GPU unit test
+    checks (N-shard SyncBN == full-batch BN; SURVEY.md §5).
+    """
+
+    use_running_average: Optional[bool] = None
+    axis_name: Optional[str] = None
+    momentum: float = 0.1          # torch convention: weight of the new stat
+    epsilon: float = 1e-5
+    dtype: Optional[jnp.dtype] = None       # compute/output dtype (policy.bn_dtype)
+    param_dtype: jnp.dtype = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        feat = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros(feat, jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones(feat, jnp.float32))
+
+        xf = x.astype(jnp.float32)
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # Local moments in fp32 (reference: welford.cu local pass).
+            n_local = 1
+            for a in reduce_axes:
+                n_local *= x.shape[a]
+            local_sum = jnp.sum(xf, axis=reduce_axes)
+            local_mean = local_sum / n_local
+            local_m2 = jnp.sum(
+                jnp.square(xf - local_mean), axis=reduce_axes)
+
+            if self.axis_name is not None:
+                # Cross-replica Welford merge (reference: syncbn allreduce of
+                # (count, mean, M2); here two psums over the mesh axis).
+                world = lax.axis_size(self.axis_name)
+                n = n_local * world
+                mean = lax.psum(local_sum, self.axis_name) / n
+                m2 = lax.psum(
+                    local_m2 + n_local * jnp.square(local_mean - mean),
+                    self.axis_name)
+            else:
+                n = n_local
+                mean, m2 = local_mean, local_m2
+            var = m2 / n
+
+            if not self.is_initializing():
+                m = self.momentum
+                unbiased = m2 / max(n - 1, 1)
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+
+        inv = lax.rsqrt(var + self.epsilon)
+        y = (xf - mean) * inv
+
+        if self.use_scale:
+            scale = self.param("scale", nn.initializers.ones, (feat,),
+                               self.param_dtype)
+            y = y * scale.astype(jnp.float32)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (feat,),
+                              self.param_dtype)
+            y = y + bias.astype(jnp.float32)
+
+        out_dtype = self.dtype or x.dtype
+        return y.astype(out_dtype)
+
+
+def convert_syncbn_model(module: nn.Module,
+                         axis_name: str = "data") -> nn.Module:
+    """Reference parity: apex.parallel.convert_syncbn_model recursively swaps
+    nn.BatchNorm for SyncBatchNorm.  Flax modules are immutable dataclasses,
+    so models in this framework expose a ``bn_axis_name`` field and conversion
+    is a clone with the mesh axis bound.
+    """
+    if not hasattr(module, "bn_axis_name"):
+        raise TypeError(
+            f"{type(module).__name__} does not expose bn_axis_name; "
+            "only models built with framework norm layers can be converted")
+    return module.clone(bn_axis_name=axis_name)

@@ -1,0 +1,3 @@
+from apex_example_tpu.utils.meters import AverageMeter, Throughput, accuracy
+
+__all__ = ["AverageMeter", "Throughput", "accuracy"]

@@ -1,0 +1,56 @@
+"""Checkpoint/resume via orbax (reference: torch.save/load of model +
+optimizer + amp.state_dict on rank 0; SURVEY.md §4.5, §6).
+
+The saved pytree is (step, params, batch_stats, opt_state, scaler fields) —
+crucially including the loss-scaler state, whose survival across resume the
+reference tests explicitly (apex test_checkpointing.py).  orbax handles
+sharded arrays natively, so the same call works single-chip and under a mesh;
+process 0 coordinates the write in multi-host settings.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from apex_example_tpu.engine import TrainState
+
+
+class CheckpointManager:
+    """Thin manager: save(state), restore(template) -> state, latest step."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, state: TrainState, step: Optional[int] = None,
+             wait: bool = True) -> None:
+        step = int(state.step) if step is None else step
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, template: TrainState,
+                step: Optional[int] = None) -> TrainState:
+        """Restore into the structure of ``template`` (shapes/shardings from
+        a freshly created state — restore before jit warmup, SURVEY.md §4.5).
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, template)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(abstract))
+
+    def close(self):
+        self._mgr.close()

@@ -1,0 +1,68 @@
+"""Metrics utilities (reference harness: AverageMeter / accuracy /
+reduce_tensor; SURVEY.md §3.5)."""
+
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+class AverageMeter:
+    """Running average — same surface as the reference harness's meter."""
+
+    def __init__(self, name: str = "", fmt: str = ":f"):
+        self.name, self.fmt = name, fmt
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val, n: int = 1):
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return f"{self.name} {self.val:.4f} ({self.avg:.4f})"
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
+             topk: Tuple[int, ...] = (1,)) -> Tuple[jnp.ndarray, ...]:
+    """Top-k accuracy in percent (matches the reference's accuracy())."""
+    maxk = max(topk)
+    top = jnp.argsort(-logits, axis=-1)[..., :maxk]
+    correct = top == labels[..., None]
+    return tuple(
+        100.0 * jnp.mean(correct[..., :k].any(axis=-1).astype(jnp.float32))
+        for k in topk)
+
+
+class Throughput:
+    """images/sec (or tokens/sec) meter with warmup skipping."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup = warmup_steps
+        self.items = 0
+        self.seen_steps = 0
+        self.start: float | None = None
+
+    def step(self, n_items: int):
+        self.seen_steps += 1
+        if self.seen_steps == self.warmup:
+            self.start = time.perf_counter()
+            self.items = 0
+        elif self.seen_steps > self.warmup:
+            self.items += n_items
+
+    @property
+    def rate(self) -> float:
+        if self.start is None or self.items == 0:
+            return 0.0
+        return self.items / (time.perf_counter() - self.start)

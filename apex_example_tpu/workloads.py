@@ -1,0 +1,118 @@
+"""Workload-specific losses and step builders (C4 BERT-MLM, C5 TXL-LM).
+
+The classification engine (engine.py) covers C1–C3.  BERT reuses it with an
+MLM loss (the label pytree is (labels, weights)); Transformer-XL needs its
+own step because segment recurrence threads a memory carry alongside the
+train state — the memory is per-replica activation state (batch-sharded under
+DDP, P(None, "data") on its (layers, B, mem, d) layout), unlike the
+replicated TrainState.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_example_tpu import amp as amp_lib
+from apex_example_tpu.amp.policy import Policy
+from apex_example_tpu.engine import TrainState, _wrap_optimizer
+from apex_example_tpu.parallel.distributed import DDPConfig, allreduce_grads
+from apex_example_tpu.parallel.mesh import DATA_AXIS
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def mlm_loss(logits: jnp.ndarray, target: Tuple[jnp.ndarray, jnp.ndarray]
+             ) -> jnp.ndarray:
+    """Masked-LM loss: mean CE over masked positions only (weights mark
+    them).  target = (labels, weights)."""
+    labels, weights = target
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    return (ce * weights).sum() / denom
+
+
+def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Next-token CE, mean over all positions (Transformer-XL objective)."""
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels)
+    return ce.mean()
+
+
+def make_txl_train_step(model, optimizer, policy: Policy,
+                        ddp: Optional[DDPConfig] = None,
+                        axis_name: Optional[str] = None,
+                        max_grad_norm: float = 0.25):
+    """Transformer-XL step: (state, mems, (inp, tgt)) → (state, mems', metrics).
+
+    Mirrors the reference C5 recipe (SURVEY.md §1): FusedLayerNorm inside the
+    model, global-norm grad clipping (the multi_tensor_l2norm path) before the
+    update, segment recurrence via the mems carry.
+    """
+    from apex_example_tpu.ops import clip_grad_norm
+
+    opt = _wrap_optimizer(optimizer)
+    ddp = ddp or DDPConfig()
+
+    def train_step(state: TrainState, mems, batch):
+        inp, tgt = batch
+
+        def scaled_loss_fn(params):
+            logits, new_mems = model.apply({"params": params}, inp,
+                                           mems=mems)
+            loss = lm_loss(logits, tgt)
+            return amp_lib.scale_loss(loss, state.scaler), (loss, new_mems)
+
+        grads, (loss, new_mems) = jax.grad(
+            scaled_loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = allreduce_grads(grads, ddp, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
+        grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
+        grads, gnorm = clip_grad_norm(grads, max_grad_norm)
+
+        new_params, new_opt_state = opt.apply(grads, state.opt_state,
+                                              state.params)
+        if policy.uses_dynamic_scaling:
+            new_params = amp_lib.select_tree(grads_finite, new_params,
+                                            state.params)
+            new_opt_state = amp_lib.select_tree(grads_finite, new_opt_state,
+                                                state.opt_state)
+        scaler = amp_lib.update_scaler(state.scaler, grads_finite)
+
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "ppl": jnp.exp(loss), "scale": scaler.scale,
+                   "grads_finite": grads_finite.astype(jnp.float32)}
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               batch_stats=state.batch_stats,
+                               opt_state=new_opt_state, scaler=scaler)
+        return new_state, new_mems, metrics
+
+    return train_step
+
+
+def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
+                                ddp: Optional[DDPConfig] = None,
+                                max_grad_norm: float = 0.25,
+                                axis_name: str = DATA_AXIS,
+                                donate: bool = True):
+    """DDP Transformer-XL step.  mems are sharded on their batch axis
+    (dim 1 of (layers, B, mem, d)); state is replicated."""
+    per_shard = make_txl_train_step(model, optimizer, policy, ddp=ddp,
+                                    axis_name=axis_name,
+                                    max_grad_norm=max_grad_norm)
+    mem_spec = P(None, axis_name)
+    sharded = _shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), mem_spec, (P(axis_name), P(axis_name))),
+        out_specs=(P(), mem_spec, P()))
+    return jax.jit(sharded,
+                   donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Benchmark harness: headline metric = ResNet-50 ImageNet-shaped images/sec
+per chip under amp-O2 bf16 (BASELINE.md; target 4000 img/s/chip on v5e).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Data is generated on-device once and reused across steps so the number
+isolates device throughput (this host has 1 CPU core; a host-side input
+pipeline would bottleneck the measurement — the reference isolates the same
+way with its CUDA-stream prefetcher, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import image_batch
+from apex_example_tpu.engine import create_train_state, make_train_step
+from apex_example_tpu.models import resnet50
+from apex_example_tpu.optim import FusedSGD
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 4000.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+
+    policy, scaler = amp.initialize("O2")
+    model = resnet50(num_classes=1000, dtype=policy.compute_dtype,
+                     param_dtype=policy.param_dtype, bn_dtype=policy.bn_dtype)
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+
+    batch = image_batch(jnp.asarray(0), batch_size=args.batch_size,
+                        image_size=args.image_size, channels=3,
+                        num_classes=1000, seed=0)
+    batch = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), batch)
+
+    state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                               batch[0][:1], policy, scaler)
+    step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
+
+    for _ in range(max(args.warmup, 1)):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    # Two-point measurement: a scalar *value fetch* is the only reliable
+    # execution barrier through the remote-TPU tunnel (block_until_ready
+    # returns at enqueue there), and differencing two chain lengths cancels
+    # the fetch round-trip so the rate reflects device throughput.
+    def run_chain(n, state):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        return time.perf_counter() - t0, state
+
+    n1 = max(args.steps // 5, 1)
+    t1, state = run_chain(n1, state)
+    t2, state = run_chain(args.steps, state)
+    rate = (args.steps - n1) * args.batch_size / max(t2 - t1, 1e-9)
+    print(json.dumps({
+        "metric": "resnet50_imagenet_ampO2_bf16_train_images_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(rate / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Test rig: 8 logical CPU devices + Pallas interpret mode.
+
+SURVEY.md §5: multi-device semantics are tested on real XLA CPU devices via
+--xla_force_host_platform_device_count=8 (the actual pjit/psum code path, not
+a mock — this exceeds the reference's "need 2 physical GPUs" test gap), and
+Pallas kernels run under the interpreter so kernel tests execute on CPU.
+Env vars must be set before jax initializes, hence the import-time block.
+"""
+
+import os
+
+# Overwrite (not setdefault): the shell may pin JAX_PLATFORMS to the real
+# TPU ("axon"); tests always run on the 8-logical-device CPU rig.  Set
+# APEX_TPU_TESTS=1 to run on whatever platform the env selects instead.
+if not os.environ.get("APEX_TPU_TESTS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+if not os.environ.get("APEX_TPU_TESTS"):
+    # The axon TPU plugin pins jax_platforms at import time; the env var
+    # alone does not win.  Force CPU before the backend initializes.
+    jax.config.update("jax_platforms", "cpu")
+
+from apex_example_tpu import ops  # noqa: E402
+
+ops.set_interpret_mode(True)
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("need 8 logical devices")
+    return devs[:8]

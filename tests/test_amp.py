@@ -1,0 +1,131 @@
+"""AMP policy + loss scaler unit tests (reference pyramid: tests/L0/run_amp;
+SURVEY.md §5 — opt-level property semantics, overflow/skip/growth schedule,
+checkpoint round-trip of scaler state)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_example_tpu import amp
+
+
+class TestPolicyTable:
+    def test_o0_is_fp32_noop(self):
+        p = amp.get_policy("O0")
+        assert p.param_dtype == jnp.float32
+        assert p.compute_dtype == jnp.float32
+        assert not p.master_weights
+        assert p.static_scale == 1.0
+
+    def test_o1_boundary_casts(self):
+        p = amp.get_policy("O1")
+        assert p.param_dtype == jnp.float32
+        assert p.compute_dtype == jnp.bfloat16
+        assert p.bn_dtype == jnp.float32
+        assert p.cast_at_call_sites
+
+    def test_o2_master_weights_bn_fp32(self):
+        p = amp.get_policy("O2")
+        assert p.compute_dtype == jnp.bfloat16
+        assert p.bn_dtype == jnp.float32
+        assert p.master_weights
+        # bf16: static scaling by default (fp32-equal exponent range).
+        assert not p.uses_dynamic_scaling
+
+    def test_o2_fp16_is_dynamic(self):
+        p = amp.get_policy("O2", half_dtype=jnp.float16)
+        assert p.uses_dynamic_scaling
+
+    def test_o3_pure_half(self):
+        p = amp.get_policy("O3")
+        assert p.param_dtype == jnp.bfloat16
+        assert p.bn_dtype == jnp.bfloat16
+
+    def test_overrides(self):
+        p = amp.get_policy("O2", loss_scale=128.0)
+        assert p.static_scale == 128.0
+        p = amp.get_policy("O2", loss_scale="dynamic")
+        assert p.uses_dynamic_scaling
+        p = amp.get_policy("O3", keep_batchnorm_fp32=True)
+        assert p.bn_dtype == jnp.float32
+
+    def test_bad_level_raises(self):
+        with pytest.raises(ValueError):
+            amp.get_policy("O4")
+
+
+class TestScaler:
+    def _dyn(self, **kw):
+        p = amp.get_policy("O2", loss_scale="dynamic")
+        return amp.make_scaler(p, **kw)
+
+    def test_scale_unscale_roundtrip(self):
+        s = self._dyn(init_scale=2.0 ** 8)
+        loss = jnp.asarray(3.0)
+        scaled = amp.scale_loss(loss, s)
+        assert scaled == 3.0 * 256.0
+        grads = {"w": jnp.full((4,), 256.0)}
+        un, finite = amp.unscale_grads(grads, s)
+        assert bool(finite)
+        assert jnp.allclose(un["w"], 1.0)
+
+    def test_overflow_backoff_and_growth(self):
+        s = self._dyn(init_scale=2.0 ** 16, growth_interval=3)
+        grads = {"w": jnp.array([jnp.inf, 1.0])}
+        _, finite = amp.unscale_grads(grads, s)
+        assert not bool(finite)
+        s2 = amp.update_scaler(s, finite)
+        assert float(s2.scale) == 2.0 ** 15      # ×0.5 backoff
+        assert int(s2.growth_counter) == 0
+        # 3 clean steps → ×2 growth.
+        clean = jnp.asarray(True)
+        for _ in range(3):
+            s2 = amp.update_scaler(s2, clean)
+        assert float(s2.scale) == 2.0 ** 16
+        assert int(s2.growth_counter) == 0
+
+    def test_static_scaler_ignores_updates(self):
+        p = amp.get_policy("O2")          # static
+        s = amp.make_scaler(p)
+        s2 = amp.update_scaler(s, jnp.asarray(False))
+        assert float(s2.scale) == float(s.scale)
+
+    def test_nan_detected(self):
+        s = self._dyn()
+        _, finite = amp.unscale_grads({"w": jnp.array([jnp.nan])}, s)
+        assert not bool(finite)
+
+    def test_state_dict_roundtrip(self):
+        s = self._dyn(init_scale=4096.0)
+        s = amp.update_scaler(s, jnp.asarray(True))
+        d = amp.state_dict(s)
+        fresh = self._dyn()
+        restored = amp.load_state_dict(fresh, d)
+        assert float(restored.scale) == 4096.0
+        assert int(restored.growth_counter) == 1
+
+    def test_update_traced_in_jit(self):
+        s = self._dyn(growth_interval=2)
+
+        @jax.jit
+        def f(scaler, flag):
+            return amp.update_scaler(scaler, flag)
+
+        s2 = f(s, jnp.asarray(False))
+        assert float(s2.scale) == float(s.scale) * 0.5
+
+    def test_select_tree_skip_step(self):
+        old = {"w": jnp.zeros(3)}
+        new = {"w": jnp.ones(3)}
+        kept = amp.select_tree(jnp.asarray(False), new, old)
+        assert jnp.allclose(kept["w"], 0.0)
+        taken = amp.select_tree(jnp.asarray(True), new, old)
+        assert jnp.allclose(taken["w"], 1.0)
+
+
+def test_initialize_frontend():
+    policy, scaler = amp.initialize(opt_level="O2", loss_scale="dynamic",
+                                    init_scale=1024.0)
+    assert policy.opt_level == "O2"
+    assert scaler.dynamic
+    assert float(scaler.scale) == 1024.0

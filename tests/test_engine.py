@@ -1,0 +1,193 @@
+"""End-to-end train-step tests: the C1 slice (SURVEY.md §8 phase 2) plus
+amp/DDP composition — loss decreases, skip-step fires, DDP equals big-batch
+single-device training, checkpoint round-trips."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import image_batch
+from apex_example_tpu.engine import (
+    TrainState, create_train_state, make_eval_step, make_sharded_train_step,
+    make_train_step)
+from apex_example_tpu.models import resnet18
+from apex_example_tpu.optim import FusedSGD
+from apex_example_tpu.parallel import make_data_mesh
+
+
+def tiny_model(**kw):
+    # ResNet-18 topology at tiny width/stem so CPU tests stay fast.
+    from apex_example_tpu.models.resnet import BasicBlock, ResNet
+    return ResNet(stage_sizes=[1, 1], block_cls=BasicBlock, num_classes=4,
+                  num_filters=8, small_stem=True, **kw)
+
+
+def tiny_batch(step=0, bs=16):
+    x, y = image_batch(jnp.asarray(step), batch_size=bs, image_size=8,
+                       channels=3, num_classes=4, seed=7, noise=0.3)
+    return x, y
+
+
+class TestC1SingleDevice:
+    def test_loss_decreases_fp32(self):
+        policy, scaler = amp.initialize("O0")
+        model = tiny_model()
+        opt = FusedSGD(lr=0.05, momentum=0.9)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+        step = jax.jit(make_train_step(model, opt, policy))
+        first = last = None
+        for i in range(12):
+            state, metrics = step(state, tiny_batch(i))
+            if i == 0:
+                first = float(metrics["loss"])
+            last = float(metrics["loss"])
+        assert int(state.step) == 12
+        assert last < first, (first, last)
+
+    def test_o2_bf16_params_stay_fp32(self):
+        policy, scaler = amp.initialize("O2")
+        model = tiny_model(dtype=jnp.bfloat16, bn_dtype=jnp.float32)
+        opt = FusedSGD(lr=0.05, momentum=0.9)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+        # fp32 master params (apex O2: master weights).
+        for leaf in jax.tree_util.tree_leaves(state.params):
+            assert leaf.dtype == jnp.float32
+        step = jax.jit(make_train_step(model, opt, policy))
+        losses = []
+        for i in range(10):
+            state, metrics = step(state, tiny_batch(i))
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_eval_step(self):
+        policy, scaler = amp.initialize("O0")
+        model = tiny_model()
+        opt = FusedSGD(lr=0.05)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+        ev = jax.jit(make_eval_step(model))
+        m = ev(state, tiny_batch(99))
+        assert np.isfinite(float(m["loss"]))
+        assert 0.0 <= float(m["top1"]) <= 100.0
+
+
+class TestDynamicScalingSkipStep:
+    def test_inf_grad_skips_update_and_halves_scale(self):
+        policy, scaler = amp.initialize("O2", loss_scale="dynamic",
+                                        init_scale=2.0 ** 10)
+        model = tiny_model(dtype=jnp.bfloat16)
+        opt = FusedSGD(lr=1e10)   # absurd LR: any real update visibly moves
+
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+
+        # Poison batch: inf input produces nonfinite grads.
+        x, y = tiny_batch(0)
+        x_bad = x.at[0, 0, 0, 0].set(jnp.inf)
+        step = jax.jit(make_train_step(model, opt, policy))
+        p_before = jax.tree_util.tree_leaves(state.params)[0].copy()
+        state, metrics = step(state, (x_bad, y))
+        assert float(metrics["grads_finite"]) == 0.0
+        # step skipped: params unchanged
+        p_after = jax.tree_util.tree_leaves(state.params)[0]
+        np.testing.assert_array_equal(np.asarray(p_before),
+                                      np.asarray(p_after))
+        assert float(state.scaler.scale) == 2.0 ** 9
+
+    def test_growth_after_interval(self):
+        policy, _ = amp.initialize("O2", loss_scale="dynamic")
+        scaler = amp.make_scaler(policy, init_scale=8.0, growth_interval=2)
+        model = tiny_model(dtype=jnp.bfloat16)
+        opt = FusedSGD(lr=0.01)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+        step = jax.jit(make_train_step(model, opt, policy))
+        for i in range(2):
+            state, _ = step(state, tiny_batch(i))
+        assert float(state.scaler.scale) == 16.0
+
+
+class TestDDPEightDevices:
+    def test_ddp_matches_single_device_bigbatch(self, devices8):
+        """DDP over 8 shards × B/8 == single device × B (SyncBN on):
+        identical params after each step (the DDP contract)."""
+        policy, scaler = amp.initialize("O0")
+        mesh = make_data_mesh(devices=devices8)
+        model_sync = tiny_model(bn_axis_name="data")
+        model_local = tiny_model()
+        opt = FusedSGD(lr=0.05, momentum=0.9)
+
+        state = create_train_state(jax.random.PRNGKey(0), model_local, opt,
+                                   tiny_batch()[0], policy, scaler)
+        state2 = jax.tree_util.tree_map(lambda x: x.copy(), state)
+
+        sharded = make_sharded_train_step(mesh, model_sync, opt, policy,
+                                          donate=False)
+        single = jax.jit(make_train_step(model_local, opt, policy))
+
+        for i in range(3):
+            batch = tiny_batch(i, bs=16)
+            state, m_ddp = sharded(state, batch)
+            state2, m_one = single(state2, batch)
+
+        np.testing.assert_allclose(float(m_ddp["loss"]),
+                                   float(m_one["loss"]), rtol=1e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(state2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=1e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(state.batch_stats),
+                        jax.tree_util.tree_leaves(state2.batch_stats)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-4)
+
+    def test_ddp_o2_runs(self, devices8):
+        policy, scaler = amp.initialize("O2")
+        mesh = make_data_mesh(devices=devices8)
+        model = tiny_model(dtype=jnp.bfloat16, bn_axis_name="data")
+        opt = FusedSGD(lr=0.05, momentum=0.9)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+        sharded = make_sharded_train_step(mesh, model, opt, policy,
+                                          donate=False)
+        losses = []
+        for i in range(6):
+            state, m = sharded(state, tiny_batch(i))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+
+
+class TestCheckpoint:
+    def test_roundtrip_including_scaler(self, tmp_path):
+        from apex_example_tpu.utils.checkpoint import CheckpointManager
+        policy, scaler = amp.initialize("O2", loss_scale="dynamic",
+                                        init_scale=512.0)
+        model = tiny_model(dtype=jnp.bfloat16)
+        opt = FusedSGD(lr=0.05, momentum=0.9)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+        step = jax.jit(make_train_step(model, opt, policy))
+        for i in range(3):
+            state, _ = step(state, tiny_batch(i))
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(state)
+
+        template = create_train_state(jax.random.PRNGKey(1), model, opt,
+                                      tiny_batch()[0], policy,
+                                      amp.make_scaler(policy))
+        restored = mgr.restore(template)
+        assert int(restored.step) == 3
+        # scaler state survives resume (apex test_checkpointing behavior)
+        assert float(restored.scaler.scale) == float(state.scaler.scale)
+        for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                        jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        mgr.close()

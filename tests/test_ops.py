@@ -1,0 +1,254 @@
+"""Kernel tests: Pallas (interpret mode) + XLA reference vs torch goldens.
+
+The reference's L0 pattern (SURVEY.md §5): FusedLayerNorm vs nn.LayerNorm,
+fused optimizers vs torch.optim on identical data.  torch here is CPU-only
+and used solely as the golden.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_example_tpu import ops
+from apex_example_tpu.ops import layer_norm as ln_mod
+
+
+def _rand(*shape, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(dtype)
+
+
+class TestLayerNorm:
+    @pytest.mark.parametrize("shape", [(4, 128), (3, 5, 256), (16, 384)])
+    def test_forward_vs_torch(self, shape):
+        x = _rand(*shape, seed=1)
+        g = _rand(shape[-1], seed=2) * 0.1 + 1.0
+        b = _rand(shape[-1], seed=3) * 0.1
+        y = ops.layer_norm(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b))
+        tln = torch.nn.LayerNorm(shape[-1], eps=1e-5)
+        with torch.no_grad():
+            tln.weight.copy_(torch.from_numpy(g))
+            tln.bias.copy_(torch.from_numpy(b))
+        want = tln(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(np.asarray(y), want, atol=2e-5, rtol=2e-5)
+
+    def test_backward_vs_torch(self):
+        shape = (8, 256)
+        x = _rand(*shape, seed=4)
+        g = _rand(shape[-1], seed=5) * 0.1 + 1.0
+        b = _rand(shape[-1], seed=6) * 0.1
+
+        def f(x_, g_, b_):
+            return jnp.sum(ops.layer_norm(x_, g_, b_) ** 2)
+
+        dx, dg, db = jax.grad(f, argnums=(0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(g), jnp.asarray(b))
+
+        tx = torch.from_numpy(x).requires_grad_(True)
+        tln = torch.nn.LayerNorm(shape[-1], eps=1e-5)
+        with torch.no_grad():
+            tln.weight.copy_(torch.from_numpy(g))
+            tln.bias.copy_(torch.from_numpy(b))
+        (tln(tx) ** 2).sum().backward()
+        np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dg), tln.weight.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(db), tln.bias.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_bf16_io_fp32_stats(self):
+        x = jnp.asarray(_rand(4, 128, seed=7), jnp.bfloat16)
+        g = jnp.ones((128,)); b = jnp.zeros((128,))
+        y = ops.layer_norm(x, g, b)
+        assert y.dtype == jnp.bfloat16
+        ref = ops.layer_norm_reference(x, g, b)
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32), np.asarray(ref, np.float32),
+            atol=0.05)
+
+    def test_pallas_matches_reference_path(self):
+        # Same inputs through the kernel (interpret) and pure-XLA path.
+        x = jnp.asarray(_rand(6, 384, seed=8))
+        g = jnp.asarray(_rand(384, seed=9))
+        b = jnp.asarray(_rand(384, seed=10))
+        y_kernel = ops.layer_norm(x, g, b)
+        y_ref = ops.layer_norm_reference(x, g, b)
+        np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestMultiTensor:
+    def _tree(self, seed=0):
+        return {"a": jnp.asarray(_rand(3, 7, seed=seed)),
+                "b": jnp.asarray(_rand(130, seed=seed + 1)),
+                "c": jnp.asarray(_rand(2, 2, 2, seed=seed + 2))}
+
+    def test_scale(self):
+        t = self._tree()
+        out, finite = ops.multi_tensor_scale(t, 0.5)
+        assert bool(finite)
+        for k in t:
+            np.testing.assert_allclose(np.asarray(out[k]),
+                                       np.asarray(t[k]) * 0.5, rtol=1e-6)
+
+    def test_scale_detects_inf_nan(self):
+        t = self._tree()
+        t["b"] = t["b"].at[7].set(jnp.inf)
+        _, finite = ops.multi_tensor_scale(t, 1.0)
+        assert not bool(finite)
+        t["b"] = t["b"].at[7].set(jnp.nan)
+        _, finite = ops.multi_tensor_scale(t, 1.0)
+        assert not bool(finite)
+
+    def test_axpby(self):
+        x, y = self._tree(1), self._tree(5)
+        out = ops.multi_tensor_axpby(2.0, x, -0.5, y)
+        for k in x:
+            np.testing.assert_allclose(
+                np.asarray(out[k]),
+                2.0 * np.asarray(x[k]) - 0.5 * np.asarray(y[k]), rtol=1e-5,
+                atol=1e-6)
+
+    def test_l2norm_global_and_per_tensor(self):
+        t = self._tree(3)
+        total, per = ops.multi_tensor_l2norm(t, per_tensor=True)
+        flat = np.concatenate([np.asarray(v).ravel() for v in
+                               jax.tree_util.tree_leaves(t)])
+        np.testing.assert_allclose(float(total), np.linalg.norm(flat),
+                                   rtol=1e-5)
+        leaves = jax.tree_util.tree_leaves(t)
+        for n, leaf in zip(per, leaves):
+            np.testing.assert_allclose(float(n),
+                                       np.linalg.norm(np.asarray(leaf)),
+                                       rtol=1e-5)
+
+    def test_clip_grad_norm(self):
+        t = {"w": jnp.asarray(_rand(64, seed=11)) * 100.0}
+        clipped, norm = ops.clip_grad_norm(t, max_norm=1.0)
+        new_norm = ops.multi_tensor_l2norm(clipped)
+        assert float(norm) > 1.0
+        np.testing.assert_allclose(float(new_norm), 1.0, rtol=1e-3)
+
+
+class TestFusedOptimKernels:
+    def test_adamw_step_vs_torch(self):
+        p = _rand(33, seed=20); g = _rand(33, seed=21)
+        m = np.zeros_like(p); v = np.zeros_like(p)
+        kw = dict(lr=1e-2, beta1=0.9, beta2=0.999, eps=1e-8,
+                  weight_decay=0.01)
+        # two steps
+        jp, jm, jv = jnp.asarray(p), jnp.asarray(m), jnp.asarray(v)
+        tp = torch.from_numpy(p.copy()).requires_grad_(True)
+        topt = torch.optim.AdamW([tp], lr=1e-2, betas=(0.9, 0.999),
+                                 eps=1e-8, weight_decay=0.01)
+        for t in (1, 2):
+            c1 = 1.0 / (1.0 - 0.9 ** t)
+            c2 = 1.0 / (1.0 - 0.999 ** t)
+            jp, jm, jv = ops.adam_update_leaf(
+                jp, jnp.asarray(g), jm, jv, bias_c1=c1, bias_c2=c2,
+                adam_w_mode=True, **kw)
+            tp.grad = torch.from_numpy(g.copy())
+            topt.step()
+        np.testing.assert_allclose(np.asarray(jp), tp.detach().numpy(),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_adam_l2_mode_vs_torch(self):
+        p = _rand(40, seed=22); g = _rand(40, seed=23)
+        jp = jnp.asarray(p)
+        jm = jnp.zeros(40); jv = jnp.zeros(40)
+        tp = torch.from_numpy(p.copy()).requires_grad_(True)
+        topt = torch.optim.Adam([tp], lr=3e-3, betas=(0.9, 0.999),
+                                eps=1e-8, weight_decay=0.1)
+        for t in (1, 2, 3):
+            c1 = 1.0 / (1.0 - 0.9 ** t)
+            c2 = 1.0 / (1.0 - 0.999 ** t)
+            jp, jm, jv = ops.adam_update_leaf(
+                jp, jnp.asarray(g), jm, jv, lr=3e-3, beta1=0.9, beta2=0.999,
+                eps=1e-8, weight_decay=0.1, bias_c1=c1, bias_c2=c2,
+                adam_w_mode=False)
+            tp.grad = torch.from_numpy(g.copy())
+            topt.step()
+        np.testing.assert_allclose(np.asarray(jp), tp.detach().numpy(),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_sgd_momentum_vs_torch(self):
+        p = _rand(50, seed=24); g = _rand(50, seed=25)
+        jp = jnp.asarray(p); jb = jnp.zeros(50)
+        tp = torch.from_numpy(p.copy()).requires_grad_(True)
+        topt = torch.optim.SGD([tp], lr=0.1, momentum=0.9,
+                               weight_decay=1e-4)
+        for _ in range(3):
+            jp, jb = ops.sgd_update_leaf(jp, jnp.asarray(g), jb, lr=0.1,
+                                         momentum=0.9, weight_decay=1e-4)
+            tp.grad = torch.from_numpy(g.copy())
+            topt.step()
+        np.testing.assert_allclose(np.asarray(jp), tp.detach().numpy(),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_lamb_stages_consistency(self):
+        # Kernel path vs pure-numpy restatement of the two-stage math.
+        p = _rand(70, seed=26); g = _rand(70, seed=27)
+        u, m, v, psq, usq = ops.lamb_stage1_leaf(
+            jnp.asarray(p), jnp.asarray(g), jnp.zeros(70), jnp.zeros(70),
+            beta1=0.9, beta2=0.999, eps=1e-6, weight_decay=0.01,
+            bias_c1=10.0, bias_c2=1000.0, grad_scale=1.0)
+        m_ref = 0.1 * g
+        v_ref = 0.001 * g * g
+        u_ref = (m_ref * 10.0) / (np.sqrt(v_ref * 1000.0) + 1e-6) + 0.01 * p
+        np.testing.assert_allclose(np.asarray(u), u_ref, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(float(psq), np.sum(p * p), rtol=1e-5)
+        np.testing.assert_allclose(float(usq), np.sum(u_ref * u_ref),
+                                   rtol=1e-4)
+        pn = ops.lamb_stage2_leaf(jnp.asarray(p), u, 0.37)
+        np.testing.assert_allclose(np.asarray(pn), p - 0.37 * u_ref,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_sgd_nesterov_first_step_vs_torch(self):
+        # Review finding: wd must fold into the grad before the nesterov
+        # direction on the first step too.
+        p = _rand(33, seed=30); g = _rand(33, seed=31)
+        po, bo = ops.sgd_update_leaf(
+            jnp.asarray(p), jnp.asarray(g), jnp.zeros(33), lr=0.1,
+            momentum=0.9, weight_decay=0.1, nesterov=True, first_step=True)
+        tp = torch.from_numpy(p.copy()).requires_grad_(True)
+        topt = torch.optim.SGD([tp], lr=0.1, momentum=0.9, weight_decay=0.1,
+                               nesterov=True)
+        tp.grad = torch.from_numpy(g.copy())
+        topt.step()
+        np.testing.assert_allclose(np.asarray(po), tp.detach().numpy(),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_grid_rows_padding_bounded(self):
+        from apex_example_tpu.ops.multi_tensor import _grid_rows
+        for rows in (1, 7, 8, 127, 128, 513, 520, 1000, 4096):
+            block, pad = _grid_rows(rows)
+            assert pad <= 7, (rows, block, pad)
+            assert (rows + pad) % block == 0
+
+
+def test_larc_clip_matches_apex_semantics():
+    import optax
+    from apex_example_tpu.parallel import larc as larc_fn
+    lr = 0.1
+    params = {"w": jnp.ones(4) * 2.0}          # ||p|| = 4
+    grads = {"w": jnp.ones(4) * 0.01}          # ||g|| = 0.02
+    tx = optax.chain(larc_fn(trust_coefficient=0.02, clip=True, lr=lr),
+                     optax.sgd(lr))
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    # adaptive_lr = 0.02*4/0.02 = 4.0 > lr -> ratio clamps at 1 ->
+    # effective step = lr * g.
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               -lr * np.asarray(grads["w"]), rtol=1e-5)
+    # adaptive_lr below lr scales the step down by adaptive/lr.
+    grads2 = {"w": jnp.ones(4) * 10.0}         # ||g||=20, adaptive=0.004
+    updates2, _ = tx.update(grads2, state, params)
+    np.testing.assert_allclose(
+        np.asarray(updates2["w"]),
+        -lr * (0.02 * 4.0 / 20.0 / lr) * np.asarray(grads2["w"]), rtol=1e-4)

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""train.py — the reference-parity training entrypoint, TPU-native.
+
+CLI surface preserved from the reference harness (SURVEY.md §3.5/§6: argparse
+flags --arch --opt-level --loss-scale --sync_bn --delay-allreduce ... as in
+apex's examples/imagenet/main_amp.py pattern), so invocations carry over.
+Flags that configure CUDA-specific machinery (--local_rank process binding,
+--workers, channels-last) are accepted and recorded but are no-ops on TPU —
+one process drives all local devices and the mesh replaces process groups.
+
+Examples
+--------
+C1 (ResNet-18 / CIFAR-shaped, fp32, single device):
+    python train.py --arch resnet18 --dataset cifar10 --opt-level O0 \
+        --epochs 2 --batch-size 256
+
+C2/C3 (ResNet-50 / ImageNet-shaped, amp O2 bf16, DDP over all devices):
+    python train.py --arch resnet50 --dataset imagenet --opt-level O2 \
+        --sync_bn --batch-size 256 --opt sgd
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import CIFAR10, IMAGENET, image_batch
+from apex_example_tpu.engine import (
+    create_train_state, make_eval_step, make_sharded_train_step,
+    make_train_step)
+from apex_example_tpu.models import ARCHS
+from apex_example_tpu.optim import FusedAdam, FusedLAMB, FusedSGD
+from apex_example_tpu.parallel import DDPConfig, make_data_mesh
+from apex_example_tpu.utils import AverageMeter, Throughput
+from apex_example_tpu.utils.checkpoint import CheckpointManager
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="TPU-native apex-parity trainer")
+    p.add_argument("--arch", "-a", default="resnet18", choices=sorted(ARCHS))
+    p.add_argument("--dataset", default="cifar10",
+                   choices=["cifar10", "imagenet"])
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--steps-per-epoch", type=int, default=100)
+    p.add_argument("--batch-size", "-b", type=int, default=256,
+                   help="global batch size (split across devices)")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", "--wd", type=float, default=1e-4)
+    p.add_argument("--opt", default="sgd", choices=["sgd", "adam", "lamb"])
+    # amp surface (apex parity)
+    p.add_argument("--opt-level", default="O0",
+                   choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--loss-scale", default=None,
+                   help='None, a number, or "dynamic"')
+    p.add_argument("--keep-batchnorm-fp32", default=None, type=lambda s:
+                   None if s in (None, "None") else s.lower() == "true")
+    # DDP surface (apex parity)
+    p.add_argument("--sync_bn", action="store_true",
+                   help="use cross-replica SyncBatchNorm")
+    p.add_argument("--delay-allreduce", action="store_true", default=True)
+    p.add_argument("--gradient-predivide-factor", type=float, default=1.0)
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="devices to use (default: all)")
+    # harness
+    p.add_argument("--resume", default="", help="checkpoint dir to resume")
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eval", action="store_true")
+    p.add_argument("--prof", action="store_true",
+                   help="capture a jax profiler trace of a few steps")
+    # accepted no-ops (CUDA-specific in the reference)
+    p.add_argument("--local_rank", type=int, default=0)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--deterministic", action="store_true")
+    return p.parse_args(argv)
+
+
+def build_optimizer(args):
+    if args.opt == "sgd":
+        return FusedSGD(lr=args.lr, momentum=args.momentum,
+                        weight_decay=args.weight_decay)
+    if args.opt == "adam":
+        return FusedAdam(lr=args.lr, weight_decay=args.weight_decay)
+    return FusedLAMB(lr=args.lr, weight_decay=args.weight_decay)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    policy, scaler = amp.initialize(
+        args.opt_level, loss_scale=args.loss_scale,
+        keep_batchnorm_fp32=args.keep_batchnorm_fp32)
+
+    spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
+    devices = jax.devices()[:args.num_devices] if args.num_devices \
+        else jax.devices()
+    n_dev = len(devices)
+    if args.batch_size % n_dev:
+        raise SystemExit(f"--batch-size {args.batch_size} not divisible by "
+                         f"{n_dev} devices")
+
+    model = ARCHS[args.arch](
+        num_classes=spec["num_classes"],
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        bn_dtype=policy.bn_dtype,
+        bn_axis_name="data" if (args.sync_bn and n_dev > 1) else None)
+
+    optimizer = build_optimizer(args)
+    batch_fn = lambda i: image_batch(
+        jnp.asarray(i, jnp.int32), batch_size=args.batch_size,
+        image_size=spec["image_size"], channels=spec["channels"],
+        num_classes=spec["num_classes"], seed=args.seed)
+
+    sample = batch_fn(0)[0]
+    state = create_train_state(jax.random.PRNGKey(args.seed), model,
+                               optimizer, sample[:1], policy, scaler)
+
+    ddp = DDPConfig(
+        delay_allreduce=args.delay_allreduce,
+        gradient_predivide_factor=args.gradient_predivide_factor)
+
+    if n_dev > 1:
+        mesh = make_data_mesh(devices=devices)
+        step_fn = make_sharded_train_step(mesh, model, optimizer, policy,
+                                          ddp=ddp)
+        print(f"DDP over {n_dev} devices: {mesh}")
+    else:
+        step_fn = jax.jit(make_train_step(model, optimizer, policy),
+                          donate_argnums=(0,))
+    eval_fn = jax.jit(make_eval_step(model))
+
+    mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
+        else None
+    start_epoch = 0
+    if args.resume:
+        rmgr = CheckpointManager(args.resume)
+        state = rmgr.restore(state)
+        start_epoch = int(state.step) // args.steps_per_epoch
+        print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
+
+    if args.prof:
+        jax.profiler.start_trace("/tmp/apex_tpu_trace")
+
+    global_step = int(state.step)
+    for epoch in range(start_epoch, args.epochs):
+        losses, top1s = AverageMeter("loss"), AverageMeter("top1")
+        thr = Throughput(warmup_steps=2)
+        for i in range(args.steps_per_epoch):
+            batch = batch_fn(global_step)
+            state, metrics = step_fn(state, batch)
+            global_step += 1
+            thr.step(args.batch_size)
+            if (i + 1) % args.print_freq == 0 or i + 1 == args.steps_per_epoch:
+                losses.update(float(metrics["loss"]))
+                top1s.update(float(metrics["top1"]))
+                print(f"epoch {epoch} step {i + 1}/{args.steps_per_epoch} "
+                      f"{losses} {top1s} "
+                      f"{thr.rate:.1f} img/s "
+                      f"scale {float(metrics['scale']):.0f}")
+        if args.eval:
+            em = eval_fn(state, batch_fn(10_000 + epoch))
+            print(f"epoch {epoch} EVAL loss {float(em['loss']):.4f} "
+                  f"top1 {float(em['top1']):.2f}")
+        if mgr is not None:
+            mgr.save(state)
+            print(f"saved checkpoint at step {int(state.step)}")
+
+    if args.prof:
+        jax.profiler.stop_trace()
+        print("profile written to /tmp/apex_tpu_trace")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
