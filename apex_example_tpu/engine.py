@@ -111,6 +111,15 @@ def make_train_step(model, optimizer, policy: Policy,
     opt = _wrap_optimizer(optimizer)
     ddp = ddp or DDPConfig()
 
+    # Non-default reduction options (fp16 overflow-headroom pre-divide, fp32
+    # upcast) need the *explicit* psum path: differentiating wrt replicated
+    # params would psum implicitly inside backward, before those options
+    # could apply.  Casting params to shard-varying first keeps the grads
+    # per-shard so allreduce_grads controls the reduction.
+    explicit_reduce = (axis_name is not None and
+                       (ddp.gradient_predivide_factor != 1.0 or
+                        ddp.allreduce_always_fp32))
+
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
         x, y = batch
 
@@ -122,8 +131,13 @@ def make_train_step(model, optimizer, policy: Policy,
             return amp_lib.scale_loss(loss, state.scaler), (loss, logits,
                                                             new_stats)
 
+        diff_params = state.params
+        if explicit_reduce:
+            diff_params = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, axis_name, to="varying"),
+                diff_params)
         grads, (loss, logits, new_stats) = jax.grad(
-            scaled_loss_fn, has_aux=True)(state.params)
+            scaled_loss_fn, has_aux=True)(diff_params)
 
         # DDP: reduce *scaled* grads, like the reference's backward-hook
         # allreduce; then unscale + finite-check (scale_loss __exit__).

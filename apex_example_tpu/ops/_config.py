@@ -13,3 +13,15 @@ def use_pallas() -> bool:
     """Pallas path on TPU (or under the interpreter); XLA reference
     implementations elsewhere."""
     return INTERPRET or jax.default_backend() in ("tpu", "axon")
+
+
+def use_pallas_for(*operands) -> bool:
+    """Like use_pallas, but under the interpreter (CPU tests) falls back to
+    the XLA reference path when an operand varies over a shard_map mesh axis:
+    the HLO interpreter evaluates the kernel body with vma-typed values and
+    trips on mixed varying/invariant arithmetic.  Real mosaic lowering erases
+    vma at the pallas_call boundary, so TPU always keeps the kernel."""
+    if INTERPRET:
+        return not any(
+            getattr(jax.typeof(x), "vma", frozenset()) for x in operands)
+    return jax.default_backend() in ("tpu", "axon")

@@ -40,8 +40,8 @@ def _interpret() -> bool:
     return _cfg.interpret()
 
 
-def _use_pallas() -> bool:
-    return _cfg.use_pallas()
+def _use_pallas(*operands) -> bool:
+    return _cfg.use_pallas_for(*operands)
 
 
 # --------------------------------------------------------------------------
@@ -73,7 +73,7 @@ def _adam_kernel(p_ref, g_ref, m_ref, v_ref, s_ref,
 def adam_update_leaf(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay,
                      bias_c1, bias_c2, adam_w_mode: bool = True):
     """One fused Adam step for one leaf.  Scalars may be traced values."""
-    if not _use_pallas():
+    if not _use_pallas(p, g, m, v):
         return adam_update_leaf_reference(
             p, g, m, v, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
             weight_decay=weight_decay, bias_c1=bias_c1, bias_c2=bias_c2,
@@ -165,7 +165,7 @@ def _lamb1_kernel(p_ref, g_ref, m_ref, v_ref, s_ref,
 def lamb_stage1_leaf(p, g, m, v, *, beta1, beta2, eps, weight_decay,
                      bias_c1, bias_c2, grad_scale=1.0):
     """Returns (update, m', v', ||p||², ||update||²) for one leaf."""
-    if not _use_pallas():
+    if not _use_pallas(p, g, m, v):
         pf, gf = p.astype(jnp.float32), g.astype(jnp.float32) * grad_scale
         mf, vf = m.astype(jnp.float32), v.astype(jnp.float32)
         mf = beta1 * mf + (1.0 - beta1) * gf
@@ -222,7 +222,7 @@ def _lamb2_kernel(p_ref, u_ref, s_ref, po_ref):
 
 def lamb_stage2_leaf(p, update, scaled_lr):
     """p' = p - scaled_lr * update (scaled_lr = lr * trust_ratio, traced)."""
-    if not _use_pallas():
+    if not _use_pallas(p, update):
         return (p.astype(jnp.float32)
                 - scaled_lr * update.astype(jnp.float32)).astype(p.dtype)
 
@@ -272,7 +272,7 @@ def _sgd_kernel(p_ref, g_ref, b_ref, s_ref, po_ref, bo_ref, *, nesterov,
 def sgd_update_leaf(p, g, buf, *, lr, momentum, weight_decay, dampening=0.0,
                     nesterov=False, first_step=False):
     """Fused momentum-SGD step (reference: multi_tensor_sgd_kernel.cu)."""
-    if not _use_pallas():
+    if not _use_pallas(p, g, buf):
         pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
         gf = gf + weight_decay * pf
         if first_step:

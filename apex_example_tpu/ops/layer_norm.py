@@ -31,9 +31,9 @@ from apex_example_tpu.ops._vma import sds
 from apex_example_tpu.ops import _config as _cfg
 
 
-def _use_pallas(x) -> bool:
+def _use_pallas(x, *more) -> bool:
     if _cfg.INTERPRET:
-        return True
+        return _cfg.use_pallas_for(x, *more)
     if jax.default_backend() not in ("tpu", "axon"):
         return False
     # Lane-dim constraint: hidden must tile to 128 for a clean kernel.
@@ -234,7 +234,7 @@ def _layer_norm_bwd_vjp(eps, res, dy):
     h = shape[-1]
     x2d = x.reshape(-1, h)
     dy2d = dy.reshape(-1, h)
-    if _use_pallas(x2d):
+    if _use_pallas(x2d, dy2d):
         dx, dg, db = _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d)
     else:
         xf = x2d.astype(jnp.float32)

@@ -38,8 +38,8 @@ def _interpret() -> bool:
     return _cfg.interpret()
 
 
-def _use_pallas() -> bool:
-    return _cfg.use_pallas()
+def _use_pallas(*operands) -> bool:
+    return _cfg.use_pallas_for(*operands)
 
 
 def _to_lanes(x: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
@@ -133,7 +133,7 @@ def multi_tensor_scale(tree: Any, scale) -> Tuple[Any, jnp.ndarray]:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree, jnp.asarray(True)
-    if _use_pallas():
+    if _use_pallas(scale, *leaves):
         outs, bads = zip(*[_scale_leaf_pallas(l, scale) for l in leaves])
         all_finite = jnp.logical_not(jnp.stack(bads).any())
     else:
@@ -187,7 +187,7 @@ def _axpby_leaf_pallas(x, y, a, b):
 
 def multi_tensor_axpby(a, x_tree: Any, b, y_tree: Any) -> Any:
     """out = a*x + b*y, leafwise (reference: multi_tensor_axpby_kernel.cu)."""
-    if _use_pallas():
+    if _use_pallas(*jax.tree_util.tree_leaves((x_tree, y_tree))):
         return jax.tree_util.tree_map(
             lambda x, y: _axpby_leaf_pallas(x, y, a, b), x_tree, y_tree)
     return jax.tree_util.tree_map(
@@ -233,7 +233,7 @@ def _sqsum_leaf_pallas(x) -> jnp.ndarray:
 
 
 def _sqsum_leaf(x) -> jnp.ndarray:
-    if _use_pallas():
+    if _use_pallas(x):
         return _sqsum_leaf_pallas(x)
     xf = x.astype(jnp.float32)
     return jnp.sum(xf * xf)

@@ -29,20 +29,33 @@ import jax
 import jax.numpy as jnp
 
 from apex_example_tpu import amp
-from apex_example_tpu.data import CIFAR10, IMAGENET, image_batch
+from apex_example_tpu.data import CIFAR10, IMAGENET, image_batch, lm_batch, \
+    mlm_batch
 from apex_example_tpu.engine import (
     create_train_state, make_eval_step, make_sharded_train_step,
     make_train_step)
 from apex_example_tpu.models import ARCHS
+from apex_example_tpu.models.bert import bert_base, bert_tiny
+from apex_example_tpu.models.transformer_xl import (transformer_xl_base,
+                                                    transformer_xl_tiny)
 from apex_example_tpu.optim import FusedAdam, FusedLAMB, FusedSGD
 from apex_example_tpu.parallel import DDPConfig, make_data_mesh
 from apex_example_tpu.utils import AverageMeter, Throughput
 from apex_example_tpu.utils.checkpoint import CheckpointManager
+from apex_example_tpu.workloads import (make_sharded_txl_train_step,
+                                        make_txl_train_step, mlm_loss)
+
+LM_ARCHS = ["bert_base", "bert_tiny", "transformer_xl", "transformer_xl_tiny"]
 
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="TPU-native apex-parity trainer")
-    p.add_argument("--arch", "-a", default="resnet18", choices=sorted(ARCHS))
+    p.add_argument("--arch", "-a", default="resnet18",
+                   choices=sorted(ARCHS) + LM_ARCHS)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--vocab-size", type=int, default=30522)
+    p.add_argument("--max-grad-norm", type=float, default=0.25,
+                   help="global-norm grad clip (transformer_xl)")
     p.add_argument("--dataset", default="cifar10",
                    choices=["cifar10", "imagenet"])
     p.add_argument("--epochs", type=int, default=1)
@@ -82,6 +95,15 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def select_devices(args):
+    devices = jax.devices()[:args.num_devices] if args.num_devices \
+        else jax.devices()
+    if args.batch_size % len(devices):
+        raise SystemExit(f"--batch-size {args.batch_size} not divisible by "
+                         f"{len(devices)} devices")
+    return devices
+
+
 def build_optimizer(args):
     if args.opt == "sgd":
         return FusedSGD(lr=args.lr, momentum=args.momentum,
@@ -96,14 +118,12 @@ def main(argv=None):
     policy, scaler = amp.initialize(
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
+    if args.arch in LM_ARCHS:
+        return lm_main(args, policy, scaler)
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
-    devices = jax.devices()[:args.num_devices] if args.num_devices \
-        else jax.devices()
+    devices = select_devices(args)
     n_dev = len(devices)
-    if args.batch_size % n_dev:
-        raise SystemExit(f"--batch-size {args.batch_size} not divisible by "
-                         f"{n_dev} devices")
 
     model = ARCHS[args.arch](
         num_classes=spec["num_classes"],
@@ -168,6 +188,106 @@ def main(argv=None):
             em = eval_fn(state, batch_fn(10_000 + epoch))
             print(f"epoch {epoch} EVAL loss {float(em['loss']):.4f} "
                   f"top1 {float(em['top1']):.2f}")
+        if mgr is not None:
+            mgr.save(state)
+            print(f"saved checkpoint at step {int(state.step)}")
+
+    if args.prof:
+        jax.profiler.stop_trace()
+        print("profile written to /tmp/apex_tpu_trace")
+    return 0
+
+
+def lm_main(args, policy, scaler):
+    """C4 (BERT-base MLM + FusedLAMB) and C5 (Transformer-XL) workloads."""
+    devices = select_devices(args)
+    n_dev = len(devices)
+    is_bert = args.arch.startswith("bert")
+    builder = {"bert_base": bert_base, "bert_tiny": bert_tiny,
+               "transformer_xl": transformer_xl_base,
+               "transformer_xl_tiny": transformer_xl_tiny}[args.arch]
+    mkw = dict(dtype=policy.compute_dtype, param_dtype=policy.param_dtype)
+    if args.arch in ("bert_base", "transformer_xl"):
+        mkw["vocab_size"] = args.vocab_size
+    model = builder(**mkw)
+    optimizer = build_optimizer(args)
+
+    V = model.vocab_size
+    if is_bert:
+        def batch_fn(i):
+            ids, labels, w = mlm_batch(
+                jnp.asarray(i, jnp.int32), batch_size=args.batch_size,
+                seq_len=args.seq_len, vocab_size=V, mask_token_id=V - 1,
+                seed=args.seed)
+            return ids, (labels, w)
+    else:
+        def batch_fn(i):
+            toks = lm_batch(jnp.asarray(i, jnp.int32),
+                            batch_size=args.batch_size,
+                            seq_len=args.seq_len, vocab_size=V,
+                            seed=args.seed)
+            return toks[:, :-1], toks[:, 1:]
+
+    sample = batch_fn(0)[0]
+    state = create_train_state(jax.random.PRNGKey(args.seed), model,
+                               optimizer, sample[:1], policy, scaler,
+                               train_kwargs={} if not is_bert else None)
+    mems = None if is_bert else model.init_mems(args.batch_size)
+
+    if is_bert:
+        if n_dev > 1:
+            mesh = make_data_mesh(devices=devices)
+            step_fn = make_sharded_train_step(
+                mesh, model, optimizer, policy, loss_fn=mlm_loss,
+                compute_accuracy=False)
+        else:
+            step_fn = jax.jit(make_train_step(model, optimizer, policy,
+                                              loss_fn=mlm_loss,
+                                              compute_accuracy=False),
+                              donate_argnums=(0,))
+    else:
+        if n_dev > 1:
+            mesh = make_data_mesh(devices=devices)
+            step_fn = make_sharded_txl_train_step(
+                mesh, model, optimizer, policy,
+                max_grad_norm=args.max_grad_norm)
+        else:
+            step_fn = jax.jit(make_txl_train_step(
+                model, optimizer, policy, max_grad_norm=args.max_grad_norm),
+                donate_argnums=(0, 1))
+
+    mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
+        else None
+    start_epoch = 0
+    if args.resume:
+        # TXL mems are transient per-segment activations and restart cold on
+        # resume (matches the reference harness, which does not persist them).
+        state = CheckpointManager(args.resume).restore(state)
+        start_epoch = int(state.step) // args.steps_per_epoch
+        print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
+
+    if args.prof:
+        jax.profiler.start_trace("/tmp/apex_tpu_trace")
+
+    global_step = int(state.step)
+    for epoch in range(start_epoch, args.epochs):
+        losses = AverageMeter("loss")
+        thr = Throughput(warmup_steps=2)
+        for i in range(args.steps_per_epoch):
+            batch = batch_fn(global_step)
+            if is_bert:
+                state, metrics = step_fn(state, batch)
+            else:
+                state, mems, metrics = step_fn(state, mems, batch)
+            global_step += 1
+            thr.step(args.batch_size * args.seq_len)
+            if (i + 1) % args.print_freq == 0 or i + 1 == args.steps_per_epoch:
+                losses.update(float(metrics["loss"]))
+                extra = (f"ppl {float(metrics['ppl']):.1f} " if "ppl" in
+                         metrics else "")
+                print(f"epoch {epoch} step {i + 1}/{args.steps_per_epoch} "
+                      f"{losses} {extra}{thr.rate:.0f} tok/s "
+                      f"scale {float(metrics['scale']):.0f}")
         if mgr is not None:
             mgr.save(state)
             print(f"saved checkpoint at step {int(state.step)}")
