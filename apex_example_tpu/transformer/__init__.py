@@ -1,0 +1,20 @@
+"""apex.transformer-shaped surface: tensor/sequence/pipeline parallelism.
+
+Reference (SURVEY.md §3.2): ``apex/transformer/`` carries Megatron-derived
+tensor parallelism (``tensor_parallel/``: ColumnParallelLinear,
+RowParallelLinear, VocabParallelEmbedding, mappings, vocab-parallel
+cross-entropy), pipeline parallelism (``pipeline_parallel/``: no-pipelining +
+1F1B schedules, p2p_communication), sequence parallelism (a flag on the TP
+layers), and ``parallel_state.py`` (TP/PP/DP process-group topology).
+
+TPU-native restatement: the process groups are named axes of one
+:class:`jax.sharding.Mesh` (parallel_state), layer parallelism is expressed as
+*sharding annotations* that GSPMD lowers to ICI collectives (layers), the
+explicit collective mappings exist for shard_map-style manual use (mappings),
+and the pipeline schedule is a collective program over the ``pipe`` axis
+(pipeline_parallel).
+"""
+
+from apex_example_tpu.transformer import parallel_state  # noqa: F401
+from apex_example_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_example_tpu.transformer import pipeline_parallel  # noqa: F401

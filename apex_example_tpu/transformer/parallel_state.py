@@ -1,0 +1,124 @@
+"""Model-parallel topology bookkeeping.
+
+Reference (apex/transformer/parallel_state.py, SURVEY.md §3.2):
+``initialize_model_parallel(tp, pp)`` carves the flat NCCL world into
+TP/PP/DP process groups and exposes ``get_*_world_size/rank`` getters that
+the rest of apex.transformer queries.
+
+TPU-native restatement: the "groups" are named axes of a single
+:class:`jax.sharding.Mesh` built by
+:func:`apex_example_tpu.parallel.mesh.initialize_model_parallel`
+(pipe, data, model).  Sizes come from the mesh shape; ranks only exist
+*inside* a shard_map/jit region where the axis is bound, via
+``lax.axis_index`` — there is no process-global rank because one process
+drives many devices.  The getters below accept a mesh (host side) or read the
+bound axis (trace side), mirroring the reference's API names.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax import lax
+from jax.sharding import Mesh
+
+from apex_example_tpu.parallel import mesh as mesh_lib
+from apex_example_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+
+__all__ = [
+    "destroy_model_parallel",
+    "initialize_model_parallel",
+    "set_mesh",
+    "get_mesh",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_world_size",
+    "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_rank",
+    "get_data_parallel_rank",
+    "is_pipeline_first_stage",
+    "is_pipeline_last_stage",
+    "model_parallel_is_initialized",
+]
+
+# The most recent mesh registered via set_mesh/initialize; mirrors the
+# reference's module-global group handles.
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+def initialize_model_parallel(tensor_parallel: int = 1,
+                              pipeline_parallel: int = 1,
+                              devices=None) -> Mesh:
+    """Build the (pipe, data, model) mesh AND register it as current.
+
+    Reference parity: apex's ``initialize_model_parallel`` both builds the
+    process groups and stores them in module globals that the TP/PP layers
+    read — registering here keeps :func:`constrain`-based layers working
+    through the same single entry point.
+    """
+    return set_mesh(mesh_lib.initialize_model_parallel(
+        tensor_parallel, pipeline_parallel, devices=devices))
+
+
+def set_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Register (or, with None, clear) the current model-parallel mesh."""
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _CURRENT_MESH is not None
+
+
+def destroy_model_parallel() -> None:
+    """Reference-parity teardown: forget the registered mesh."""
+    set_mesh(None)
+
+
+def _axis_size(axis: str, mesh: Optional[Mesh]) -> int:
+    mesh = mesh or _CURRENT_MESH
+    if mesh is not None and axis in mesh.shape:
+        return mesh.shape[axis]
+    # Trace side: axis bound by an enclosing shard_map.
+    try:
+        return lax.axis_size(axis)
+    except (NameError, KeyError):
+        return 1
+
+
+def get_tensor_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(MODEL_AXIS, mesh)
+
+
+def get_pipeline_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(PIPE_AXIS, mesh)
+
+
+def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(DATA_AXIS, mesh)
+
+
+def get_tensor_model_parallel_rank():
+    """Rank along the model axis — valid only inside shard_map (traced)."""
+    return lax.axis_index(MODEL_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return lax.axis_index(PIPE_AXIS)
+
+
+def get_data_parallel_rank():
+    return lax.axis_index(DATA_AXIS)
+
+
+def is_pipeline_first_stage():
+    return lax.axis_index(PIPE_AXIS) == 0
+
+
+def is_pipeline_last_stage():
+    return lax.axis_index(PIPE_AXIS) == lax.axis_size(PIPE_AXIS) - 1

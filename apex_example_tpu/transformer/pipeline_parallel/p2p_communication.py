@@ -1,0 +1,48 @@
+"""Stage-to-stage activation transfer.
+
+Reference (apex/transformer/pipeline_parallel/p2p_communication.py, SURVEY.md
+§3.2): hand-rolled ``torch.distributed`` isend/irecv pairs between adjacent
+pipeline ranks, with shape negotiation and separate fwd/bwd channels.
+
+TPU-native restatement: a neighbour shift on the ``pipe`` mesh axis is one
+``lax.ppermute``, which XLA lowers to an ICI neighbour exchange; its JAX
+transpose is the reverse permutation, so "send_backward" channels are what
+autodiff derives from "send_forward" for free.  The wrappers keep the
+reference's four names for surface parity; all must run inside shard_map
+with ``axis_name`` bound.
+
+The edge semantics differ from isend/irecv in one visible way: a ring
+ppermute is collective, so the first stage receives the last stage's payload
+(and vice versa).  Schedules mask those wrap-around values instead of not
+receiving them — same information flow, collective form.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_example_tpu.parallel.mesh import PIPE_AXIS
+
+__all__ = ["send_forward", "send_backward", "recv_forward", "recv_backward"]
+
+
+def _ring(axis_name: str, step: int):
+    n = lax.axis_size(axis_name)
+    return [(i, (i + step) % n) for i in range(n)]
+
+
+def send_forward(x: jnp.ndarray, axis_name: str = PIPE_AXIS) -> jnp.ndarray:
+    """Shift activations one stage downstream (stage i → i+1)."""
+    return lax.ppermute(x, axis_name, _ring(axis_name, +1))
+
+
+def send_backward(g: jnp.ndarray, axis_name: str = PIPE_AXIS) -> jnp.ndarray:
+    """Shift gradients one stage upstream (stage i → i−1)."""
+    return lax.ppermute(g, axis_name, _ring(axis_name, -1))
+
+
+# In the collective formulation receive IS the result of the neighbour's
+# send; the recv_* names are kept as aliases so reference call sites map 1:1.
+recv_forward = send_forward
+recv_backward = send_backward

@@ -1,0 +1,45 @@
+"""Tensor parallelism (reference: apex/transformer/tensor_parallel/).
+
+Two complementary realizations of the same Megatron semantics:
+
+- :mod:`layers` — GSPMD-first modules (ColumnParallelLinear,
+  RowParallelLinear, VocabParallelEmbedding): params carry full logical
+  shapes with flax partitioning metadata; sharding constraints at the
+  Megatron f/g points let XLA insert the ICI collectives (the TPU-idiomatic
+  "annotate shardings, let the compiler place all_gather/reduce_scatter"
+  recipe).
+- :mod:`mappings` — the explicit collective mapping functions
+  (copy/reduce/gather/scatter over the ``model`` axis, plus the
+  sequence-parallel all_gather/reduce-scatter pair) for shard_map-style
+  manual use, mirroring apex's autograd-function mappings one-for-one.
+- :mod:`cross_entropy` — vocab-parallel cross entropy that never
+  materializes the full-vocab logits on one shard.
+"""
+
+from apex_example_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_example_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    param_partition_specs)
+from apex_example_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
+    vocab_parallel_cross_entropy)
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "param_partition_specs",
+    "reduce_from_tensor_model_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "vocab_parallel_cross_entropy",
+]

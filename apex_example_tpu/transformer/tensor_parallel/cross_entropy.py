@@ -1,0 +1,83 @@
+"""Vocab-parallel cross entropy.
+
+Reference (apex/transformer/tensor_parallel/cross_entropy.py, SURVEY.md
+§3.2): with logits sharded along the vocab dim, compute softmax-CE without
+ever materializing the full-vocab logits on one rank — local max → all-reduce
+max, local Σexp → all-reduce sum, masked pick of the target logit →
+all-reduce.  The backward scales exp(logit − lse) and subtracts the one-hot
+on the owning shard.
+
+TPU-native restatement, two forms:
+
+- :func:`vocab_parallel_cross_entropy` with ``axis_name`` — the explicit
+  algorithm under shard_map using ``lax.pmax``/``lax.psum``.  The backward
+  falls out of differentiating the forward (every collective transposes
+  correctly); no custom gradient needed.
+- with ``axis_name=None`` — the GSPMD form: a numerically stable CE over
+  full-shape logits whose vocab dim may be sharded by annotation; XLA keeps
+  the reductions sharded and inserts the same collectives the explicit form
+  spells.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def vocab_parallel_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                                 axis_name: Optional[str] = None,
+                                 label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Per-token CE loss ([...,] shaped), fp32.
+
+    With ``axis_name``: ``logits`` is THIS SHARD's slice [..., V/tp] inside a
+    shard_map region; ``labels`` hold *global* vocab ids.  Without:
+    ``logits`` is the full [..., V] array (possibly GSPMD-sharded).
+    """
+    logits = logits.astype(jnp.float32)
+    if axis_name is None:
+        # Full-logits form: delegate to the stock stable LSE (GSPMD keeps
+        # the reduction sharded when the vocab dim is annotated sharded).
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        target = jnp.take_along_axis(
+            logits, labels[..., None], axis=-1)[..., 0]
+        loss = lse - target
+        if label_smoothing:
+            loss = ((1.0 - label_smoothing) * loss +
+                    label_smoothing * (lse - jnp.mean(logits, axis=-1)))
+        return loss
+
+    # Explicit vocab-parallel path (must run under shard_map).
+    vocab_shard = logits.shape[-1]
+    rank = lax.axis_index(axis_name)
+    lo = rank * vocab_shard
+
+    # Stable LSE across shards: global max via pmax, then psum of Σexp.
+    # The max is a shift constant only — stop_gradient keeps it out of the
+    # backward (pmax has no transpose; the reference's backward likewise
+    # treats the max as a constant).
+    local_max = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+    gmax = lax.pmax(local_max, axis_name)
+    sumexp = lax.psum(
+        jnp.sum(jnp.exp(logits - gmax[..., None]), axis=-1), axis_name)
+    lse = jnp.log(sumexp) + gmax
+
+    # Target logit: owned by exactly one shard; mask + psum assembles it.
+    local_ids = labels - lo
+    in_range = (local_ids >= 0) & (local_ids < vocab_shard)
+    safe_ids = jnp.clip(local_ids, 0, vocab_shard - 1)
+    picked = jnp.take_along_axis(logits, safe_ids[..., None], axis=-1)[..., 0]
+    target = lax.psum(jnp.where(in_range, picked, 0.0), axis_name)
+
+    loss = lse - target
+    if label_smoothing:
+        mean_logit = lax.psum(jnp.sum(logits, axis=-1), axis_name) / (
+            vocab_shard * lax.axis_size(axis_name))
+        loss = ((1.0 - label_smoothing) * loss +
+                label_smoothing * (lse - mean_logit))
+    return loss

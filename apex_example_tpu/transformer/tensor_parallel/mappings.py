@@ -1,0 +1,112 @@
+"""Explicit TP collective mappings for shard_map-style use.
+
+Reference (apex/transformer/tensor_parallel/mappings.py, SURVEY.md §3.2):
+Megatron expresses TP as four autograd functions —
+
+    f  = copy_to_model_parallel_region      (identity fwd, all-reduce bwd)
+    g  = reduce_from_model_parallel_region  (all-reduce fwd, identity bwd)
+    gather / scatter along the partitioned dim, and (sequence parallel)
+    all-gather / reduce-scatter along the sequence dim.
+
+TPU-native restatement: under ``jax.shard_map`` every one of these is a
+*plain lax collective whose JAX transpose is exactly the Megatron backward*:
+
+    pvary        ⟂ psum          (f / g pair)
+    all_gather   ⟂ psum_scatter  (sequence-parallel pair)
+    dynamic_slice over axis_index transposes to the masked scatter-add that
+    a gather-backward is.
+
+No hand-written custom_vjp is needed — the correctness of each backward is
+guaranteed by transposition, and tests/test_transformer_parallel.py checks the
+gradients against a single-device dense golden.  All functions must run
+inside shard_map with ``axis_name`` bound.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_example_tpu.parallel.mesh import MODEL_AXIS
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+]
+
+
+def copy_to_tensor_model_parallel_region(x: jnp.ndarray,
+                                         axis_name: str = MODEL_AXIS):
+    """Megatron ``f``: identity forward, psum backward.
+
+    ``lax.pcast(..., to='varying')`` marks a replicated value as
+    device-varying; its transpose is psum, which is precisely the gradient
+    all-reduce the reference's _CopyToModelParallelRegion.backward performs.
+    """
+    return lax.pcast(x, axis_name, to="varying")
+
+
+def reduce_from_tensor_model_parallel_region(x: jnp.ndarray,
+                                             axis_name: str = MODEL_AXIS):
+    """Megatron ``g``: psum forward, identity backward."""
+    return lax.psum(x, axis_name)
+
+
+def gather_from_tensor_model_parallel_region(x: jnp.ndarray,
+                                             axis_name: str = MODEL_AXIS,
+                                             dim: int = -1):
+    """All-gather shards along the partitioned (feature) dim."""
+    return lax.all_gather(x, axis_name, axis=dim if dim >= 0 else
+                          x.ndim + dim, tiled=True)
+
+
+def scatter_to_tensor_model_parallel_region(x: jnp.ndarray,
+                                            axis_name: str = MODEL_AXIS,
+                                            dim: int = -1):
+    """Keep this shard's chunk of the partitioned dim (fwd slice; the
+    transpose is the gather the reference's backward does)."""
+    dim = dim if dim >= 0 else x.ndim + dim
+    world = lax.axis_size(axis_name)
+    if x.shape[dim] % world:
+        raise ValueError(f"dim {dim} of size {x.shape[dim]} not divisible "
+                         f"by axis '{axis_name}' size {world}")
+    chunk = x.shape[dim] // world
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=dim)
+
+
+def gather_from_sequence_parallel_region(x: jnp.ndarray,
+                                         axis_name: str = MODEL_AXIS,
+                                         seq_dim: int = 1):
+    """SP → TP boundary: all-gather the sequence dim (bwd: reduce-scatter).
+
+    Reference: sequence_parallel_enabled path in tensor_parallel/layers.py —
+    activations enter a TP block sequence-sharded and are gathered right
+    before the first partitioned matmul.
+    """
+    return lax.all_gather(x, axis_name, axis=seq_dim, tiled=True)
+
+
+def reduce_scatter_to_sequence_parallel_region(x: jnp.ndarray,
+                                               axis_name: str = MODEL_AXIS,
+                                               seq_dim: int = 1):
+    """TP → SP boundary: reduce-scatter partial sums onto sequence shards
+    (bwd: all-gather).  Replaces RowParallel's trailing all-reduce when
+    sequence parallelism is on — same bytes, but the result lands already
+    sequence-sharded."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=seq_dim,
+                            tiled=True)
+
+
+def scatter_to_sequence_parallel_region(x: jnp.ndarray,
+                                        axis_name: str = MODEL_AXIS,
+                                        seq_dim: int = 1):
+    """Split a replicated activation along the sequence dim (entry into an
+    SP region from replicated land, e.g. after the embedding)."""
+    return scatter_to_tensor_model_parallel_region(x, axis_name, seq_dim)

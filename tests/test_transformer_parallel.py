@@ -1,0 +1,272 @@
+"""Tensor/sequence/pipeline parallelism tests (8 logical CPU devices).
+
+Strategy (SURVEY.md §5): run the real pjit/shard_map collective code paths on
+8 XLA CPU devices and compare numerics + gradients against single-device
+dense goldens — exceeding the reference's "needs ≥2 physical GPUs" test gap
+for apex.transformer (SURVEY.md §3.2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+from flax.core import meta
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_example_tpu.parallel.mesh import MODEL_AXIS, PIPE_AXIS
+from apex_example_tpu.transformer import parallel_state
+from apex_example_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving)
+from apex_example_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    param_partition_specs,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    vocab_parallel_cross_entropy)
+
+
+@pytest.fixture()
+def model_mesh(devices8):
+    mesh = Mesh(np.asarray(devices8), (MODEL_AXIS,))
+    old = parallel_state.get_mesh()
+    parallel_state.set_mesh(mesh)
+    yield mesh
+    parallel_state.set_mesh(old)
+
+
+# ---------------------------------------------------------------------------
+# Explicit shard_map mappings: Megatron column->row MLP vs dense golden.
+# ---------------------------------------------------------------------------
+
+def test_mappings_column_row_mlp(model_mesh):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+    w1 = jnp.asarray(rng.randn(16, 32), jnp.float32) * 0.1
+    w2 = jnp.asarray(rng.randn(32, 16), jnp.float32) * 0.1
+
+    def golden_loss(w1, w2):
+        return jnp.sum((jnp.tanh(x @ w1) @ w2) ** 2)
+
+    def tp_loss_fn(w1s, w2s):
+        xi = copy_to_tensor_model_parallel_region(x)
+        h = jnp.tanh(xi @ w1s)              # column shard: [4, 32/8]
+        y = reduce_from_tensor_model_parallel_region(h @ w2s)
+        return lax.pmean(jnp.sum(y ** 2), MODEL_AXIS)
+
+    tp = shard_map(
+        tp_loss_fn, mesh=model_mesh,
+        in_specs=(P(None, MODEL_AXIS), P(MODEL_AXIS, None)), out_specs=P())
+    np.testing.assert_allclose(tp(w1, w2), golden_loss(w1, w2), rtol=1e-5)
+
+    g_tp = jax.grad(lambda ws: tp(*ws))((w1, w2))
+    g_ref = jax.grad(lambda ws: golden_loss(*ws))((w1, w2))
+    for a, b in zip(jax.tree_util.tree_leaves(g_tp),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_parallel_mappings_roundtrip(model_mesh):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 16, 8), jnp.float32)  # [B, S, D], S%8==0
+
+    def f(xs):
+        full = gather_from_sequence_parallel_region(xs, seq_dim=1)
+        # partial sums on each device -> reduce-scatter back to seq shards
+        return reduce_scatter_to_sequence_parallel_region(
+            full / lax.axis_size(MODEL_AXIS), seq_dim=1)
+
+    out = shard_map(f, mesh=model_mesh,
+                              in_specs=P(None, MODEL_AXIS, None),
+                              out_specs=P(None, MODEL_AXIS, None))(x)
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel cross entropy vs full-vocab golden (value + grad).
+# ---------------------------------------------------------------------------
+
+def test_vocab_parallel_cross_entropy(model_mesh):
+    rng = np.random.RandomState(2)
+    V, B = 64, 12
+    logits = jnp.asarray(rng.randn(B, V), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, V, size=(B,)), jnp.int32)
+
+    def full_ce(lg):
+        lse = jax.nn.logsumexp(lg, axis=-1)
+        tgt = jnp.take_along_axis(lg, labels[:, None], axis=-1)[:, 0]
+        return jnp.mean(lse - tgt)
+
+    def tp_ce(lg_shard):
+        per_tok = vocab_parallel_cross_entropy(lg_shard, labels,
+                                               axis_name=MODEL_AXIS)
+        return lax.pmean(jnp.mean(per_tok), MODEL_AXIS)
+
+    tp = shard_map(tp_ce, mesh=model_mesh,
+                             in_specs=P(None, MODEL_AXIS), out_specs=P())
+    np.testing.assert_allclose(tp(logits), full_ce(logits), rtol=1e-5)
+    g_tp = jax.grad(tp)(logits)
+    g_ref = jax.grad(full_ce)(logits)
+    np.testing.assert_allclose(g_tp, g_ref, rtol=1e-4, atol=1e-6)
+
+
+def test_vocab_parallel_cross_entropy_gspmd_form():
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(5, 33), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 33, size=(5,)), jnp.int32)
+    loss = vocab_parallel_cross_entropy(logits, labels)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    np.testing.assert_allclose(loss, lse - tgt, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# GSPMD layers: params really shard; numerics match the no-mesh run.
+# ---------------------------------------------------------------------------
+
+class _TpMlp(nn.Module):
+    hidden: int
+    sequence_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelLinear(self.hidden, gather_output=False,
+                                 sequence_parallel=self.sequence_parallel,
+                                 name="fc1")(x)
+        h = nn.gelu(h)
+        return RowParallelLinear(x.shape[-1], input_is_parallel=True,
+                                 sequence_parallel=self.sequence_parallel,
+                                 name="fc2")(h)
+
+
+def _init_sharded(model, rng, x, mesh):
+    variables = model.init(rng, x)
+    specs = param_partition_specs(variables)
+    unboxed = meta.unbox(variables)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda v: isinstance(v, P))
+    return jax.device_put(unboxed, shardings), specs
+
+
+def test_gspmd_column_row_mlp(model_mesh):
+    model = _TpMlp(hidden=64)
+    x = jnp.asarray(np.random.RandomState(4).randn(8, 4, 32), jnp.float32)
+    sharded_vars, specs = _init_sharded(model, jax.random.PRNGKey(0), x,
+                                        model_mesh)
+    # The column kernel must actually be sharded 8-ways on its output dim.
+    k1 = sharded_vars["params"]["fc1"]["kernel"]
+    assert k1.sharding.spec == P(None, MODEL_AXIS)
+    assert k1.addressable_shards[0].data.shape == (32, 64 // 8)
+
+    out = jax.jit(model.apply)(sharded_vars, x)
+
+    # Golden: same params, no mesh registered -> constraints no-op.
+    parallel_state.set_mesh(None)
+    try:
+        ref = jax.jit(model.apply)(
+            jax.device_put(sharded_vars, jax.devices("cpu")[0]), x)
+    finally:
+        parallel_state.set_mesh(model_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gspmd_mlp_grads_match(model_mesh):
+    model = _TpMlp(hidden=64, sequence_parallel=True)
+    x = jnp.asarray(np.random.RandomState(5).randn(4, 8, 32), jnp.float32)
+    sharded_vars, _ = _init_sharded(model, jax.random.PRNGKey(1), x,
+                                    model_mesh)
+
+    loss = lambda v: jnp.sum(model.apply(v, x) ** 2)
+    g = jax.jit(jax.grad(loss))(sharded_vars)
+
+    parallel_state.set_mesh(None)
+    try:
+        host_vars = jax.device_put(sharded_vars, jax.devices("cpu")[0])
+        g_ref = jax.jit(jax.grad(loss))(host_vars)
+    finally:
+        parallel_state.set_mesh(model_mesh)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_vocab_parallel_embedding_gspmd(model_mesh):
+    model = VocabParallelEmbedding(num_embeddings=64, features=16)
+    ids = jnp.asarray(np.random.RandomState(6).randint(0, 64, (4, 10)))
+    sharded_vars, _ = _init_sharded(model, jax.random.PRNGKey(2), ids,
+                                    model_mesh)
+    table = sharded_vars["params"]["embedding"]
+    assert table.sharding.spec == P(MODEL_AXIS, None)
+    out = jax.jit(model.apply)(sharded_vars, ids)
+    ref = jnp.take(np.asarray(table), np.asarray(ids), axis=0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline schedules.
+# ---------------------------------------------------------------------------
+
+def test_no_pipelining_matches_full_batch():
+    rng = np.random.RandomState(7)
+    w = jnp.asarray(rng.randn(8, 8), jnp.float32) * 0.3
+    xs = jnp.asarray(rng.randn(4, 6, 8), jnp.float32)  # 4 microbatches
+    ys = jnp.asarray(rng.randn(4, 6, 8), jnp.float32)
+
+    def loss_fn(p, mb):
+        x, y = mb
+        return jnp.mean((jnp.tanh(x @ p) - y) ** 2)
+
+    loss, grads = forward_backward_no_pipelining(loss_fn, w, (xs, ys))
+    full_loss = jnp.mean(jnp.stack(
+        [loss_fn(w, (xs[i], ys[i])) for i in range(4)]))
+    full_grad = jax.grad(
+        lambda p: jnp.mean(jnp.stack(
+            [loss_fn(p, (xs[i], ys[i])) for i in range(4)])))(w)
+    np.testing.assert_allclose(loss, full_loss, rtol=1e-6)
+    np.testing.assert_allclose(grads, full_grad, rtol=1e-5, atol=1e-7)
+
+
+def test_spmd_pipeline_matches_sequential(devices8):
+    S, M, B, D = 8, 16, 4, 8
+    mesh = Mesh(np.asarray(devices8), (PIPE_AXIS,))
+    rng = np.random.RandomState(8)
+    stacked_w = jnp.asarray(rng.randn(S, D, D), jnp.float32) * 0.3
+    xs = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+    ys = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    def last_stage_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def pipeline(w):
+        # shard_map hands each device its [1, D, D] slice of the stage stack.
+        return forward_backward_pipelining_without_interleaving(
+            lambda p, x: stage_fn(p[0], x), last_stage_fn, w, xs, ys)
+
+    loss, grads = shard_map(
+        pipeline, mesh=mesh,
+        in_specs=P(PIPE_AXIS, None, None),
+        out_specs=(P(), P(PIPE_AXIS, None, None)))(stacked_w)
+
+    def sequential_loss(stacked):
+        def one(mb_x, mb_y):
+            h = mb_x
+            for s in range(S):
+                h = stage_fn(stacked[s], h)
+            return last_stage_fn(h, mb_y)
+        return jnp.mean(jnp.stack([one(xs[i], ys[i]) for i in range(M)]))
+
+    ref_loss = sequential_loss(stacked_w)
+    ref_grads = jax.grad(sequential_loss)(stacked_w)
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
+                               rtol=1e-4, atol=1e-6)
