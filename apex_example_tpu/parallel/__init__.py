@@ -5,8 +5,11 @@ SyncBatchNorm, convert_syncbn_model, LARC (SURVEY.md §3.2).
 """
 
 from apex_example_tpu.parallel.mesh import (
-    DATA_AXIS, MODEL_AXIS, PIPE_AXIS, data_sharding,
+    CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, data_sharding,
     initialize_model_parallel, make_data_mesh, replicated)
+from apex_example_tpu.parallel.context_parallel import (
+    heads_to_seq, plain_attention, ring_attention, seq_to_heads,
+    ulysses_attention)
 from apex_example_tpu.parallel.distributed import (
     DDPConfig, DistributedDataParallel, allreduce_grads, broadcast_from_zero,
     reduce_mean)
@@ -15,9 +18,10 @@ from apex_example_tpu.parallel.sync_batchnorm import (
 from apex_example_tpu.parallel.larc import LARC, larc
 
 __all__ = [
-    "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "DDPConfig",
+    "CONTEXT_AXIS", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "DDPConfig",
     "DistributedDataParallel", "LARC", "SyncBatchNorm", "allreduce_grads",
     "broadcast_from_zero", "convert_syncbn_model", "data_sharding",
-    "initialize_model_parallel", "larc", "make_data_mesh", "reduce_mean",
-    "replicated",
+    "heads_to_seq", "initialize_model_parallel", "larc", "make_data_mesh",
+    "plain_attention", "reduce_mean", "replicated", "ring_attention",
+    "seq_to_heads", "ulysses_attention",
 ]

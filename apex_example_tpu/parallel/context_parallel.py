@@ -1,0 +1,170 @@
+"""Context parallelism: ring attention + Ulysses all-to-all attention.
+
+Reference status (SURVEY.md §3.2/§6): the reference family has NO context/
+sequence-dim attention parallelism — its long-sequence workload (C5) is
+handled algorithmically by Transformer-XL recurrence.  Long-context sharding
+is nonetheless first-class in this framework: these are the two standard
+ways to run attention over sequences longer than one chip's HBM, built on
+XLA collectives over ICI.
+
+- :func:`ring_attention` — blockwise attention with the K/V shards rotating
+  around the mesh axis via ``lax.ppermute`` (one neighbour hop per step, so
+  the transfer rides ICI), merged with the flash-attention online-softmax
+  rule in fp32.  Sequence length per device stays S/N; full S×S attention is
+  never materialized.  The backward ring falls out of differentiating the
+  scan (the transpose of ppermute is the reverse rotation).
+- :func:`ulysses_attention` — the all-to-all form: exchange sequence shards
+  for head shards (``lax.all_to_all``), run exact attention over the full
+  sequence on H/N heads per device, exchange back.  Cheaper collectives for
+  moderate S; requires num_heads % axis_size == 0.
+
+Both must run inside shard_map with ``axis_name`` bound, operating on
+[batch, seq/N, heads, head_dim] local shards, and agree with single-device
+attention to float tolerance (tests/test_context_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+
+__all__ = ["plain_attention", "ring_attention", "ulysses_attention",
+           "seq_to_heads", "heads_to_seq"]
+
+_NEG_INF = -1e30  # finite mask sentinel: keeps exp() NaN-free on all-masked
+                  # blocks (every causal row sees its own diagonal at step 0,
+                  # so a real max is always established before masked blocks
+                  # contribute exp(-1e30 - m) == 0)
+
+
+def plain_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False,
+                    scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-device reference attention, [B, S, H, D] — softmax in fp32
+    (amp blacklist op, SURVEY.md §3.1)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S_q, S_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.arange(S_k)[None, :] > jnp.arange(S_q)[:, None]
+        logits = jnp.where(mask, _NEG_INF, logits)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str = CONTEXT_AXIS, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Inputs are this device's [B, s, H, D] shards of the global [B, N*s, H, D]
+    arrays, sharded contiguously (device i owns positions [i*s, (i+1)*s)).
+    Each of the N steps scores the local queries against one K/V chunk, folds
+    the block into fp32 running (acc, lse-normalizer, max) with the online
+    softmax rule, and rotates the chunk to the next neighbour.  Equivalent
+    to (but never materializing) full softmax(QKᵀ)V.
+
+    With ``causal=True``, blocks entirely in the future are masked; the
+    naive ring still *computes* those blocks (N−1 of 2N−1 block-steps wasted
+    at worst) — the standard trade without zigzag load balancing, which is
+    documented future work.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    scale_ = scale if scale is not None else 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+
+    def block(acc, l, m, kc, vc, t):
+        """Fold one K/V chunk into the online-softmax state."""
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            kc.astype(jnp.float32)) * scale_
+        if causal:
+            # Global positions: the chunk at step t originated on device
+            # (idx - t) mod n; mask keys strictly after each query.
+            src = (idx - t) % n
+            qpos = idx * s + jnp.arange(s)
+            kpos = src * s + jnp.arange(s)
+            logits = jnp.where(kpos[None, :] > qpos[:, None], _NEG_INF,
+                               logits)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = (acc * corr[..., None] +
+               jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)))
+        return acc, l, m_new
+
+    # Carry initials are device-varying (each device accumulates its own
+    # queries' state); mark them for shard_map's vma-checked scan.
+    vary = lambda x: lax.pcast(x, axis_name, to="varying")
+    acc0 = vary(jnp.zeros((b, h, s, d), jnp.float32))
+    l0 = vary(jnp.zeros((b, h, s), jnp.float32))
+    m0 = vary(jnp.full((b, h, s), _NEG_INF, jnp.float32))
+
+    def step(carry, t):
+        acc, l, m, kc, vc = carry
+        acc, l, m = block(acc, l, m, kc, vc, t)
+        kc, vc = lax.ppermute((kc, vc), axis_name, perm)
+        return (acc, l, m, kc, vc), None
+
+    # n-1 rotated steps, then the final chunk folded without the (otherwise
+    # discarded) trailing K/V rotation — one ICI exchange saved per call.
+    (acc, l, m, kc, vc), _ = lax.scan(
+        step, (acc0, l0, m0, k, v), jnp.arange(n - 1))
+    acc, l, _ = block(acc, l, m, kc, vc, jnp.asarray(n - 1))
+    out = acc / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def seq_to_heads(x: jnp.ndarray, axis_name: str = CONTEXT_AXIS,
+                 seq_dim: int = 1, head_dim: int = 2) -> jnp.ndarray:
+    """[B, S/N, H, D] → [B, S, H/N, D]: trade sequence shards for head
+    shards (the Ulysses all-to-all)."""
+    return lax.all_to_all(x, axis_name, split_axis=head_dim,
+                          concat_axis=seq_dim, tiled=True)
+
+
+def heads_to_seq(x: jnp.ndarray, axis_name: str = CONTEXT_AXIS,
+                 seq_dim: int = 1, head_dim: int = 2) -> jnp.ndarray:
+    """[B, S, H/N, D] → [B, S/N, H, D]: the inverse exchange."""
+    return lax.all_to_all(x, axis_name, split_axis=seq_dim,
+                          concat_axis=head_dim, tiled=True)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str = CONTEXT_AXIS, causal: bool = False,
+                      scale: Optional[float] = None,
+                      inner: Optional[Callable] = None) -> jnp.ndarray:
+    """All-to-all sequence parallelism: exact attention, full sequence per
+    device, H/N heads per device.
+
+    ``inner`` swaps the attention kernel (defaults to
+    :func:`plain_attention`; pass a Pallas flash kernel for production).
+    A custom ``inner`` owns ALL attention semantics — combining it with
+    ``causal``/``scale`` is rejected rather than silently ignored.
+    """
+    if q.shape[2] % lax.axis_size(axis_name):
+        raise ValueError(
+            f"num_heads {q.shape[2]} not divisible by axis "
+            f"'{axis_name}' size {lax.axis_size(axis_name)}")
+    if inner is not None and (causal or scale is not None):
+        raise ValueError(
+            "pass causal/scale inside your custom `inner` kernel; the "
+            "flags only configure the default plain_attention")
+    inner = inner or functools.partial(plain_attention, causal=causal,
+                                       scale=scale)
+    qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
+    out = inner(qh, kh, vh)
+    return heads_to_seq(out, axis_name)

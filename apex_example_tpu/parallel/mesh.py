@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
+CONTEXT_AXIS = "context"   # sequence/context parallelism (ring / Ulysses)
 
 
 def make_data_mesh(num_devices: Optional[int] = None,
@@ -39,25 +40,32 @@ def make_data_mesh(num_devices: Optional[int] = None,
 
 def initialize_model_parallel(tensor_parallel: int = 1,
                               pipeline_parallel: int = 1,
+                              context_parallel: int = 1,
                               devices: Optional[Sequence] = None) -> Mesh:
-    """3-D mesh (pipe, data, model); data absorbs the remaining devices.
+    """4-D mesh (pipe, data, context, model); data absorbs the leftovers.
 
     Reference: apex/transformer/parallel_state.py initialize_model_parallel
     builds TP/PP/DP process groups by slicing the global rank grid; here the
     same topology is one Mesh and the "groups" are its named axes.  TP is
-    innermost (fastest-varying devices => ICI neighbours), matching Megatron's
-    group layout where TP ranks are contiguous.
+    innermost (fastest-varying devices => ICI neighbours, matching Megatron's
+    contiguous TP ranks), context parallelism next (with tp=1 the ring
+    ppermute hops are ICI neighbours; with tp>1, CP peers sit tp positions
+    apart — the usual Megatron group layout trade), pipeline outermost.
+    The context axis has no
+    reference analog (SURVEY.md §3.2: CP absent there) — it exists because
+    long-context sharding is first-class here.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    denom = tensor_parallel * pipeline_parallel
+    denom = tensor_parallel * pipeline_parallel * context_parallel
     if n % denom:
         raise ValueError(
-            f"world size {n} not divisible by tp*pp = {denom}")
+            f"world size {n} not divisible by tp*pp*cp = {denom}")
     data = n // denom
-    arr = np.asarray(devices).reshape(pipeline_parallel, data, tensor_parallel)
-    return Mesh(arr, (PIPE_AXIS, DATA_AXIS, MODEL_AXIS))
+    arr = np.asarray(devices).reshape(
+        pipeline_parallel, data, context_parallel, tensor_parallel)
+    return Mesh(arr, (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS))
 
 
 def data_sharding(mesh: Mesh, *batch_axes: int, ndim: int = None):

@@ -8,7 +8,7 @@ the rest of apex.transformer queries.
 TPU-native restatement: the "groups" are named axes of a single
 :class:`jax.sharding.Mesh` built by
 :func:`apex_example_tpu.parallel.mesh.initialize_model_parallel`
-(pipe, data, model).  Sizes come from the mesh shape; ranks only exist
+(pipe, data, context, model).  Sizes come from the mesh shape; ranks only exist
 *inside* a shard_map/jit region where the axis is bound, via
 ``lax.axis_index`` — there is no process-global rank because one process
 drives many devices.  The getters below accept a mesh (host side) or read the
@@ -23,7 +23,8 @@ from jax import lax
 from jax.sharding import Mesh
 
 from apex_example_tpu.parallel import mesh as mesh_lib
-from apex_example_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from apex_example_tpu.parallel.mesh import (CONTEXT_AXIS, DATA_AXIS,
+                                            MODEL_AXIS, PIPE_AXIS)
 
 __all__ = [
     "destroy_model_parallel",
@@ -33,9 +34,11 @@ __all__ = [
     "get_tensor_model_parallel_world_size",
     "get_pipeline_model_parallel_world_size",
     "get_data_parallel_world_size",
+    "get_context_parallel_world_size",
     "get_tensor_model_parallel_rank",
     "get_pipeline_model_parallel_rank",
     "get_data_parallel_rank",
+    "get_context_parallel_rank",
     "is_pipeline_first_stage",
     "is_pipeline_last_stage",
     "model_parallel_is_initialized",
@@ -48,8 +51,10 @@ _CURRENT_MESH: Optional[Mesh] = None
 
 def initialize_model_parallel(tensor_parallel: int = 1,
                               pipeline_parallel: int = 1,
+                              context_parallel: int = 1,
                               devices=None) -> Mesh:
-    """Build the (pipe, data, model) mesh AND register it as current.
+    """Build the (pipe, data, context, model) mesh AND register it as
+    current.
 
     Reference parity: apex's ``initialize_model_parallel`` both builds the
     process groups and stores them in module globals that the TP/PP layers
@@ -57,7 +62,8 @@ def initialize_model_parallel(tensor_parallel: int = 1,
     through the same single entry point.
     """
     return set_mesh(mesh_lib.initialize_model_parallel(
-        tensor_parallel, pipeline_parallel, devices=devices))
+        tensor_parallel, pipeline_parallel, context_parallel,
+        devices=devices))
 
 
 def set_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
@@ -103,6 +109,10 @@ def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
     return _axis_size(DATA_AXIS, mesh)
 
 
+def get_context_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(CONTEXT_AXIS, mesh)
+
+
 def get_tensor_model_parallel_rank():
     """Rank along the model axis — valid only inside shard_map (traced)."""
     return lax.axis_index(MODEL_AXIS)
@@ -114,6 +124,10 @@ def get_pipeline_model_parallel_rank():
 
 def get_data_parallel_rank():
     return lax.axis_index(DATA_AXIS)
+
+
+def get_context_parallel_rank():
+    return lax.axis_index(CONTEXT_AXIS)
 
 
 def is_pipeline_first_stage():

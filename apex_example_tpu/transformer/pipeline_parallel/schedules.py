@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_example_tpu.parallel.mesh import PIPE_AXIS
+from apex_example_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_forward)
 
 __all__ = ["forward_backward_no_pipelining",
            "forward_backward_pipelining_without_interleaving",
@@ -114,30 +116,33 @@ def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             lambda s: lax.dynamic_index_in_dim(
                 s, jnp.clip(t, 0, M - 1), keepdims=False), stack)
 
-    x0 = pick(inputs, jnp.asarray(0))
-    out_sd = jax.eval_shape(stage_fn, stage_params, x0)
-    # The carry is device-varying (each stage holds different activations);
-    # mark the zero initials as such for shard_map's vma-checked scan.
-    state0 = lax.pcast(jnp.zeros(out_sd.shape, out_sd.dtype), axis_name,
-                       to="varying")
-    loss0 = lax.pcast(jnp.zeros((), jnp.float32), axis_name, to="varying")
-
-    def tick(carry, t):
-        state, loss_acc = carry
+    def compute(recv, loss_acc, t):
+        """One tick given the activation received from upstream."""
         # First stage injects a fresh microbatch; others consume the ring.
-        inject = pick(inputs, t)
-        x = jnp.where(idx == 0, inject, state)
+        x = jnp.where(idx == 0, pick(inputs, t), recv)
         y = body(stage_params, x)
         # Last stage scores microbatch t-(S-1) when it is real.
         mb = t - (S - 1)
         loss_t = last_stage_fn(y, pick(targets, mb))
         use = (idx == S - 1) & (mb >= 0)
-        loss_acc = loss_acc + jnp.where(use, loss_t, 0.0)
-        state = lax.ppermute(y, axis_name,
-                             [(i, (i + 1) % S) for i in range(S)])
-        return (state, loss_acc), None
+        return y, loss_acc + jnp.where(use, loss_t, 0.0)
 
-    (_, loss_sum), _ = lax.scan(tick, (state0, loss0), jnp.arange(T))
+    # Tick 0 needs no upstream receive (the pipe is empty); the remaining
+    # ticks rotate at entry via p2p send_forward, so no final rotation is
+    # computed only to be discarded.
+    x0 = pick(inputs, jnp.asarray(0))
+    out_sd = jax.eval_shape(stage_fn, stage_params, x0)
+    empty = lax.pcast(jnp.zeros(out_sd.shape, out_sd.dtype), axis_name,
+                      to="varying")
+    loss0 = lax.pcast(jnp.zeros((), jnp.float32), axis_name, to="varying")
+    y, loss_acc = compute(empty, loss0, jnp.asarray(0))
+
+    def tick(carry, t):
+        y, loss_acc = carry
+        y, loss_acc = compute(send_forward(y, axis_name), loss_acc, t)
+        return (y, loss_acc), None
+
+    (_, loss_sum), _ = lax.scan(tick, (y, loss_acc), jnp.arange(1, T))
     # Only the last stage accumulated anything; psum makes the mean loss a
     # cross-stage invariant (and its transpose routes the cotangent there).
     return lax.psum(loss_sum, axis_name) / M

@@ -1,0 +1,138 @@
+"""Context parallelism tests: ring + Ulysses attention vs plain attention.
+
+8 logical CPU devices shard the sequence dim; both parallel forms must agree
+with single-device attention to float tolerance, values and gradients
+(the same golden-parity pattern as the TP tests; SURVEY.md §5).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_example_tpu.parallel import (CONTEXT_AXIS, plain_attention,
+                                       ring_attention, ulysses_attention)
+
+
+@pytest.fixture()
+def ctx_mesh(devices8):
+    return Mesh(np.asarray(devices8), (CONTEXT_AXIS,))
+
+
+def _qkv(seed, b=2, s=32, h=8, d=16):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.5
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_plain(ctx_mesh, causal):
+    q, k, v = _qkv(0)
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, causal=causal),
+        mesh=ctx_mesh,
+        in_specs=P(None, CONTEXT_AXIS, None, None),
+        out_specs=P(None, CONTEXT_AXIS, None, None))
+    out = ring(q, k, v)
+    ref = plain_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_grads_match(ctx_mesh):
+    q, k, v = _qkv(1, s=16)
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, causal=True),
+        mesh=ctx_mesh,
+        in_specs=P(None, CONTEXT_AXIS, None, None),
+        out_specs=P(None, CONTEXT_AXIS, None, None))
+
+    def loss_ring(args):
+        return jnp.sum(ring(*args) ** 2)
+
+    def loss_ref(args):
+        return jnp.sum(plain_attention(*args, causal=True) ** 2)
+
+    g = jax.grad(loss_ring)((q, k, v))
+    g_ref = jax.grad(loss_ref)((q, k, v))
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_plain(ctx_mesh, causal):
+    q, k, v = _qkv(2)
+
+    uly = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, causal=causal),
+        mesh=ctx_mesh,
+        in_specs=P(None, CONTEXT_AXIS, None, None),
+        out_specs=P(None, CONTEXT_AXIS, None, None))
+    out = uly(q, k, v)
+    ref = plain_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_attention_grads_match(ctx_mesh):
+    q, k, v = _qkv(3, s=16)
+
+    uly = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, causal=False),
+        mesh=ctx_mesh,
+        in_specs=P(None, CONTEXT_AXIS, None, None),
+        out_specs=P(None, CONTEXT_AXIS, None, None))
+
+    g = jax.grad(lambda a: jnp.sum(uly(*a) ** 2))((q, k, v))
+    g_ref = jax.grad(
+        lambda a: jnp.sum(plain_attention(*a) ** 2))((q, k, v))
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_long_sequence_memory_shape(ctx_mesh):
+    """The point of the ring: per-device logits are [s, s] blocks, never
+    [S, S].  Smoke a longer sequence through to prove the sharded path
+    compiles and matches."""
+    q, k, v = _qkv(4, b=1, s=256, h=2, d=8)
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, causal=True),
+        mesh=ctx_mesh,
+        in_specs=P(None, CONTEXT_AXIS, None, None),
+        out_specs=P(None, CONTEXT_AXIS, None, None))
+    out = jax.jit(ring)(q, k, v)
+    ref = plain_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_initialize_model_parallel_4d_topology(devices8):
+    """The 4-D (pipe, data, context, model) reshape and its divisibility
+    guard (reference-parity entry point; SURVEY.md §3.2)."""
+    from apex_example_tpu.transformer import parallel_state as ps
+
+    mesh = ps.initialize_model_parallel(
+        tensor_parallel=2, pipeline_parallel=2, context_parallel=2,
+        devices=devices8)
+    try:
+        assert dict(mesh.shape) == {"pipe": 2, "data": 1, "context": 2,
+                                    "model": 2}
+        assert ps.get_tensor_model_parallel_world_size() == 2
+        assert ps.get_context_parallel_world_size() == 2
+        assert ps.get_pipeline_model_parallel_world_size() == 2
+        assert ps.get_data_parallel_world_size() == 1
+        # TP innermost: the first TP group is the first two devices in order.
+        arr = np.asarray(mesh.devices).reshape(-1)
+        assert list(arr[:2]) == list(devices8[:2])
+
+        with pytest.raises(ValueError, match="not divisible"):
+            ps.initialize_model_parallel(tensor_parallel=3,
+                                         devices=devices8)
+    finally:
+        ps.set_mesh(None)
