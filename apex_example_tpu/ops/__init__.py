@@ -8,16 +8,17 @@ on TPU) and an XLA reference implementation (CPU fallback + test golden).
 from apex_example_tpu.ops.layer_norm import layer_norm, layer_norm_reference
 from apex_example_tpu.ops.multi_tensor import (
     MultiTensorApply, clip_grad_norm, multi_tensor_axpby, multi_tensor_l2norm,
-    multi_tensor_scale)
+    multi_tensor_scale, sqsum_leaf)
 from apex_example_tpu.ops.fused_optim import (
     adam_update_leaf, adam_update_leaf_reference, lamb_stage1_leaf,
-    lamb_stage2_leaf, sgd_update_leaf)
+    lamb_stage2_leaf, novograd_update_leaf, sgd_update_leaf)
 
 __all__ = [
     "MultiTensorApply", "adam_update_leaf", "adam_update_leaf_reference",
     "clip_grad_norm", "lamb_stage1_leaf", "lamb_stage2_leaf", "layer_norm",
     "layer_norm_reference", "multi_tensor_axpby", "multi_tensor_l2norm",
-    "multi_tensor_scale", "sgd_update_leaf",
+    "multi_tensor_scale", "novograd_update_leaf", "sgd_update_leaf",
+    "sqsum_leaf",
 ]
 
 

@@ -1,8 +1,10 @@
-"""Fused optimizer update kernels (Adam/AdamW, LAMB stages, SGD+momentum).
+"""Fused optimizer update kernels (Adam/AdamW, LAMB stages, SGD+momentum,
+NovoGrad).
 
 Reference (csrc/multi_tensor_adam.cu, multi_tensor_lamb.cu with
-lamb_stage_1/lamb_stage_2, multi_tensor_sgd_kernel.cu; SURVEY.md §2.1): one
-CUDA launch updates chunks of (p, g, m, v) in place for the whole param list.
+lamb_stage_1/lamb_stage_2, multi_tensor_sgd_kernel.cu,
+multi_tensor_novograd.cu; SURVEY.md §2.1): one CUDA launch updates chunks of
+(p, g, m, v) in place for the whole param list.
 
 TPU-native design: the payoff of fusion here is reading p/g/m/v from HBM once
 and writing p'/m'/v' once — a Pallas kernel per leaf does exactly that, with
@@ -267,6 +269,63 @@ def _sgd_kernel(p_ref, g_ref, b_ref, s_ref, po_ref, bo_ref, *, nesterov,
     step_dir = (g + mom * buf) if nesterov else buf
     po_ref[:] = (p - lr * step_dir).astype(po_ref.dtype)
     bo_ref[:] = buf.astype(bo_ref.dtype)
+
+
+def _novograd_kernel(p_ref, g_ref, m_ref, s_ref, po_ref, mo_ref):
+    inv_denom, wd, b1, ga, lr_c1 = (s_ref[i] for i in range(5))
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    m = m_ref[:].astype(jnp.float32)
+    g_hat = g * inv_denom + wd * p       # normalized grad + L2 (reg outside)
+    m = b1 * m + ga * g_hat
+    po_ref[:] = (p - lr_c1 * m).astype(po_ref.dtype)
+    mo_ref[:] = m.astype(mo_ref.dtype)
+
+
+def novograd_update_leaf(p, g, m, *, inv_denom, lr_c1, beta1, weight_decay,
+                         grad_avg_coeff):
+    """Fused NovoGrad apply for one leaf, given the per-tensor normalization
+    scalar ``inv_denom`` = 1/(sqrt(v̂)+eps) (reference:
+    multi_tensor_novograd.cu — the per-tensor second moment is the squared
+    grad L2-norm, so it is scalar work outside the elementwise kernel).
+
+    g_hat = g*inv_denom + wd*p;  m' = b1*m + ga*g_hat;  p' = p − lr_c1*m'
+    (lr_c1 folds the bias correction 1/(1−b1^t) into the learning rate).
+    """
+    if not _use_pallas(p, g, m):
+        pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+        mf = m.astype(jnp.float32)
+        g_hat = gf * inv_denom + weight_decay * pf
+        mf = beta1 * mf + grad_avg_coeff * g_hat
+        return (pf - lr_c1 * mf).astype(p.dtype), mf.astype(m.dtype)
+
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p2, n = _to_lanes(p)
+    g2, _ = _to_lanes(g)
+    m2, _ = _to_lanes(m)
+    rows = p2.shape[0]
+    block, pad = _grid_rows(rows)
+    p2, g2, m2 = (_pad_rows(t, pad) for t in (p2, g2, m2))
+    grid = p2.shape[0] // block
+    scal = jnp.stack([jnp.asarray(s, jnp.float32) for s in
+                      (inv_denom, weight_decay, beta1, grad_avg_coeff,
+                       lr_c1)])
+    bspec = lambda: pl.BlockSpec((block, _LANES), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)
+    po, mo = pl.pallas_call(
+        _novograd_kernel,
+        grid=(grid,),
+        in_specs=[bspec(), bspec(), bspec(),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[bspec(), bspec()],
+        out_shape=[sds(p2.shape, p.dtype, p2, g2, m2),
+                   sds(p2.shape, m.dtype, p2, g2, m2)],
+        input_output_aliases={0: 0, 2: 1},
+        interpret=_interpret(),
+    )(p2, g2, m2, scal)
+    return _unpad(po, n, p), _unpad(mo, n, m)
 
 
 def sgd_update_leaf(p, g, buf, *, lr, momentum, weight_decay, dampening=0.0,

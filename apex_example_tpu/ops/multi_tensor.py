@@ -239,6 +239,12 @@ def _sqsum_leaf(x) -> jnp.ndarray:
     return jnp.sum(xf * xf)
 
 
+def sqsum_leaf(x) -> jnp.ndarray:
+    """Public per-leaf ||x||² on the kernel path (NovoGrad's per-tensor
+    second moment is the squared grad norm)."""
+    return _sqsum_leaf(x)
+
+
 def multi_tensor_l2norm(tree: Any, per_tensor: bool = False):
     """Global L2 norm of all leaves; optionally also per-leaf norms.
 

@@ -1,7 +1,8 @@
 """apex.optimizers-shaped surface (SURVEY.md §3.4)."""
 
 from apex_example_tpu.optim.fused import (
-    AdamState, FusedAdam, FusedLAMB, FusedSGD, LambState, SGDState)
+    AdamState, FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD, LambState,
+    NovoGradState, SGDState)
 
-__all__ = ["AdamState", "FusedAdam", "FusedLAMB", "FusedSGD", "LambState",
-           "SGDState"]
+__all__ = ["AdamState", "FusedAdam", "FusedLAMB", "FusedNovoGrad",
+           "FusedSGD", "LambState", "NovoGradState", "SGDState"]

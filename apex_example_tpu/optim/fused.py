@@ -29,8 +29,10 @@ import jax.numpy as jnp
 import optax
 
 from apex_example_tpu.ops.fused_optim import (
-    adam_update_leaf, lamb_stage1_leaf, lamb_stage2_leaf, sgd_update_leaf)
-from apex_example_tpu.ops.multi_tensor import multi_tensor_l2norm
+    adam_update_leaf, lamb_stage1_leaf, lamb_stage2_leaf,
+    novograd_update_leaf, sgd_update_leaf)
+from apex_example_tpu.ops.multi_tensor import (multi_tensor_l2norm,
+                                               sqsum_leaf)
 
 Schedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
 
@@ -164,6 +166,85 @@ class FusedLAMB:
             new_m.append(mo), new_v.append(vo)
         unflat = treedef.unflatten
         return unflat(new_p), LambState(step, unflat(new_m), unflat(new_v))
+
+    def as_optax(self) -> optax.GradientTransformation:
+        return _as_optax(self)
+
+
+class NovoGradState(NamedTuple):
+    step: jnp.ndarray
+    mu: Any
+    nu: Any          # per-TENSOR scalars: EMA of the squared grad L2-norm
+
+
+class FusedNovoGrad:
+    """NovoGrad: layer-wise normalized momentum SGD.
+
+    Reference surface: apex.optimizers.FusedNovoGrad backed by
+    multi_tensor_novograd.cu (SURVEY.md §3.4).  The second moment is a
+    *scalar per tensor* — the EMA of ||g||₂² — so the state is a pytree of
+    scalars; the elementwise apply is one fused kernel per leaf.
+
+    Defaults mirror the reference: betas=(0.95, 0.98), grad_averaging=True,
+    bias_correction=True, ``init_zero=False`` (first-step v = ||g₁||²),
+    L2 applied to the *normalized* gradient (reg_inside_moment=False).
+    norm_type is fixed at 2, amsgrad unsupported — both as in the reference's
+    kernel path.
+    """
+
+    def __init__(self, lr: Schedule = 1e-3, betas=(0.95, 0.98),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 grad_averaging: bool = True, bias_correction: bool = True,
+                 init_zero: bool = False, amsgrad: bool = False):
+        if amsgrad:
+            raise ValueError("FusedNovoGrad does not support amsgrad "
+                             "(parity with the reference)")
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay = weight_decay
+        self.grad_averaging = grad_averaging
+        self.bias_correction = bias_correction
+        self.init_zero = init_zero
+
+    def init(self, params) -> NovoGradState:
+        zeros = lambda t: jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), t)
+        return NovoGradState(
+            step=jnp.zeros((), jnp.int32), mu=zeros(params),
+            nu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), jnp.float32), params))
+
+    def apply(self, grads, state: NovoGradState, params
+              ) -> Tuple[Any, NovoGradState]:
+        step = state.step + 1
+        b1, b2 = self.betas
+        t = step.astype(jnp.float32)
+        if self.bias_correction:
+            c1 = 1.0 / (1.0 - jnp.power(b1, t))
+            c2 = 1.0 / (1.0 - jnp.power(b2, t))
+        else:
+            c1 = c2 = jnp.asarray(1.0, jnp.float32)
+        lr = _lr_at(self.lr, step)
+        ga = (1.0 - b1) if self.grad_averaging else 1.0
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            gsq = sqsum_leaf(g)
+            if self.init_zero:
+                vo = b2 * v + (1.0 - b2) * gsq
+            else:        # reference default: first-step v is the raw norm²
+                vo = jnp.where(step == 1, gsq, b2 * v + (1.0 - b2) * gsq)
+            inv_denom = 1.0 / (jnp.sqrt(vo * c2) + self.eps)
+            po, mo = novograd_update_leaf(
+                p, g, m, inv_denom=inv_denom, lr_c1=lr * c1, beta1=b1,
+                weight_decay=self.weight_decay, grad_avg_coeff=ga)
+            new_p.append(po), new_m.append(mo), new_v.append(vo)
+        unflat = treedef.unflatten
+        return unflat(new_p), NovoGradState(step, unflat(new_m),
+                                            unflat(new_v))
 
     def as_optax(self) -> optax.GradientTransformation:
         return _as_optax(self)

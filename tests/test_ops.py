@@ -252,3 +252,81 @@ def test_larc_clip_matches_apex_semantics():
     np.testing.assert_allclose(
         np.asarray(updates2["w"]),
         -lr * (0.02 * 4.0 / 20.0 / lr) * np.asarray(grads2["w"]), rtol=1e-4)
+
+
+class TestNovoGrad:
+    """FusedNovoGrad vs a pure-numpy restatement of the reference semantics
+    (multi_tensor_novograd.cu / apex.optimizers.FusedNovoGrad, SURVEY.md
+    §3.4): per-tensor second moment = EMA of ||g||², first-step v = ||g₁||²,
+    grad_averaging, L2 on the normalized gradient, Adam-style bias
+    correction."""
+
+    @staticmethod
+    def _numpy_novograd(p, grads, lr=1e-2, b1=0.95, b2=0.98, eps=1e-8,
+                        wd=0.01, grad_averaging=True, bias_correction=True):
+        p = p.astype(np.float64).copy()
+        m = np.zeros_like(p)
+        v = 0.0
+        ga = (1.0 - b1) if grad_averaging else 1.0
+        for t, g in enumerate(grads, start=1):
+            g = g.astype(np.float64)
+            gsq = float(np.sum(g * g))
+            v = gsq if t == 1 else b2 * v + (1.0 - b2) * gsq
+            c1 = 1.0 / (1.0 - b1 ** t) if bias_correction else 1.0
+            c2 = 1.0 / (1.0 - b2 ** t) if bias_correction else 1.0
+            g_hat = g / (np.sqrt(v * c2) + eps) + wd * p
+            m = b1 * m + ga * g_hat
+            p = p - lr * c1 * m
+        return p
+
+    def test_three_steps_vs_numpy(self):
+        from apex_example_tpu.optim import FusedNovoGrad
+        p0 = _rand(37, seed=40)
+        grads = [_rand(37, seed=41 + i) for i in range(3)]
+        opt = FusedNovoGrad(lr=1e-2, betas=(0.95, 0.98), eps=1e-8,
+                            weight_decay=0.01)
+        params = {"w": jnp.asarray(p0)}
+        state = opt.init(params)
+        for g in grads:
+            params, state = opt.apply({"w": jnp.asarray(g)}, state, params)
+        want = self._numpy_novograd(p0, grads)
+        np.testing.assert_allclose(np.asarray(params["w"]), want,
+                                   atol=1e-5, rtol=1e-4)
+        assert state.nu["w"].shape == ()          # per-TENSOR scalar state
+
+    def test_no_bias_correction_no_averaging(self):
+        from apex_example_tpu.optim import FusedNovoGrad
+        p0 = _rand(20, seed=50)
+        grads = [_rand(20, seed=51 + i) for i in range(2)]
+        opt = FusedNovoGrad(lr=5e-3, weight_decay=0.0, grad_averaging=False,
+                            bias_correction=False)
+        params = {"w": jnp.asarray(p0)}
+        state = opt.init(params)
+        for g in grads:
+            params, state = opt.apply({"w": jnp.asarray(g)}, state, params)
+        want = self._numpy_novograd(p0, grads, lr=5e-3, wd=0.0,
+                                    grad_averaging=False,
+                                    bias_correction=False)
+        np.testing.assert_allclose(np.asarray(params["w"]), want,
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_kernel_matches_reference_path(self):
+        # Pallas (interpret) vs the XLA reference branch of the leaf update.
+        from apex_example_tpu.ops import _config
+        p = _rand(300, seed=60); g = _rand(300, seed=61)
+        m = _rand(300, seed=62) * 0.1
+        kw = dict(inv_denom=0.37, lr_c1=0.02, beta1=0.95,
+                  weight_decay=0.01, grad_avg_coeff=0.05)
+        po_k, mo_k = ops.novograd_update_leaf(
+            jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), **kw)
+        saved = _config.INTERPRET
+        _config.INTERPRET = False     # on CPU this selects the XLA reference
+        try:
+            po_r, mo_r = ops.novograd_update_leaf(
+                jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), **kw)
+        finally:
+            _config.INTERPRET = saved
+        np.testing.assert_allclose(np.asarray(po_k), np.asarray(po_r),
+                                   atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(mo_k), np.asarray(mo_r),
+                                   atol=1e-6, rtol=1e-6)
