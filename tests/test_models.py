@@ -139,3 +139,36 @@ class TestTransformerXL:
             state, mems, m = step(state, mems, (toks[:, :8], toks[:, 1:9]))
         assert np.isfinite(float(m["loss"]))
         assert int(state.step) == 2
+
+
+class TestSpaceToDepthStem:
+    def test_equivalent_to_7x7_stem(self):
+        """The s2d stem is a reparametrization: same param tree, same math
+        (MLPerf-style; apex_example_tpu/models/resnet.py)."""
+        from apex_example_tpu.models.resnet import ResNet, Bottleneck
+        kw = dict(stage_sizes=[1, 1], block_cls=Bottleneck, num_classes=10,
+                  num_filters=8)
+        m_plain = ResNet(stem_space_to_depth=False, **kw)
+        m_s2d = ResNet(stem_space_to_depth=True, **kw)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                        jnp.float32)
+        v_plain = m_plain.init(jax.random.PRNGKey(7), x, train=False)
+        v_s2d = m_s2d.init(jax.random.PRNGKey(7), x, train=False)
+        # identical param trees (same names, shapes, and init values)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            v_plain["params"], v_s2d["params"])
+        y_plain = m_plain.apply(v_plain, x, train=False)
+        y_s2d = m_s2d.apply(v_plain, x, train=False)
+        np.testing.assert_allclose(np.asarray(y_plain), np.asarray(y_s2d),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_odd_input_falls_back(self):
+        from apex_example_tpu.models.resnet import ResNet, BasicBlock
+        m = ResNet(stage_sizes=[1, 1], block_cls=BasicBlock, num_classes=4,
+                   num_filters=8, stem_space_to_depth=True)
+        x = jnp.zeros((1, 31, 31, 3), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (1, 4)
