@@ -1,0 +1,238 @@
+"""ctypes bindings for the native host runtime (csrc/apex_tpu_host.cpp).
+
+Division of labor mirrors the reference (SURVEY.md §2.1): device math is
+XLA/Pallas; the *host* runtime — contiguous staging buffers (the apex_C
+flatten/unflatten analog), the synthetic-data generator, uint8→float32
+collate, and a double-buffered background prefetcher (the fast_collate +
+CUDA-stream-prefetcher analog, SURVEY.md §3.5) — is C++.
+
+The shared library is compiled lazily with g++ on first use and cached next
+to the source; everything here degrades gracefully (``available()`` →
+False) if no toolchain is present, and pure-Python fallbacks exist in
+``apex_example_tpu.data.synthetic``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CSRC = os.path.join(_REPO, "csrc")
+_SO = os.path.join(_CSRC, "libapex_tpu_host.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    src = os.path.join(_CSRC, "apex_tpu_host.cpp")
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(src)):
+        return _SO
+    try:
+        subprocess.run(["make", "-C", _CSRC], check=True,
+                       capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _SO if os.path.exists(_SO) else None
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        i64, u64, i32 = ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32
+        fp, u8p = ctypes.POINTER(ctypes.c_float), ctypes.POINTER(
+            ctypes.c_uint8)
+        i32p = ctypes.POINTER(i32)
+        lib.apex_flatten_f32.restype = i64
+        lib.apex_flatten_f32.argtypes = [ctypes.POINTER(fp),
+                                         ctypes.POINTER(i64), i64, fp]
+        lib.apex_unflatten_f32.restype = i64
+        lib.apex_unflatten_f32.argtypes = [fp, ctypes.POINTER(fp),
+                                           ctypes.POINTER(i64), i64]
+        lib.apex_gen_u8.restype = None
+        lib.apex_gen_u8.argtypes = [u64, u64, u8p, i64]
+        lib.apex_gen_labels_i32.restype = None
+        lib.apex_gen_labels_i32.argtypes = [u64, u64, i32p, i64, i32]
+        lib.apex_collate_f32.restype = None
+        lib.apex_collate_f32.argtypes = [u8p, i64, i64, i64, fp, fp, fp]
+        lib.apex_prefetcher_new.restype = ctypes.c_void_p
+        lib.apex_prefetcher_new.argtypes = [i64, i64, i64, i64, u64, fp, fp,
+                                            i64]
+        lib.apex_prefetcher_next.restype = i64
+        lib.apex_prefetcher_next.argtypes = [ctypes.c_void_p, fp, i32p]
+        lib.apex_prefetcher_free.restype = None
+        lib.apex_prefetcher_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is present (built or buildable)."""
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+# ---------------------------------------------------------------------------
+# apex_C analog
+# ---------------------------------------------------------------------------
+
+def flatten_f32(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Concatenate float32 arrays into one contiguous fp32 buffer (native).
+
+    Reference: csrc/flatten_unflatten.cpp / ``apex_C.flatten`` — the staging
+    step of bucketed collectives and of flat checkpoint/broadcast buffers.
+    """
+    lib = _load()
+    arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+    sizes = np.asarray([a.size for a in arrays], np.int64)
+    out = np.empty(int(sizes.sum()), np.float32)
+    if lib is None:        # pure-numpy fallback
+        np.concatenate([a.ravel() for a in arrays], out=out)
+        return out
+    Srcs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+        *[_fptr(a) for a in arrays])
+    n = lib.apex_flatten_f32(
+        Srcs, sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(arrays), _fptr(out))
+    assert n == out.size
+    return out
+
+
+def unflatten_f32(flat: np.ndarray,
+                  shapes: Sequence[Tuple[int, ...]]) -> List[np.ndarray]:
+    """Scatter a contiguous fp32 buffer back into arrays of ``shapes``."""
+    lib = _load()
+    flat = np.ascontiguousarray(flat, dtype=np.float32)
+    outs = [np.empty(s, np.float32) for s in shapes]
+    sizes = np.asarray([o.size for o in outs], np.int64)
+    assert int(sizes.sum()) == flat.size, "shapes do not tile the buffer"
+    if lib is None:
+        off = 0
+        for o in outs:
+            o[...] = flat[off:off + o.size].reshape(o.shape)
+            off += o.size
+        return outs
+    Dsts = (ctypes.POINTER(ctypes.c_float) * len(outs))(
+        *[_fptr(o) for o in outs])
+    lib.apex_unflatten_f32(
+        _fptr(flat), Dsts,
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(outs))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Native synthetic generator + collate
+# ---------------------------------------------------------------------------
+
+def gen_u8(seed: int, start_index: int, n: int) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native host runtime unavailable; gate calls "
+                           "with host_runtime.available()")
+    out = np.empty(n, np.uint8)
+    lib.apex_gen_u8(seed, start_index,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n)
+    return out
+
+
+def collate_f32(frames_u8: np.ndarray, mean: Sequence[float],
+                std: Sequence[float]) -> np.ndarray:
+    """uint8 [N, H, W, C] → normalized float32 NHWC (native fast_collate)."""
+    lib = _load()
+    frames_u8 = np.ascontiguousarray(frames_u8, dtype=np.uint8)
+    n, h, w, c = frames_u8.shape
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    out = np.empty((n, h, w, c), np.float32)
+    if lib is None:
+        return ((frames_u8.astype(np.float32) / 255.0 - mean) / std)
+    lib.apex_collate_f32(
+        frames_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h * w, c, _fptr(mean), _fptr(std), _fptr(out))
+    return out
+
+
+class NativePrefetcher:
+    """Double-buffered background producer of normalized synthetic batches.
+
+    The TPU-native analog of the reference harness's data prefetcher: a C++
+    worker thread generates + collates batch i+1 while the device runs batch
+    i.  Deterministic in (seed, batch index).  Use as an iterator:
+
+        pf = NativePrefetcher(batch=256, image_size=224, num_classes=1000)
+        for _ in range(steps):
+            images, labels = next(pf)     # np.float32 NHWC, np.int32
+        pf.close()
+    """
+
+    MEAN = (0.485, 0.456, 0.406)
+    STD = (0.229, 0.224, 0.225)
+
+    def __init__(self, batch: int, image_size: int, num_classes: int,
+                 channels: int = 3, seed: int = 0, start_index: int = 0,
+                 mean: Optional[Sequence[float]] = None,
+                 std: Optional[Sequence[float]] = None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native host runtime unavailable "
+                               "(g++ build failed?)")
+        mean = list(self.MEAN if mean is None else mean)
+        std = list(self.STD if std is None else std)
+        if len(mean) < channels or len(std) < channels:
+            raise ValueError(
+                f"need {channels} per-channel mean/std values, got "
+                f"{len(mean)}/{len(std)}")
+        self._lib = lib
+        self.batch, self.channels = batch, channels
+        self.image_size, self.num_classes = image_size, num_classes
+        mean = np.asarray(mean[:channels], np.float32)
+        std = np.asarray(std[:channels], np.float32)
+        self._img = np.empty((batch, image_size, image_size, channels),
+                             np.float32)
+        self._lab = np.empty((batch,), np.int32)
+        self._h = lib.apex_prefetcher_new(
+            batch, image_size * image_size, channels, num_classes, seed,
+            _fptr(mean), _fptr(std), start_index)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (images, labels) VIEWS valid until the next ``next()``
+        call (the underlying buffers are reused; ``jnp.asarray``/device_put
+        them before pulling another batch)."""
+        if self._h is None:
+            raise StopIteration
+        self._lib.apex_prefetcher_next(
+            self._h, _fptr(self._img),
+            self._lab.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return self._img, self._lab
+
+    def close(self):
+        if self._h is not None:
+            self._lib.apex_prefetcher_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

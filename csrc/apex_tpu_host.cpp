@@ -1,0 +1,291 @@
+// Host-side native runtime for apex_example_tpu.
+//
+// The reference keeps its host-side native code in csrc/ (SURVEY.md §2.1):
+//   - csrc/flatten_unflatten.cpp ("apex_C"): flatten a list of tensors into
+//     one contiguous buffer (bucketed-NCCL staging) and scatter it back.
+//   - the fast_collate + pinned-memory prefetcher in the harness (SURVEY.md
+//     §3.5): uint8 HWC frames -> normalized float batch on a side thread,
+//     overlapping host work with device compute.
+//
+// TPU-native restatement, same division of labor: device math belongs to
+// XLA/Pallas; the *host* runtime around it — contiguous staging buffers for
+// checkpoint/broadcast, the synthetic-data generator, uint8->float collate,
+// and a double-buffered background producer — is plain C++ driven through
+// ctypes (no pybind11 in this image).  Single compilation unit, no deps.
+//
+// All functions use C linkage and raw pointers + explicit sizes so the
+// ctypes layer stays declarative.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// apex_C analog: flatten / unflatten over a list of float32 spans.
+// ---------------------------------------------------------------------------
+
+// Copy n_tensors source spans (srcs[i], sizes[i] floats) back-to-back into
+// dst.  Returns total floats copied.
+int64_t apex_flatten_f32(const float** srcs, const int64_t* sizes,
+                         int64_t n_tensors, float* dst) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n_tensors; ++i) {
+    std::memcpy(dst + off, srcs[i], sizeof(float) * (size_t)sizes[i]);
+    off += sizes[i];
+  }
+  return off;
+}
+
+// Scatter the contiguous src back into n_tensors destination spans.
+int64_t apex_unflatten_f32(const float* src, float** dsts,
+                           const int64_t* sizes, int64_t n_tensors) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n_tensors; ++i) {
+    std::memcpy(dsts[i], src + off, sizeof(float) * (size_t)sizes[i]);
+    off += sizes[i];
+  }
+  return off;
+}
+
+// ---------------------------------------------------------------------------
+// Synthetic data generator (the "dataset"): splitmix64 -> uint8 pixels /
+// int32 labels.  Deterministic in (seed, index) exactly like the Python
+// generators in apex_example_tpu/data/synthetic.py, so epochs are
+// reproducible without any dataset on disk (SURVEY.md §5 env facts).
+// ---------------------------------------------------------------------------
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// Fill `out` with n uint8 values derived from (seed, start_index).
+void apex_gen_u8(uint64_t seed, uint64_t start_index, uint8_t* out,
+                 int64_t n) {
+  int64_t i = 0;
+  uint64_t ctr = start_index;
+  while (i < n) {
+    uint64_t r = splitmix64(seed ^ (0xA5A5A5A5u + ctr * 0x100000001B3ULL));
+    ++ctr;
+    for (int b = 0; b < 8 && i < n; ++b, ++i) {
+      out[i] = (uint8_t)(r >> (8 * b));
+    }
+  }
+}
+
+// Labels in [0, num_classes).
+void apex_gen_labels_i32(uint64_t seed, uint64_t start_index, int32_t* out,
+                         int64_t n, int32_t num_classes) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = (int32_t)(splitmix64(seed ^ (start_index + (uint64_t)i)) %
+                       (uint64_t)num_classes);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// fast_collate analog: uint8 HWC frames -> normalized float32 NHWC batch.
+// mean/std are per-channel (length c), matching the reference harness's
+// normalize-in-prefetcher (SURVEY.md §3.5).
+// ---------------------------------------------------------------------------
+
+void apex_collate_f32(const uint8_t* src, int64_t n, int64_t hw, int64_t c,
+                      const float* mean, const float* std_, float* dst) {
+  // Precompute 256-entry LUT per channel: (v/255 - mean) / std.
+  std::vector<float> lut((size_t)c * 256);
+  for (int64_t ch = 0; ch < c; ++ch) {
+    const float inv = 1.0f / std_[ch];
+    for (int v = 0; v < 256; ++v) {
+      lut[(size_t)ch * 256 + v] = ((float)v * (1.0f / 255.0f) - mean[ch]) *
+                                  inv;
+    }
+  }
+  const int64_t total = n * hw * c;
+  for (int64_t i = 0; i < total; ++i) {
+    dst[i] = lut[(size_t)(i % c) * 256 + src[i]];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Double-buffered background producer (prefetcher).  One worker thread fills
+// image+label buffers for batch index `next`, the consumer swaps and
+// continues — host generation overlaps device compute exactly like the
+// reference's CUDA-stream prefetcher overlapped H2D with the step.
+// ---------------------------------------------------------------------------
+
+// Cheap standard-normal-ish noise: Irwin–Hall sum of 4 uniforms.
+static inline float approx_gauss(uint64_t r) {
+  float s = 0.0f;
+  for (int i = 0; i < 4; ++i) {
+    s += (float)((r >> (16 * i)) & 0xFFFF) * (1.0f / 65535.0f);
+  }
+  return (s - 2.0f) * 1.732f;  // var of IH(4) is 4/12 → scale to ~unit
+}
+
+struct Prefetcher {
+  int64_t batch, hw, c, num_classes;
+  int64_t side;                   // image_size (hw == side*side)
+  uint64_t seed;
+  std::vector<float> mean, std_;
+  // Learnable signal, as in data/synthetic.py: a fixed low-res (8×8×C)
+  // per-class pattern, bilinearly upsampled, plus noise — so models
+  // genuinely train from this pipeline (loss falls, top-1 rises).
+  static const int64_t PAT = 8;
+  std::vector<float> patterns;    // [num_classes, 8, 8, c]
+  std::vector<int> y0s, x0s;      // bilinear taps per output row/col
+  std::vector<float> wys, wxs;
+  // two slots
+  std::vector<uint8_t> raw[2];
+  std::vector<float> img[2];
+  std::vector<int32_t> lab[2];
+  int64_t slot_index[2];          // which batch index each slot holds
+  int filled[2];
+  int64_t next_index;             // next batch index to produce
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<bool> stop;
+
+  void init_patterns() {
+    patterns.resize((size_t)(num_classes * PAT * PAT * c));
+    for (size_t i = 0; i < patterns.size(); ++i) {
+      patterns[i] = approx_gauss(splitmix64(seed ^ (0xbeefULL + i)));
+    }
+    // Half-pixel-center bilinear taps (jax.image.resize "bilinear" style).
+    y0s.resize((size_t)side); wys.resize((size_t)side);
+    x0s.resize((size_t)side); wxs.resize((size_t)side);
+    for (int64_t i = 0; i < side; ++i) {
+      float srcf = ((float)i + 0.5f) * (float)PAT / (float)side - 0.5f;
+      if (srcf < 0.0f) srcf = 0.0f;
+      if (srcf > (float)(PAT - 1)) srcf = (float)(PAT - 1);
+      int lo = (int)srcf;
+      if (lo > PAT - 2) lo = PAT - 2;
+      y0s[i] = x0s[i] = lo;
+      wys[i] = wxs[i] = srcf - (float)lo;
+    }
+  }
+
+  // Pure computation: fills slot s for batch index bi.  No shared flags are
+  // touched here; run() publishes the slot under the lock.
+  void produce(int s, int64_t bi) {
+    const int64_t npix = batch * hw * c;
+    apex_gen_labels_i32(seed ^ 0x51ab5eedULL, (uint64_t)bi * (uint64_t)batch,
+                        lab[s].data(), batch, (int32_t)num_classes);
+    apex_gen_u8(seed, (uint64_t)bi * (uint64_t)npix, raw[s].data(), npix);
+    // uint8 frame = clip(128 + 40·pattern + 20·noise): the class signal
+    // dominates, collate re-centers it around zero.
+    uint8_t* dst = raw[s].data();
+    for (int64_t b = 0; b < batch; ++b) {
+      const float* pat =
+          &patterns[(size_t)lab[s][b] * PAT * PAT * c];
+      for (int64_t y = 0; y < side; ++y) {
+        const int y0 = y0s[y];
+        const float wy = wys[y];
+        for (int64_t x = 0; x < side; ++x) {
+          const int x0 = x0s[x];
+          const float wx = wxs[x];
+          for (int64_t ch = 0; ch < c; ++ch) {
+            const float p00 = pat[(y0 * PAT + x0) * c + ch];
+            const float p01 = pat[(y0 * PAT + x0 + 1) * c + ch];
+            const float p10 = pat[((y0 + 1) * PAT + x0) * c + ch];
+            const float p11 = pat[((y0 + 1) * PAT + x0 + 1) * c + ch];
+            const float v = (1 - wy) * ((1 - wx) * p00 + wx * p01) +
+                            wy * ((1 - wx) * p10 + wx * p11);
+            // raw[] currently holds uniform bytes — reuse as noise source.
+            const float noise = ((float)(*dst) * (1.0f / 255.0f) - 0.5f);
+            float px = 128.0f + 40.0f * v + 40.0f * noise;
+            if (px < 0.0f) px = 0.0f;
+            if (px > 255.0f) px = 255.0f;
+            *dst++ = (uint8_t)px;
+          }
+        }
+      }
+    }
+    apex_collate_f32(raw[s].data(), batch, hw, c, mean.data(), std_.data(),
+                     img[s].data());
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (!stop.load()) {
+      int s = -1;
+      if (!filled[0]) s = 0;
+      else if (!filled[1]) s = 1;
+      if (s < 0) {
+        cv.wait(lk);
+        continue;
+      }
+      const int64_t bi = next_index++;
+      lk.unlock();
+      produce(s, bi);
+      lk.lock();
+      slot_index[s] = bi;
+      filled[s] = 1;
+      cv.notify_all();
+    }
+  }
+};
+
+void* apex_prefetcher_new(int64_t batch, int64_t hw, int64_t c,
+                          int64_t num_classes, uint64_t seed,
+                          const float* mean, const float* std_,
+                          int64_t start_index) {
+  auto* p = new Prefetcher();
+  p->batch = batch; p->hw = hw; p->c = c; p->num_classes = num_classes;
+  p->seed = seed;
+  p->side = 1;
+  while (p->side * p->side < hw) ++p->side;   // hw is image_size²
+  p->mean.assign(mean, mean + c);
+  p->std_.assign(std_, std_ + c);
+  p->init_patterns();
+  for (int s = 0; s < 2; ++s) {
+    p->raw[s].resize((size_t)(batch * hw * c));
+    p->img[s].resize((size_t)(batch * hw * c));
+    p->lab[s].resize((size_t)batch);
+    p->filled[s] = 0;
+    p->slot_index[s] = -1;
+  }
+  p->next_index = start_index;   // checkpoint-resume: continue the stream
+  p->stop.store(false);
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Blocks until the slot holding the OLDEST ready batch is available, copies
+// it out, marks the slot refillable, and returns the batch index.
+int64_t apex_prefetcher_next(void* handle, float* img_out, int32_t* lab_out) {
+  auto* p = (Prefetcher*)handle;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv.wait(lk, [p] { return p->filled[0] || p->filled[1]; });
+  int s;
+  if (p->filled[0] && p->filled[1])
+    s = p->slot_index[0] < p->slot_index[1] ? 0 : 1;
+  else
+    s = p->filled[0] ? 0 : 1;
+  const int64_t bi = p->slot_index[s];
+  std::memcpy(img_out, p->img[s].data(), p->img[s].size() * sizeof(float));
+  std::memcpy(lab_out, p->lab[s].data(), p->lab[s].size() * sizeof(int32_t));
+  p->filled[s] = 0;
+  p->cv.notify_all();
+  return bi;
+}
+
+void apex_prefetcher_free(void* handle) {
+  auto* p = (Prefetcher*)handle;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop.store(true);
+    p->cv.notify_all();
+  }
+  p->worker.join();
+  delete p;
+}
+
+}  // extern "C"

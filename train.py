@@ -83,6 +83,10 @@ def parse_args(argv=None):
     # harness
     p.add_argument("--resume", default="", help="checkpoint dir to resume")
     p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--host-pipeline", action="store_true",
+                   help="feed batches from the native C++ prefetcher "
+                        "(csrc/; the reference's fast_collate analog) "
+                        "instead of on-device synthesis")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval", action="store_true")
@@ -133,14 +137,20 @@ def main(argv=None):
         bn_axis_name="data" if (args.sync_bn and n_dev > 1) else None)
 
     optimizer = build_optimizer(args)
-    batch_fn = lambda i: image_batch(
-        jnp.asarray(i, jnp.int32), batch_size=args.batch_size,
-        image_size=spec["image_size"], channels=spec["channels"],
-        num_classes=spec["num_classes"], seed=args.seed)
+    if args.host_pipeline:
+        from apex_example_tpu import host_runtime
+        if not host_runtime.available():
+            raise SystemExit("--host-pipeline: native runtime not buildable")
+    else:
+        batch_fn = lambda i: image_batch(
+            jnp.asarray(i, jnp.int32), batch_size=args.batch_size,
+            image_size=spec["image_size"], channels=spec["channels"],
+            num_classes=spec["num_classes"], seed=args.seed)
 
-    sample = batch_fn(0)[0]
+    sample = jnp.zeros((1, spec["image_size"], spec["image_size"],
+                        spec["channels"]), jnp.float32)
     state = create_train_state(jax.random.PRNGKey(args.seed), model,
-                               optimizer, sample[:1], policy, scaler)
+                               optimizer, sample, policy, scaler)
 
     ddp = DDPConfig(
         delay_allreduce=args.delay_allreduce,
@@ -169,28 +179,59 @@ def main(argv=None):
         jax.profiler.start_trace("/tmp/apex_tpu_trace")
 
     global_step = int(state.step)
-    for epoch in range(start_epoch, args.epochs):
-        losses, top1s = AverageMeter("loss"), AverageMeter("top1")
-        thr = Throughput(warmup_steps=2)
-        for i in range(args.steps_per_epoch):
-            batch = batch_fn(global_step)
-            state, metrics = step_fn(state, batch)
-            global_step += 1
-            thr.step(args.batch_size)
-            if (i + 1) % args.print_freq == 0 or i + 1 == args.steps_per_epoch:
-                losses.update(float(metrics["loss"]))
-                top1s.update(float(metrics["top1"]))
-                print(f"epoch {epoch} step {i + 1}/{args.steps_per_epoch} "
-                      f"{losses} {top1s} "
-                      f"{thr.rate:.1f} img/s "
-                      f"scale {float(metrics['scale']):.0f}")
+    prefetcher = eval_prefetcher = None
+    if args.host_pipeline:
+        # Created AFTER resume so the native stream continues at the exact
+        # batch index training stopped at (start_index); the eval stream
+        # lives at a far-offset index range, disjoint from training — the
+        # same contract as the on-device batch_fn(10_000 + epoch) path.
+        mk = lambda start: host_runtime.NativePrefetcher(
+            batch=args.batch_size, image_size=spec["image_size"],
+            num_classes=spec["num_classes"], channels=spec["channels"],
+            seed=args.seed, start_index=start)
+        prefetcher = mk(global_step)
         if args.eval:
-            em = eval_fn(state, batch_fn(10_000 + epoch))
-            print(f"epoch {epoch} EVAL loss {float(em['loss']):.4f} "
-                  f"top1 {float(em['top1']):.2f}")
-        if mgr is not None:
-            mgr.save(state)
-            print(f"saved checkpoint at step {int(state.step)}")
+            eval_prefetcher = mk(10_000_000 + start_epoch)
+
+        def batch_fn(i):
+            images, labels = next(prefetcher)
+            return jnp.asarray(images), jnp.asarray(labels)
+
+        def eval_batch_fn(i):
+            images, labels = next(eval_prefetcher)
+            return jnp.asarray(images), jnp.asarray(labels)
+    else:
+        eval_batch_fn = batch_fn
+
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            losses, top1s = AverageMeter("loss"), AverageMeter("top1")
+            thr = Throughput(warmup_steps=2)
+            for i in range(args.steps_per_epoch):
+                batch = batch_fn(global_step)
+                state, metrics = step_fn(state, batch)
+                global_step += 1
+                thr.step(args.batch_size)
+                if (i + 1) % args.print_freq == 0 \
+                        or i + 1 == args.steps_per_epoch:
+                    losses.update(float(metrics["loss"]))
+                    top1s.update(float(metrics["top1"]))
+                    print(f"epoch {epoch} step "
+                          f"{i + 1}/{args.steps_per_epoch} "
+                          f"{losses} {top1s} "
+                          f"{thr.rate:.1f} img/s "
+                          f"scale {float(metrics['scale']):.0f}")
+            if args.eval:
+                em = eval_fn(state, eval_batch_fn(10_000 + epoch))
+                print(f"epoch {epoch} EVAL loss {float(em['loss']):.4f} "
+                      f"top1 {float(em['top1']):.2f}")
+            if mgr is not None:
+                mgr.save(state)
+                print(f"saved checkpoint at step {int(state.step)}")
+    finally:
+        for pf in (prefetcher, eval_prefetcher):
+            if pf is not None:
+                pf.close()
 
     if args.prof:
         jax.profiler.stop_trace()
