@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Accuracy-acceptance harness: the second half of the north star.
+
+BASELINE.md's acceptance bar has two numbers — throughput (bench.py) AND
+"<0.1% top-1 gap, amp-O2 bf16 vs fp32" (SURVEY.md §7).  This harness
+measures the second: it trains the same model from the same init under two
+opt levels on identical data, evaluates both on a held-out synthetic split,
+and emits a JSON artifact:
+
+    {"top1_fp32": ..., "top1_o2": ..., "gap": ..., ...}
+
+Presets:
+  ci    — ResNet-18 / CIFAR-shaped, few hundred steps, CPU-or-TPU (~min).
+  full  — ResNet-50 / ImageNet-shaped on the real chip (long).
+
+The train stream is ``image_batch(step)`` and the eval split lives at a
+disjoint index range (indices >= 10^6), mirroring the train.py contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import CIFAR10, IMAGENET, image_batch
+from apex_example_tpu.engine import (create_train_state, make_eval_step,
+                                     make_train_step)
+from apex_example_tpu.models import ARCHS
+from apex_example_tpu.optim import FusedSGD, build_schedule
+
+EVAL_OFFSET = 1_000_000     # held-out split: indices disjoint from training
+
+
+def run_one(opt_level: str, arch: str, spec: dict, steps: int,
+            batch_size: int, eval_batches: int, lr: float, warmup: int,
+            seed: int) -> dict:
+    policy, scaler = amp.initialize(opt_level)
+    md = amp.module_dtypes(policy)
+    model = ARCHS[arch](num_classes=spec["num_classes"],
+                        dtype=md.compute, param_dtype=md.param,
+                        bn_dtype=md.bn_stats, bn_io_dtype=md.bn_io)
+    schedule = build_schedule("cosine", lr, steps, warmup_steps=warmup)
+    opt = FusedSGD(lr=schedule, momentum=0.9, weight_decay=5e-4)
+
+    sample = jnp.zeros((1, spec["image_size"], spec["image_size"],
+                        spec["channels"]), jnp.float32)
+    state = create_train_state(jax.random.PRNGKey(seed), model, opt, sample,
+                               policy, scaler)
+    step_fn = jax.jit(make_train_step(model, opt, policy),
+                      donate_argnums=(0,))
+    eval_fn = jax.jit(make_eval_step(model))
+
+    mk = lambda i: image_batch(jnp.asarray(i, jnp.int32),
+                               batch_size=batch_size,
+                               image_size=spec["image_size"],
+                               channels=spec["channels"],
+                               num_classes=spec["num_classes"], seed=seed)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step_fn(state, mk(i))
+    final_loss = float(metrics["loss"])
+    train_s = time.perf_counter() - t0
+
+    # Full eval loop over the held-out split (top-1 averaged across batches;
+    # every batch has the same size so the plain mean is exact).
+    top1s, losses = [], []
+    for j in range(eval_batches):
+        em = eval_fn(state, mk(EVAL_OFFSET + j))
+        top1s.append(float(em["top1"]))
+        losses.append(float(em["loss"]))
+    return {"opt_level": opt_level,
+            "top1": sum(top1s) / len(top1s),
+            "eval_loss": sum(losses) / len(losses),
+            "final_train_loss": final_loss,
+            "train_seconds": round(train_s, 1)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="ci", choices=["ci", "full"])
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--eval-batches", type=int, default=None)
+    ap.add_argument("--lr", type=float, default=None)
+    ap.add_argument("--warmup-steps", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--opt-levels", default="O0,O2")
+    ap.add_argument("--out", default="ACCURACY.json")
+    args = ap.parse_args(argv)
+
+    if args.preset == "ci":
+        arch, spec = "resnet18", CIFAR10
+        defaults = dict(steps=300, batch_size=128, eval_batches=8, lr=0.1,
+                        warmup=20)
+    else:
+        arch, spec = "resnet50", IMAGENET
+        defaults = dict(steps=1500, batch_size=256, eval_batches=16, lr=0.2,
+                        warmup=100)
+    steps = args.steps if args.steps is not None else defaults["steps"]
+    bs = args.batch_size if args.batch_size is not None \
+        else defaults["batch_size"]
+    ev = args.eval_batches if args.eval_batches is not None \
+        else defaults["eval_batches"]
+    lr = args.lr if args.lr is not None else defaults["lr"]
+    warmup = args.warmup_steps if args.warmup_steps is not None \
+        else defaults["warmup"]
+
+    results = {}
+    for lvl in args.opt_levels.split(","):
+        r = run_one(lvl.strip(), arch, spec, steps, bs, ev, lr, warmup,
+                    args.seed)
+        results[lvl.strip()] = r
+        print(f"{lvl}: top1 {r['top1']:.2f}%  eval_loss "
+              f"{r['eval_loss']:.4f}  ({r['train_seconds']}s)")
+
+    levels = list(results)
+    artifact = {
+        "preset": args.preset, "arch": arch, "steps": steps,
+        "batch_size": bs, "eval_batches": ev,
+        "top1_fp32": results.get("O0", {}).get("top1"),
+        "top1_o2": results.get("O2", {}).get("top1"),
+        "per_level": results,
+    }
+    if "O0" in results and "O2" in results:
+        artifact["gap"] = results["O0"]["top1"] - results["O2"]["top1"]
+        print(f"top-1 gap (fp32 − O2): {artifact['gap']:+.3f}% "
+              f"(acceptance: |gap| < 0.1% at convergence; short runs are "
+              f"noisier)")
+    elif len(levels) >= 2:
+        artifact["gap"] = (results[levels[0]]["top1"]
+                           - results[levels[1]]["top1"])
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,15 +7,23 @@ of the reference surface (``scale_loss``, ``state_dict``/``load_state_dict``,
 ``master_params``) maps onto the functions below.
 """
 
+from apex_example_tpu.amp.autocast import (ModuleDtypes, cast_args,
+                                           module_dtypes, op_dtype)
+from apex_example_tpu.amp.lists import (register_float_function,
+                                        register_half_function,
+                                        register_promote_function)
 from apex_example_tpu.amp.policy import Policy, get_policy, opt_level_table
 from apex_example_tpu.amp.scaler import (
     ScalerState, all_finite, load_state_dict, make_scaler, scale_loss,
     select_tree, state_dict, unscale_grads, update as update_scaler)
 
 __all__ = [
-    "Policy", "get_policy", "opt_level_table", "ScalerState", "all_finite",
-    "initialize", "load_state_dict", "make_scaler", "scale_loss",
-    "select_tree", "state_dict", "unscale_grads", "update_scaler",
+    "ModuleDtypes", "Policy", "ScalerState", "all_finite", "cast_args",
+    "get_policy", "initialize", "load_state_dict", "make_scaler",
+    "module_dtypes", "op_dtype", "opt_level_table",
+    "register_float_function", "register_half_function",
+    "register_promote_function", "scale_loss", "select_tree", "state_dict",
+    "unscale_grads", "update_scaler",
 ]
 
 

@@ -1,0 +1,111 @@
+"""The O1 cast engine: white/blacklist tables → per-boundary dtypes.
+
+Reference: apex/amp/{wrap,amp,utils}.py (SURVEY.md §3.1) — O1 monkey-patches
+torch functions so each call casts its arguments per the op lists, leaving
+the model itself fp32.  JAX traces pure functions, so the same semantics are
+realized *structurally*: :func:`op_dtype` answers "what dtype does this op
+class run in under this policy", and the framework's modules ask it at their
+call-site boundaries.  :func:`module_dtypes` bundles the answers for the ops
+our model families contain, and is what model builders consume.
+
+Behavioral contract (and how O1 differs from its neighbors):
+
+  op class      O0     O1                O2                O3
+  conv/dense    fp32   half              half              half
+  batch_norm    fp32   fp32 (I/O+stats)  half I/O,         half
+                                         fp32 stats
+  layer_norm    fp32   fp32 (I/O+stats)  half I/O,         half I/O
+                                         fp32 stats        (fp32 stats: the
+                                                           kernel contract)
+  softmax       fp32   fp32              fp32              half
+  loss          fp32   fp32              fp32              half-ish (logits
+                                                           cast by caller)
+
+Under O2 the *model* is half (minus BN stats) — casting is a property of
+model construction, exactly apex's ``model.half()``.  Under O1 params stay
+fp32 and only whitelisted boundaries drop to half.  O3 ignores the lists
+entirely (pure half).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from apex_example_tpu.amp import lists
+from apex_example_tpu.amp.policy import Policy
+
+
+def op_dtype(policy: Policy, op: str,
+             *operand_dtypes) -> Optional[jnp.dtype]:
+    """The dtype op-class ``op`` runs in under ``policy``; None = no opinion
+    (caller keeps its configured dtype).
+
+    Only O1 (``cast_at_call_sites``) consults the lists — O0/O2/O3 configure
+    dtypes at model construction, like the reference's whole-model cast.
+    """
+    if not policy.cast_at_call_sites:
+        return None
+    cls = lists.classify(op)
+    if cls == "half":
+        return policy.compute_dtype
+    if cls == "float":
+        return jnp.dtype(jnp.float32)
+    if cls == "promote":
+        if operand_dtypes:
+            return jnp.result_type(*operand_dtypes)
+        return None
+    return None
+
+
+def cast_args(policy: Policy, op: str, *arrays) -> Tuple:
+    """Cast arrays per the op classification (identity when the policy has
+    no opinion).  The call-site form of apex's wrapped functions."""
+    dts = [a.dtype for a in arrays]
+    d = op_dtype(policy, op, *dts)
+    if d is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    out = tuple(a.astype(d) for a in arrays)
+    return out if len(out) != 1 else out[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleDtypes:
+    """Resolved per-op-class dtypes for one policy — what model builders
+    thread into module constructors."""
+    compute: jnp.dtype        # conv/dense/matmul (whitelist)
+    bn_io: jnp.dtype          # BatchNorm input/output
+    bn_stats: jnp.dtype       # BatchNorm moment/normalization math
+    ln_io: jnp.dtype          # LayerNorm input/output
+    softmax: jnp.dtype        # attention probabilities / softmax math
+    param: jnp.dtype          # parameter storage
+
+
+def module_dtypes(policy: Policy) -> ModuleDtypes:
+    """Derive every module-boundary dtype from the policy + op lists.
+
+    O2/O3 reproduce the whole-model-cast semantics (bn_io follows the
+    compute dtype; ``keep_batchnorm_fp32`` only keeps the *stats* fp32 —
+    the way cuDNN realizes it).  O1 consults the lists: blacklisted norm
+    ops run wholly in fp32, I/O included.
+    """
+    f32 = jnp.dtype(jnp.float32)
+    if policy.cast_at_call_sites:      # O1
+        conv = op_dtype(policy, "conv") or policy.compute_dtype
+        return ModuleDtypes(
+            compute=conv,
+            bn_io=op_dtype(policy, "batch_norm") or conv,
+            bn_stats=op_dtype(policy, "batch_norm") or policy.bn_dtype,
+            ln_io=op_dtype(policy, "layer_norm") or conv,
+            softmax=op_dtype(policy, "softmax") or conv,
+            param=policy.param_dtype)
+    half_everything = policy.opt_level == "O3"
+    return ModuleDtypes(
+        compute=policy.compute_dtype,
+        bn_io=policy.compute_dtype,
+        bn_stats=policy.bn_dtype,
+        ln_io=policy.compute_dtype,
+        softmax=(policy.compute_dtype if half_everything else f32),
+        param=policy.param_dtype)

@@ -1,0 +1,71 @@
+"""amp op-classification lists + registration API.
+
+Reference: apex/amp/lists/{functional,torch,tensor}_overrides.py (SURVEY.md
+§3.1) — the whitelist (run in half: conv/mm/addmm...), blacklist (run in
+fp32: softmax/log/exp/norm/loss...), and promote list (mixed-input ops take
+the widest input dtype), consumed by the O1 monkey-patcher, plus the
+``amp.register_{half,float,promote}_function`` extension points.
+
+TPU-native restatement: JAX has no torch-function interception point, so the
+lists are keyed by *op-class names* that the framework's modules consult at
+call-site boundaries (amp/autocast.py).  The registration API mutates the
+same tables, so user extensions work the way apex's do — the delta (module
+boundary granularity, not individual tensor-method patching) is documented
+in amp/policy.py.
+"""
+
+from __future__ import annotations
+
+# Run in the half compute dtype (MXU ops — where the FLOPs are).
+FP16_FUNCS = {
+    "conv", "conv1d", "conv2d", "conv3d", "conv_transpose",
+    "dense", "linear", "matmul", "mm", "bmm", "addmm", "einsum",
+    "attention_scores", "attention_context", "embedding",
+}
+
+# Run in fp32 (numerically sensitive: large reductions, exp/log families,
+# losses, normalization statistics).
+FP32_FUNCS = {
+    "softmax", "log_softmax", "batch_norm", "sync_batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "cross_entropy", "nll_loss", "mse_loss",
+    "exp", "log", "pow", "sum", "mean", "var", "std", "norm", "cumsum",
+    "softplus", "sigmoid_focal_loss", "gelu_fp32",
+}
+
+# Mixed-dtype inputs are promoted to the widest participating dtype.
+PROMOTE_FUNCS = {
+    "add", "sub", "mul", "div", "addcmul", "addcdiv", "cat", "stack",
+    "where", "residual_add",
+}
+
+
+def register_half_function(name: str) -> None:
+    """apex parity: ``amp.register_half_function(module, fn_name)`` — adds an
+    op class to the whitelist (string-keyed here; there is no module object
+    to patch)."""
+    _move(name, FP16_FUNCS)
+
+
+def register_float_function(name: str) -> None:
+    _move(name, FP32_FUNCS)
+
+
+def register_promote_function(name: str) -> None:
+    _move(name, PROMOTE_FUNCS)
+
+
+def _move(name: str, target: set) -> None:
+    for s in (FP16_FUNCS, FP32_FUNCS, PROMOTE_FUNCS):
+        s.discard(name)
+    target.add(name)
+
+
+def classify(name: str) -> str:
+    """'half' | 'float' | 'promote' | 'none' for an op-class name."""
+    if name in FP16_FUNCS:
+        return "half"
+    if name in FP32_FUNCS:
+        return "float"
+    if name in PROMOTE_FUNCS:
+        return "promote"
+    return "none"

@@ -50,11 +50,16 @@ class Policy:
         => dynamic loss scaling (scaler.py).
       cast_at_call_sites: O1's per-op white/blacklist semantics.  JAX has no
         torch-function interception point; the honest equivalent is
-        boundary-level casting — compute-heavy modules (conv/dense/attention)
-        run in ``compute_dtype`` while numerically sensitive ops (softmax,
-        norms, losses) run fp32.  Our models implement exactly that split when
-        this flag is set, and the semantic delta vs per-call patching is
-        documented here rather than hidden.
+        boundary-level casting driven by the op-classification tables in
+        amp/lists.py.  When this flag is set, ``amp.module_dtypes(policy)``
+        resolves each op class through those tables (whitelist → half,
+        blacklist → fp32, promote → widest input) and the builders thread
+        the results into model construction — so under O1 convs/dense run
+        half while batch_norm/layer_norm/softmax run wholly fp32, unlike O2
+        (whole model half, only norm *stats* fp32).  The semantic delta vs
+        per-call monkey-patching (module-boundary granularity) is documented
+        here rather than hidden; tests/test_amp.py pins the behavioral
+        differences between O1, O2 and O3.
     """
 
     opt_level: str

@@ -39,6 +39,12 @@ class ScalerState:
     growth_interval: int = struct.field(pytree_node=False, default=2000)
     growth_factor: float = struct.field(pytree_node=False, default=2.0)
     backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+    # Static fact "scale is exactly 1.0 forever" (bf16 O1/O2 default).  The
+    # scale held in the state is a *traced* array, so without this flag the
+    # no-op unscale multiply stays in the compiled step as a full read+write
+    # of every grad (XLA cannot constant-fold a dynamic scalar into the
+    # opaque Pallas optimizer kernels that consume the grads).
+    identity: bool = struct.field(pytree_node=False, default=False)
 
 
 def make_scaler(policy: Policy,
@@ -48,13 +54,16 @@ def make_scaler(policy: Policy,
         return ScalerState(scale=jnp.asarray(init_scale, jnp.float32),
                            growth_counter=jnp.asarray(0, jnp.int32),
                            dynamic=True, growth_interval=growth_interval)
-    return ScalerState(scale=jnp.asarray(policy.static_scale, jnp.float32),
+    static = policy.static_scale
+    return ScalerState(scale=jnp.asarray(static, jnp.float32),
                        growth_counter=jnp.asarray(0, jnp.int32),
-                       dynamic=False)
+                       dynamic=False, identity=(static == 1.0))
 
 
 def scale_loss(loss: jnp.ndarray, scaler: ScalerState) -> jnp.ndarray:
     """``with amp.scale_loss(loss, opt) as scaled_loss`` — the enter half."""
+    if scaler.identity:
+        return loss
     return loss * scaler.scale.astype(loss.dtype)
 
 
@@ -71,11 +80,15 @@ def unscale_grads(grads: Any, scaler: ScalerState
                   ) -> Tuple[Any, jnp.ndarray]:
     """The ``scale_loss.__exit__`` half: grads /= scale, inf/nan check.
 
-    Returns (unscaled_grads, grads_finite).  For a static scale of exactly 1.0
-    the multiply still appears in the trace but XLA folds it away; the finite
-    check is only materialized for dynamic scalers (callers gate on
-    ``scaler.dynamic``).
+    Returns (unscaled_grads, grads_finite).  When the scaler is statically
+    known to be the identity (bf16 O1/O2: static scale 1.0) the whole pass is
+    elided — the multiply would otherwise survive compilation as a full HBM
+    read+write of every grad, because the traced scale defeats constant
+    folding (see ScalerState.identity).  The finite check is only
+    materialized for dynamic scalers (callers gate on ``scaler.dynamic``).
     """
+    if scaler.identity and not scaler.dynamic:
+        return grads, jnp.asarray(True)
     inv = (1.0 / scaler.scale)
     grads = jax.tree_util.tree_map(
         lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
@@ -117,6 +130,10 @@ def state_dict(scaler: ScalerState) -> dict:
 
 
 def load_state_dict(scaler: ScalerState, d: dict) -> ScalerState:
+    scale = float(d["scale"])
     return scaler.replace(
-        scale=jnp.asarray(d["scale"], jnp.float32),
-        growth_counter=jnp.asarray(d["growth_counter"], jnp.int32))
+        scale=jnp.asarray(scale, jnp.float32),
+        growth_counter=jnp.asarray(d["growth_counter"], jnp.int32),
+        # Re-derive the static identity fact from the loaded value: a resumed
+        # static scaler may carry a different scale than the fresh policy.
+        identity=(not scaler.dynamic and scale == 1.0))

@@ -102,6 +102,8 @@ def flatten_f32(arrays: Sequence[np.ndarray]) -> np.ndarray:
     """
     lib = _load()
     arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+    if not arrays:      # keep native and numpy paths consistent
+        return np.empty(0, np.float32)
     sizes = np.asarray([a.size for a in arrays], np.int64)
     out = np.empty(int(sizes.sum()), np.float32)
     if lib is None:        # pure-numpy fallback
@@ -189,7 +191,8 @@ class NativePrefetcher:
     def __init__(self, batch: int, image_size: int, num_classes: int,
                  channels: int = 3, seed: int = 0, start_index: int = 0,
                  mean: Optional[Sequence[float]] = None,
-                 std: Optional[Sequence[float]] = None):
+                 std: Optional[Sequence[float]] = None,
+                 copy: bool = True):
         lib = _load()
         if lib is None:
             raise RuntimeError("native host runtime unavailable "
@@ -208,6 +211,7 @@ class NativePrefetcher:
         self._img = np.empty((batch, image_size, image_size, channels),
                              np.float32)
         self._lab = np.empty((batch,), np.int32)
+        self._copy = copy
         self._h = lib.apex_prefetcher_new(
             batch, image_size * image_size, channels, num_classes, seed,
             _fptr(mean), _fptr(std), start_index)
@@ -216,15 +220,29 @@ class NativePrefetcher:
         return self
 
     def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Returns (images, labels) VIEWS valid until the next ``next()``
-        call (the underlying buffers are reused; ``jnp.asarray``/device_put
-        them before pulling another batch)."""
+        """Returns (images, labels): fresh arrays by default.
+
+        With ``copy=False`` the returned arrays are VIEWS of internal
+        buffers valid only until the next ``next()`` call.  That mode is
+        unsafe to hand to JAX: on the CPU backend ``jnp.asarray``
+        zero-copy-aliases large aligned numpy buffers and dispatch is
+        async, so reusing the buffer can corrupt a still-pending step.
+        Only use it when the consumer synchronously memcpys the data.
+        """
         if self._h is None:
             raise StopIteration
+        if self._copy:
+            # Fresh output buffers per call: the native producer writes
+            # straight into them, so fresh-array semantics cost no extra
+            # host pass (vs fill-then-copy).
+            img = np.empty_like(self._img)
+            lab = np.empty_like(self._lab)
+        else:
+            img, lab = self._img, self._lab
         self._lib.apex_prefetcher_next(
-            self._h, _fptr(self._img),
-            self._lab.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
-        return self._img, self._lab
+            self._h, _fptr(img),
+            lab.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return img, lab
 
     def close(self):
         if self._h is not None:

@@ -32,6 +32,9 @@ class BertSelfAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    # softmax is blacklisted under O0–O2 (fp32); O3 runs it half.  Resolved
+    # by amp/autocast.module_dtypes and threaded in by the builder.
+    softmax_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -44,11 +47,15 @@ class BertSelfAttention(nn.Module):
         q = dense("query")(x).reshape(*x.shape[:-1], h, hd)
         k = dense("key")(x).reshape(*x.shape[:-1], h, hd)
         v = dense("value")(x).reshape(*x.shape[:-1], h, hd)
-        # Attention scores in fp32 (softmax is a blacklist op).
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        logits = logits / jnp.sqrt(hd).astype(jnp.float32)
+        sd = self.softmax_dtype
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(sd)
+        logits = logits / jnp.sqrt(hd).astype(sd)
         if mask_bias is not None:
-            logits = logits + mask_bias
+            # Clamp before the cast: -1e9 overflows to -inf in fp16 and a
+            # fully-masked row would softmax to NaN (cf. transformer_xl's
+            # mask fill).  -1e4 is "minus infinity enough" for half dtypes.
+            neg = -1e9 if sd == jnp.float32 else -1e4
+            logits = logits + jnp.maximum(mask_bias, neg).astype(sd)
         probs = nn.softmax(logits, axis=-1).astype(self.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         ctx = ctx.reshape(*x.shape[:-1], d)
@@ -61,22 +68,30 @@ class BertLayer(nn.Module):
     intermediate_size: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    ln_dtype: Optional[jnp.dtype] = None     # LN I/O; None follows dtype
+    softmax_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask_bias):
+        # LN I/O dtype per the op classification (O1: fp32; O2/O3: half
+        # I/O).  The Pallas kernel computes its statistics in fp32
+        # regardless, so half I/O loses no precision in the moments — the
+        # MixedFusedLayerNorm contract.
+        ln_io = self.ln_dtype or self.dtype
         attn = BertSelfAttention(self.hidden_size, self.num_heads,
                                  self.dtype, self.param_dtype,
+                                 self.softmax_dtype,
                                  name="attention")(x, mask_bias)
-        x = FusedLayerNorm(dtype=self.dtype, name="attention_ln")(
-            (x + attn).astype(jnp.float32))
+        x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
+            (x + attn).astype(ln_io))
         x = x.astype(self.dtype)
         y = nn.Dense(self.intermediate_size, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="intermediate")(x)
         y = nn.gelu(y, approximate=False)
         y = nn.Dense(self.hidden_size, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="output")(y)
-        x = FusedLayerNorm(dtype=self.dtype, name="output_ln")(
-            (x + y).astype(jnp.float32))
+        x = FusedLayerNorm(dtype=ln_io, name="output_ln")(
+            (x + y).astype(ln_io))
         return x.astype(self.dtype)
 
 
@@ -91,11 +106,14 @@ class BertForMaskedLM(nn.Module):
     max_position: int = 512
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    ln_dtype: Optional[jnp.dtype] = None
+    softmax_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
                  train: bool = True):
         del train  # no dropout in the pretraining benchmark path
+        ln_io = self.ln_dtype or self.dtype
         b, L = input_ids.shape
         word_emb = nn.Embed(self.vocab_size, self.hidden_size,
                             dtype=self.dtype, param_dtype=self.param_dtype,
@@ -105,8 +123,8 @@ class BertForMaskedLM(nn.Module):
         x = x + nn.Embed(self.max_position, self.hidden_size,
                          dtype=self.dtype, param_dtype=self.param_dtype,
                          name="position_embeddings")(pos)
-        x = FusedLayerNorm(dtype=self.dtype, name="embeddings_ln")(
-            x.astype(jnp.float32)).astype(self.dtype)
+        x = FusedLayerNorm(dtype=ln_io, name="embeddings_ln")(
+            x.astype(ln_io)).astype(self.dtype)
 
         if attention_mask is not None:
             mask_bias = jnp.where(attention_mask[:, None, None, :] > 0,
@@ -117,14 +135,16 @@ class BertForMaskedLM(nn.Module):
         for i in range(self.num_layers):
             x = BertLayer(self.hidden_size, self.num_heads,
                           self.intermediate_size, self.dtype,
-                          self.param_dtype, name=f"layer_{i}")(x, mask_bias)
+                          self.param_dtype, self.ln_dtype,
+                          self.softmax_dtype,
+                          name=f"layer_{i}")(x, mask_bias)
 
         # MLM head: dense+gelu+LN, then tied decoder.
         x = nn.Dense(self.hidden_size, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="mlm_dense")(x)
         x = nn.gelu(x, approximate=False)
-        x = FusedLayerNorm(dtype=self.dtype, name="mlm_ln")(
-            x.astype(jnp.float32)).astype(self.dtype)
+        x = FusedLayerNorm(dtype=ln_io, name="mlm_ln")(
+            x.astype(ln_io)).astype(self.dtype)
         logits = word_emb.attend(x)
         logits = logits + self.param("mlm_bias", nn.initializers.zeros,
                                      (self.vocab_size,), jnp.float32)

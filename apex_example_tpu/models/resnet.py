@@ -123,6 +123,10 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.float32          # compute dtype (policy)
     param_dtype: jnp.dtype = jnp.float32
     bn_dtype: Optional[jnp.dtype] = None    # None: follow dtype (O3)
+    # BN input/output dtype; None follows ``dtype``.  O1 (op-classification:
+    # batch_norm is blacklisted) sets this to fp32 so the norm runs wholly in
+    # fp32 while convs stay half — see amp/autocast.module_dtypes.
+    bn_io_dtype: Optional[jnp.dtype] = None
     bn_axis_name: Optional[str] = None      # "data" => SyncBatchNorm
     bn_momentum: float = 0.1
     small_stem: bool = False                # CIFAR-style 3x3 stem (optional)
@@ -146,8 +150,9 @@ class ResNet(nn.Module):
             epsilon=1e-5,
             # I/O in the compute dtype (fuses with the bf16 conv chain);
             # moments/normalization in bn_dtype — keep_batchnorm_fp32 the
-            # way the reference's cuDNN path actually does it.
-            dtype=self.dtype,
+            # way the reference's cuDNN path actually does it.  Under O1
+            # bn_io_dtype=fp32 blacklists the whole op instead.
+            dtype=self.bn_io_dtype or self.dtype,
             stats_dtype=self.bn_dtype or self.dtype,
             param_dtype=jnp.float32)
 

@@ -47,6 +47,7 @@ class RelMultiHeadAttn(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32   # blacklist op; O3 runs it half
 
     @nn.compact
     def __call__(self, x, mem, pos_emb):
@@ -82,13 +83,15 @@ class RelMultiHeadAttn(nn.Module):
         ac = jnp.einsum("bqhd,bkhd->bhqk", q + u, k)
         bd = jnp.einsum("bqhd,khd->bhqk", q + w, r)
         bd = rel_shift(bd)
-        logits = (ac + bd).astype(jnp.float32) / jnp.sqrt(hd)
+        sd = self.softmax_dtype
+        logits = (ac + bd).astype(sd) / jnp.asarray(jnp.sqrt(hd), sd)
 
         # causal mask with memory: query i attends keys [0 .. mlen+i]
         qi = jnp.arange(qlen)[:, None]
         kj = jnp.arange(klen)[None, :]
         causal = kj <= (qi + mlen)
-        logits = jnp.where(causal[None, None], logits, -1e30)
+        neg = jnp.asarray(-1e30 if sd == jnp.float32 else -1e4, sd)
+        logits = jnp.where(causal[None, None], logits, neg)
 
         probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, qlen, d)
@@ -102,20 +105,24 @@ class TXLLayer(nn.Module):
     d_inner: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    ln_dtype: Optional[jnp.dtype] = None     # LN I/O; None follows dtype
+    softmax_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mem, pos_emb):
+        ln_io = self.ln_dtype or self.dtype
         a = RelMultiHeadAttn(self.d_model, self.num_heads, self.dtype,
-                             self.param_dtype, name="attn")(x, mem, pos_emb)
-        x = FusedLayerNorm(dtype=self.dtype, name="attn_ln")(
-            (x + a).astype(jnp.float32)).astype(self.dtype)
+                             self.param_dtype, self.softmax_dtype,
+                             name="attn")(x, mem, pos_emb)
+        x = FusedLayerNorm(dtype=ln_io, name="attn_ln")(
+            (x + a).astype(ln_io)).astype(self.dtype)
         y = nn.Dense(self.d_inner, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="ff1")(x)
         y = nn.relu(y)
         y = nn.Dense(self.d_model, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="ff2")(y)
-        x = FusedLayerNorm(dtype=self.dtype, name="ff_ln")(
-            (x + y).astype(jnp.float32)).astype(self.dtype)
+        x = FusedLayerNorm(dtype=ln_io, name="ff_ln")(
+            (x + y).astype(ln_io)).astype(self.dtype)
         return x
 
 
@@ -136,6 +143,8 @@ class TransformerXL(nn.Module):
     clamp_len: int = 1000
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    ln_dtype: Optional[jnp.dtype] = None
+    softmax_dtype: jnp.dtype = jnp.float32
 
     def init_mems(self, batch_size: int) -> jnp.ndarray:
         return jnp.zeros((self.num_layers, batch_size, self.mem_len,
@@ -173,7 +182,8 @@ class TransformerXL(nn.Module):
             cat = jnp.concatenate([mems[i], x], axis=1)
             new_mems.append(jax.lax.stop_gradient(cat[:, -self.mem_len:]))
             x = TXLLayer(self.d_model, self.num_heads, self.d_inner,
-                         self.dtype, self.param_dtype,
+                         self.dtype, self.param_dtype, self.ln_dtype,
+                         self.softmax_dtype,
                          name=f"layer_{i}")(x, mems[i], pos_emb)
 
         logits = emb.attend(x).astype(jnp.float32)

@@ -1,0 +1,103 @@
+"""Learning-rate schedules for the harness (SURVEY.md §3.5: step-decay
+``adjust_learning_rate`` with warmup; §7 item C4: BERT/LAMB warmup).
+
+The reference adjusts ``param_group['lr']`` host-side per epoch; here a
+schedule is a pure function ``f(step) -> lr`` fed to the fused optimizers'
+callable-lr path (optim/fused.py ``_lr_at``), so the learning rate is a
+traced scalar and one compiled step serves the whole run.
+
+All schedules compose linear warmup (0 → base over ``warmup_steps``) with a
+decay phase and are exact ``jnp`` expressions of the step counter — no
+Python control flow, jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _warmup_factor(step: jnp.ndarray, warmup_steps: int) -> jnp.ndarray:
+    """Linear 0→1 over warmup_steps; 1 afterwards.  ``step`` is 1-based (the
+    fused optimizers call the schedule with the post-increment count)."""
+    if warmup_steps <= 0:
+        return jnp.asarray(1.0, jnp.float32)
+    s = step.astype(jnp.float32)
+    return jnp.minimum(s / float(warmup_steps), 1.0)
+
+
+def constant_lr(base_lr: float, warmup_steps: int = 0) -> Schedule:
+    def f(step):
+        return base_lr * _warmup_factor(step, warmup_steps)
+    return f
+
+
+def step_decay(base_lr: float, boundaries: Sequence[int],
+               gamma: float = 0.1, warmup_steps: int = 0) -> Schedule:
+    """lr = base · gamma^(#boundaries passed) — the reference harness's
+    ``adjust_learning_rate`` (epoch//30 decades), expressed in steps."""
+    bounds = jnp.asarray(sorted(int(b) for b in boundaries), jnp.int32)
+
+    def f(step):
+        passed = jnp.sum((step >= bounds).astype(jnp.int32))
+        return (base_lr * jnp.power(gamma, passed.astype(jnp.float32))
+                * _warmup_factor(step, warmup_steps))
+    return f
+
+
+def cosine_decay(base_lr: float, total_steps: int, warmup_steps: int = 0,
+                 min_lr: float = 0.0) -> Schedule:
+    """Cosine from base to min_lr over [warmup_steps, total_steps]."""
+    span = max(total_steps - warmup_steps, 1)
+
+    def f(step):
+        s = jnp.clip(step.astype(jnp.float32) - warmup_steps, 0.0,
+                     float(span))
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * s / span))
+        return ((min_lr + (base_lr - min_lr) * cos)
+                * _warmup_factor(step, warmup_steps))
+    return f
+
+
+def polynomial_decay(base_lr: float, total_steps: int, warmup_steps: int = 0,
+                     power: float = 1.0, min_lr: float = 0.0) -> Schedule:
+    """Linear (power=1) / polynomial decay — the BERT/LAMB pretraining
+    schedule (warmup then linear to 0)."""
+    span = max(total_steps - warmup_steps, 1)
+
+    def f(step):
+        s = jnp.clip(step.astype(jnp.float32) - warmup_steps, 0.0,
+                     float(span))
+        frac = jnp.power(1.0 - s / span, power)
+        return ((min_lr + (base_lr - min_lr) * frac)
+                * _warmup_factor(step, warmup_steps))
+    return f
+
+
+def build_schedule(name: str, base_lr: float, total_steps: int,
+                   warmup_steps: int = 0,
+                   boundaries: Sequence[int] = (),
+                   gamma: float = 0.1, power: float = 1.0,
+                   min_lr: float = 0.0):
+    """CLI-facing factory.  ``name`` in {const, step, cosine, poly}.
+    Returns a float (not a closure) for warmup-free const so optimizers
+    keep their static-lr fast path."""
+    if name == "const":
+        if warmup_steps <= 0:
+            return base_lr
+        return constant_lr(base_lr, warmup_steps)
+    if name == "step":
+        if not boundaries:
+            # Reference default: decade drops at 1/3 and 2/3 of the run
+            # (the epoch//30-of-90 recipe, expressed fractionally).
+            boundaries = [total_steps // 3, 2 * total_steps // 3]
+        return step_decay(base_lr, boundaries, gamma, warmup_steps)
+    if name == "cosine":
+        return cosine_decay(base_lr, total_steps, warmup_steps, min_lr)
+    if name == "poly":
+        return polynomial_decay(base_lr, total_steps, warmup_steps, power,
+                                min_lr)
+    raise ValueError(f"unknown schedule {name!r}")

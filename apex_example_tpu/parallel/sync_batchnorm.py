@@ -80,27 +80,34 @@ class SyncBatchNorm(nn.Module):
             # Local moments, one pass: (Σx, Σx²) in a single fused read —
             # the two-pass Welford form re-reads x after the mean (a full
             # HBM pass per BN layer); cuDNN's spatial BN uses the same
-            # single-pass E[x²] formulation.
+            # single-pass E[x²] formulation.  The pass is centered by the
+            # running mean (a per-channel constant, identical on every
+            # replica): shifted moments are exact for any constant shift,
+            # and with c tracking the batch mean the Σ(x−c)² accumulation
+            # no longer cancels catastrophically when |mean| ≫ std.
             n_local = 1
             for a in reduce_axes:
                 n_local *= x.shape[a]
-            local_sum = jnp.sum(xf, axis=reduce_axes)
-            local_sumsq = jnp.sum(jnp.square(xf), axis=reduce_axes)
-            local_mean = local_sum / n_local
-            local_m2 = local_sumsq - jnp.square(local_mean) * n_local
+            c = ra_mean.value.astype(jnp.float32)
+            xc = xf - c
+            local_sum = jnp.sum(xc, axis=reduce_axes)
+            local_sumsq = jnp.sum(jnp.square(xc), axis=reduce_axes)
+            local_mean_c = local_sum / n_local          # E[x] − c, locally
+            local_m2 = local_sumsq - jnp.square(local_mean_c) * n_local
 
             if self.axis_name is not None:
                 # Cross-replica Welford merge (reference: syncbn allreduce of
                 # (count, mean, M2); here two psums over the mesh axis).
                 world = lax.axis_size(self.axis_name)
                 n = n_local * world
-                mean = lax.psum(local_sum, self.axis_name) / n
+                mean_c = lax.psum(local_sum, self.axis_name) / n
                 m2 = lax.psum(
-                    local_m2 + n_local * jnp.square(local_mean - mean),
+                    local_m2 + n_local * jnp.square(local_mean_c - mean_c),
                     self.axis_name)
             else:
                 n = n_local
-                mean, m2 = local_mean, local_m2
+                mean_c, m2 = local_mean_c, local_m2
+            mean = c + mean_c
             # E[x²]−E[x]² can go fractionally negative under cancellation.
             var = jnp.maximum(m2 / n, 0.0)
 

@@ -129,3 +129,75 @@ def test_initialize_frontend():
     assert policy.opt_level == "O2"
     assert scaler.dynamic
     assert float(scaler.scale) == 1024.0
+
+
+class TestOpClassification:
+    """The O1 engine: white/blacklist tables → per-boundary dtypes
+    (reference: apex/amp/lists + wrap.py; SURVEY.md §3.1).  These tests pin
+    the BEHAVIORAL differences between O1, O2 and O3."""
+
+    def test_module_dtypes_table(self):
+        o1 = amp.module_dtypes(amp.get_policy("O1"))
+        o2 = amp.module_dtypes(amp.get_policy("O2"))
+        o3 = amp.module_dtypes(amp.get_policy("O3"))
+        # whitelist (conv/dense): half under all of O1/O2/O3
+        assert o1.compute == o2.compute == o3.compute == jnp.bfloat16
+        # blacklist (batch_norm): O1 runs it WHOLLY fp32 (I/O included);
+        # O2 keeps only the stats fp32; O3 is pure half.
+        assert o1.bn_io == jnp.float32
+        assert o2.bn_io == jnp.bfloat16
+        assert o3.bn_io == jnp.bfloat16
+        assert o2.bn_stats == jnp.float32
+        assert o3.bn_stats == jnp.bfloat16
+        # blacklist (softmax): fp32 under O1/O2, half under O3.
+        assert o1.softmax == jnp.float32
+        assert o2.softmax == jnp.float32
+        assert o3.softmax == jnp.bfloat16
+
+    def test_op_dtype_only_active_under_o1(self):
+        o1, o2 = amp.get_policy("O1"), amp.get_policy("O2")
+        assert amp.op_dtype(o1, "conv") == jnp.bfloat16
+        assert amp.op_dtype(o1, "softmax") == jnp.float32
+        assert amp.op_dtype(o2, "conv") is None   # O2 casts at model build
+        # promote: widest participating dtype
+        assert amp.op_dtype(o1, "add", jnp.bfloat16, jnp.float32) \
+            == jnp.float32
+
+    def test_cast_args(self):
+        o1 = amp.get_policy("O1")
+        x = jnp.ones((4,), jnp.float32)
+        assert amp.cast_args(o1, "dense", x).dtype == jnp.bfloat16
+        a, b = amp.cast_args(o1, "add", x.astype(jnp.bfloat16), x)
+        assert a.dtype == b.dtype == jnp.float32
+
+    def test_register_functions_move_ops(self):
+        from apex_example_tpu.amp import lists
+        o1 = amp.get_policy("O1")
+        assert amp.op_dtype(o1, "softmax") == jnp.float32
+        amp.register_half_function("softmax")
+        try:
+            assert amp.op_dtype(o1, "softmax") == jnp.bfloat16
+        finally:
+            amp.register_float_function("softmax")
+        assert amp.op_dtype(o1, "softmax") == jnp.float32
+        assert "softmax" in lists.FP32_FUNCS
+
+    def test_o1_vs_o2_bn_io_in_model(self):
+        """A blacklisted op (batch_norm) runs fp32 under O1 but half under
+        O2/O3 in an actual model forward (capture_intermediates)."""
+        from apex_example_tpu.models.resnet import BasicBlock, ResNet
+        x = jnp.zeros((2, 8, 8, 3), jnp.float32)
+        outs = {}
+        for lvl in ("O1", "O2", "O3"):
+            md = amp.module_dtypes(amp.get_policy(lvl))
+            m = ResNet(stage_sizes=[1], block_cls=BasicBlock, num_classes=4,
+                       num_filters=8, small_stem=True, dtype=md.compute,
+                       param_dtype=md.param, bn_dtype=md.bn_stats,
+                       bn_io_dtype=md.bn_io)
+            v = m.init(jax.random.PRNGKey(0), x, train=False)
+            _, inter = m.apply(v, x, train=False,
+                               capture_intermediates=True)
+            outs[lvl] = inter["intermediates"]["bn_init"]["__call__"][0]
+        assert outs["O1"].dtype == jnp.float32
+        assert outs["O2"].dtype == jnp.bfloat16
+        assert outs["O3"].dtype == jnp.bfloat16

@@ -1,0 +1,68 @@
+"""LR schedules (SURVEY.md §3.5 adjust_learning_rate + warmup)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_example_tpu.optim import (FusedSGD, build_schedule, constant_lr,
+                                    cosine_decay, polynomial_decay,
+                                    step_decay)
+
+
+def _at(f, s):
+    return float(f(jnp.asarray(s, jnp.int32)))
+
+
+def test_warmup_ramp():
+    f = constant_lr(1.0, warmup_steps=10)
+    assert _at(f, 1) == pytest.approx(0.1)
+    assert _at(f, 5) == pytest.approx(0.5)
+    assert _at(f, 10) == pytest.approx(1.0)
+    assert _at(f, 500) == pytest.approx(1.0)
+
+
+def test_step_decay_boundaries():
+    f = step_decay(1.0, boundaries=[30, 60], gamma=0.1)
+    assert _at(f, 29) == pytest.approx(1.0)
+    assert _at(f, 30) == pytest.approx(0.1)
+    assert _at(f, 59) == pytest.approx(0.1)
+    assert _at(f, 60) == pytest.approx(0.01, rel=1e-5)
+
+
+def test_cosine_endpoints():
+    f = cosine_decay(1.0, total_steps=100, warmup_steps=10, min_lr=0.05)
+    assert _at(f, 10) == pytest.approx(1.0)
+    mid = _at(f, 55)
+    assert 0.05 < mid < 1.0
+    assert _at(f, 100) == pytest.approx(0.05)
+    assert _at(f, 200) == pytest.approx(0.05)   # clamped past the end
+
+
+def test_poly_linear():
+    f = polynomial_decay(1.0, total_steps=110, warmup_steps=10, power=1.0)
+    assert _at(f, 10) == pytest.approx(1.0)
+    assert _at(f, 60) == pytest.approx(0.5)
+    assert _at(f, 110) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_build_schedule_const_fast_path():
+    assert build_schedule("const", 0.3, 100) == pytest.approx(0.3)
+    f = build_schedule("step", 1.0, 90)   # default boundaries at 30/60
+    assert _at(f, 29) == pytest.approx(1.0)
+    assert _at(f, 31) == pytest.approx(0.1)
+
+
+def test_fused_sgd_consumes_schedule():
+    """The optimizer's callable-lr path: updates shrink as the schedule
+    decays (SGD no-momentum: Δp = lr·g)."""
+    f = step_decay(1.0, boundaries=[2], gamma=0.1)
+    opt = FusedSGD(lr=f, momentum=0.0)
+    p = {"w": jnp.ones((4,), jnp.float32)}
+    g = {"w": jnp.ones((4,), jnp.float32)}
+    s = opt.init(p)
+    p1, s = opt.apply(g, s, p)     # step 1: lr 1.0
+    p2, s = opt.apply(g, s, p1)    # step 2: lr 0.1
+    d1 = float(jnp.abs(p1["w"] - p["w"]).mean())
+    d2 = float(jnp.abs(p2["w"] - p1["w"]).mean())
+    np.testing.assert_allclose(d1, 1.0, rtol=1e-6)
+    np.testing.assert_allclose(d2, 0.1, rtol=1e-5)

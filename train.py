@@ -38,7 +38,8 @@ from apex_example_tpu.models import ARCHS
 from apex_example_tpu.models.bert import bert_base, bert_tiny
 from apex_example_tpu.models.transformer_xl import (transformer_xl_base,
                                                     transformer_xl_tiny)
-from apex_example_tpu.optim import FusedAdam, FusedLAMB, FusedSGD
+from apex_example_tpu.optim import (FusedAdam, FusedLAMB, FusedSGD,
+                                    build_schedule)
 from apex_example_tpu.parallel import DDPConfig, make_data_mesh
 from apex_example_tpu.utils import AverageMeter, Throughput
 from apex_example_tpu.utils.checkpoint import CheckpointManager
@@ -63,6 +64,16 @@ def parse_args(argv=None):
     p.add_argument("--batch-size", "-b", type=int, default=256,
                    help="global batch size (split across devices)")
     p.add_argument("--lr", type=float, default=0.1)
+    # LR schedule (reference harness: step-decay adjust_learning_rate with
+    # warmup; BERT/LAMB uses warmup+poly — SURVEY.md §3.5, §7)
+    p.add_argument("--lr-schedule", default="const",
+                   choices=["const", "step", "cosine", "poly"])
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--lr-decay-epochs", default="",
+                   help='comma epochs for step decay, e.g. "30,60,90" '
+                        "(default: 1/3 and 2/3 of the run)")
+    p.add_argument("--lr-gamma", type=float, default=0.1)
+    p.add_argument("--lr-min", type=float, default=0.0)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", "--wd", type=float, default=1e-4)
     p.add_argument("--opt", default="sgd", choices=["sgd", "adam", "lamb"])
@@ -108,13 +119,26 @@ def select_devices(args):
     return devices
 
 
+def build_lr(args):
+    """Float or traced schedule f(step), fed to the fused optimizers'
+    callable-lr path."""
+    total = args.epochs * args.steps_per_epoch
+    boundaries = [int(e) * args.steps_per_epoch
+                  for e in args.lr_decay_epochs.split(",") if e]
+    return build_schedule(args.lr_schedule, args.lr, total,
+                          warmup_steps=args.warmup_steps,
+                          boundaries=boundaries, gamma=args.lr_gamma,
+                          min_lr=args.lr_min)
+
+
 def build_optimizer(args):
+    lr = build_lr(args)
     if args.opt == "sgd":
-        return FusedSGD(lr=args.lr, momentum=args.momentum,
+        return FusedSGD(lr=lr, momentum=args.momentum,
                         weight_decay=args.weight_decay)
     if args.opt == "adam":
-        return FusedAdam(lr=args.lr, weight_decay=args.weight_decay)
-    return FusedLAMB(lr=args.lr, weight_decay=args.weight_decay)
+        return FusedAdam(lr=lr, weight_decay=args.weight_decay)
+    return FusedLAMB(lr=lr, weight_decay=args.weight_decay)
 
 
 def main(argv=None):
@@ -123,17 +147,25 @@ def main(argv=None):
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
     if args.arch in LM_ARCHS:
+        if args.host_pipeline:
+            raise SystemExit("--host-pipeline is only wired for the image "
+                             "workloads; LM archs use on-device token "
+                             "generators")
         return lm_main(args, policy, scaler)
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
     devices = select_devices(args)
     n_dev = len(devices)
 
+    # Per-op-class dtypes from the policy + white/blacklist tables (O1's
+    # call-site classification; O0/O2/O3 collapse to the uniform table).
+    md = amp.module_dtypes(policy)
     model = ARCHS[args.arch](
         num_classes=spec["num_classes"],
-        dtype=policy.compute_dtype,
-        param_dtype=policy.param_dtype,
-        bn_dtype=policy.bn_dtype,
+        dtype=md.compute,
+        param_dtype=md.param,
+        bn_dtype=md.bn_stats,
+        bn_io_dtype=md.bn_io,
         bn_axis_name="data" if (args.sync_bn and n_dev > 1) else None)
 
     optimizer = build_optimizer(args)
@@ -179,7 +211,7 @@ def main(argv=None):
         jax.profiler.start_trace("/tmp/apex_tpu_trace")
 
     global_step = int(state.step)
-    prefetcher = eval_prefetcher = None
+    prefetcher = None
     if args.host_pipeline:
         # Created AFTER resume so the native stream continues at the exact
         # batch index training stopped at (start_index); the eval stream
@@ -190,15 +222,20 @@ def main(argv=None):
             num_classes=spec["num_classes"], channels=spec["channels"],
             seed=args.seed, start_index=start)
         prefetcher = mk(global_step)
-        if args.eval:
-            eval_prefetcher = mk(10_000_000 + start_epoch)
 
         def batch_fn(i):
             images, labels = next(prefetcher)
             return jnp.asarray(images), jnp.asarray(labels)
 
         def eval_batch_fn(i):
-            images, labels = next(eval_prefetcher)
+            # Deterministic in the batch index alone (a fresh stream per
+            # call), so fresh and resumed runs evaluate identical batches —
+            # the same contract as the on-device batch_fn(10_000 + epoch).
+            pf = mk(10_000_000 + i)
+            try:
+                images, labels = next(pf)
+            finally:
+                pf.close()
             return jnp.asarray(images), jnp.asarray(labels)
     else:
         eval_batch_fn = batch_fn
@@ -229,9 +266,8 @@ def main(argv=None):
                 mgr.save(state)
                 print(f"saved checkpoint at step {int(state.step)}")
     finally:
-        for pf in (prefetcher, eval_prefetcher):
-            if pf is not None:
-                pf.close()
+        if prefetcher is not None:
+            prefetcher.close()
 
     if args.prof:
         jax.profiler.stop_trace()
@@ -247,7 +283,9 @@ def lm_main(args, policy, scaler):
     builder = {"bert_base": bert_base, "bert_tiny": bert_tiny,
                "transformer_xl": transformer_xl_base,
                "transformer_xl_tiny": transformer_xl_tiny}[args.arch]
-    mkw = dict(dtype=policy.compute_dtype, param_dtype=policy.param_dtype)
+    md = amp.module_dtypes(policy)
+    mkw = dict(dtype=md.compute, param_dtype=md.param, ln_dtype=md.ln_io,
+               softmax_dtype=md.softmax)
     if args.arch in ("bert_base", "transformer_xl"):
         mkw["vocab_size"] = args.vocab_size
     model = builder(**mkw)
