@@ -208,10 +208,13 @@ class NativePrefetcher:
         self.image_size, self.num_classes = image_size, num_classes
         mean = np.asarray(mean[:channels], np.float32)
         std = np.asarray(std[:channels], np.float32)
-        self._img = np.empty((batch, image_size, image_size, channels),
-                             np.float32)
-        self._lab = np.empty((batch,), np.int32)
+        self._shape = (batch, image_size, image_size, channels)
         self._copy = copy
+        if not copy:
+            # Reused staging buffers only exist in view mode; copy mode
+            # allocates fresh outputs per call and would leave these dead.
+            self._img = np.empty(self._shape, np.float32)
+            self._lab = np.empty((batch,), np.int32)
         self._h = lib.apex_prefetcher_new(
             batch, image_size * image_size, channels, num_classes, seed,
             _fptr(mean), _fptr(std), start_index)
@@ -235,8 +238,8 @@ class NativePrefetcher:
             # Fresh output buffers per call: the native producer writes
             # straight into them, so fresh-array semantics cost no extra
             # host pass (vs fill-then-copy).
-            img = np.empty_like(self._img)
-            lab = np.empty_like(self._lab)
+            img = np.empty(self._shape, np.float32)
+            lab = np.empty((self.batch,), np.int32)
         else:
             img, lab = self._img, self._lab
         self._lib.apex_prefetcher_next(

@@ -9,6 +9,13 @@ traced scalar and one compiled step serves the whole run.
 All schedules compose linear warmup (0 → base over ``warmup_steps``) with a
 decay phase and are exact ``jnp`` expressions of the step counter — no
 Python control flow, jit-safe.
+
+Deliberately not thin wrappers over optax's schedule zoo: the fused
+optimizers call schedules with a **1-based** post-increment step (the apex
+``state.step`` convention their bias corrections use), while optax
+schedules are 0-based — wrapping would hide an off-by-one at every
+boundary.  These ~60 lines keep the convention explicit and are pinned by
+tests/test_schedules.py at the exact boundary steps.
 """
 
 from __future__ import annotations

@@ -5,14 +5,18 @@ Exports the two schedule entry points (SURVEY.md §3.2) and the p2p helpers.
 
 from apex_example_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
+    pipeline_1f1b,
     spmd_pipeline)
 from apex_example_tpu.transformer.pipeline_parallel.p2p_communication import (  # noqa: F401
     recv_backward, recv_forward, send_backward, send_forward)
 
 __all__ = [
     "forward_backward_no_pipelining",
+    "forward_backward_pipelining_with_interleaving",
     "forward_backward_pipelining_without_interleaving",
+    "pipeline_1f1b",
     "recv_backward", "recv_forward", "send_backward", "send_forward",
     "spmd_pipeline",
 ]

@@ -7,17 +7,25 @@ interleaved-virtual-stage variant.  Each manually orchestrates
 forward/backward passes and isend/irecv pairs per microbatch.
 
 TPU-native restatement: a schedule is a *traced collective program*, not an
-orchestration loop.  ``spmd_pipeline`` runs the classic SPMD ring pipeline —
-``lax.scan`` over ticks, each tick computing one stage-step on every device
-and rotating activations with ``ppermute`` — and gets its backward schedule
-from autodiff (the transpose of the scan runs the ticks reversed with the
-reverse rotation, i.e. the backward pipeline).  ``jax.checkpoint`` around the
-stage body keeps live memory to one activation per in-flight microbatch,
-which is the same peak-memory class 1F1B targets; the steady-state
-compute/communication overlap is XLA's latency-hiding scheduler's job.  The
-reference's entry-point names are preserved; the semantic delta (autodiff
-chooses the fwd/bwd interleaving, not the host) is documented here rather
-than hidden.
+orchestration loop.  Two families are provided:
+
+- ``spmd_pipeline``: the classic SPMD ring pipeline — ``lax.scan`` over
+  ticks, each tick computing one stage-step on every device and rotating
+  activations with ``ppermute`` — whose backward schedule comes from
+  autodiff (the transpose of the scan runs the ticks reversed with the
+  reverse rotation).  Simplest program, best XLA overlap; but the scan
+  transpose stores one carry per tick, so live activations grow with M.
+- ``pipeline_1f1b`` (and the reference-named wrappers
+  ``forward_backward_pipelining_without_interleaving`` /
+  ``_with_interleaving``): TRUE 1F1B — a static per-tick action table
+  (warmup forwards, steady 1F/1B alternation, drain) drives masked
+  forward/backward compute, bounding in-flight activations to ≤ S
+  microbatch inputs per stage regardless of M.
+
+Bubble accounting: both forms pay the same tick bubble
+(S−1)/(M+S−1) per direction; 1F1B's win is the M-independent activation
+memory, and the interleaved variant trades (V−1)·S extra warmup depth for
+a ≈V× smaller bubble — the reference's tradeoff, reproduced.
 """
 
 from __future__ import annotations
@@ -30,11 +38,12 @@ from jax import lax
 
 from apex_example_tpu.parallel.mesh import PIPE_AXIS
 from apex_example_tpu.transformer.pipeline_parallel.p2p_communication import (
-    send_forward)
+    send_backward, send_forward)
 
 __all__ = ["forward_backward_no_pipelining",
            "forward_backward_pipelining_without_interleaving",
-           "spmd_pipeline"]
+           "forward_backward_pipelining_with_interleaving",
+           "pipeline_1f1b", "spmd_pipeline"]
 
 
 def forward_backward_no_pipelining(
@@ -148,16 +157,337 @@ def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     return lax.psum(loss_sum, axis_name) / M
 
 
+# ---------------------------------------------------------------------------
+# True 1F1B (and interleaved-virtual-stage) schedules
+# ---------------------------------------------------------------------------
+
+def _simulate_1f1b(M: int, S: int, V: int = 1):
+    """Lockstep simulation of the 1F1B schedule → per-tick action tables.
+
+    Builds each device's action sequence (warmup forwards, steady-state
+    F/B alternation, drain backwards — the reference schedule's structure;
+    for V>1 the interleaved order: microbatches in groups of S, chunks
+    cycled per group, warmup 2(S−1−s)+(V−1)S), then advances a global tick
+    clock where an action runs only when its producer finished on an
+    EARLIER tick (one-ring-hop latency).  Returns ``(fwd_tbl, bwd_tbl)``
+    as [T][S] lists of encoded actions (chunk·M + microbatch, −1 = idle).
+
+    The simulation also proves the runtime's fixed-size buffers safe for
+    this (M, S, V): each (device, chunk) forward/backward message register
+    is single-slot, and the per-(device, chunk) input stash has S slots
+    reused mod S — any schedule that would overwrite an unconsumed value
+    fails loudly here at trace time instead of corrupting data.
+    """
+    if V > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) divisible by "
+            f"pipeline stages ({S})")
+    total = M * V
+
+    def fwd_order(i):
+        group, r = divmod(i, S * V)
+        return r // S, group * S + r % S            # (chunk, microbatch)
+
+    def bwd_order(i):
+        group, r = divmod(i, S * V)
+        return V - 1 - r // S, group * S + r % S
+
+    # Per-device F and B sequences.  Unlike the reference's one-op-per-tick
+    # host schedule, each SPMD tick has an F slot AND a B slot: in the
+    # steady state a stage runs its next forward and its next backward in
+    # the same tick (masked compute executes both paths anyway, and even
+    # under real control flow a combined tick costs exactly what two
+    # serial ticks would).  The 1F1B memory bound is kept by capping
+    # produced-but-unretired forwards at the warmup window.
+    fseqs, bseqs, caps = [], [], []
+    for s in range(S):
+        if V == 1:
+            w = min(S - 1 - s, M)
+        else:
+            w = min(2 * (S - 1 - s) + (V - 1) * S, total)
+        fseqs.append([fwd_order(i) for i in range(total)])
+        bseqs.append([bwd_order(i) for i in range(total)])
+        caps.append(w + 1)
+
+    done_f = {}          # (device, chunk, mb) -> completion tick
+    done_b = {}
+    fptr = [0] * S
+    bptr = [0] * S
+    fwd_tbl, bwd_tbl = [], []
+    t = 0
+    while any(fptr[s] < total or bptr[s] < total for s in range(S)):
+        if t > 8 * (total + S) + 16:     # deadlock guard
+            raise AssertionError("1F1B simulation did not converge")
+        frow, brow = [-1] * S, [-1] * S
+        for s in range(S):
+            # B slot first: a backward retiring this tick frees its
+            # in-flight slot for this tick's forward (the runtime reads
+            # the stash before the forward overwrites it).
+            if bptr[s] < total:
+                c, k = bseqs[s][bptr[s]]
+                j = c * S + s
+                if j == V * S - 1:
+                    ok = done_f.get((s, c, k), t) < t
+                else:
+                    nxt = ((s + 1) % S, c if s < S - 1 else c + 1, k)
+                    ok = done_b.get(nxt, t) < t
+                if ok:
+                    brow[s] = c * M + k
+                    done_b[(s, c, k)] = t
+                    bptr[s] += 1
+            if fptr[s] < total and fptr[s] - bptr[s] < caps[s]:
+                c, k = fseqs[s][fptr[s]]
+                j = c * S + s            # global stage index
+                # producer of my chunk-c input: (device s-1, same chunk),
+                # wrapping to (device S-1, chunk c-1) at the ring seam.
+                ok = (j == 0) or (done_f.get(((s - 1) % S,
+                                              c if s > 0 else c - 1, k),
+                                             t) < t)
+                if ok:
+                    frow[s] = c * M + k
+                    done_f[(s, c, k)] = t
+                    fptr[s] += 1
+        fwd_tbl.append(frow)
+        bwd_tbl.append(brow)
+        t += 1
+
+    # Register sizing + safety proofs.  A (device, chunk) message stream is
+    # FIFO in the microbatch index, so the slot file keyed k mod depth is
+    # safe iff consumption of k happens no later than production of k+depth;
+    # the minimal depth is the peak produced-but-unconsumed count.
+    def _depth(done_prod, done_cons, prod_of):
+        need = 1
+        for s in range(S):
+            for c in range(V):
+                ps, pc = prod_of(s, c)
+                events = []
+                for k in range(M):
+                    if (ps, pc, k) in done_prod and (s, c, k) in done_cons:
+                        # produced at END of its tick, freed at START of the
+                        # consuming tick — same-tick consume-then-produce
+                        # reuses the slot.
+                        events.append((done_prod[(ps, pc, k)] + 0.9, +1))
+                        events.append((done_cons[(s, c, k)] + 0.1, -1))
+                live = peak = 0
+                for _, delta in sorted(events):
+                    live += delta
+                    peak = max(peak, live)
+                need = max(need, peak)
+                # safety with the chosen keying: cons(k) <= prod(k+need)
+                for k in range(M - need):
+                    if (ps, pc, k + need) in done_prod \
+                            and (s, c, k) in done_cons:
+                        assert done_cons[(s, c, k)] <= \
+                            done_prod[(ps, pc, k + need)], \
+                            f"register clobbered at stage {s} chunk {c}"
+        return need
+
+    fdepth = _depth(done_f, done_f,
+                    lambda s, c: ((s - 1) % S, c if s > 0 else c - 1))
+    bdepth = _depth(done_b, done_b,
+                    lambda s, c: ((s + 1) % S, c if s < S - 1 else c + 1))
+    # Input stash: produced by my own F, consumed by my own B.  Its depth is
+    # the peak number of in-flight microbatches per (stage, chunk) — S-1-s+1
+    # for plain 1F1B, larger under interleaving.
+    xdepth = _depth(done_f, done_b, lambda s, c: (s, c))
+    return fwd_tbl, bwd_tbl, fdepth, bdepth, xdepth
+
+
+def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                  last_stage_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
+                  stage_params: Any,
+                  inputs: jnp.ndarray,
+                  targets: Any,
+                  axis_name: str = PIPE_AXIS,
+                  num_chunks: int = 1) -> Tuple[jnp.ndarray, Any]:
+    """True 1F1B pipeline: explicit warmup/steady/drain microbatch ordering
+    with bounded in-flight activations.  Must run inside shard_map.
+
+    Reference semantics (the 1F1B schedule and its interleaved-virtual-
+    stage variant, SURVEY.md §3.2): after a warmup of S−1−s forwards,
+    stage s alternates 1F/1B, then drains.  Each SPMD tick carries an F
+    slot and a B slot (a combined tick costs what two serial slots would,
+    so this only tightens the transient; the steady-state rate is set by
+    the 1F1B in-flight cap, exactly as in the reference schedule).  Live activation state is the
+    schedule's proven peak in-flight count (S−s inputs per stage for V=1;
+    the simulator computes and sizes it exactly), independent of M —
+    NOT the M-deep carry stack the autodiff-transposed ring
+    (:func:`spmd_pipeline`) keeps — which is the defining property of
+    1F1B.  Backward recomputes the stage forward from
+    the stashed input (jax.checkpoint-style remat), so a backward tick
+    costs ~2 forward units.
+
+    With ``num_chunks=V > 1`` each device owns V non-adjacent virtual
+    stages (leaves of ``stage_params`` carry a leading [V] dim; global
+    stage v·S+s lives on device s), shrinking the bubble fraction from
+    (S−1)/(M+S−1) to ≈(S−1)/(V·M) at the cost of V× activation registers
+    and (V−1)·S extra warmup depth — the reference's interleaved
+    tradeoff.
+
+    The per-tick schedule is a static table computed by
+    :func:`_simulate_1f1b` (M, S, V are trace-time constants), so the
+    traced program is a single ``lax.scan`` whose body does masked
+    compute (``lax.cond``) + two ring ``ppermute`` hops; ``stage_fn``
+    must therefore be collective-free (put TP collectives inside
+    :func:`spmd_pipeline` instead, or keep TP on a separate mesh axis
+    outside the cond).
+
+    Returns ``(mean loss, grads)`` with grads shaped like
+    ``stage_params``.
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    V = num_chunks
+    M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+
+    # Uniform chunked form: V=1 gets a singleton chunk dim.
+    params = stage_params if V > 1 else jax.tree_util.tree_map(
+        lambda p: p[None], stage_params)
+
+    def params_for(c):
+        return jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, c, keepdims=False), params)
+
+    p0 = jax.tree_util.tree_map(lambda p: p[0], params)
+    y_sd = jax.eval_shape(stage_fn, p0, jax.eval_shape(
+        lambda a: a[0], inputs))
+    if y_sd.shape != inputs.shape[1:] or y_sd.dtype != inputs.dtype:
+        raise ValueError(
+            "stage output must match the per-microbatch input (the ring "
+            f"carries one activation shape); got {y_sd.shape}/{y_sd.dtype} "
+            f"vs {inputs.shape[1:]}/{inputs.dtype}")
+    act_shape, act_dtype = y_sd.shape, y_sd.dtype
+
+    fwd_tbl, bwd_tbl, fdepth, bdepth, xdepth = _simulate_1f1b(M, S, V)
+    fwd_tbl = jnp.asarray(fwd_tbl, jnp.int32)
+    bwd_tbl = jnp.asarray(bwd_tbl, jnp.int32)
+
+    def _idx(stack, i):
+        return lax.dynamic_index_in_dim(
+            stack, jnp.clip(i, 0, stack.shape[0] - 1), keepdims=False)
+
+    def _idx2(stack, c, k):
+        return _idx(_idx(stack, c), k)
+
+    def _upd(stack, val, c):
+        return lax.dynamic_update_index_in_dim(stack, val, c, 0)
+
+    def _upd2(stack, val, c, k):
+        return _upd(stack, _upd(_idx(stack, c), val, k), c)
+
+    def _vzeros(shape, dtype):
+        # Zeros with the shard-varying type: cond branches must agree with
+        # the real-compute branch, whose outputs vary across the pipe axis.
+        return lax.pcast(jnp.zeros(shape, dtype), axis_name, to="varying")
+
+    zeros_act = lambda *lead: _vzeros(lead + act_shape, act_dtype)
+    gzero = jax.tree_util.tree_map(
+        lambda p: lax.pcast(jnp.zeros(p.shape, jnp.float32), axis_name,
+                            to="varying"), params)
+
+    def tick(carry, rows):
+        fwd_reg, bwd_reg, xbuf, gacc, lacc = carry
+        frow, brow = rows
+        af = jnp.take(frow, idx)
+        ab = jnp.take(brow, idx)
+        do_f, do_b = af >= 0, ab >= 0
+        cf, kf = jnp.clip(af, 0) // M, jnp.clip(af, 0) % M
+        cb, kb = jnp.clip(ab, 0) // M, jnp.clip(ab, 0) % M
+
+        # The backward's stash read MUST precede the forward's stash write:
+        # a combined F+B tick may reuse the same slot (the simulator's
+        # depth proof frees a slot at tick start, consume-then-produce).
+        xb = _idx2(xbuf, cb, kb % xdepth)
+
+        # ---- forward: consume input or upstream register, stash, compute.
+        is_inject = (idx == 0) & (cf == 0)
+        x_in = jnp.where(is_inject, _idx(inputs, kf),
+                         _idx2(fwd_reg, cf, kf % fdepth))
+        y = lax.cond(do_f,
+                     lambda x: stage_fn(params_for(cf), x).astype(act_dtype),
+                     lambda x: _vzeros(act_shape, act_dtype), x_in)
+        xbuf = jnp.where(do_f, _upd2(xbuf, x_in, cf, kf % xdepth), xbuf)
+
+        # ---- backward: recompute from stash, pull cotangent, vjp.
+        is_last = (idx == S - 1) & (cb == V - 1)
+        tgt = jax.tree_util.tree_map(lambda s: _idx(s, kb), targets)
+
+        def run_bwd(opr):
+            xb, cot_in, tgt = opr
+            pb = params_for(cb)
+            yb, vjp = jax.vjp(stage_fn, pb, xb)
+            lval, dy_loss = jax.value_and_grad(
+                lambda yy: last_stage_fn(yy, tgt))(yb)
+            dy = jnp.where(is_last, dy_loss.astype(act_dtype), cot_in)
+            dp, dx = vjp(dy.astype(yb.dtype))
+            return dp, dx.astype(act_dtype), \
+                jnp.where(is_last, lval, 0.0).astype(jnp.float32)
+
+        def skip_bwd(opr):
+            return (jax.tree_util.tree_map(
+                        lambda p: _vzeros(p.shape[1:], p.dtype), params),
+                    _vzeros(act_shape, act_dtype),
+                    _vzeros((), jnp.float32))
+
+        dp, dx, lval = lax.cond(do_b, run_bwd, skip_bwd,
+                                (xb, _idx2(bwd_reg, cb, kb % bdepth), tgt))
+        gacc = jax.tree_util.tree_map(
+            lambda a, d: jnp.where(
+                do_b, _upd(a, _idx(a, cb) + d.astype(jnp.float32), cb), a),
+            gacc, dp)
+        lacc = lacc + lval
+
+        # ---- ring exchange (unconditional; receivers mask).
+        y_in = send_forward(y, axis_name)
+        af_in = send_forward(af, axis_name)
+        dx_in = send_backward(dx, axis_name)
+        ab_in = send_backward(ab, axis_name)
+
+        cf_in, kf_in = jnp.clip(af_in, 0) // M, jnp.clip(af_in, 0) % M
+        c_r = jnp.where(idx == 0, cf_in + 1, cf_in)      # my chunk for it
+        fwd_reg = jnp.where(
+            (af_in >= 0) & (c_r < V),
+            _upd2(fwd_reg, y_in, jnp.clip(c_r, 0, V - 1), kf_in % fdepth),
+            fwd_reg)
+        cb_in, kb_in = jnp.clip(ab_in, 0) // M, jnp.clip(ab_in, 0) % M
+        c_rb = jnp.where(idx == S - 1, cb_in - 1, cb_in)
+        bwd_reg = jnp.where(
+            (ab_in >= 0) & (c_rb >= 0),
+            _upd2(bwd_reg, dx_in, jnp.clip(c_rb, 0, V - 1), kb_in % bdepth),
+            bwd_reg)
+        return (fwd_reg, bwd_reg, xbuf, gacc, lacc), None
+
+    carry0 = (zeros_act(V, fdepth), zeros_act(V, bdepth),
+              zeros_act(V, xdepth), gzero,
+              lax.pcast(jnp.zeros((), jnp.float32), axis_name, to="varying"))
+    (_, _, _, gacc, lacc), _ = lax.scan(
+        tick, carry0, (fwd_tbl, bwd_tbl))
+
+    loss = lax.psum(lacc, axis_name) / M
+    grads = jax.tree_util.tree_map(
+        lambda a, p: (a / M).astype(p.dtype), gacc, params)
+    if V == 1:
+        grads = jax.tree_util.tree_map(lambda g: g[0], grads)
+    return loss, grads
+
+
 def forward_backward_pipelining_without_interleaving(
         stage_fn, last_stage_fn, stage_params, inputs, targets,
-        axis_name: str = PIPE_AXIS, remat: bool = True,
+        axis_name: str = PIPE_AXIS,
 ) -> Tuple[jnp.ndarray, Any]:
-    """(loss, grads-wrt-stage_params) of the ring pipeline.
+    """(loss, grads-wrt-stage_params) under the true 1F1B schedule
+    (reference entry-point name).  See :func:`pipeline_1f1b`."""
+    return pipeline_1f1b(stage_fn, last_stage_fn, stage_params, inputs,
+                         targets, axis_name=axis_name, num_chunks=1)
 
-    Reference-name parity for the 1F1B schedule; see module docstring for
-    the honest scheduling delta.
-    """
-    def f(p):
-        return spmd_pipeline(stage_fn, last_stage_fn, p, inputs, targets,
-                             axis_name=axis_name, remat=remat)
-    return jax.value_and_grad(f)(stage_params)
+
+def forward_backward_pipelining_with_interleaving(
+        stage_fn, last_stage_fn, stage_params, inputs, targets,
+        num_chunks: int, axis_name: str = PIPE_AXIS,
+) -> Tuple[jnp.ndarray, Any]:
+    """Interleaved-virtual-stage 1F1B (reference entry-point name).
+    ``stage_params`` leaves carry a leading [num_chunks] dim; device s owns
+    global stages {v·S+s}.  See :func:`pipeline_1f1b`."""
+    return pipeline_1f1b(stage_fn, last_stage_fn, stage_params, inputs,
+                         targets, axis_name=axis_name,
+                         num_chunks=num_chunks)

@@ -270,3 +270,112 @@ def test_spmd_pipeline_matches_sequential(devices8):
     np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_interleaved_matches_sequential(devices8):
+    """Interleaved-virtual-stage 1F1B: 8 global stages as V=2 chunks on
+    S=4 devices; loss/grads must match the sequential 8-layer model."""
+    from apex_example_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving)
+    S, V, M, B, D = 4, 2, 8, 2, 8
+    mesh = Mesh(np.asarray(devices8[:S]), (PIPE_AXIS,))
+    rng = np.random.RandomState(11)
+    w_global = jnp.asarray(rng.randn(V * S, D, D), jnp.float32) * 0.3
+    # device s owns global stages {v*S + s} -> [S, V, D, D]
+    w_dev = jnp.transpose(w_global.reshape(V, S, D, D), (1, 0, 2, 3))
+    xs = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+    ys = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+
+    def stage_fn(w, x):          # w: one chunk's [D, D]
+        return jnp.tanh(x @ w)
+
+    def last_stage_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def pipeline(w):             # w: [1, V, D, D] per device
+        loss, grads = forward_backward_pipelining_with_interleaving(
+            stage_fn, last_stage_fn, w[0], xs, ys, num_chunks=V)
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss, grads = shard_map(
+        pipeline, mesh=mesh,
+        in_specs=P(PIPE_AXIS, None, None, None),
+        out_specs=(P(), P(PIPE_AXIS, None, None, None)))(w_dev)
+
+    def sequential_loss(stacked):
+        def one(mb_x, mb_y):
+            h = mb_x
+            for j in range(V * S):
+                h = stage_fn(stacked[j], h)
+            return last_stage_fn(h, mb_y)
+        return jnp.mean(jnp.stack([one(xs[i], ys[i]) for i in range(M)]))
+
+    ref_loss = sequential_loss(w_global)
+    ref_grads = jax.grad(sequential_loss)(w_global)
+    # back to device layout for comparison
+    ref_dev = jnp.transpose(ref_grads.reshape(V, S, D, D), (1, 0, 2, 3))
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_dev),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_schedule_tables_are_sound():
+    """The schedule simulator: tick counts and per-stage work for the
+    non-interleaved form (T = 2(M+S-1); every stage does M F's + M B's)."""
+    from apex_example_tpu.transformer.pipeline_parallel.schedules import (
+        _simulate_1f1b)
+    for M, S in [(4, 2), (8, 4), (16, 8), (2, 2)]:
+        f, b, fd, bd, xd = _simulate_1f1b(M, S)
+        # combined F+B ticks: never worse than the serial 2(M+S-1) slots,
+        # and at least the 1F1B steady-state bound (~2M: the in-flight cap
+        # ties each stage's forward rate to its backward-return rate).
+        assert 2 * M <= len(f) <= 2 * (M + S - 1), (M, S, len(f))
+        for s in range(S):
+            assert sum(r[s] >= 0 for r in f) == M
+            assert sum(r[s] >= 0 for r in b) == M
+    # interleaved: still M*V per direction per device
+    f, b, fd, bd, xd = _simulate_1f1b(8, 4, V=2)
+    assert xd > 4   # interleaving carries more in-flight stash than V=1
+    for s in range(4):
+        assert sum(r[s] >= 0 for r in f) == 16
+        assert sum(r[s] >= 0 for r in b) == 16
+
+
+def test_spmd_pipeline_direct(devices8):
+    """spmd_pipeline exercised directly (the reference-named wrapper now
+    routes to pipeline_1f1b, so the ring form needs its own coverage)."""
+    from apex_example_tpu.transformer.pipeline_parallel import spmd_pipeline
+    S, M, B, D = 8, 16, 4, 8
+    mesh = Mesh(np.asarray(devices8), (PIPE_AXIS,))
+    rng = np.random.RandomState(9)
+    stacked_w = jnp.asarray(rng.randn(S, D, D), jnp.float32) * 0.3
+    xs = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+    ys = jnp.asarray(rng.randn(M, B, D), jnp.float32)
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w[0])
+
+    def last_stage_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def pipeline(w):
+        return jax.value_and_grad(
+            lambda p: spmd_pipeline(stage_fn, last_stage_fn, p, xs, ys))(w)
+
+    loss, grads = shard_map(
+        pipeline, mesh=mesh,
+        in_specs=P(PIPE_AXIS, None, None),
+        out_specs=(P(), P(PIPE_AXIS, None, None)))(stacked_w)
+
+    def sequential_loss(stacked):
+        def one(mb_x, mb_y):
+            h = mb_x
+            for s in range(S):
+                h = jnp.tanh(h @ stacked[s])
+            return last_stage_fn(h, mb_y)
+        return jnp.mean(jnp.stack([one(xs[i], ys[i]) for i in range(M)]))
+
+    np.testing.assert_allclose(loss, sequential_loss(stacked_w), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads), np.asarray(jax.grad(sequential_loss)(stacked_w)),
+        rtol=1e-4, atol=1e-6)
