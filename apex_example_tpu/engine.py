@@ -100,16 +100,26 @@ def make_train_step(model, optimizer, policy: Policy,
                     ddp: Optional[DDPConfig] = None,
                     axis_name: Optional[str] = None,
                     loss_fn: Callable = cross_entropy_loss,
-                    compute_accuracy: bool = True):
+                    compute_accuracy: bool = True,
+                    grad_accum: int = 1):
     """Build the single-device (or per-shard) train step.
 
     ``optimizer`` is a fused optimizer (init/apply) from
     ``apex_example_tpu.optim``; optax GradientTransformations are adapted
     automatically.  When ``axis_name`` is set the step must run inside
     shard_map/pmap with that axis bound (see :func:`make_sharded_train_step`).
+
+    ``grad_accum=K`` splits the batch into K microbatches and accumulates
+    fp32 grads across them before the (single) optimizer step — the
+    reference's DDP grad-accumulation hook semantics (SURVEY.md §3.2
+    ``message_size``/accumulation): BN running stats update per forward,
+    grads average over microbatches, the allreduce happens once on the
+    accumulated grads (delay_allreduce-style).
     """
     opt = _wrap_optimizer(optimizer)
     ddp = ddp or DDPConfig()
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
     # Non-default reduction options (fp16 overflow-headroom pre-divide, fp32
     # upcast) need the *explicit* psum path: differentiating wrt replicated
@@ -123,21 +133,56 @@ def make_train_step(model, optimizer, policy: Policy,
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
         x, y = batch
 
-        def scaled_loss_fn(params):
-            logits, new_stats = _apply_model(
-                model, params, state.batch_stats, x, train=True)
-            loss = loss_fn(logits, y)
-            # amp.scale_loss: multiply before backward (SURVEY.md §4.3).
-            return amp_lib.scale_loss(loss, state.scaler), (loss, logits,
-                                                            new_stats)
-
         diff_params = state.params
         if explicit_reduce:
             diff_params = jax.tree_util.tree_map(
                 lambda p: jax.lax.pcast(p, axis_name, to="varying"),
                 diff_params)
-        grads, (loss, logits, new_stats) = jax.grad(
-            scaled_loss_fn, has_aux=True)(diff_params)
+
+        def scaled_loss_for(stats, x_mb, y_mb):
+            def scaled_loss_fn(params):
+                logits, new_stats = _apply_model(
+                    model, params, stats, x_mb, train=True)
+                loss = loss_fn(logits, y_mb)
+                # amp.scale_loss: multiply before backward (§4.3).
+                return amp_lib.scale_loss(loss, state.scaler), (
+                    loss, logits, new_stats)
+            return scaled_loss_fn
+
+        if grad_accum == 1:
+            grads, (loss, logits, new_stats) = jax.grad(
+                scaled_loss_for(state.batch_stats, x, y),
+                has_aux=True)(diff_params)
+            top1 = _batch_top1(logits, y) if (
+                compute_accuracy and isinstance(y, jnp.ndarray)) else None
+        else:
+            k = grad_accum
+            split = lambda a: a.reshape(k, a.shape[0] // k, *a.shape[1:])
+            xk = jax.tree_util.tree_map(split, x)
+            yk = jax.tree_util.tree_map(split, y)
+            gzero = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), diff_params)
+
+            def body(carry, mb):
+                stats, gsum, lsum, tsum = carry
+                x_mb, y_mb = mb
+                grads_mb, (loss_mb, logits_mb, stats) = jax.grad(
+                    scaled_loss_for(stats, x_mb, y_mb),
+                    has_aux=True)(diff_params)
+                gsum = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), gsum, grads_mb)
+                if compute_accuracy and isinstance(y, jnp.ndarray):
+                    tsum = tsum + _batch_top1(logits_mb, y_mb)
+                return (stats, gsum, lsum + loss_mb, tsum), None
+
+            (new_stats, gsum, lsum, tsum), _ = jax.lax.scan(
+                body, (state.batch_stats, gzero, jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)), (xk, yk))
+            grads = jax.tree_util.tree_map(
+                lambda a, p: (a / k).astype(p.dtype), gsum, diff_params)
+            loss = lsum / k
+            top1 = tsum / k if (compute_accuracy and
+                                isinstance(y, jnp.ndarray)) else None
 
         # DDP: reduce *scaled* grads, like the reference's backward-hook
         # allreduce; then unscale + finite-check (scale_loss __exit__).
@@ -163,9 +208,7 @@ def make_train_step(model, optimizer, policy: Policy,
         # top1 only makes sense for integer-class labels; structured label
         # pytrees (e.g. BERT's (labels, weights)) must not silently broadcast
         # into a garbage metric.
-        if compute_accuracy and isinstance(y, jnp.ndarray):
-            top1 = jnp.mean((jnp.argmax(logits, -1) == y)
-                            .astype(jnp.float32)) * 100.0
+        if top1 is not None:
             if axis_name is not None:
                 top1 = jax.lax.pmean(top1, axis_name)
             metrics["top1"] = top1
@@ -177,19 +220,29 @@ def make_train_step(model, optimizer, policy: Policy,
     return train_step
 
 
+def _batch_top1(logits, y):
+    return jnp.mean((jnp.argmax(logits, -1) == y)
+                    .astype(jnp.float32)) * 100.0
+
+
 def make_eval_step(model, loss_fn: Callable = cross_entropy_loss,
                    axis_name: Optional[str] = None):
+    """Eval step with the reference harness's top-1/top-5 metrics
+    (utils.meters.accuracy; SURVEY.md §3.5)."""
+    from apex_example_tpu.utils.meters import accuracy
+
     def eval_step(state: TrainState, batch) -> Dict:
         x, y = batch
         logits, _ = _apply_model(model, state.params, state.batch_stats, x,
                                  train=False)
         loss = loss_fn(logits, y)
-        top1 = jnp.mean((jnp.argmax(logits, -1) == y)
-                        .astype(jnp.float32)) * 100.0
+        k5 = min(5, logits.shape[-1])
+        top1, top5 = accuracy(logits, y, topk=(1, k5))
         if axis_name is not None:
             loss = jax.lax.pmean(loss, axis_name)
             top1 = jax.lax.pmean(top1, axis_name)
-        return {"loss": loss, "top1": top1}
+            top5 = jax.lax.pmean(top5, axis_name)
+        return {"loss": loss, "top1": top1, "top5": top5}
     return eval_step
 
 
@@ -198,7 +251,8 @@ def make_sharded_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                             loss_fn: Callable = cross_entropy_loss,
                             compute_accuracy: bool = True,
                             axis_name: str = DATA_AXIS,
-                            donate: bool = True):
+                            donate: bool = True,
+                            grad_accum: int = 1):
     """DDP train step: shard_map over the data axis, jitted, state donated.
 
     State is replicated (P()), the batch is split on axis 0.  Inside the
@@ -207,7 +261,8 @@ def make_sharded_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     """
     per_shard = make_train_step(model, optimizer, policy, ddp=ddp,
                                 axis_name=axis_name, loss_fn=loss_fn,
-                                compute_accuracy=compute_accuracy)
+                                compute_accuracy=compute_accuracy,
+                                grad_accum=grad_accum)
 
     def step_and_sync(state, batch):
         new_state, metrics = per_shard(state, batch)

@@ -16,12 +16,15 @@ from apex_example_tpu.parallel.distributed import (
 from apex_example_tpu.parallel.sync_batchnorm import (
     SyncBatchNorm, convert_syncbn_model)
 from apex_example_tpu.parallel.larc import LARC, larc
+from apex_example_tpu.parallel.launch import (
+    is_main_process, maybe_initialize_distributed)
 
 __all__ = [
     "CONTEXT_AXIS", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "DDPConfig",
     "DistributedDataParallel", "LARC", "SyncBatchNorm", "allreduce_grads",
     "broadcast_from_zero", "convert_syncbn_model", "data_sharding",
-    "heads_to_seq", "initialize_model_parallel", "larc", "make_data_mesh",
-    "plain_attention", "reduce_mean", "replicated", "ring_attention",
-    "seq_to_heads", "ulysses_attention",
+    "heads_to_seq", "initialize_model_parallel", "is_main_process", "larc",
+    "make_data_mesh", "maybe_initialize_distributed", "plain_attention",
+    "reduce_mean", "replicated", "ring_attention", "seq_to_heads",
+    "ulysses_attention",
 ]

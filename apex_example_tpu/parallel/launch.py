@@ -1,0 +1,78 @@
+"""Multi-host launch wiring (layer L6, SURVEY.md §2/§3.3/§4.1).
+
+The reference is launched one-process-per-GPU by ``torch.distributed.launch``
+with the TCP rendezvous described by ``MASTER_ADDR/MASTER_PORT/RANK/
+WORLD_SIZE``.  The TPU-native process model is one process per HOST:
+``jax.distributed.initialize()`` performs the rendezvous, after which
+``jax.devices()`` spans every chip in the slice and the mesh/collective
+machinery works unchanged — the per-device fork of the reference collapses
+into the runtime (SURVEY.md §4.1 "TPU equivalent").
+
+Env contract (first match wins):
+
+1. JAX-native: ``JAX_COORDINATOR_ADDRESS`` (+ optional
+   ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID`` — on TPU pods both are
+   inferred from the metadata server, so the address alone suffices).
+2. Reference-parity (torch names, so existing launch scripts carry over):
+   ``MASTER_ADDR`` + ``MASTER_PORT`` + ``WORLD_SIZE`` + ``RANK``.
+   ``WORLD_SIZE``/``RANK`` here count **hosts**, not devices — the one
+   semantic delta from torch.distributed.launch, documented rather than
+   hidden.
+
+With neither set this is a no-op and the framework runs single-process —
+the same collapse rule train.py has always had.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+
+_initialized = False
+
+
+def _parse_env(env=None) -> Optional[dict]:
+    """Extract jax.distributed.initialize kwargs from the environment, or
+    None when no multi-host rendezvous is configured."""
+    env = os.environ if env is None else env
+    if env.get("JAX_COORDINATOR_ADDRESS"):
+        kw = {"coordinator_address": env["JAX_COORDINATOR_ADDRESS"]}
+        if env.get("JAX_NUM_PROCESSES"):
+            kw["num_processes"] = int(env["JAX_NUM_PROCESSES"])
+        if env.get("JAX_PROCESS_ID"):
+            kw["process_id"] = int(env["JAX_PROCESS_ID"])
+        return kw
+    if env.get("MASTER_ADDR") and env.get("WORLD_SIZE"):
+        if int(env["WORLD_SIZE"]) <= 1:
+            return None          # degenerate single-host launch
+        return {
+            "coordinator_address":
+                f'{env["MASTER_ADDR"]}:{env.get("MASTER_PORT", "12355")}',
+            "num_processes": int(env["WORLD_SIZE"]),
+            "process_id": int(env.get("RANK", "0")),
+        }
+    return None
+
+
+def maybe_initialize_distributed(env=None) -> Tuple[int, int]:
+    """Rendezvous if the environment asks for it; returns
+    ``(process_index, process_count)``.
+
+    Idempotent; must run before the first device use (the backend is
+    fixed at first touch — same constraint as torch's init_process_group
+    before CUDA calls, SURVEY.md §4.1).
+    """
+    global _initialized
+    kw = _parse_env(env)
+    if kw is not None and not _initialized:
+        jax.distributed.initialize(**kw)
+        _initialized = True
+    return jax.process_index(), jax.process_count()
+
+
+def is_main_process() -> bool:
+    """The rank-0 predicate (reference: ``rank == 0`` guards around
+    checkpoint writes and logging)."""
+    return jax.process_index() == 0

@@ -1,0 +1,105 @@
+"""Auxiliary-subsystem tests (SURVEY.md §6):
+
+- psum determinism: the race-detection analog.  XLA/jit is data-race-free
+  by construction; the observable contract is bitwise-identical results for
+  identical (seed, data, devices) — which the reference's
+  ddp_race_condition_test can only probe stochastically.
+- fault injection: kill a training process mid-run (SIGKILL, no cleanup),
+  resume from its checkpoint, assert step continuity — the reference
+  family's recovery contract is exactly relaunch+resume (no elastic).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import image_batch
+from apex_example_tpu.engine import (create_train_state,
+                                     make_sharded_train_step)
+from apex_example_tpu.models import resnet18
+from apex_example_tpu.optim import FusedSGD
+from apex_example_tpu.parallel import make_data_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_steps(devices, n_steps=5, seed=0):
+    policy, scaler = amp.initialize("O2")
+    model = resnet18(num_classes=8, small_stem=True, num_filters=8,
+                     bn_axis_name="data")
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    mesh = make_data_mesh(devices=devices)
+    x, y = image_batch(jnp.asarray(0), batch_size=16, image_size=16,
+                       channels=3, num_classes=8, seed=seed)
+    state = create_train_state(jax.random.PRNGKey(seed), model, opt, x[:1],
+                               policy, scaler)
+    step = make_sharded_train_step(mesh, model, opt, policy, donate=False)
+    losses = []
+    for i in range(n_steps):
+        batch = image_batch(jnp.asarray(i), batch_size=16, image_size=16,
+                            channels=3, num_classes=8, seed=seed)
+        state, metrics = step(state, batch)
+        losses.append(np.asarray(metrics["loss"]))
+    return np.stack(losses), state
+
+
+def test_psum_determinism_bitwise(devices8):
+    """Same seed, same 8-device mesh, two runs → bitwise-equal losses and
+    params (SURVEY.md §6 race-detection row)."""
+    l1, s1 = _run_steps(devices8)
+    l2, s2 = _run_steps(devices8)
+    np.testing.assert_array_equal(l1, l2)      # bitwise, not allclose
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        s1.params, s2.params)
+
+
+def _spawn_trainer(ckpt, extra, env):
+    return subprocess.Popen(
+        [sys.executable, "train.py", "--arch", "resnet18", "--opt-level",
+         "O2", "--epochs", "3", "--steps-per-epoch", "3", "--batch-size",
+         "16", "--print-freq", "1", "--checkpoint-dir", ckpt] + extra,
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, bufsize=1)
+
+
+def test_fault_injection_kill_and_resume(tmp_path):
+    """SIGKILL mid-run, then resume: training continues from the saved
+    step with loss continuity (SURVEY.md §6 failure-detection row)."""
+    ckpt = str(tmp_path / "ck")
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and not k.startswith("TPU_")}
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+
+    # Phase 1: run until the first checkpoint lands, then SIGKILL (the
+    # harshest failure mode: no atexit, no finally blocks).
+    p = _spawn_trainer(ckpt, [], env)
+    saw_save, out1 = False, []
+    deadline = time.time() + 540
+    for line in p.stdout:
+        out1.append(line)
+        if "saved checkpoint at step" in line:
+            saw_save = True
+            break
+        if time.time() > deadline:
+            break
+    assert saw_save, "".join(out1)
+    os.kill(p.pid, signal.SIGKILL)
+    p.wait(timeout=60)
+
+    # Phase 2: resume from the murdered run's checkpoint.
+    p2 = _spawn_trainer(ckpt, ["--resume", ckpt], env)
+    out2, _ = p2.communicate(timeout=540)
+    assert p2.returncode == 0, out2
+    assert "resumed from step 3 (epoch 1)" in out2, out2
+    # It continued (epoch 1 and 2 ran, a later checkpoint was written).
+    assert "saved checkpoint at step 9" in out2, out2

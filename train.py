@@ -40,7 +40,9 @@ from apex_example_tpu.models.transformer_xl import (transformer_xl_base,
                                                     transformer_xl_tiny)
 from apex_example_tpu.optim import (FusedAdam, FusedLAMB, FusedSGD,
                                     build_schedule)
-from apex_example_tpu.parallel import DDPConfig, make_data_mesh
+from apex_example_tpu.parallel import (DDPConfig, is_main_process,
+                                       make_data_mesh,
+                                       maybe_initialize_distributed)
 from apex_example_tpu.utils import AverageMeter, Throughput
 from apex_example_tpu.utils.checkpoint import CheckpointManager
 from apex_example_tpu.workloads import (make_sharded_txl_train_step,
@@ -101,6 +103,12 @@ def parse_args(argv=None):
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval", action="store_true")
+    p.add_argument("--eval-batches", type=int, default=10,
+                   help="validation batches per eval pass")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches accumulated per optimizer step")
+    p.add_argument("--tensorboard", default="",
+                   help="write scalars to this tensorboard logdir")
     p.add_argument("--prof", action="store_true",
                    help="capture a jax profiler trace of a few steps")
     # accepted no-ops (CUDA-specific in the reference)
@@ -131,6 +139,15 @@ def build_lr(args):
                           min_lr=args.lr_min)
 
 
+def make_writer(args):
+    """Optional tensorboard writer (SURVEY.md §6 metrics row: stdout meters
+    are the contract; tensorboardX sits behind a flag), rank-0 only."""
+    if not args.tensorboard or not is_main_process():
+        return None
+    from tensorboardX import SummaryWriter
+    return SummaryWriter(args.tensorboard)
+
+
 def build_optimizer(args):
     lr = build_lr(args)
     if args.opt == "sgd":
@@ -143,6 +160,14 @@ def build_optimizer(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    # Multi-host rendezvous (no-op single-host): must precede first device
+    # use.  Launch contract in parallel/launch.py — JAX_COORDINATOR_ADDRESS
+    # or the reference's MASTER_ADDR/PORT + WORLD_SIZE/RANK (hosts).
+    proc_id, n_procs = maybe_initialize_distributed()
+    if n_procs > 1 and proc_id != 0:
+        # Reference behavior: only rank 0 logs; workers run silently.
+        global print
+        print = lambda *a, **k: None  # noqa: A001
     policy, scaler = amp.initialize(
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
@@ -191,15 +216,18 @@ def main(argv=None):
     if n_dev > 1:
         mesh = make_data_mesh(devices=devices)
         step_fn = make_sharded_train_step(mesh, model, optimizer, policy,
-                                          ddp=ddp)
+                                          ddp=ddp,
+                                          grad_accum=args.grad_accum)
         print(f"DDP over {n_dev} devices: {mesh}")
     else:
-        step_fn = jax.jit(make_train_step(model, optimizer, policy),
+        step_fn = jax.jit(make_train_step(model, optimizer, policy,
+                                          grad_accum=args.grad_accum),
                           donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_step(model))
 
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
         else None
+    writer = make_writer(args)
     start_epoch = 0
     if args.resume:
         rmgr = CheckpointManager(args.resume)
@@ -258,16 +286,42 @@ def main(argv=None):
                           f"{losses} {top1s} "
                           f"{thr.rate:.1f} img/s "
                           f"scale {float(metrics['scale']):.0f}")
+                    if writer is not None:
+                        writer.add_scalar("train/loss", losses.val,
+                                          global_step)
+                        writer.add_scalar("train/top1", top1s.val,
+                                          global_step)
+                        writer.add_scalar("train/img_per_sec", thr.rate,
+                                          global_step)
             if args.eval:
-                em = eval_fn(state, eval_batch_fn(10_000 + epoch))
-                print(f"epoch {epoch} EVAL loss {float(em['loss']):.4f} "
-                      f"top1 {float(em['top1']):.2f}")
-            if mgr is not None:
+                # Full validation loop (reference harness shape: N batches,
+                # top-1/top-5 meters, SURVEY.md §3.5) on a held-out index
+                # range disjoint from training.
+                el, e1, e5 = (AverageMeter("loss"), AverageMeter("top1"),
+                              AverageMeter("top5"))
+                for j in range(args.eval_batches):
+                    em = eval_fn(state, eval_batch_fn(
+                        10_000 + epoch * args.eval_batches + j))
+                    el.update(float(em["loss"]))
+                    e1.update(float(em["top1"]))
+                    e5.update(float(em["top5"]))
+                print(f"epoch {epoch} EVAL loss {el.avg:.4f} "
+                      f"top1 {e1.avg:.2f} top5 {e5.avg:.2f} "
+                      f"({args.eval_batches} batches)")
+                if writer is not None:
+                    writer.add_scalar("eval/loss", el.avg, global_step)
+                    writer.add_scalar("eval/top1", e1.avg, global_step)
+                    writer.add_scalar("eval/top5", e5.avg, global_step)
+            if mgr is not None and is_main_process():
+                # Reference: rank 0 writes the checkpoint (SURVEY.md §4.5);
+                # state is replicated so one host's copy is the full state.
                 mgr.save(state)
                 print(f"saved checkpoint at step {int(state.step)}")
     finally:
         if prefetcher is not None:
             prefetcher.close()
+        if writer is not None:
+            writer.close()
 
     if args.prof:
         jax.profiler.stop_trace()
@@ -318,13 +372,19 @@ def lm_main(args, policy, scaler):
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_train_step(
                 mesh, model, optimizer, policy, loss_fn=mlm_loss,
-                compute_accuracy=False)
+                compute_accuracy=False, grad_accum=args.grad_accum)
         else:
             step_fn = jax.jit(make_train_step(model, optimizer, policy,
                                               loss_fn=mlm_loss,
-                                              compute_accuracy=False),
+                                              compute_accuracy=False,
+                                              grad_accum=args.grad_accum),
                               donate_argnums=(0,))
     else:
+        if args.grad_accum > 1:
+            raise SystemExit("--grad-accum is not wired for transformer_xl: "
+                             "recurrence memory advances per forward, so "
+                             "microbatch accumulation would change the "
+                             "segment stream semantics")
         if n_dev > 1:
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_txl_train_step(
@@ -337,6 +397,7 @@ def lm_main(args, policy, scaler):
 
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
         else None
+    writer = make_writer(args)
     start_epoch = 0
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
@@ -367,10 +428,16 @@ def lm_main(args, policy, scaler):
                 print(f"epoch {epoch} step {i + 1}/{args.steps_per_epoch} "
                       f"{losses} {extra}{thr.rate:.0f} tok/s "
                       f"scale {float(metrics['scale']):.0f}")
-        if mgr is not None:
+                if writer is not None:
+                    writer.add_scalar("train/loss", losses.val, global_step)
+                    writer.add_scalar("train/tok_per_sec", thr.rate,
+                                      global_step)
+        if mgr is not None and is_main_process():
             mgr.save(state)
             print(f"saved checkpoint at step {int(state.step)}")
 
+    if writer is not None:
+        writer.close()
     if args.prof:
         jax.profiler.stop_trace()
         print("profile written to /tmp/apex_tpu_trace")
