@@ -1,0 +1,166 @@
+"""Legacy fp16 utilities: the predecessor API to amp (SURVEY.md:129 —
+``FP16_Optimizer``, manual master-weight management, ``network_to_half``,
+``prep_param_lists``; reference layout ``apex/fp16_utils/{fp16_optimizer,
+loss_scaler,fp16util}.py``).
+
+The reference kept this surface for users who managed mixed precision by
+hand before ``amp.initialize`` existed.  The TPU-native restatement is
+functional: instead of an object that mutates ``.param_groups`` in place,
+``FP16_Optimizer`` is an init/step pair over an explicit state pytree —
+the same shape as every optimizer in this framework (optim/fused.py), so it
+drops into the engine unchanged.  Half precision on TPU means bf16 (fp16 is
+supported end-to-end for parity; the dynamic scaler exists for it).
+
+What maps where:
+
+  apex.fp16_utils.network_to_half(net)      -> network_to_half(model_or_tree)
+  apex.fp16_utils.prep_param_lists(model)   -> prep_param_lists(params)
+  master_params_to_model_params(m, M)       -> master_to_model(masters, like)
+  model_grads_to_master_grads(m, M)         -> grads_to_master(grads)
+  apex.fp16_utils.FP16_Optimizer            -> FP16_Optimizer (init/step)
+  apex.fp16_utils.LossScaler                -> amp.make_scaler(dynamic=False)
+  apex.fp16_utils.DynamicLossScaler         -> amp.make_scaler(dynamic=True)
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu.amp.scaler import (ScalerState, load_state_dict,
+                                         scale_loss, select_tree,
+                                         state_dict, unscale_grads)
+from apex_example_tpu.amp.scaler import update as update_scaler
+
+
+def network_to_half(model_or_tree, half_dtype=jnp.bfloat16):
+    """Convert a model (or a param pytree) to half precision.
+
+    Reference: fp16util.network_to_half — wraps the net so inputs/weights run
+    in half while BatchNorm stays fp32.  Framework models expose dtype fields,
+    so conversion is a functional clone: compute dtype goes half, BN stats
+    stay fp32 (``bn_dtype``) exactly like the reference's BN_convert_float.
+    Param pytrees are cast leaf-wise.
+    """
+    if hasattr(model_or_tree, "clone") and hasattr(model_or_tree, "dtype"):
+        kw = {"dtype": half_dtype}
+        if hasattr(model_or_tree, "bn_dtype"):
+            kw["bn_dtype"] = jnp.float32
+        return model_or_tree.clone(**kw)
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(half_dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, model_or_tree)
+
+
+def prep_param_lists(params) -> Tuple[Any, Any]:
+    """(model_params_half, master_params_fp32) from a half param tree.
+
+    Reference: fp16util.prep_param_lists — creates the fp32 master copies the
+    legacy flow updates in the optimizer.
+    """
+    masters = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+    return params, masters
+
+
+def master_to_model(masters, like):
+    """Cast fp32 masters back onto the model's (half) dtypes.
+
+    Reference: fp16util.master_params_to_model_params."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype), masters, like)
+
+
+def grads_to_master(grads):
+    """Upcast half model grads to fp32 master grads.
+
+    Reference: fp16util.model_grads_to_master_grads."""
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32)
+        if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+
+
+class FP16State(NamedTuple):
+    masters: Any              # fp32 master weights
+    inner_state: Any          # wrapped optimizer's state over the masters
+    scaler: ScalerState
+
+
+class FP16_Optimizer:
+    """Manual master-weight mixed precision: the legacy flow as init/step.
+
+    Reference: fp16_utils/fp16_optimizer.py — wraps any optimizer; keeps fp32
+    masters; ``backward()`` scales the loss, ``step()`` unscales, checks for
+    inf/nan, skips on overflow, updates masters, writes halves back.  Here
+    the same contract is one pure function:
+
+        opt = FP16_Optimizer(FusedSGD(lr=0.1), dynamic_loss_scale=True)
+        state = opt.init(half_params)
+        loss_scaled = opt.scale_loss(loss, state)        # 'backward()'
+        half_params, state = opt.step(half_grads, state) # 'step()'
+
+    The step is jit/shard_map-safe: the overflow skip is a where-select, not
+    host control flow (the same mechanism as engine.py's train step).
+    """
+
+    def __init__(self, inner, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None):
+        self.inner = inner
+        kw = dict(dynamic_loss_args or {})
+        if dynamic_loss_scale:
+            self.scaler0 = DynamicLossScaler(
+                init_scale=kw.get("init_scale", 2.0 ** 16),
+                scale_factor=kw.get("scale_factor", 2.0),
+                scale_window=kw.get("scale_window", 2000))
+        else:
+            self.scaler0 = LossScaler(static_loss_scale)
+
+    def init(self, half_params) -> FP16State:
+        _, masters = prep_param_lists(half_params)
+        return FP16State(masters=masters,
+                         inner_state=self.inner.init(masters),
+                         scaler=self.scaler0)
+
+    def scale_loss(self, loss, state: FP16State):
+        """The ``with amp.scale_loss``-less legacy form: loss * scale."""
+        return scale_loss(loss, state.scaler)
+
+    def step(self, half_grads, state: FP16State):
+        """Unscale → finite-check → (maybe skipped) master update → halves."""
+        grads, finite = unscale_grads(grads_to_master(half_grads),
+                                      state.scaler)
+        new_masters, new_inner = self.inner.apply(grads, state.inner_state,
+                                                  state.masters)
+        new_masters = select_tree(finite, new_masters, state.masters)
+        new_inner = select_tree(finite, new_inner, state.inner_state)
+        scaler = update_scaler(state.scaler, finite)
+        half_params = master_to_model(new_masters, half_grads)
+        return half_params, FP16State(new_masters, new_inner, scaler)
+
+    # --- checkpoint surface (reference: FP16_Optimizer.state_dict) ---
+    def state_dict(self, state: FP16State) -> dict:
+        return {"scaler": state_dict(state.scaler)}
+
+    def load_state_dict(self, state: FP16State, d: dict) -> FP16State:
+        return state._replace(scaler=load_state_dict(state.scaler,
+                                                     d["scaler"]))
+
+
+# Legacy scaler names (reference: fp16_utils/loss_scaler.py).
+def LossScaler(scale: float = 1.0) -> ScalerState:
+    return ScalerState(scale=jnp.asarray(scale, jnp.float32),
+                       growth_counter=jnp.asarray(0, jnp.int32),
+                       dynamic=False, identity=(scale == 1.0))
+
+
+def DynamicLossScaler(init_scale: float = 2.0 ** 16,
+                      scale_factor: float = 2.0,
+                      scale_window: int = 2000) -> ScalerState:
+    return ScalerState(scale=jnp.asarray(init_scale, jnp.float32),
+                       growth_counter=jnp.asarray(0, jnp.int32),
+                       dynamic=True, growth_factor=scale_factor,
+                       growth_interval=scale_window)

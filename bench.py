@@ -1,81 +1,329 @@
 #!/usr/bin/env python
-"""Benchmark harness: headline metric = ResNet-50 ImageNet-shaped images/sec
-per chip under amp-O2 bf16 (BASELINE.md; target 4000 img/s/chip on v5e).
+"""Benchmark harness for the BASELINE.md acceptance matrix.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default (no args) = the headline metric: ResNet-50 ImageNet-shaped
+images/sec per chip under amp-O2 bf16 (BASELINE.md; target 4000 img/s/chip
+on v5e).  Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Data is generated on-device once and reused across steps so the number
-isolates device throughput (this host has 1 CPU core; a host-side input
-pipeline would bottleneck the measurement — the reference isolates the same
-way with its CUDA-stream prefetcher, SURVEY.md §3.5).
+``--config`` selects the other acceptance-matrix rows (BASELINE.md:17-30):
+  c1        ResNet-18 / CIFAR-shaped fp32 O0, single device   (img/s/chip)
+  c2        ResNet-50 / ImageNet-shaped amp-O2 bf16 (default) (img/s/chip)
+  c3        ResNet-50 DDP + SyncBatchNorm over all local devices
+            (img/s/chip; on the 1-chip rig this measures the sharded-step
+            path; semantics are covered by the 8-CPU-device tests)
+  c4        BERT-base MLM + FusedLAMB amp-O2                  (tokens/s/chip)
+  c5        Transformer-XL + FusedLayerNorm + grad clip       (tokens/s/chip)
+  hostpipe  c2 step fed by the native C++ double-buffered prefetcher
+            instead of on-device synthesis (quantifies the host pipeline;
+            stderr carries the on-device comparison)
+
+Data is generated on-device once and reused across steps (c1-c5) so the
+number isolates device throughput (this host has 1 CPU core; a host-side
+input pipeline would bottleneck the measurement — the reference isolates
+the same way with its CUDA-stream prefetcher, SURVEY.md §3.5).
+
+``vs_baseline`` is reported against the only normative target (4000
+img/s/chip, ResNet-50 O2) for c2/c3; other rows have no published baseline
+(BASELINE.md:3) and report ``vs_baseline: null``.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
-from apex_example_tpu import amp
-from apex_example_tpu.data import image_batch
-from apex_example_tpu.engine import create_train_state, make_train_step
-from apex_example_tpu.models import resnet50
-from apex_example_tpu.optim import FusedSGD
-
 BASELINE_IMG_PER_SEC_PER_CHIP = 4000.0
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=256)
-    ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--steps", type=int, default=30)
-    ap.add_argument("--warmup", type=int, default=5)
-    args = ap.parse_args()
+def _emit(metric: str, value: float, unit: str, vs_baseline):
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": (round(vs_baseline, 4)
+                        if vs_baseline is not None else None),
+    }))
 
-    policy, scaler = amp.initialize("O2")
-    model = resnet50(num_classes=1000, dtype=policy.compute_dtype,
-                     param_dtype=policy.param_dtype, bn_dtype=policy.bn_dtype)
+
+def chain_rate(step, state, batch, steps: int, items_per_step: int,
+               fetch) -> float:
+    """Two-point measurement: a scalar *value fetch* is the only reliable
+    execution barrier through the remote-TPU tunnel (block_until_ready
+    returns at enqueue there), and differencing two chain lengths cancels
+    the fetch round-trip so the rate reflects device throughput.
+
+    NOTE: consumes ``state`` (steps donate their input state); callers must
+    not reuse the pytree they passed in.
+    """
+    steps = max(steps, 2)           # two chains must differ in length
+    def run_chain(n, state):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        fetch(metrics)
+        return time.perf_counter() - t0, state
+
+    n1 = max(steps // 5, 1)
+    if n1 >= steps:
+        n1 = steps - 1
+    t1, state = run_chain(n1, state)
+    t2, state = run_chain(steps, state)
+    return (steps - n1) * items_per_step / max(t2 - t1, 1e-9)
+
+
+def _image_setup(policy, scaler, *, arch: str, batch_size: int,
+                 image_size: int, num_classes: int,
+                 syncbn: bool = False):
+    from apex_example_tpu.data import image_batch
+    from apex_example_tpu.engine import create_train_state
+    from apex_example_tpu.models import ARCHS
+    from apex_example_tpu.optim import FusedSGD
+
+    model = ARCHS[arch](
+        num_classes=num_classes, dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype, bn_dtype=policy.bn_dtype,
+        bn_axis_name="data" if syncbn else None)
     opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
-
-    batch = image_batch(jnp.asarray(0), batch_size=args.batch_size,
-                        image_size=args.image_size, channels=3,
-                        num_classes=1000, seed=0)
-    batch = jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, jax.devices()[0]), batch)
-
+    batch = image_batch(jnp.asarray(0), batch_size=batch_size,
+                        image_size=image_size, channels=3,
+                        num_classes=num_classes, seed=0)
     state = create_train_state(jax.random.PRNGKey(0), model, opt,
                                batch[0][:1], policy, scaler)
+    return model, opt, batch, state
+
+
+def bench_image_single(args, *, arch: str, opt_level: str, image_size: int,
+                       num_classes: int, metric: str, vs_target: bool):
+    from apex_example_tpu import amp
+    from apex_example_tpu.engine import make_train_step
+
+    policy, scaler = amp.initialize(opt_level)
+    model, opt, batch, state = _image_setup(
+        policy, scaler, arch=arch, batch_size=args.batch_size,
+        image_size=image_size, num_classes=num_classes)
+    batch = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), batch)
     step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
 
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch)
     float(metrics["loss"])
 
-    # Two-point measurement: a scalar *value fetch* is the only reliable
-    # execution barrier through the remote-TPU tunnel (block_until_ready
-    # returns at enqueue there), and differencing two chain lengths cancels
-    # the fetch round-trip so the rate reflects device throughput.
-    def run_chain(n, state):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, metrics = step(state, batch)
-        float(metrics["loss"])
-        return time.perf_counter() - t0, state
+    rate = chain_rate(step, state, batch, args.steps, args.batch_size,
+                      lambda m: float(m["loss"]))
+    _emit(metric, rate, "images/sec/chip",
+          rate / BASELINE_IMG_PER_SEC_PER_CHIP if vs_target else None)
 
-    n1 = max(args.steps // 5, 1)
-    t1, state = run_chain(n1, state)
-    t2, state = run_chain(args.steps, state)
-    rate = (args.steps - n1) * args.batch_size / max(t2 - t1, 1e-9)
-    print(json.dumps({
-        "metric": "resnet50_imagenet_ampO2_bf16_train_images_per_sec_per_chip",
-        "value": round(rate, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(rate / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
-    }))
+
+def bench_c3(args):
+    """ResNet-50 DDP + SyncBN over every local device (BASELINE.md row 3)."""
+    from apex_example_tpu import amp
+    from apex_example_tpu.engine import make_sharded_train_step
+    from apex_example_tpu.parallel.mesh import make_data_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = make_data_mesh(devices=devices)
+    policy, scaler = amp.initialize("O2")
+    global_bs = args.batch_size * n
+    model, opt, batch, state = _image_setup(
+        policy, scaler, arch="resnet50", batch_size=global_bs,
+        image_size=args.image_size, num_classes=1000, syncbn=True)
+    step = make_sharded_train_step(mesh, model, opt, policy)
+
+    for _ in range(max(args.warmup, 1)):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    rate = chain_rate(step, state, batch, args.steps, global_bs,
+                      lambda m: float(m["loss"]))
+    _emit(f"resnet50_ddp_syncbn_{n}dev_ampO2_images_per_sec_per_chip",
+          rate / n, "images/sec/chip",
+          rate / n / BASELINE_IMG_PER_SEC_PER_CHIP)
+
+
+def bench_c4(args):
+    """BERT-base MLM + FusedLAMB under amp-O2 (BASELINE.md row 4)."""
+    from apex_example_tpu import amp
+    from apex_example_tpu.data import mlm_batch
+    from apex_example_tpu.engine import create_train_state, make_train_step
+    from apex_example_tpu.models.bert import bert_base
+    from apex_example_tpu.optim import FusedLAMB
+    from apex_example_tpu.workloads import mlm_loss
+
+    policy, scaler = amp.initialize("O2")
+    md = amp.module_dtypes(policy)
+    model = bert_base(dtype=md.compute, param_dtype=md.param,
+                      ln_dtype=md.ln_io, softmax_dtype=md.softmax)
+    opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
+    bs, seq = args.batch_size, args.seq_len
+    V = model.vocab_size
+    ids, labels, w = mlm_batch(jnp.asarray(0), batch_size=bs, seq_len=seq,
+                               vocab_size=V, mask_token_id=V - 1, seed=0)
+    batch = (ids, (labels, w))
+    state = create_train_state(jax.random.PRNGKey(0), model, opt, ids[:1],
+                               policy, scaler, train_kwargs={})
+    step = jax.jit(make_train_step(model, opt, policy, loss_fn=mlm_loss,
+                                   compute_accuracy=False),
+                   donate_argnums=(0,))
+
+    for _ in range(max(args.warmup, 1)):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    rate = chain_rate(step, state, batch, args.steps, bs * seq,
+                      lambda m: float(m["loss"]))
+    _emit("bert_base_mlm_fusedlamb_ampO2_tokens_per_sec_per_chip",
+          rate, "tokens/sec/chip", None)
+
+
+def bench_c5(args):
+    """Transformer-XL + FusedLayerNorm + grad clip (BASELINE.md row 5)."""
+    from apex_example_tpu import amp
+    from apex_example_tpu.data import lm_batch
+    from apex_example_tpu.engine import create_train_state
+    from apex_example_tpu.models.transformer_xl import transformer_xl_base
+    from apex_example_tpu.optim import FusedAdam
+    from apex_example_tpu.workloads import make_txl_train_step
+
+    policy, scaler = amp.initialize("O2")
+    md = amp.module_dtypes(policy)
+    model = transformer_xl_base(dtype=md.compute, param_dtype=md.param,
+                                ln_dtype=md.ln_io, softmax_dtype=md.softmax)
+    opt = FusedAdam(lr=2.5e-4)
+    bs, seq = args.batch_size, args.seq_len
+    V = model.vocab_size
+    toks = lm_batch(jnp.asarray(0), batch_size=bs, seq_len=seq + 1,
+                    vocab_size=V, seed=0)
+    batch = (toks[:, :-1], toks[:, 1:])
+    state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                               batch[0][:1], policy, scaler,
+                               train_kwargs={})
+    mems = model.init_mems(bs)
+    raw = jax.jit(make_txl_train_step(model, opt, policy),
+                  donate_argnums=(0, 1))
+    # adapt (state, mems) into the chain_rate (state, batch) shape
+    def step(carry, batch):
+        state, mems = carry
+        state, mems, metrics = raw(state, mems, batch)
+        return (state, mems), metrics
+
+    carry = (state, mems)
+    for _ in range(max(args.warmup, 1)):
+        carry, metrics = step(carry, batch)
+    float(metrics["loss"])
+
+    rate = chain_rate(step, carry, batch, args.steps, bs * seq,
+                      lambda m: float(m["loss"]))
+    _emit("transformer_xl_fusedln_clip_tokens_per_sec_per_chip",
+          rate, "tokens/sec/chip", None)
+
+
+def bench_hostpipe(args):
+    """C2 step fed by the native host prefetcher vs on-device synthesis.
+
+    Quantifies the C++ double-buffered pipeline (csrc/apex_tpu_host.cpp):
+    the JSON line is the host-fed rate; stderr carries the on-device rate
+    so the comparison lands in one run.
+    """
+    from apex_example_tpu import amp
+    from apex_example_tpu.engine import make_train_step
+    from apex_example_tpu.host_runtime import NativePrefetcher, available
+    if not available():
+        print("hostpipe: native runtime not buildable", file=sys.stderr)
+        return
+
+    policy, scaler = amp.initialize("O2")
+    model, opt, batch, state = _image_setup(
+        policy, scaler, arch="resnet50", batch_size=args.batch_size,
+        image_size=args.image_size, num_classes=1000)
+    step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
+
+    dev_batch = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), batch)
+    for _ in range(max(args.warmup, 1)):
+        state, metrics = step(state, dev_batch)
+    float(metrics["loss"])
+
+    on_device = chain_rate(step, state, dev_batch, args.steps,
+                           args.batch_size, lambda m: float(m["loss"]))
+
+    pf = NativePrefetcher(batch=args.batch_size,
+                          image_size=args.image_size,
+                          num_classes=1000, seed=0)
+    it = iter(pf)
+
+    def host_step(state, _):
+        img, lab = next(it)
+        b = (jnp.asarray(img), jnp.asarray(lab))
+        return step(state, b)
+
+    # chain_rate consumed the donated state above — start a fresh one for
+    # the host-fed phase.
+    _, _, _, state = _image_setup(
+        policy, scaler, arch="resnet50", batch_size=args.batch_size,
+        image_size=args.image_size, num_classes=1000)
+    for _ in range(2):
+        state, metrics = host_step(state, None)
+    float(metrics["loss"])
+    host_rate = chain_rate(host_step, state, None, args.steps,
+                           args.batch_size, lambda m: float(m["loss"]))
+    print(f"hostpipe: on-device {on_device:.1f} img/s, "
+          f"host-fed {host_rate:.1f} img/s "
+          f"({host_rate / on_device:.2%})", file=sys.stderr)
+    _emit("resnet50_ampO2_hostpipe_images_per_sec_per_chip", host_rate,
+          "images/sec/chip", host_rate / BASELINE_IMG_PER_SEC_PER_CHIP)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="c2",
+                    choices=["c1", "c2", "c3", "c4", "c5", "hostpipe"])
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--image-size", type=int, default=None)
+    ap.add_argument("--seq-len", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+
+    defaults = {          # (batch_size, image_size, seq_len)
+        "c1": (256, 32, None), "c2": (256, 224, None),
+        "c3": (256, 224, None), "c4": (64, None, 128),
+        "c5": (32, None, 192), "hostpipe": (256, 224, None),
+    }
+    db, di, ds = defaults[args.config]
+    if args.batch_size is None:
+        args.batch_size = db
+    if args.image_size is None:
+        args.image_size = di
+    if args.seq_len is None:
+        args.seq_len = ds
+
+    if args.config == "c1":
+        bench_image_single(
+            args, arch="resnet18", opt_level="O0",
+            image_size=args.image_size, num_classes=10,
+            metric="resnet18_cifar_fp32_images_per_sec_per_chip",
+            vs_target=False)
+    elif args.config == "c2":
+        bench_image_single(
+            args, arch="resnet50", opt_level="O2",
+            image_size=args.image_size, num_classes=1000,
+            metric="resnet50_imagenet_ampO2_bf16_train_images_per_sec_per_chip",
+            vs_target=True)
+    elif args.config == "c3":
+        bench_c3(args)
+    elif args.config == "c4":
+        bench_c4(args)
+    elif args.config == "c5":
+        bench_c5(args)
+    elif args.config == "hostpipe":
+        bench_hostpipe(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Microbenchmark per-channel reductions on the real chip: XLA vs Pallas.
+
+The C2 trace shows BN stat/backward reduce fusions running at ~130GB/s
+effective — 16% of v5e HBM peak.  This probe measures, for a
+bf16[256,56,56,C] activation:
+
+  1. xla_sum:    jnp.sum(x, (0,1,2)) in fp32
+  2. xla_bnstat: centered (Σ(x-c), Σ(x-c)²) pair (our BN fwd stats)
+  3. xla_bnbwd:  (Σdy, Σdy·x̂) pair (BN bwd sums; x̂ recomputed)
+  4. pl_bnstat:  Pallas one-pass (Σ, Σ²) kernel
+  5. pl_bnbwd:   Pallas one-pass (Σdy, Σdy·x̂) kernel
+
+Prints effective GB/s (bytes read / time) for each.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+    float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    # two-point chain through the tunnel
+    def chain(n):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = fn(*args)
+        float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+        return time.perf_counter() - t0
+    t1 = chain(max(iters // 5, 1))
+    t2 = chain(iters)
+    return (t2 - t1) / (iters - max(iters // 5, 1))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--hw", type=int, default=56)
+    ap.add_argument("--c", type=int, default=256)
+    args = ap.parse_args()
+
+    N, H, W, C = args.n, args.hw, args.hw, args.c
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (N, H, W, C), jnp.bfloat16)
+    dy = jax.random.normal(jax.random.PRNGKey(1), (N, H, W, C), jnp.bfloat16)
+    nbytes = x.size * 2
+    c0 = jnp.zeros((C,), jnp.float32)
+    mean = jnp.zeros((C,), jnp.float32)
+    inv = jnp.ones((C,), jnp.float32)
+
+    @jax.jit
+    def xla_sum(x):
+        return jnp.sum(x.astype(jnp.float32), axis=(0, 1, 2))
+
+    @jax.jit
+    def xla_bnstat(x, c):
+        xc = x.astype(jnp.float32) - c
+        return jnp.sum(xc, (0, 1, 2)), jnp.sum(xc * xc, (0, 1, 2))
+
+    @jax.jit
+    def xla_bnbwd(x, dy, mean, inv):
+        xf = x.astype(jnp.float32)
+        dyf = dy.astype(jnp.float32)
+        xhat = (xf - mean) * inv
+        return jnp.sum(dyf, (0, 1, 2)), jnp.sum(dyf * xhat, (0, 1, 2))
+
+    t = timeit(xla_sum, x)
+    print(f"xla_sum:     {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s")
+    t = timeit(xla_bnstat, x, c0)
+    print(f"xla_bnstat:  {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s")
+    t = timeit(xla_bnbwd, x, dy, mean, inv)
+    print(f"xla_bnbwd:   {t*1e3:7.3f} ms  {2*nbytes/t/1e9:7.1f} GB/s")
+
+    # ---- Pallas kernels ----
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BN_BLOCK = 8  # rows of (H*W) per grid step? use batch blocking
+
+    x3 = x.reshape(N * H * W, C)
+    dy3 = dy.reshape(N * H * W, C)
+    rows = x3.shape[0]
+    blk = 2048
+
+    def stat_kernel(x_ref, s_ref, ss_ref):
+        i = pl.program_id(0)
+        xf = x_ref[...].astype(jnp.float32)
+        s = jnp.sum(xf, axis=0)
+        ss = jnp.sum(xf * xf, axis=0)
+
+        @pl.when(i == 0)
+        def _():
+            s_ref[...] = jnp.zeros_like(s_ref)
+            ss_ref[...] = jnp.zeros_like(ss_ref)
+        s_ref[...] += s
+        ss_ref[...] += ss
+
+    @jax.jit
+    def pl_bnstat(x3):
+        grid = rows // blk
+        return pl.pallas_call(
+            stat_kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=[pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM)],
+            out_shape=[jax.ShapeDtypeStruct((C,), jnp.float32),
+                       jax.ShapeDtypeStruct((C,), jnp.float32)],
+        )(x3)
+
+    def bwd_kernel(x_ref, dy_ref, m_ref, i_ref, s_ref, sx_ref):
+        i = pl.program_id(0)
+        xf = x_ref[...].astype(jnp.float32)
+        dyf = dy_ref[...].astype(jnp.float32)
+        xhat = (xf - m_ref[...]) * i_ref[...]
+        s = jnp.sum(dyf, axis=0)
+        sx = jnp.sum(dyf * xhat, axis=0)
+
+        @pl.when(i == 0)
+        def _():
+            s_ref[...] = jnp.zeros_like(s_ref)
+            sx_ref[...] = jnp.zeros_like(sx_ref)
+        s_ref[...] += s
+        sx_ref[...] += sx
+
+    @jax.jit
+    def pl_bnbwd(x3, dy3, mean, inv):
+        grid = rows // blk
+        return pl.pallas_call(
+            bwd_kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((C,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((C,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=[pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM)],
+            out_shape=[jax.ShapeDtypeStruct((C,), jnp.float32),
+                       jax.ShapeDtypeStruct((C,), jnp.float32)],
+        )(x3, dy3, mean, inv)
+
+    t = timeit(pl_bnstat, x3)
+    s_ref = xla_bnstat(x, c0)
+    s_pl = pl_bnstat(x3)
+    err = float(jnp.max(jnp.abs(s_ref[0] - s_pl[0])))
+    print(f"pl_bnstat:   {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s  (maxerr {err:.2e})")
+    t = timeit(pl_bnbwd, x3, dy3, mean, inv)
+    b_ref = xla_bnbwd(x, dy, mean, inv)
+    b_pl = pl_bnbwd(x3, dy3, mean, inv)
+    err = float(jnp.max(jnp.abs(b_ref[1] - b_pl[1])))
+    print(f"pl_bnbwd:    {t*1e3:7.3f} ms  {2*nbytes/t/1e9:7.1f} GB/s  (maxerr {err:.2e})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""BN reduce microbenchmark, take 2: time inside one jit via lax.fori_loop
+with forced data dependence, so dispatch/tunnel effects cancel.
+
+Also benchmarks the fused one-pass BN-backward (sums + dx in one kernel
+read) and the 4-D NHWC-blocked variants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import jax.experimental.pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def loop_time(make_step, init, iters=50):
+    """Time `iters` dependent applications inside one jit."""
+    @jax.jit
+    def run(carry):
+        return jax.lax.fori_loop(0, iters, lambda i, c: make_step(c), carry)
+    out = run(init)
+    float(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # warm
+    t0 = time.perf_counter()
+    out = run(init)
+    float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    N, H, W, C = 256, 56, 56, 256
+    x = jax.random.normal(jax.random.PRNGKey(0), (N, H, W, C), jnp.bfloat16)
+    dy = jax.random.normal(jax.random.PRNGKey(1), (N, H, W, C), jnp.bfloat16)
+    nbytes = x.size * 2
+    R = N * H * W
+    x2, dy2 = x.reshape(R, C), dy.reshape(R, C)
+    mean = jnp.zeros((C,), jnp.float32)
+    inv = jnp.ones((C,), jnp.float32)
+
+    blk = 4096
+
+    def stat_kernel(x_ref, s_ref, ss_ref):
+        i = pl.program_id(0)
+        xf = x_ref[...].astype(jnp.float32)
+
+        @pl.when(i == 0)
+        def _():
+            s_ref[...] = jnp.zeros_like(s_ref)
+            ss_ref[...] = jnp.zeros_like(ss_ref)
+        s_ref[...] += jnp.sum(xf, axis=0)
+        ss_ref[...] += jnp.sum(xf * xf, axis=0)
+
+    def pl_bnstat(x2):
+        return pl.pallas_call(
+            stat_kernel,
+            grid=(R // blk,),
+            in_specs=[pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=[pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM)],
+            out_shape=[jax.ShapeDtypeStruct((C,), jnp.float32),
+                       jax.ShapeDtypeStruct((C,), jnp.float32)])(x2)
+
+    # chain: feed the (tiny) sums back so iterations depend on each other
+    def step_stat(carry):
+        xx, acc = carry
+        s, ss = pl_bnstat(xx)
+        return xx, acc + s[0] + ss[0]
+
+    t = loop_time(step_stat, (x2, jnp.zeros((), jnp.float32)))
+    print(f"pl_bnstat(2d):    {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s")
+
+    # XLA versions under the same harness
+    def step_xla_stat(carry):
+        xx, acc = carry
+        xf = xx.astype(jnp.float32)
+        s = jnp.sum(xf, (0,))
+        ss = jnp.sum(xf * xf, (0,))
+        return xx, acc + s[0] + ss[0]
+
+    t = loop_time(step_xla_stat, (x2, jnp.zeros((), jnp.float32)))
+    print(f"xla_bnstat(2d):   {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s")
+
+    def step_xla_stat4(carry):
+        xx, acc = carry
+        xf = xx.astype(jnp.float32)
+        s = jnp.sum(xf, (0, 1, 2))
+        ss = jnp.sum(xf * xf, (0, 1, 2))
+        return xx, acc + s[0] + ss[0]
+
+    t = loop_time(step_xla_stat4, (x, jnp.zeros((), jnp.float32)))
+    print(f"xla_bnstat(4d):   {t*1e3:7.3f} ms  {nbytes/t/1e9:7.1f} GB/s")
+
+    # ---- backward: sums only ----
+    def bwd_kernel(x_ref, dy_ref, m_ref, i_ref, s_ref, sx_ref):
+        i = pl.program_id(0)
+        xf = x_ref[...].astype(jnp.float32)
+        dyf = dy_ref[...].astype(jnp.float32)
+        xhat = (xf - m_ref[...]) * i_ref[...]
+
+        @pl.when(i == 0)
+        def _():
+            s_ref[...] = jnp.zeros_like(s_ref)
+            sx_ref[...] = jnp.zeros_like(sx_ref)
+        s_ref[...] += jnp.sum(dyf, axis=0)
+        sx_ref[...] += jnp.sum(dyf * xhat, axis=0)
+
+    def pl_bnbwd(x2, dy2):
+        return pl.pallas_call(
+            bwd_kernel,
+            grid=(R // blk,),
+            in_specs=[pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((C,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((C,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=[pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((C,), lambda i: (0,),
+                                    memory_space=pltpu.VMEM)],
+            out_shape=[jax.ShapeDtypeStruct((C,), jnp.float32),
+                       jax.ShapeDtypeStruct((C,), jnp.float32)])(x2, dy2, mean, inv)
+
+    def step_bwd(carry):
+        xx, dd, acc = carry
+        s, sx = pl_bnbwd(xx, dd)
+        return xx, dd, acc + s[0] + sx[0]
+
+    t = loop_time(step_bwd, (x2, dy2, jnp.zeros((), jnp.float32)))
+    print(f"pl_bnbwd(2d):     {t*1e3:7.3f} ms  {2*nbytes/t/1e9:7.1f} GB/s")
+
+    # ---- full BN backward: sums pass + dx pass, both Pallas ----
+    def dx_kernel(x_ref, dy_ref, m_ref, i_ref, g_ref, s_ref, sx_ref, dx_ref):
+        xf = x_ref[...].astype(jnp.float32)
+        dyf = dy_ref[...].astype(jnp.float32)
+        xhat = (xf - m_ref[...]) * i_ref[...]
+        dx = g_ref[...] * i_ref[...] * (dyf - s_ref[...] - xhat * sx_ref[...])
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+
+    def pl_bndx(x2, dy2, s, sx):
+        return pl.pallas_call(
+            dx_kernel,
+            grid=(R // blk,),
+            in_specs=[pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)] +
+                     [pl.BlockSpec((C,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM)] * 5,
+            out_specs=[pl.BlockSpec((blk, C), lambda i: (i, 0),
+                                    memory_space=pltpu.VMEM)],
+            out_shape=[jax.ShapeDtypeStruct((R, C), jnp.bfloat16)],
+        )(x2, dy2, mean, inv, jnp.ones((C,), jnp.float32), s, sx)
+
+    def step_full_bwd(carry):
+        xx, dd, acc = carry
+        s, sx = pl_bnbwd(xx, dd)
+        dx, = pl_bndx(xx, dd, s / R, sx / R)
+        return xx, dd, acc + dx[0, 0].astype(jnp.float32)
+
+    t = loop_time(step_full_bwd, (x2, dy2, jnp.zeros((), jnp.float32)))
+    print(f"pl_bn_full_bwd:   {t*1e3:7.3f} ms  {5*nbytes/t/1e9:7.1f} GB/s "
+          f"(sums+dx, 4r+1w)")
+
+    # XLA full backward under same harness
+    def step_xla_full_bwd(carry):
+        xx, dd, acc = carry
+        xf = xx.astype(jnp.float32)
+        dyf = dd.astype(jnp.float32)
+        xhat = (xf - mean) * inv
+        s = jnp.sum(dyf, 0) / R
+        sx = jnp.sum(dyf * xhat, 0) / R
+        dx = (inv * (dyf - s - xhat * sx)).astype(jnp.bfloat16)
+        return xx, dd, acc + dx[0, 0].astype(jnp.float32)
+
+    t = loop_time(step_xla_full_bwd, (x2, dy2, jnp.zeros((), jnp.float32)))
+    print(f"xla_bn_full_bwd:  {t*1e3:7.3f} ms  {5*nbytes/t/1e9:7.1f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Calibrate achievable HBM bandwidth on this chip.
+
+- xla elementwise scale (read+write) on 3.3GB
+- xla sum (read) on 3.3GB
+- pallas stream-sum, one launch over 3.3GB, parallel vs arbitrary semantics
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import jax.experimental.pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GB = 1e9
+
+
+def timed(label, jfn, args, bytes_moved, iters=10):
+    out = jfn(*args)
+    float(jax.tree_util.tree_leaves(out)[-1].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jfn(*args)
+    float(jax.tree_util.tree_leaves(out)[-1].ravel()[0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:28s} {dt*1e3:8.3f} ms  {bytes_moved/dt/GB:6.0f} GB/s")
+
+
+def main():
+    M, K = 8 * 802816, 256   # 3.29 GB bf16
+    x = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+    nbytes = M * K * 2
+
+    @jax.jit
+    def scale(x):
+        return x * jnp.bfloat16(1.001)
+
+    timed("xla elementwise r+w", scale, (x,), 2 * nbytes)
+
+    @jax.jit
+    def xsum(x):
+        return jnp.sum(x.astype(jnp.float32), axis=0)
+
+    timed("xla colsum read", xsum, (x,), nbytes)
+
+    for sem in ("parallel", "arbitrary"):
+        blk = 4096
+
+        def kernel(x_ref, s_ref):
+            @pl.when(pl.program_id(0) == 0)
+            def _():
+                s_ref[...] = jnp.zeros_like(s_ref)
+            s_ref[...] += jnp.sum(x_ref[...].astype(jnp.float32), axis=0)
+
+        f = pl.pallas_call(
+            kernel, grid=(M // blk,),
+            in_specs=[pl.BlockSpec((blk, K), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((K,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((K,), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=(sem,)))
+        timed(f"pl stream sum ({sem})", jax.jit(f), (x,), nbytes)
+
+    # bigger block
+    for blk in (8192, 16384):
+        def kernel(x_ref, s_ref):
+            @pl.when(pl.program_id(0) == 0)
+            def _():
+                s_ref[...] = jnp.zeros_like(s_ref)
+            s_ref[...] += jnp.sum(x_ref[...].astype(jnp.float32), axis=0)
+
+        f = pl.pallas_call(
+            kernel, grid=(M // blk,),
+            in_specs=[pl.BlockSpec((blk, K), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((K,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((K,), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)))
+        timed(f"pl stream sum blk={blk}", jax.jit(f), (x,), nbytes)
+
+    # bf16 accumulate (no convert): how much is the fp32 convert costing?
+    blk = 8192
+
+    def kernel_bf(x_ref, s_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            s_ref[...] = jnp.zeros_like(s_ref)
+        s_ref[...] += jnp.sum(x_ref[...], axis=0, dtype=jnp.float32)
+
+    f = pl.pallas_call(
+        kernel_bf, grid=(M // blk,),
+        in_specs=[pl.BlockSpec((blk, K), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((K,), lambda i: (0,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((K,), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)))
+    timed("pl sum dtype=f32 arg", jax.jit(f), (x,), nbytes)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Gate test for the fused-bottleneck plan: can a Pallas matmul with
+BN-apply prologue + stats epilogue stream the 1x1-conv shapes at HBM speed?
+
+Shapes (bs256, 56^2): A=(802816,256)x(256,64)  B=(802816,64)x(64,256)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import jax.experimental.pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def loop_time(fn, init, iters=30):
+    @jax.jit
+    def run(carry):
+        return jax.lax.fori_loop(0, iters, lambda i, c: fn(c), carry)
+    out = run(init)
+    float(jax.tree_util.tree_leaves(out)[-1].ravel()[0])
+    t0 = time.perf_counter()
+    out = run(init)
+    float(jax.tree_util.tree_leaves(out)[-1].ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def make_mm(M, K, N, blk_m, prologue, epilogue):
+    def kernel(*refs):
+        if prologue:
+            x_ref, m_ref, i_ref, g_ref, b_ref, w_ref = refs[:6]
+            orefs = refs[6:]
+        else:
+            x_ref, w_ref = refs[:2]
+            orefs = refs[2:]
+        x = x_ref[...]
+        if prologue:
+            xf = x.astype(jnp.float32)
+            xa = (xf - m_ref[...]) * i_ref[...] * g_ref[...] + b_ref[...]
+            x = jnp.maximum(xa, 0.0).astype(jnp.bfloat16)
+        y = jax.lax.dot_general(x, w_ref[...], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        yb = y.astype(jnp.bfloat16)
+        orefs[0][...] = yb
+        if epilogue:
+            s_ref, ss_ref = orefs[1], orefs[2]
+
+            @pl.when(pl.program_id(0) == 0)
+            def _():
+                s_ref[...] = jnp.zeros_like(s_ref)
+                ss_ref[...] = jnp.zeros_like(ss_ref)
+            s_ref[...] += jnp.sum(y, axis=0)
+            ss_ref[...] += jnp.sum(y * y, axis=0)
+
+    grid = (M // blk_m,)
+    in_specs = [pl.BlockSpec((blk_m, K), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)]
+    if prologue:
+        in_specs += [pl.BlockSpec((K,), lambda i: (0,),
+                                  memory_space=pltpu.VMEM)] * 4
+    in_specs += [pl.BlockSpec((K, N), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)]
+    out_specs = [pl.BlockSpec((blk_m, N), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((M, N), jnp.bfloat16)]
+    if epilogue:
+        out_specs += [pl.BlockSpec((N,), lambda i: (0,),
+                                   memory_space=pltpu.VMEM)] * 2
+        out_shape += [jax.ShapeDtypeStruct((N,), jnp.float32)] * 2
+
+    def f(x, w, params=None):
+        args = [x] + (list(params) if prologue else []) + [w]
+        return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                              out_specs=out_specs, out_shape=out_shape)(*args)
+    return f
+
+
+def bench_shape(M, K, N, blk_m=1024):
+    x = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.bfloat16) * 0.05
+    params = [jnp.zeros((K,), jnp.float32), jnp.ones((K,), jnp.float32),
+              jnp.ones((K,), jnp.float32), jnp.zeros((K,), jnp.float32)]
+    bytes_min = (M * K + M * N) * 2
+    flops = 2 * M * K * N
+
+    def xla_mm(c):
+        xx, ww, acc = c
+        y = jnp.dot(xx, ww, preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        return xx, ww, acc + y[0, 0].astype(jnp.float32)
+
+    t = loop_time(xla_mm, (x, w, jnp.zeros((), jnp.float32)))
+    print(f"  xla dot:            {t*1e3:7.3f} ms  {bytes_min/t/1e9:6.0f} GB/s  {flops/t/1e12:5.1f} TF/s")
+
+    mm = make_mm(M, K, N, blk_m, False, False)
+    def pl_plain(c):
+        xx, ww, acc = c
+        y, = mm(xx, ww)
+        return xx, ww, acc + y[0, 0].astype(jnp.float32)
+    t = loop_time(pl_plain, (x, w, jnp.zeros((), jnp.float32)))
+    print(f"  pl  mm:             {t*1e3:7.3f} ms  {bytes_min/t/1e9:6.0f} GB/s")
+
+    mmf = make_mm(M, K, N, blk_m, True, True)
+    def pl_fused(c):
+        xx, ww, acc = c
+        y, s, ss = mmf(xx, ww, params)
+        return xx, ww, acc + s[0] + ss[0] + y[0, 0].astype(jnp.float32)
+    t = loop_time(pl_fused, (x, w, jnp.zeros((), jnp.float32)))
+    print(f"  pl  mm+prol+stats:  {t*1e3:7.3f} ms  {bytes_min/t/1e9:6.0f} GB/s")
+
+    # correctness
+    y_ref = jnp.dot(jnp.maximum(x.astype(jnp.float32), 0.0).astype(jnp.bfloat16),
+                    w, preferred_element_type=jnp.float32)
+    y_pl, s, ss = mmf(x, w, params)
+    err = float(jnp.max(jnp.abs(y_ref.astype(jnp.float32) - y_pl.astype(jnp.float32))))
+    serr = float(jnp.max(jnp.abs(jnp.sum(y_ref, 0) - s)))
+    print(f"  maxerr y {err:.3e}  s {serr:.3e}")
+
+
+def main():
+    for (M, K, N) in [(802816, 256, 64), (802816, 64, 256),
+                      (200704, 512, 128), (802816, 256, 256)]:
+        print(f"M={M} K={K} N={N}")
+        bench_shape(M, K, N)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Matmul tuning round: block_m sweep, NHWC conv reference, multi-step grid.
+
+Question: what's the real ceiling for the 1x1-conv shape (802816,256)->(.,64)
+on this chip, and can Pallas reach it?
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import jax.experimental.pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def loop_time(fn, init, iters=30):
+    @jax.jit
+    def run(carry):
+        return jax.lax.fori_loop(0, iters, lambda i, c: fn(c), carry)
+    out = run(init)
+    float(jax.tree_util.tree_leaves(out)[-1].ravel()[0])
+    t0 = time.perf_counter()
+    out = run(init)
+    float(jax.tree_util.tree_leaves(out)[-1].ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+M, K, N = 802816, 256, 64
+NB, HH, WW = 256, 56, 56
+
+
+def main():
+    x = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+    x4 = x.reshape(NB, HH, WW, K)
+    w = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.bfloat16) * 0.05
+    w4 = w.reshape(1, 1, K, N)
+    bytes_min = (M * K + M * N) * 2
+
+    # reference: XLA 1x1 conv in NHWC
+    def conv(c):
+        xx, ww, acc = c
+        y = jax.lax.conv_general_dilated(
+            xx, ww, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        return xx, ww, acc + y[0, 0, 0, 0].astype(jnp.float32)
+    t = loop_time(conv, (x4, w4, jnp.zeros((), jnp.float32)))
+    print(f"xla conv1x1 NHWC:   {t*1e3:7.3f} ms  {bytes_min/t/1e9:6.0f} GB/s")
+
+    # XLA conv fused with a relu producer and consumer (in-model-like)
+    def conv_ctx(c):
+        xx, ww, acc = c
+        a = jnp.maximum(xx, 0)
+        y = jax.lax.conv_general_dilated(
+            a, ww, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        return xx, ww, acc + y[0, 0, 0, 0].astype(jnp.float32)
+    t = loop_time(conv_ctx, (x4, w4, jnp.zeros((), jnp.float32)))
+    print(f"xla relu+conv1x1:   {t*1e3:7.3f} ms  {bytes_min/t/1e9:6.0f} GB/s")
+
+    # pallas blocked matmul, block_m sweep
+    for blk_m in (2048, 4096, 8192):
+        def kernel(x_ref, w_ref, o_ref):
+            o_ref[...] = jax.lax.dot_general(
+                x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        mm = pl.pallas_call(
+            kernel, grid=(M // blk_m,),
+            in_specs=[pl.BlockSpec((blk_m, K), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((K, N), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((blk_m, N), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((M, N), jnp.bfloat16))
+
+        def pl_mm(c):
+            xx, ww, acc = c
+            y = mm(xx, ww)
+            return xx, ww, acc + y[0, 0].astype(jnp.float32)
+        t = loop_time(pl_mm, (x, w, jnp.zeros((), jnp.float32)))
+        print(f"pl mm blk_m={blk_m:5d}:  {t*1e3:7.3f} ms  {bytes_min/t/1e9:6.0f} GB/s")
+
+    # pallas with wider N via K-padding? try fp32 accum output stats-only read
+    # pure read benchmark: how fast can pallas stream x at all?
+    blk_m = 4096
+    def rd_kernel(x_ref, s_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            s_ref[...] = jnp.zeros_like(s_ref)
+        s_ref[...] += jnp.sum(x_ref[...].astype(jnp.float32), axis=0)
+
+    rd = pl.pallas_call(
+        rd_kernel, grid=(M // blk_m,),
+        in_specs=[pl.BlockSpec((blk_m, K), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((K,), lambda i: (0,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((K,), jnp.float32))
+
+    def pl_rd(c):
+        xx, acc = c
+        s = rd(xx)
+        return xx, acc + s[0]
+    t = loop_time(pl_rd, (x, jnp.zeros((), jnp.float32)))
+    print(f"pl stream-read sum: {t*1e3:7.3f} ms  {M*K*2/t/1e9:6.0f} GB/s")
+
+    # MXU-reduce read: s = ones @ x
+    def rd2_kernel(x_ref, s_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            s_ref[...] = jnp.zeros_like(s_ref)
+        ones = jnp.ones((8, blk_m), jnp.bfloat16)
+        s_ref[...] += jax.lax.dot_general(
+            ones, x_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    rd2 = pl.pallas_call(
+        rd2_kernel, grid=(M // blk_m,),
+        in_specs=[pl.BlockSpec((blk_m, K), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, K), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, K), jnp.float32))
+
+    def pl_rd2(c):
+        xx, acc = c
+        s = rd2(xx)
+        return xx, acc + s[0, 0]
+    t = loop_time(pl_rd2, (x, jnp.zeros((), jnp.float32)))
+    print(f"pl mxu-reduce read: {t*1e3:7.3f} ms  {M*K*2/t/1e9:6.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

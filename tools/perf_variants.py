@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Measure C2 step throughput under one configuration variant (one process
+per variant so XLA flags and compile caches don't cross-contaminate).
+
+Usage: python tools/perf_variants.py <variant> [--batch-size N]
+Variants: base, bs512, bnbf16, s2d, s2d512, vmem64, vmem128
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+
+if VARIANT in ("vmem64", "vmem128"):
+    kib = {"vmem64": 65536, "vmem128": 131072}[VARIANT]
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_tpu_scoped_vmem_limit_kib={kib}")
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import image_batch
+from apex_example_tpu.engine import create_train_state, make_train_step
+from apex_example_tpu.models import resnet50
+from apex_example_tpu.optim import FusedSGD
+
+
+def main():
+    bs = 256
+    if "512" in VARIANT:
+        bs = 512
+    if "1024" in VARIANT:
+        bs = 1024
+    for a in sys.argv[2:]:
+        if a.startswith("--batch-size="):
+            bs = int(a.split("=")[1])
+
+    policy, scaler = amp.initialize("O2")
+    kw = dict(num_classes=1000, dtype=policy.compute_dtype,
+              param_dtype=policy.param_dtype, bn_dtype=policy.bn_dtype)
+    if VARIANT == "bnbf16":
+        kw["bn_dtype"] = jnp.bfloat16
+    if VARIANT.startswith("s2d"):
+        kw["stem_space_to_depth"] = True
+    model = resnet50(**kw)
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+
+    batch = image_batch(jnp.asarray(0), batch_size=bs, image_size=224,
+                        channels=3, num_classes=1000, seed=0)
+    batch = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), batch)
+    state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                               batch[0][:1], policy, scaler)
+    step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
+
+    for _ in range(5):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    def run_chain(n, state):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        return time.perf_counter() - t0, state
+
+    steps = 30
+    n1 = steps // 5
+    t1, state = run_chain(n1, state)
+    t2, state = run_chain(steps, state)
+    rate = (steps - n1) * bs / max(t2 - t1, 1e-9)
+    ms = (t2 - t1) / (steps - n1) * 1e3
+    print(f"{VARIANT:10s} bs={bs:5d}  {ms:7.2f} ms/step  {rate:7.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler Chrome trace: top device ops by total duration.
+
+Usage: python tools/trace_top.py /tmp/xprof_c2 [--top 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--raw", action="store_true",
+                    help="don't merge fusion instances (keep full names)")
+    args = ap.parse_args()
+
+    traces = glob.glob(os.path.join(args.logdir, "**", "*.trace.json.gz"),
+                       recursive=True)
+    assert traces, f"no trace.json.gz under {args.logdir}"
+    path = max(traces, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        j = json.load(f)
+    events = j["traceEvents"]
+
+    # Identify device (TPU) process ids by name metadata.
+    pid_name = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {pid for pid, n in pid_name.items()
+                if re.search(r"TPU|/device", n, re.I)}
+
+    tot = collections.Counter()
+    cnt = collections.Counter()
+    total_time = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "")
+        dur = e.get("dur", 0)  # microseconds
+        key = name if args.raw else re.sub(r"\.\d+$", "", name)
+        tot[key] += dur
+        cnt[key] += 1
+        total_time += dur
+    print(f"trace: {path}")
+    print(f"device pids: { {p: pid_name[p] for p in dev_pids} }")
+    print(f"total device op time: {total_time/1e3:.2f} ms")
+    print(f"{'us_total':>10} {'n':>5} {'%':>6}  name")
+    for name, us in tot.most_common(args.top):
+        print(f"{us:10.0f} {cnt[name]:5d} {us/total_time:6.1%}  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Capture a device trace of the C2 train step and print the top ops by
+self-time (tensorboard_plugin_profile's framework_op_stats over a
+jax.profiler trace).
+
+Usage: python tools/xprof_dump.py [--batch-size 256] [--steps 5] [--top 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--logdir", default="/tmp/xprof_c2")
+    args = ap.parse_args()
+
+    from apex_example_tpu import amp
+    from apex_example_tpu.data import image_batch
+    from apex_example_tpu.engine import create_train_state, make_train_step
+    from apex_example_tpu.models import resnet50
+    from apex_example_tpu.optim import FusedSGD
+
+    policy, scaler = amp.initialize("O2")
+    model = resnet50(num_classes=1000, dtype=policy.compute_dtype,
+                     param_dtype=policy.param_dtype, bn_dtype=policy.bn_dtype)
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    batch = image_batch(jnp.asarray(0), batch_size=args.batch_size,
+                        image_size=224, channels=3, num_classes=1000, seed=0)
+    batch = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), batch)
+    state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                               batch[0][:1], policy, scaler)
+    step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
+
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    with jax.profiler.trace(args.logdir):
+        for _ in range(args.steps):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+
+    # ---- parse the xplane with the tensorboard profile plugin ----
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+    xplanes = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
+                        recursive=True)
+    assert xplanes, f"no xplane under {args.logdir}"
+    xp = max(xplanes, key=os.path.getmtime)
+    for tool in ("framework_op_stats", "op_profile"):
+        try:
+            data, _ = rtd.xspace_to_tool_data([xp], tool, {})
+        except Exception as e:
+            print(f"[{tool}] failed: {type(e).__name__}: {e}")
+            continue
+        out = os.path.join(args.logdir, f"{tool}.out")
+        mode = "wb" if isinstance(data, bytes) else "w"
+        with open(out, mode) as f:
+            f.write(data)
+        print(f"[{tool}] -> {out} ({len(data)} bytes)")
+
+    # framework_op_stats is CSV-ish JSON; try to print a quick top-N
+    import json
+    fos = os.path.join(args.logdir, "framework_op_stats.out")
+    if os.path.exists(fos):
+        try:
+            with open(fos) as f:
+                j = json.load(f)
+            print(json.dumps(j, indent=1)[:4000])
+        except Exception:
+            with open(fos, errors="replace") as f:
+                print(f.read()[:4000])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Parse an already-captured xplane.pb and print top ops by self time.
+
+Usage: PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+       python tools/xprof_parse.py /tmp/xprof_c2 [--top 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--tool", default="framework_op_stats")
+    args = ap.parse_args()
+
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+    xplanes = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
+                        recursive=True)
+    assert xplanes, f"no xplane under {args.logdir}"
+    xp = max(xplanes, key=os.path.getmtime)
+    data, _ = rtd.xspace_to_tool_data([xp], args.tool, {})
+    if isinstance(data, bytes):
+        try:
+            data = data.decode()
+        except UnicodeDecodeError:
+            out = os.path.join(args.logdir, args.tool + ".bin")
+            with open(out, "wb") as f:
+                f.write(data)
+            print("binary output ->", out)
+            return
+    try:
+        j = json.loads(data)
+    except Exception:
+        print(data[:8000])
+        return
+
+    # gviz table format: [{cols, rows}, ...] or dict
+    tables = j if isinstance(j, list) else [j]
+    for t in tables:
+        if not isinstance(t, dict) or "cols" not in t:
+            continue
+        cols = [c.get("label") or c.get("id") for c in t["cols"]]
+        print("\t".join(str(c) for c in cols))
+        for row in t["rows"][:args.top]:
+            vals = [c.get("v") for c in row["c"]]
+            print("\t".join(str(v) for v in vals))
+        print("---")
+
+
+if __name__ == "__main__":
+    main()
