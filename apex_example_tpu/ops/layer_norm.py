@@ -66,16 +66,19 @@ def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
     xhat = xc * rstd
     y = xhat * g_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
     y_ref[:] = y.astype(y_ref.dtype)
-    mean_ref[:] = mean[:, 0]
-    rstd_ref[:] = rstd[:, 0]
+    # Stats are (block, 1) 2-D: rank-1 outputs would pin the row block to
+    # Mosaic's 1024-element 1-D tiling (hit on real TPU by hidden=768);
+    # rank-2 blocks only need the usual (8, 128) tiling.
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
 
 
 def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
                 dx_ref, dg_ref, db_ref):
     xf = x_ref[:].astype(jnp.float32)
     dyf = dy_ref[:].astype(jnp.float32)
-    mean = mean_ref[:][:, None]
-    rstd = rstd_ref[:][:, None]
+    mean = mean_ref[:]          # (block, 1)
+    rstd = rstd_ref[:]
     xhat = (xf - mean) * rstd
     gamma = g_ref[:].astype(jnp.float32)
 
@@ -90,12 +93,15 @@ def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
     dx_ref[:] = (rstd * (wdy - c1 - xhat * c2)).astype(dx_ref.dtype)
 
 
-def _pick_block_rows(n_rows: int, hidden: int, dtype) -> int:
-    # Row blocks are multiples of 128: the rank-1 (mean/rstd) outputs tile at
-    # 128 elements for fp32, and 128 rows comfortably exceeds the 2-D sublane
-    # minimum.  Budget ~2 MB of VMEM for the x block.
+def _pick_block_rows(n_rows: int, hidden: int, dtype,
+                     budget: int = 1024 * 1024) -> int:
+    # Row blocks are multiples of 128 (sublane-friendly, and the (block, 1)
+    # stat outputs only face the standard 2-D tiling).  ``budget`` bounds the
+    # x-block bytes; the kernel's fp32 temporaries multiply it ~4-6x on the
+    # VMEM stack (Mosaic's 16 MiB limit — the backward kernel holds x, dy,
+    # dx plus four fp32 intermediates, so it passes a halved budget).
     bytes_per = jnp.dtype(dtype).itemsize
-    target = (2 * 1024 * 1024) // max(1, hidden * bytes_per)
+    target = budget // max(1, hidden * bytes_per)
     block = max(128, (target // 128) * 128)
     return min(block, max(128, ((n_rows + 127) // 128) * 128))
 
@@ -123,18 +129,19 @@ def _layer_norm_fwd_pallas(x2d, gamma, beta, eps):
         out_specs=[
             pl.BlockSpec((block, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             sds((np_, h), x2d.dtype, x2d),
-            sds((np_,), jnp.float32, x2d),
-            sds((np_,), jnp.float32, x2d),
+            sds((np_, 1), jnp.float32, x2d),
+            sds((np_, 1), jnp.float32, x2d),
         ],
         interpret=_cfg.INTERPRET,
     )(x2d, gamma, beta)
-    if pad:
-        y, mean, rstd = y[:n], mean[:n], rstd[:n]
+    y, mean, rstd = y[:n], mean[:n, 0], rstd[:n, 0]
     return y, mean, rstd
 
 
@@ -143,13 +150,15 @@ def _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d):
     from jax.experimental.pallas import tpu as pltpu
 
     n, h = x2d.shape
-    block = _pick_block_rows(n, h, x2d.dtype)
+    block = _pick_block_rows(n, h, x2d.dtype, budget=512 * 1024)
     pad = (-n) % block
     if pad:
         x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
         dy2d = jnp.pad(dy2d, ((0, pad), (0, 0)))
         mean = jnp.pad(mean, (0, pad))
         rstd = jnp.pad(rstd, (0, pad))  # padded rows: rstd 0 => contribute 0
+    mean2 = mean[:, None]               # (rows, 1): see _fwd_kernel note
+    rstd2 = rstd[:, None]
     np_ = x2d.shape[0]
 
     def bwd_with_init(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
@@ -169,8 +178,10 @@ def _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d):
             pl.BlockSpec((block, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((block, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
@@ -188,7 +199,7 @@ def _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d):
             sds((h,), jnp.float32, x2d, dy2d, gamma),
         ],
         interpret=_cfg.INTERPRET,
-    )(x2d, gamma, mean, rstd, dy2d)
+    )(x2d, gamma, mean2, rstd2, dy2d)
     if pad:
         dx = dx[:n]
     return dx, dg, db

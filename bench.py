@@ -263,8 +263,9 @@ def bench_hostpipe(args):
         b = (jnp.asarray(img), jnp.asarray(lab))
         return step(state, b)
 
-    # chain_rate consumed the donated state above — start a fresh one for
-    # the host-fed phase.
+    # chain_rate consumed the donated state above (including the scaler
+    # arrays) — build a fresh state from a fresh scaler for this phase.
+    policy, scaler = amp.initialize("O2")
     _, _, _, state = _image_setup(
         policy, scaler, arch="resnet50", batch_size=args.batch_size,
         image_size=args.image_size, num_classes=1000)
