@@ -149,10 +149,13 @@ def make_train_step(model, optimizer, policy: Policy,
                     loss, logits, new_stats)
             return scaled_loss_fn
 
+        # named_scope: phase labels in xprof/tensorboard traces (SURVEY.md §6
+        # tracing row — the reference's nvtx range annotations).
         if grad_accum == 1:
-            grads, (loss, logits, new_stats) = jax.grad(
-                scaled_loss_for(state.batch_stats, x, y),
-                has_aux=True)(diff_params)
+            with jax.named_scope("fwd_bwd"):
+                grads, (loss, logits, new_stats) = jax.grad(
+                    scaled_loss_for(state.batch_stats, x, y),
+                    has_aux=True)(diff_params)
             top1 = _batch_top1(logits, y) if (
                 compute_accuracy and isinstance(y, jnp.ndarray)) else None
         else:
@@ -187,12 +190,15 @@ def make_train_step(model, optimizer, policy: Policy,
         # DDP: reduce *scaled* grads, like the reference's backward-hook
         # allreduce; then unscale + finite-check (scale_loss __exit__).
         if axis_name is not None:
-            grads = allreduce_grads(grads, ddp, axis_name)
-            loss = jax.lax.pmean(loss, axis_name)
-        grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
+            with jax.named_scope("grad_allreduce"):
+                grads = allreduce_grads(grads, ddp, axis_name)
+                loss = jax.lax.pmean(loss, axis_name)
+        with jax.named_scope("unscale_check"):
+            grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
 
-        new_params, new_opt_state = opt.apply(grads, state.opt_state,
-                                              state.params)
+        with jax.named_scope("optimizer"):
+            new_params, new_opt_state = opt.apply(grads, state.opt_state,
+                                                  state.params)
         if policy.uses_dynamic_scaling:
             # Overflow ⇒ the whole update is skipped (params and optimizer
             # state keep their old values; BN stats are NOT rolled back —

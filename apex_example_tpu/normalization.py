@@ -17,7 +17,7 @@ from typing import Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from apex_example_tpu.ops.layer_norm import layer_norm
+from apex_example_tpu.ops.layer_norm import layer_norm, rms_norm
 
 
 class FusedLayerNorm(nn.Module):
@@ -47,3 +47,31 @@ class FusedLayerNorm(nn.Module):
 
 
 MixedFusedLayerNorm = FusedLayerNorm
+
+
+class FusedRMSNorm(nn.Module):
+    """RMSNorm over the last axis, backed by the Pallas kernel.
+
+    Reference: the later apex ``FusedRMSNorm`` (same extension module as
+    FusedLayerNorm, SURVEY.md §3.4) — LayerNorm without mean subtraction or
+    bias; stats fp32, ``elementwise_affine`` ⇔ ``use_scale``.
+    """
+
+    epsilon: float = 1e-5
+    dtype: Optional[jnp.dtype] = None       # output dtype (None: follow input)
+    param_dtype: jnp.dtype = jnp.float32
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        feat = x.shape[-1]
+        if self.use_scale:
+            scale = self.param("scale", nn.initializers.ones, (feat,),
+                               self.param_dtype)
+        else:
+            scale = jnp.ones((feat,), self.param_dtype)
+        y = rms_norm(x, scale, self.epsilon)
+        return y.astype(self.dtype) if self.dtype is not None else y
+
+
+MixedFusedRMSNorm = FusedRMSNorm

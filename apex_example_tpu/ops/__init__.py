@@ -5,7 +5,9 @@ The TPU-native counterpart of the reference's ``csrc/`` native-extension layer
 on TPU) and an XLA reference implementation (CPU fallback + test golden).
 """
 
-from apex_example_tpu.ops.layer_norm import layer_norm, layer_norm_reference
+from apex_example_tpu.ops.layer_norm import (layer_norm,
+                                             layer_norm_reference, rms_norm,
+                                             rms_norm_reference)
 from apex_example_tpu.ops.multi_tensor import (
     MultiTensorApply, clip_grad_norm, multi_tensor_axpby, multi_tensor_l2norm,
     multi_tensor_scale, sqsum_leaf)
@@ -17,8 +19,8 @@ __all__ = [
     "MultiTensorApply", "adam_update_leaf", "adam_update_leaf_reference",
     "clip_grad_norm", "lamb_stage1_leaf", "lamb_stage2_leaf", "layer_norm",
     "layer_norm_reference", "multi_tensor_axpby", "multi_tensor_l2norm",
-    "multi_tensor_scale", "novograd_update_leaf", "sgd_update_leaf",
-    "sqsum_leaf",
+    "multi_tensor_scale", "novograd_update_leaf", "rms_norm",
+    "rms_norm_reference", "sgd_update_leaf", "sqsum_leaf",
 ]
 
 

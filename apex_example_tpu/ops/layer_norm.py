@@ -53,6 +53,12 @@ def layer_norm_reference(x, gamma, beta, eps: float = 1e-5):
     return y.astype(x.dtype)
 
 
+def rms_norm_reference(x, gamma, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    rstd = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rstd * gamma.astype(jnp.float32)).astype(x.dtype)
+
+
 # --------------------------------------------------------------------------
 # Pallas kernels.
 # --------------------------------------------------------------------------
@@ -106,10 +112,15 @@ def _pick_block_rows(n_rows: int, hidden: int, dtype,
     return min(block, max(128, ((n_rows + 127) // 128) * 128))
 
 
-def _layer_norm_fwd_pallas(x2d, gamma, beta, eps):
+def _norm_fwd_pallas(x2d, gamma, beta, eps):
+    """Shared fwd plumbing for LayerNorm (beta given) and RMSNorm (beta
+    None): block picking, row padding, specs, and the (block, 1) stat rule.
+
+    Returns (y, mean|None, rstd)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    with_mean = beta is not None
     n, h = x2d.shape
     block = _pick_block_rows(n, h, x2d.dtype)
     pad = (-n) % block
@@ -117,92 +128,79 @@ def _layer_norm_fwd_pallas(x2d, gamma, beta, eps):
         x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
     np_ = x2d.shape[0]
 
-    y, mean, rstd = pl.pallas_call(
-        functools.partial(_fwd_kernel, eps=eps),
+    mat = lambda: pl.BlockSpec((block, h), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    vec = lambda: pl.BlockSpec((h,), lambda i: (0,),
+                               memory_space=pltpu.VMEM)
+    stat = lambda: pl.BlockSpec((block, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)
+    n_stats = 2 if with_mean else 1
+    outs = pl.pallas_call(
+        functools.partial(_fwd_kernel if with_mean else _rms_fwd_kernel,
+                          eps=eps),
         grid=(np_ // block,),
-        in_specs=[
-            pl.BlockSpec((block, h), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((block, h), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            sds((np_, h), x2d.dtype, x2d),
-            sds((np_, 1), jnp.float32, x2d),
-            sds((np_, 1), jnp.float32, x2d),
-        ],
+        in_specs=[mat()] + [vec()] * (2 if with_mean else 1),
+        out_specs=[mat()] + [stat()] * n_stats,
+        out_shape=([sds((np_, h), x2d.dtype, x2d)]
+                   + [sds((np_, 1), jnp.float32, x2d)] * n_stats),
         interpret=_cfg.INTERPRET,
-    )(x2d, gamma, beta)
-    y, mean, rstd = y[:n], mean[:n, 0], rstd[:n, 0]
-    return y, mean, rstd
+    )(*([x2d, gamma, beta] if with_mean else [x2d, gamma]))
+    if with_mean:
+        y, mean, rstd = outs
+        return y[:n], mean[:n, 0], rstd[:n, 0]
+    y, rstd = outs
+    return y[:n], None, rstd[:n, 0]
 
 
-def _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d):
+def _norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d):
+    """Shared bwd plumbing: LayerNorm when ``mean`` is given (emits dx, dg,
+    db), RMSNorm when ``mean`` is None (emits dx, dg)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    with_mean = mean is not None
     n, h = x2d.shape
     block = _pick_block_rows(n, h, x2d.dtype, budget=512 * 1024)
     pad = (-n) % block
     if pad:
         x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
         dy2d = jnp.pad(dy2d, ((0, pad), (0, 0)))
-        mean = jnp.pad(mean, (0, pad))
+        if with_mean:
+            mean = jnp.pad(mean, (0, pad))
         rstd = jnp.pad(rstd, (0, pad))  # padded rows: rstd 0 => contribute 0
-    mean2 = mean[:, None]               # (rows, 1): see _fwd_kernel note
-    rstd2 = rstd[:, None]
+    stats2 = ([mean[:, None]] if with_mean else []) + [rstd[:, None]]
     np_ = x2d.shape[0]
+    n_grads = 2 if with_mean else 1     # dg (+ db)
 
-    def bwd_with_init(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
-                      dx_ref, dg_ref, db_ref):
+    def bwd_with_init(*refs):
         from jax.experimental import pallas as pl2
+
         @pl2.when(pl2.program_id(0) == 0)
         def _():
-            dg_ref[:] = jnp.zeros_like(dg_ref)
-            db_ref[:] = jnp.zeros_like(db_ref)
-        _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
-                    dx_ref, dg_ref, db_ref)
+            # the trailing refs are the across-grid accumulators (dg [, db])
+            for r in refs[-n_grads:]:
+                r[:] = jnp.zeros_like(r)
+        (_bwd_kernel if with_mean else _rms_bwd_kernel)(*refs)
 
-    dx, dg, db = pl.pallas_call(
+    mat = lambda: pl.BlockSpec((block, h), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    vec = lambda: pl.BlockSpec((h,), lambda i: (0,),
+                               memory_space=pltpu.VMEM)
+    stat = lambda: pl.BlockSpec((block, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
         bwd_with_init,
         grid=(np_ // block,),
-        in_specs=[
-            pl.BlockSpec((block, h), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, h), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((block, h), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            # dgamma/dbeta accumulate across sequential grid steps: every
-            # step maps to the same block (TPU grids are sequential).
-            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            sds((np_, h), x2d.dtype, x2d, dy2d),
-            sds((h,), jnp.float32, x2d, dy2d, gamma),
-            sds((h,), jnp.float32, x2d, dy2d, gamma),
-        ],
+        in_specs=([mat(), vec()] + [stat()] * len(stats2) + [mat()]),
+        # dgamma/dbeta accumulate across sequential grid steps: every step
+        # maps to the same block (TPU grids are sequential).
+        out_specs=[mat()] + [vec()] * n_grads,
+        out_shape=([sds((np_, h), x2d.dtype, x2d, dy2d)]
+                   + [sds((h,), jnp.float32, x2d, dy2d, gamma)] * n_grads),
         interpret=_cfg.INTERPRET,
-    )(x2d, gamma, mean2, rstd2, dy2d)
-    if pad:
-        dx = dx[:n]
-    return dx, dg, db
+    )(x2d, gamma, *stats2, dy2d)
+    dx = outs[0][:n] if pad else outs[0]
+    return (dx, *outs[1:])
 
 
 # --------------------------------------------------------------------------
@@ -221,7 +219,7 @@ def _layer_norm_fwd(x, gamma, beta, eps):
     h = shape[-1]
     x2d = x.reshape(-1, h)
     if _use_pallas(x2d):
-        y, mean, rstd = _layer_norm_fwd_pallas(x2d, gamma, beta, eps)
+        y, mean, rstd = _norm_fwd_pallas(x2d, gamma, beta, eps)
     else:
         xf = x2d.astype(jnp.float32)
         mean = jnp.mean(xf, axis=-1)
@@ -246,7 +244,7 @@ def _layer_norm_bwd_vjp(eps, res, dy):
     x2d = x.reshape(-1, h)
     dy2d = dy.reshape(-1, h)
     if _use_pallas(x2d, dy2d):
-        dx, dg, db = _layer_norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d)
+        dx, dg, db = _norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d)
     else:
         xf = x2d.astype(jnp.float32)
         dyf = dy2d.astype(jnp.float32)
@@ -262,3 +260,77 @@ def _layer_norm_bwd_vjp(eps, res, dy):
 
 
 layer_norm.defvjp(_layer_norm_fwd_vjp, _layer_norm_bwd_vjp)
+
+
+# --------------------------------------------------------------------------
+# FusedRMSNorm (reference: the later apex FusedRMSNorm in
+# apex/normalization/fused_layer_norm.py, SURVEY.md §3.4): LayerNorm minus
+# the mean subtraction — rstd over E[x²], no beta.  Same blocking and the
+# same rank-2 (rows, 1) stat-output rule as layer_norm above.
+# --------------------------------------------------------------------------
+
+def _rms_fwd_kernel(x_ref, g_ref, y_ref, rstd_ref, *, eps):
+    xf = x_ref[:].astype(jnp.float32)
+    rstd = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    y_ref[:] = (xf * rstd * g_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _rms_bwd_kernel(x_ref, g_ref, rstd_ref, dy_ref, dx_ref, dg_ref):
+    xf = x_ref[:].astype(jnp.float32)
+    dyf = dy_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]                   # (block, 1)
+    xhat = xf * rstd
+    wdy = dyf * g_ref[:].astype(jnp.float32)
+
+    dg_ref[:] += jnp.sum(dyf * xhat, axis=0)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (wdy - xhat * c2)).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, gamma, eps: float = 1e-5):
+    """Fused RMSNorm over the last axis.  x: (..., H); gamma: (H,)."""
+    y, _ = _rms_norm_fwd(x, gamma, eps)
+    return y
+
+
+def _rms_norm_fwd(x, gamma, eps):
+    shape = x.shape
+    h = shape[-1]
+    x2d = x.reshape(-1, h)
+    if _use_pallas(x2d):
+        y, _, rstd = _norm_fwd_pallas(x2d, gamma, None, eps)
+    else:
+        xf = x2d.astype(jnp.float32)
+        rstd = lax.rsqrt(jnp.mean(xf * xf, axis=-1) + eps)
+        y = (xf * rstd[:, None] * gamma.astype(jnp.float32)).astype(x.dtype)
+    return y.reshape(shape), rstd
+
+
+def _rms_norm_fwd_vjp(x, gamma, eps):
+    y, rstd = _rms_norm_fwd(x, gamma, eps)
+    return y, (x, gamma, rstd)
+
+
+def _rms_norm_bwd_vjp(eps, res, dy):
+    del eps
+    x, gamma, rstd = res
+    shape = x.shape
+    h = shape[-1]
+    x2d = x.reshape(-1, h)
+    dy2d = dy.reshape(-1, h)
+    if _use_pallas(x2d, dy2d):
+        dx, dg = _norm_bwd_pallas(x2d, gamma, None, rstd, dy2d)
+    else:
+        xf = x2d.astype(jnp.float32)
+        dyf = dy2d.astype(jnp.float32)
+        xhat = xf * rstd[:, None]
+        wdy = dyf * gamma.astype(jnp.float32)
+        dg = jnp.sum(dyf * xhat, axis=0)
+        c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+        dx = (rstd[:, None] * (wdy - xhat * c2)).astype(x.dtype)
+    return dx.reshape(shape), dg.astype(gamma.dtype)
+
+
+rms_norm.defvjp(_rms_norm_fwd_vjp, _rms_norm_bwd_vjp)

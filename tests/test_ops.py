@@ -80,6 +80,57 @@ class TestLayerNorm:
                                    atol=2e-5, rtol=2e-5)
 
 
+class TestRMSNorm:
+    @pytest.mark.parametrize("shape", [(4, 128), (3, 5, 256), (16, 384)])
+    def test_forward_vs_torch(self, shape):
+        x = _rand(*shape, seed=21)
+        g = _rand(shape[-1], seed=22) * 0.1 + 1.0
+        y = ops.rms_norm(jnp.asarray(x), jnp.asarray(g))
+        tx = torch.from_numpy(x)
+        want = torch.nn.functional.rms_norm(
+            tx, (shape[-1],), torch.from_numpy(g), eps=1e-5).numpy()
+        np.testing.assert_allclose(np.asarray(y), want, atol=2e-5, rtol=2e-5)
+
+    def test_backward_vs_torch(self):
+        shape = (8, 256)
+        x = _rand(*shape, seed=23)
+        g = _rand(shape[-1], seed=24) * 0.1 + 1.0
+
+        def f(x_, g_):
+            return jnp.sum(ops.rms_norm(x_, g_) ** 2)
+
+        dx, dg = jax.grad(f, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(g))
+
+        tx = torch.from_numpy(x).requires_grad_(True)
+        tg = torch.from_numpy(g).requires_grad_(True)
+        (torch.nn.functional.rms_norm(tx, (shape[-1],), tg, eps=1e-5)
+         ** 2).sum().backward()
+        np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dg), tg.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_bf16_io_and_module(self):
+        from apex_example_tpu.normalization import FusedRMSNorm
+        x = jnp.asarray(_rand(4, 128, seed=25), jnp.bfloat16)
+        m = FusedRMSNorm()
+        variables = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(variables, x)
+        assert y.dtype == jnp.bfloat16
+        ref = ops.rms_norm_reference(x, jnp.ones((128,)))
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32), np.asarray(ref, np.float32),
+            atol=0.05)
+
+    def test_pallas_matches_reference_path(self):
+        x = jnp.asarray(_rand(6, 384, seed=26))
+        g = jnp.asarray(_rand(384, seed=27))
+        y_kernel = ops.rms_norm(x, g)
+        y_ref = ops.rms_norm_reference(x, g)
+        np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
 class TestMultiTensor:
     def _tree(self, seed=0):
         return {"a": jnp.asarray(_rand(3, 7, seed=seed)),
