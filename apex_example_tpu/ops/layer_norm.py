@@ -112,6 +112,17 @@ def _pick_block_rows(n_rows: int, hidden: int, dtype,
     return min(block, max(128, ((n_rows + 127) // 128) * 128))
 
 
+def _specs(pl, pltpu, block, h):
+    """(mat, vec, stat) BlockSpec constructors shared by fwd/bwd plumbing."""
+    mat = lambda: pl.BlockSpec((block, h), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    vec = lambda: pl.BlockSpec((h,), lambda i: (0,),
+                               memory_space=pltpu.VMEM)
+    stat = lambda: pl.BlockSpec((block, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)
+    return mat, vec, stat
+
+
 def _norm_fwd_pallas(x2d, gamma, beta, eps):
     """Shared fwd plumbing for LayerNorm (beta given) and RMSNorm (beta
     None): block picking, row padding, specs, and the (block, 1) stat rule.
@@ -128,12 +139,7 @@ def _norm_fwd_pallas(x2d, gamma, beta, eps):
         x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
     np_ = x2d.shape[0]
 
-    mat = lambda: pl.BlockSpec((block, h), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)
-    vec = lambda: pl.BlockSpec((h,), lambda i: (0,),
-                               memory_space=pltpu.VMEM)
-    stat = lambda: pl.BlockSpec((block, 1), lambda i: (i, 0),
-                                memory_space=pltpu.VMEM)
+    mat, vec, stat = _specs(pl, pltpu, block, h)
     n_stats = 2 if with_mean else 1
     outs = pl.pallas_call(
         functools.partial(_fwd_kernel if with_mean else _rms_fwd_kernel,
@@ -182,12 +188,7 @@ def _norm_bwd_pallas(x2d, gamma, mean, rstd, dy2d):
                 r[:] = jnp.zeros_like(r)
         (_bwd_kernel if with_mean else _rms_bwd_kernel)(*refs)
 
-    mat = lambda: pl.BlockSpec((block, h), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)
-    vec = lambda: pl.BlockSpec((h,), lambda i: (0,),
-                               memory_space=pltpu.VMEM)
-    stat = lambda: pl.BlockSpec((block, 1), lambda i: (i, 0),
-                                memory_space=pltpu.VMEM)
+    mat, vec, stat = _specs(pl, pltpu, block, h)
     outs = pl.pallas_call(
         bwd_with_init,
         grid=(np_ // block,),

@@ -38,8 +38,9 @@ from apex_example_tpu.models import ARCHS
 from apex_example_tpu.models.bert import bert_base, bert_tiny
 from apex_example_tpu.models.transformer_xl import (transformer_xl_base,
                                                     transformer_xl_tiny)
-from apex_example_tpu.optim import (FusedAdam, FusedLAMB, FusedSGD,
-                                    build_schedule)
+from apex_example_tpu.optim import (DistributedFusedAdam, FusedAdam,
+                                    FusedLAMB, FusedSGD, build_schedule,
+                                    make_zero_train_step)
 from apex_example_tpu.parallel import (DDPConfig, is_main_process,
                                        make_data_mesh,
                                        maybe_initialize_distributed)
@@ -89,6 +90,10 @@ def parse_args(argv=None):
     # DDP surface (apex parity)
     p.add_argument("--sync_bn", action="store_true",
                    help="use cross-replica SyncBatchNorm")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 optimizer-state sharding over the data "
+                        "axis (DistributedFusedAdam; forces --opt adam, "
+                        "image workloads, >1 device, static loss scale)")
     p.add_argument("--delay-allreduce", action="store_true", default=True)
     p.add_argument("--gradient-predivide-factor", type=float, default=1.0)
     p.add_argument("--num-devices", type=int, default=None,
@@ -176,6 +181,8 @@ def main(argv=None):
             raise SystemExit("--host-pipeline is only wired for the image "
                              "workloads; LM archs use on-device token "
                              "generators")
+        if args.zero:
+            raise SystemExit("--zero is only wired for the image workloads")
         return lm_main(args, policy, scaler)
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
@@ -193,7 +200,24 @@ def main(argv=None):
         bn_io_dtype=md.bn_io,
         bn_axis_name="data" if (args.sync_bn and n_dev > 1) else None)
 
-    optimizer = build_optimizer(args)
+    if args.zero:
+        if n_dev < 2:
+            raise SystemExit("--zero needs >1 device (state shards over "
+                             "the data axis)")
+        if args.opt != "adam":
+            raise SystemExit("--zero is wired for --opt adam "
+                             "(DistributedFusedAdam)")
+        if args.grad_accum != 1:
+            raise SystemExit("--zero does not support --grad-accum")
+        if args.gradient_predivide_factor != 1.0:
+            raise SystemExit("--zero does not support "
+                             "--gradient-predivide-factor (the reduction "
+                             "lives inside the sharded optimizer)")
+        optimizer = DistributedFusedAdam(lr=build_lr(args),
+                                         weight_decay=args.weight_decay,
+                                         world=n_dev)
+    else:
+        optimizer = build_optimizer(args)
     if args.host_pipeline:
         from apex_example_tpu import host_runtime
         if not host_runtime.available():
@@ -215,10 +239,14 @@ def main(argv=None):
 
     if n_dev > 1:
         mesh = make_data_mesh(devices=devices)
-        step_fn = make_sharded_train_step(mesh, model, optimizer, policy,
-                                          ddp=ddp,
-                                          grad_accum=args.grad_accum)
-        print(f"DDP over {n_dev} devices: {mesh}")
+        if args.zero:
+            step_fn = make_zero_train_step(mesh, model, optimizer, policy)
+            print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
+        else:
+            step_fn = make_sharded_train_step(mesh, model, optimizer,
+                                              policy, ddp=ddp,
+                                              grad_accum=args.grad_accum)
+            print(f"DDP over {n_dev} devices: {mesh}")
     else:
         step_fn = jax.jit(make_train_step(model, optimizer, policy,
                                           grad_accum=args.grad_accum),
