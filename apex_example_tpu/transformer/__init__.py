@@ -18,3 +18,4 @@ and the pipeline schedule is a collective program over the ``pipe`` axis
 from apex_example_tpu.transformer import parallel_state  # noqa: F401
 from apex_example_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_example_tpu.transformer import pipeline_parallel  # noqa: F401
+from apex_example_tpu.transformer import expert_parallel  # noqa: F401

@@ -1,0 +1,165 @@
+"""Expert parallelism (MoE): switch-style top-1 routing over an expert axis.
+
+Reference status: EP is ABSENT from the reference family (SURVEY.md §3.2
+marks it "documented as absent"); like context parallelism
+(parallel/context_parallel.py) this is a TPU-first extension beyond the
+reference, built because the mesh/collective machinery makes it natural and
+a "complete" modern parallelism surface includes it.
+
+TPU-native design (the Switch-Transformer dispatch, expressed as static-shape
+XLA collectives — no dynamic shapes, jit-stable):
+
+  1. router: logits = x @ w_r → top-1 expert per token, softmax gate.
+  2. capacity: each expert accepts at most C tokens per device
+     (C = ceil(tokens/E · capacity_factor)); overflow tokens are dropped
+     (their combine weight is 0 — the standard switch trade that keeps every
+     shape static).
+  3. dispatch: one-hot position-in-expert (cumsum over the token dim) builds
+     a [E, C, d] buffer per device; ``lax.all_to_all`` over the expert axis
+     turns it into this device's expert's [world·C, d] token block.
+  4. expert FFN (dense→act→dense; one expert per device shard).
+  5. inverse all_to_all + gate-weighted combine back to [tokens, d].
+
+Gradients flow through dispatch/combine as through any other collectives
+(all_to_all transposes to the inverse all_to_all).  A load-balancing aux
+loss (mean fraction·prob product, Switch eq. 4) is returned for the trainer
+to weight.
+
+``EXPERT_AXIS = "expert"``; run inside shard_map with tokens sharded over
+the axis (typically the same devices as data parallelism — EP reuses the DP
+axis the way DeepSpeed-MoE does).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+EXPERT_AXIS = "expert"
+
+
+class MoEParams(NamedTuple):
+    w_router: jnp.ndarray   # [d, E]
+    w_in: jnp.ndarray       # [d, hidden]  (this device's expert)
+    w_out: jnp.ndarray      # [hidden, d]
+
+
+def init_moe_params(rng, d: int, hidden: int, n_experts: int,
+                    dtype=jnp.float32) -> MoEParams:
+    """Per-device params: router replicated, expert weights sharded (one
+    expert per device over the expert axis → pass P(expert) specs for
+    w_in/w_out stacked as [E, ...] at the shard_map boundary)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale = 1.0 / jnp.sqrt(d)
+    return MoEParams(
+        w_router=(jax.random.normal(k1, (d, n_experts)) * scale
+                  ).astype(dtype),
+        w_in=(jax.random.normal(k2, (n_experts, d, hidden)) * scale
+              ).astype(dtype),
+        w_out=(jax.random.normal(k3, (n_experts, hidden, d)) * scale
+               ).astype(dtype))
+
+
+def _dispatch_masks(logits: jnp.ndarray, capacity: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 switch dispatch for [T, E] router logits.
+
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] gate-weighted,
+    aux_loss scalar).  All shapes static; overflow tokens get all-zero rows.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # [T, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0           # [T, E]
+    keep = (pos < capacity) & (onehot > 0)
+    pos_c = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                           dtype=jnp.float32)                  # [T, E, C]
+    dispatch = pos_c * keep[..., None]
+    combine = dispatch * gate[:, None, None]
+
+    # Switch load-balancing loss: E · Σ_e fraction_e · mean-prob_e.
+    fraction = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(fraction * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_forward(params: MoEParams, x: jnp.ndarray,
+                capacity_factor: float = 1.25,
+                axis_name: str = EXPERT_AXIS,
+                activation=jax.nn.relu) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Switch-MoE block over the expert axis.  Inside shard_map:
+
+    x: [T, d] this device's tokens; params.w_in/w_out: [1, d, h]/[1, h, d]
+    (this device's expert shard of the stacked [E, ...] arrays).
+
+    Returns (y [T, d], aux_loss).
+    """
+    T, d = x.shape
+    E = lax.axis_size(axis_name)
+    # One expert per expert-axis device: the [E, C, d] send buffer is split
+    # E-ways by the tiled all_to_all, so router width, axis size, and the
+    # local weight shard must agree or every device silently applies the
+    # wrong expert to other experts' tokens.
+    if params.w_router.shape[1] != E or params.w_in.shape[0] != 1:
+        raise ValueError(
+            f"moe_forward needs n_experts == expert-axis size with one "
+            f"expert per device; got router width "
+            f"{params.w_router.shape[1]}, axis size {E}, local shard "
+            f"{params.w_in.shape[0]} (shard stacked [E, ...] weights with "
+            f"P('{axis_name}'))")
+    capacity = int(-(-T * capacity_factor // E))
+    # lane-friendly capacity (C is a matmul/all_to_all dim)
+    capacity = capacity + (-capacity) % 8
+
+    logits = x @ params.w_router.astype(x.dtype)         # [T, E]
+    dispatch, combine, aux = _dispatch_masks(logits, capacity)
+
+    # [E, C, d] expert-major send buffer; tiled all_to_all over the axis
+    # swaps "which expert" for "which sender": recv[j] = device j's tokens
+    # for THIS device's expert.
+    send = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                      dispatch).astype(x.dtype)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                    # [E, C, d]
+    w_in = params.w_in[0].astype(x.dtype)
+    w_out = params.w_out[0].astype(x.dtype)
+    h = activation(recv @ w_in)                          # [E, C, hidden]
+    out = h @ w_out                                      # [E, C, d]
+    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                    # [E, C, d]: back[e]
+    y = jnp.einsum("ecd,tec->td", back.astype(jnp.float32),
+                   combine).astype(x.dtype)
+    return y, lax.pmean(aux, axis_name)
+
+
+def moe_forward_dense_reference(params: MoEParams, x: jnp.ndarray,
+                                capacity_factor: float = 1.25,
+                                activation=jax.nn.relu
+                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """No-mesh golden: every expert computed densely on every token, the
+    same dispatch/combine masks select the result.  Matches moe_forward
+    exactly on a single shard (tests) and defines the semantics."""
+    T, d = x.shape
+    E = params.w_in.shape[0]
+    capacity = int(-(-T * capacity_factor // E))
+    capacity = capacity + (-capacity) % 8
+
+    logits = x @ params.w_router.astype(x.dtype)
+    dispatch, combine, aux = _dispatch_masks(logits, capacity)
+
+    send = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                      dispatch).astype(x.dtype)           # [E, C, d]
+    h = activation(jnp.einsum("ecd,edh->ech", send,
+                              params.w_in.astype(x.dtype)))
+    out = jnp.einsum("ech,ehd->ecd", h, params.w_out.astype(x.dtype))
+    y = jnp.einsum("ecd,tec->td", out.astype(jnp.float32),
+                   combine).astype(x.dtype)
+    return y, aux
