@@ -116,6 +116,9 @@ def parse_args(argv=None):
                    help="write scalars to this tensorboard logdir")
     p.add_argument("--prof", action="store_true",
                    help="capture a jax profiler trace of a few steps")
+    p.add_argument("--prof-server", type=int, default=0, metavar="PORT",
+                   help="start jax.profiler.start_server(PORT) for live "
+                        "xprof/tensorboard capture (SURVEY.md §6 tracing)")
     # accepted no-ops (CUDA-specific in the reference)
     p.add_argument("--local_rank", type=int, default=0)
     p.add_argument("--workers", type=int, default=4)
@@ -173,6 +176,9 @@ def main(argv=None):
         # Reference behavior: only rank 0 logs; workers run silently.
         global print
         print = lambda *a, **k: None  # noqa: A001
+    if args.prof_server:
+        jax.profiler.start_server(args.prof_server)
+        print(f"profiler server on :{args.prof_server}")
     policy, scaler = amp.initialize(
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
