@@ -177,8 +177,12 @@ def main(argv=None):
         global print
         print = lambda *a, **k: None  # noqa: A001
     if args.prof_server:
-        jax.profiler.start_server(args.prof_server)
-        print(f"profiler server on :{args.prof_server}")
+        # Per-process port offset: single-host multi-process launches (the
+        # localhost rendezvous tests/test_launch.py exercises) would
+        # otherwise all bind the same port.
+        port = args.prof_server + jax.process_index()
+        jax.profiler.start_server(port)
+        print(f"profiler server on :{port}")
     policy, scaler = amp.initialize(
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
