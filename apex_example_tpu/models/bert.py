@@ -35,6 +35,11 @@ class BertSelfAttention(nn.Module):
     # softmax is blacklisted under O0–O2 (fp32); O3 runs it half.  Resolved
     # by amp/autocast.module_dtypes and threaded in by the builder.
     softmax_dtype: jnp.dtype = jnp.float32
+    # Blockwise flash-attention kernel (ops/attention.py).  Only taken when
+    # the softmax contract is fp32 — the kernel always computes fp32 softmax,
+    # so routing O3's half-softmax through it would silently upgrade
+    # precision.  The op itself falls back to the XLA reference off-TPU.
+    fused_attention: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -47,6 +52,13 @@ class BertSelfAttention(nn.Module):
         q = dense("query")(x).reshape(*x.shape[:-1], h, hd)
         k = dense("key")(x).reshape(*x.shape[:-1], h, hd)
         v = dense("value")(x).reshape(*x.shape[:-1], h, hd)
+        if self.fused_attention and self.softmax_dtype == jnp.float32:
+            from apex_example_tpu.ops.attention import flash_attention
+            key_bias = None if mask_bias is None \
+                else mask_bias[:, 0, 0, :].astype(jnp.float32)
+            ctx = flash_attention(q, k, v, key_bias,
+                                  scale=1.0 / float(hd) ** 0.5)
+            return dense("output")(ctx.reshape(*x.shape[:-1], d))
         sd = self.softmax_dtype
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(sd)
         logits = logits / jnp.sqrt(hd).astype(sd)
@@ -70,6 +82,7 @@ class BertLayer(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     ln_dtype: Optional[jnp.dtype] = None     # LN I/O; None follows dtype
     softmax_dtype: jnp.dtype = jnp.float32
+    fused_attention: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -81,6 +94,7 @@ class BertLayer(nn.Module):
         attn = BertSelfAttention(self.hidden_size, self.num_heads,
                                  self.dtype, self.param_dtype,
                                  self.softmax_dtype,
+                                 fused_attention=self.fused_attention,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))
@@ -108,6 +122,7 @@ class BertForMaskedLM(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     ln_dtype: Optional[jnp.dtype] = None
     softmax_dtype: jnp.dtype = jnp.float32
+    fused_attention: bool = False
 
     @nn.compact
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
@@ -137,6 +152,7 @@ class BertForMaskedLM(nn.Module):
                           self.intermediate_size, self.dtype,
                           self.param_dtype, self.ln_dtype,
                           self.softmax_dtype,
+                          fused_attention=self.fused_attention,
                           name=f"layer_{i}")(x, mask_bias)
 
         # MLM head: dense+gelu+LN, then tied decoder.

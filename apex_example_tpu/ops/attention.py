@@ -1,0 +1,442 @@
+"""Fused multi-head attention (flash attention): Pallas TPU kernels + XLA
+reference.
+
+Reference: apex ships fused attention as a contrib CUDA extension
+(apex/contrib/csrc/fmha — SURVEY.md §2.1 contrib row) used by its BERT
+recipes; the in-tree models otherwise materialize the full (Sq, Sk) score
+matrix.  This module is the TPU-native equivalent and the long-context
+workhorse the task brief asks for: blockwise attention whose score matrix
+never leaves VMEM, so HBM traffic is O(S·D) instead of O(S²).
+
+TPU-native design
+-----------------
+One forward Pallas kernel gridded ``(batch*heads, q_blocks, kv_blocks)``
+with the kv dimension innermost (TPU grids run sequentially, so the running
+online-softmax state lives in VMEM scratch across kv steps):
+
+    m    running row max            (block_q, 1)  fp32
+    l    running row sum of exp     (block_q, 1)  fp32
+    acc  running unnormalized P·V   (block_q, D)  fp32
+
+Each step computes ``S = QK^T·scale (+bias) (+causal mask)`` on the MXU with
+fp32 accumulation, rescales (m, l, acc) by ``exp(m_old - m_new)``, and at the
+last kv step writes ``O = acc / l`` plus the row logsumexp (saved for the
+backward).  The backward follows the standard two-kernel flash decomposition:
+a dK/dV kernel gridded over kv blocks (q innermost, accumulating in scratch)
+and a dQ kernel gridded over q blocks (kv innermost), both recomputing
+``P = exp(S - lse)`` from the saved logsumexp instead of storing it —
+rematerialization trades MXU FLOPs for the O(S²) HBM tensor, the same trade
+the LayerNorm kernel makes for x̂.
+
+Numerics: logits and softmax are always fp32 (the amp "blacklist" contract —
+SURVEY.md §3.1; model code keeps a naive path for O3's half-softmax).  The
+probability matrix is cast back to the input dtype for the P·V / P^T·dO
+matmuls so the MXU runs bf16 with fp32 accumulation, matching the XLA
+reference path below, which is also the CPU fallback and the test golden.
+
+Supported bias: an additive per-key bias of shape (B, Sk) — the key-padding
+mask form BERT uses (already clamped to a finite "minus infinity" by the
+model).  The bias is a constant mask, not a learned tensor: its VJP is zero.
+Rows whose every key is masked produce an arbitrary convex combination of
+values (the reference's softmax over all -1e9 logits does the same).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_example_tpu.ops import _config as _cfg
+from apex_example_tpu.ops._vma import sds
+
+# Finite stand-in for -inf: exp(_MASK - anything_reasonable) == 0 in fp32,
+# while (_MASK - _MASK) == 0 keeps fully-masked prefixes NaN-free (they are
+# then exactly cancelled by the exp(m_old - m_new) rescale once a live block
+# arrives).
+_MASK = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _dot_f32(a, b, *, trans_a=False, trans_b=False):
+    """MXU matmul with fp32 accumulation regardless of operand dtype."""
+    ca = ((0,) if trans_a else (1,), (1,) if trans_b else (0,))
+    return lax.dot_general(a, b, (ca, ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# XLA reference path (CPU fallback + kernel-test golden).
+# --------------------------------------------------------------------------
+
+def attention_reference(q, k, v, bias=None, causal=False,
+                        scale: Optional[float] = None):
+    """Naive attention.  q: (B, Sq, H, D); k/v: (B, Sk, H, D);
+    bias: (B, Sk) additive, already finite; returns (B, Sq, H, D)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias[:, None, None, :].astype(jnp.float32)
+    if causal:
+        # Bottom-right aligned (the prefix-cache convention): when Sq < Sk
+        # the queries are the LAST Sq positions, so query i sees keys
+        # 0..(Sk-Sq)+i.  For Sq == Sk this is the ordinary triangular mask.
+        sq, sk = q.shape[1], k.shape[1]
+        mask = (lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
+                >= lax.broadcasted_iota(jnp.int32, (sq, sk), 1))
+        s = jnp.where(mask, s, _MASK)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernels.  All operate on (BH, S, D) with B*H folded into the grid.
+# --------------------------------------------------------------------------
+
+def _when_live(i, j, *, causal, bq, bk, off):
+    """Decorator: run the kernel body only when causal masking leaves the
+    (q block i, kv block j) pair any live entries — i.e. the kv block starts
+    at or before the q block's last visible key.  Skipping dead pairs saves
+    ~half the causal grid's MXU work (init/write steps stay unguarded).
+    Non-causal attention has no dead pairs; the body runs unconditionally."""
+    if not causal:
+        return lambda body: body()
+    return pl.when(j * bk <= i * bq + off + bq - 1)
+
+
+def _scores(q, k, bias_ref, i, j, *, scale, causal, bq, bk, off):
+    """fp32 (bq, bk) logits for q block i vs kv block j: scale, bias, mask.
+
+    ``off`` = Sk - Sq implements the bottom-right-aligned causal convention
+    (see attention_reference)."""
+    s = _dot_f32(q, k, trans_b=True) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0][None, :].astype(jnp.float32)
+    if causal:
+        row = i * bq + off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(row >= col, s, _MASK)
+    return s
+
+
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, off):
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, acc, m, l = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l = refs
+        b_ref = None
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m[:] = jnp.full_like(m, _MASK)
+        l[:] = jnp.zeros_like(l)
+        acc[:] = jnp.zeros_like(acc)
+
+    @_when_live(i, j, causal=causal, bq=bq, bk=bk, off=off)
+    def _():
+        s = _scores(q_ref[0], k_ref[0], b_ref, i, j,
+                    scale=scale, causal=causal, bq=bq, bk=bk,
+                    off=off)
+        m_new = jnp.maximum(m[:], jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m[:] - m_new)
+        p = jnp.exp(s - m_new)
+        l[:] = l[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + _dot_f32(p.astype(v_ref.dtype), v_ref[0])
+        m[:] = m_new
+
+    @pl.when(j == nk - 1)
+    def _():
+        lsafe = jnp.where(l[:] == 0.0, 1.0, l[:])
+        o_ref[0] = (acc[:] / lsafe).astype(o_ref.dtype)
+        # lse rides as (BH, 1, Sq): a (1, 1, bq) block satisfies Mosaic's
+        # second-minor-divisible-by-8-or-full rule, which a (1, bq) block of
+        # a (BH, Sq) array does not.
+        lse_ref[0, 0] = (m[:] + jnp.log(lsafe))[:, 0]
+
+
+def _dkdv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, off):
+    if has_bias:
+        (q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        b_ref = None
+    j, i = pl.program_id(1), pl.program_id(2)   # grid: (bh, kv, q)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @_when_live(i, j, causal=causal, bq=bq, bk=bk, off=off)
+    def _():
+        s = _scores(q_ref[0], k_ref[0], b_ref, i, j,
+                    scale=scale, causal=causal, bq=bq, bk=bk,
+                    off=off)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])               # (bq, bk) fp32
+        dof = do_ref[0]
+        dv_acc[:] += _dot_f32(p.astype(dof.dtype), dof, trans_a=True)
+        dp = _dot_f32(dof, v_ref[0], trans_b=True)            # (bq, bk)
+        ds = p * (dp - dl_ref[0, 0][:, None]) * scale
+        dk_acc[:] += _dot_f32(ds.astype(q_ref.dtype), q_ref[0], trans_a=True)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, off):
+    if has_bias:
+        (q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+         dq_ref, dq_acc) = refs
+        b_ref = None
+    i, j = pl.program_id(1), pl.program_id(2)   # grid: (bh, q, kv)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @_when_live(i, j, causal=causal, bq=bq, bk=bk, off=off)
+    def _():
+        s = _scores(q_ref[0], k_ref[0], b_ref, i, j,
+                    scale=scale, causal=causal, bq=bq, bk=bk,
+                    off=off)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        dp = _dot_f32(do_ref[0], v_ref[0], trans_b=True)
+        ds = p * (dp - dl_ref[0, 0][:, None]) * scale
+        dq_acc[:] += _dot_f32(ds.astype(k_ref.dtype), k_ref[0])
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+# Deferred pallas import (the module must import on hosts without pallas
+# deps); bound at first kernel use, mirroring layer_norm.py's local imports.
+pl = None
+pltpu = None
+
+
+def _bind_pallas():
+    global pl, pltpu
+    if pl is None:
+        from jax.experimental import pallas as _pl
+        from jax.experimental.pallas import tpu as _pltpu
+        pl, pltpu = _pl, _pltpu
+
+
+def _pick_blocks(sq: int, sk: int):
+    bq = 256 if sq % 256 == 0 else 128
+    bk = 256 if sk % 256 == 0 else 128
+    return bq, bk
+
+
+def _kernel_ok(q, k, *more) -> bool:
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
+    if sq % 128 or sk % 128 or d % 8:
+        return False
+    if not _cfg.use_pallas_for(q, k, *more):
+        return False
+    return True
+
+
+def _pad_head(x):
+    """Pad the head dim up to a lane multiple when it isn't one.
+
+    Kernel blocks always span the full head dim, and Mosaic accepts a last
+    block dim equal to the overall array dim — so half-lane multiples
+    (64, 128, 192, ...) run unpadded; ragged head dims (80, 96, ...) pay a
+    pad to the next lane multiple.  Zeros change neither QK^T nor the value
+    columns sliced back off."""
+    d = x.shape[-1]
+    if d % 64:
+        pad = (-d) % 128
+        x = jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, pad),))
+    return x
+
+
+def _fold(x):
+    """(B, S, H, D) -> (B*H, S, D)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _bias_spec(bk, h, kv_axis=2):
+    # bias rides as (B, 1, Sk) (same Mosaic tiling rule as lse); grid dim 0
+    # runs over B*H, so the index map folds the head back out with a static
+    # integer division.  ``kv_axis`` names which grid position (1 or 2)
+    # walks kv blocks — it differs per kernel.
+    return pl.BlockSpec(
+        (1, 1, bk), lambda *g, h=h, a=kv_axis: (g[0] // h, 0, g[a]))
+
+
+def _attn_fwd_pallas(q, k, v, bias, causal, scale, h):
+    _bind_pallas()
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_blocks(sq, sk)
+    nq, nk = sq // bq, sk // bk
+
+    mat = lambda bs, im: pl.BlockSpec((1, bs, d), im)
+    in_specs = [mat(bq, lambda b, i, j: (b, i, 0)),
+                mat(bk, lambda b, i, j: (b, j, 0)),
+                mat(bk, lambda b, i, j: (b, j, 0))]
+    operands = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bk, h))
+        operands.append(bias[:, None, :])
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, has_bias=bias is not None,
+                          off=sk - sq),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=[mat(bq, lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))],
+        out_shape=[sds((bh, sq, d), q.dtype, q, k, v),
+                   sds((bh, 1, sq), jnp.float32, q, k, v)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32)],
+        interpret=_cfg.INTERPRET,
+    )(*operands)
+    return o, lse
+
+
+def _attn_bwd_pallas(q, k, v, bias, causal, scale, h, o, lse, do):
+    _bind_pallas()
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_blocks(sq, sk)
+    nq, nk = sq // bq, sk // bk
+
+    # delta_i = sum_d dO_i O_i — the d(logsumexp) correction; a cheap fused
+    # elementwise+reduce, left to XLA rather than a third kernel.  Carried
+    # (BH, 1, Sq) like lse (see the fwd kernel's tiling note).
+    dl = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                 axis=-1)[:, None, :]
+
+    mat = lambda bs, im: pl.BlockSpec((1, bs, d), im)
+    row = lambda bs, im: pl.BlockSpec((1, 1, bs), im)
+
+    common = dict(scale=scale, causal=causal, bq=bq, bk=bk,
+                  has_bias=bias is not None, off=sk - sq)
+    qkv_specs = lambda qi, ki, kva: (
+        [mat(bq, qi), mat(bk, ki), mat(bk, ki)]
+        + ([_bias_spec(bk, h, kv_axis=kva)] if bias is not None else []))
+    operands = [q, k, v] + ([bias[:, None, :]] if bias is not None else [])
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, nq=nq, **common),
+        grid=(bh, nk, nq),   # kv outer, q inner (accumulate over q)
+        in_specs=qkv_specs(lambda b, j, i: (b, i, 0),
+                           lambda b, j, i: (b, j, 0), 1)
+        + [mat(bq, lambda b, j, i: (b, i, 0)),     # do
+           row(bq, lambda b, j, i: (b, 0, i)),     # lse
+           row(bq, lambda b, j, i: (b, 0, i))],    # delta
+        out_specs=[mat(bk, lambda b, j, i: (b, j, 0)),
+                   mat(bk, lambda b, j, i: (b, j, 0))],
+        out_shape=[sds((bh, sk, d), k.dtype, q, k, v, do),
+                   sds((bh, sk, d), v.dtype, q, k, v, do)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=_cfg.INTERPRET,
+    )(*operands, do, lse, dl)
+
+    (dq,) = pl.pallas_call(
+        functools.partial(_dq_kernel, nk=nk, **common),
+        grid=(bh, nq, nk),   # q outer, kv inner (accumulate over kv)
+        in_specs=qkv_specs(lambda b, i, j: (b, i, 0),
+                           lambda b, i, j: (b, j, 0), 2)
+        + [mat(bq, lambda b, i, j: (b, i, 0)),
+           row(bq, lambda b, i, j: (b, 0, i)),
+           row(bq, lambda b, i, j: (b, 0, i))],
+        out_specs=[mat(bq, lambda b, i, j: (b, i, 0))],
+        out_shape=[sds((bh, sq, d), q.dtype, q, k, v, do)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_cfg.INTERPRET,
+    )(*operands, do, lse, dl)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# Public op with custom VJP.
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention(q, k, v, bias=None, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Memory-efficient multi-head attention.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D); bias: optional (B, Sk) additive
+    key bias (finite values; use ~-1e9 for masked keys); returns
+    (B, Sq, H, D) in q's dtype.  Softmax is fp32.  Falls back to the XLA
+    reference off-TPU or when shapes don't tile (S % 128, tiny sequences).
+    """
+    o, _ = _flash_fwd(q, k, v, bias, causal, scale)
+    return o
+
+
+def _flash_fwd(q, k, v, bias, causal, scale):
+    if causal and q.shape[1] > k.shape[1]:
+        # Bottom-right alignment would leave the first Sq-Sk query rows with
+        # no visible keys at all — there is no meaningful gradient for such
+        # rows (and the kernel's recomputed-softmax backward would disagree
+        # with autodiff on them), so the configuration is rejected outright.
+        raise ValueError(
+            f"causal attention needs Sq <= Sk (bottom-right alignment), got "
+            f"Sq={q.shape[1]} > Sk={k.shape[1]}")
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    args = (q, k, v) + (() if bias is None else (bias,))
+    if not _kernel_ok(*args):
+        return attention_reference(q, k, v, bias, causal, scale), None
+    b, _, h, d = q.shape
+    qf, kf, vf = (_pad_head(_fold(x)) for x in (q, k, v))
+    o, lse = _attn_fwd_pallas(qf, kf, vf, bias, causal, scale, h)
+    return _unfold(o[..., :d], b, h), lse
+
+
+def _flash_fwd_vjp(q, k, v, bias, causal, scale):
+    o, lse = _flash_fwd(q, k, v, bias, causal, scale)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_bwd_vjp(causal, scale, res, do):
+    q, k, v, bias, o, lse = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if lse is None:
+        # Fallback path: differentiate the reference directly.
+        f = lambda q, k, v: attention_reference(q, k, v, bias, causal, scale)
+        _, vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp(do)
+    else:
+        b, _, h, d = q.shape
+        qf, kf, vf, of, dof = (_pad_head(_fold(x))
+                               for x in (q, k, v, o, do))
+        dq, dk, dv = _attn_bwd_pallas(qf, kf, vf, bias, causal, scale, h,
+                                      of, lse, dof)
+        dq, dk, dv = (_unfold(g[..., :d], b, h) for g in (dq, dk, dv))
+    dbias = None if bias is None else jnp.zeros_like(bias)  # constant mask
+    return dq, dk, dv, dbias
+
+
+flash_attention.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)

@@ -159,7 +159,8 @@ def bench_c4(args):
     policy, scaler = amp.initialize("O2")
     md = amp.module_dtypes(policy)
     model = bert_base(dtype=md.compute, param_dtype=md.param,
-                      ln_dtype=md.ln_io, softmax_dtype=md.softmax)
+                      ln_dtype=md.ln_io, softmax_dtype=md.softmax,
+                      fused_attention=args.fused_attention)
     opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
     bs, seq = args.batch_size, args.seq_len
     V = model.vocab_size
@@ -290,6 +291,8 @@ def main():
     ap.add_argument("--seq-len", type=int, default=None)
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--fused-attention", action="store_true",
+                    help="c4: flash-attention kernel (ops/attention.py)")
     args = ap.parse_args()
 
     defaults = {          # (batch_size, image_size, seq_len)

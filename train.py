@@ -57,6 +57,9 @@ def parse_args(argv=None):
     p.add_argument("--arch", "-a", default="resnet18",
                    choices=sorted(ARCHS) + LM_ARCHS)
     p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--fused-attention", action="store_true",
+                   help="blockwise flash-attention kernel for BERT archs "
+                        "(ops/attention.py; fp32-softmax opt levels only)")
     p.add_argument("--vocab-size", type=int, default=30522)
     p.add_argument("--max-grad-norm", type=float, default=0.25,
                    help="global-norm grad clip (transformer_xl)")
@@ -186,6 +189,16 @@ def main(argv=None):
     policy, scaler = amp.initialize(
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
+    if args.fused_attention and not args.arch.startswith("bert"):
+        # Uniform rejection (not a silent no-op): the kernel is wired into
+        # the BERT attention module only — see lm_main for the
+        # transformer_xl rationale.
+        raise SystemExit("--fused-attention is wired for BERT archs only")
+    if args.fused_attention and args.opt_level == "O3":
+        # The kernel's softmax is always fp32; O3's contract is half softmax
+        # and the module gate would silently fall back to the naive path.
+        raise SystemExit("--fused-attention requires fp32 softmax "
+                         "(opt levels O0-O2); O3 runs softmax half")
     if args.arch in LM_ARCHS:
         if args.host_pipeline:
             raise SystemExit("--host-pipeline is only wired for the image "
@@ -380,6 +393,12 @@ def lm_main(args, policy, scaler):
                softmax_dtype=md.softmax)
     if args.arch in ("bert_base", "transformer_xl"):
         mkw["vocab_size"] = args.vocab_size
+    if is_bert:
+        # (transformer_xl is rejected in main(): its relative-position
+        # logits are q·r terms, not an additive bias — blockwise attention
+        # for it needs the rel-shift inside the kernel; its long-context
+        # story is the segment recurrence itself, SURVEY.md §6.)
+        mkw["fused_attention"] = args.fused_attention
     model = builder(**mkw)
     optimizer = build_optimizer(args)
 
