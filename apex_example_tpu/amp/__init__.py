@@ -29,7 +29,8 @@ __all__ = [
 
 def initialize(opt_level: str = "O0", loss_scale=None,
                keep_batchnorm_fp32=None, half_dtype=None,
-               init_scale: float = 2.0 ** 16, growth_interval: int = 2000):
+               init_scale: float = 2.0 ** 16, growth_interval: int = 2000,
+               num_losses: int = 1):
     """apex-parity entry point: returns ``(policy, scaler_state)``.
 
     Reference: ``amp.initialize(model, optimizer, opt_level=..., ...)``.
@@ -37,11 +38,19 @@ def initialize(opt_level: str = "O0", loss_scale=None,
     caller threads the policy into model construction (``compute_dtype`` etc.)
     and the scaler state into the train step.  See harness/train.py for the
     end-to-end wiring.
+
+    ``num_losses > 1`` returns a tuple of independent scalers (a pytree —
+    carry it in the train state like the single one); pass ``loss_id`` to
+    ``scale_loss``/``unscale_grads``/``update_scaler``.  The reference keeps
+    one LossScaler per loss for the same reason: each loss has its own
+    overflow history.
     """
     import jax.numpy as jnp
     policy = get_policy(opt_level, loss_scale=loss_scale,
                         keep_batchnorm_fp32=keep_batchnorm_fp32,
                         half_dtype=half_dtype or jnp.bfloat16)
-    scaler = make_scaler(policy, init_scale=init_scale,
-                         growth_interval=growth_interval)
-    return policy, scaler
+    mk = lambda: make_scaler(policy, init_scale=init_scale,
+                             growth_interval=growth_interval)
+    if num_losses > 1:
+        return policy, tuple(mk() for _ in range(num_losses))
+    return policy, mk()

@@ -60,8 +60,19 @@ def make_scaler(policy: Policy,
                        dynamic=False, identity=(static == 1.0))
 
 
-def scale_loss(loss: jnp.ndarray, scaler: ScalerState) -> jnp.ndarray:
+def _pick(scaler, loss_id: int) -> "ScalerState":
+    """Multi-loss support (reference: amp.initialize(..., num_losses=N) makes
+    one LossScaler per loss; scale_loss takes loss_id — upstream exercises
+    this in test_multiple_models_optimizers_losses.py).  A scaler argument
+    may be a single ScalerState or a sequence of them."""
+    if isinstance(scaler, (tuple, list)):
+        return scaler[loss_id]
+    return scaler
+
+
+def scale_loss(loss: jnp.ndarray, scaler, loss_id: int = 0) -> jnp.ndarray:
     """``with amp.scale_loss(loss, opt) as scaled_loss`` — the enter half."""
+    scaler = _pick(scaler, loss_id)
     if scaler.identity:
         return loss
     return loss * scaler.scale.astype(loss.dtype)
@@ -76,7 +87,7 @@ def all_finite(tree: Any) -> jnp.ndarray:
         [jnp.all(jnp.isfinite(l)) for l in leaves]).all()
 
 
-def unscale_grads(grads: Any, scaler: ScalerState
+def unscale_grads(grads: Any, scaler, loss_id: int = 0
                   ) -> Tuple[Any, jnp.ndarray]:
     """The ``scale_loss.__exit__`` half: grads /= scale, inf/nan check.
 
@@ -87,6 +98,7 @@ def unscale_grads(grads: Any, scaler: ScalerState
     folding (see ScalerState.identity).  The finite check is only
     materialized for dynamic scalers (callers gate on ``scaler.dynamic``).
     """
+    scaler = _pick(scaler, loss_id)
     if scaler.identity and not scaler.dynamic:
         return grads, jnp.asarray(True)
     inv = (1.0 / scaler.scale)
@@ -99,8 +111,15 @@ def unscale_grads(grads: Any, scaler: ScalerState
     return grads, finite
 
 
-def update(scaler: ScalerState, grads_finite: jnp.ndarray) -> ScalerState:
-    """Apex growth/backoff schedule, fully traced (no host sync)."""
+def update(scaler, grads_finite: jnp.ndarray, loss_id: int = 0):
+    """Apex growth/backoff schedule, fully traced (no host sync).
+
+    With a sequence of scalers, only ``loss_id``'s entry is updated (each
+    loss has its own overflow history); the full sequence is returned."""
+    if isinstance(scaler, (tuple, list)):
+        new = update(scaler[loss_id], grads_finite)
+        return type(scaler)(
+            new if i == loss_id else s for i, s in enumerate(scaler))
     if not scaler.dynamic:
         return scaler
     counter = jnp.where(grads_finite, scaler.growth_counter + 1,
@@ -121,15 +140,26 @@ def select_tree(pred: jnp.ndarray, on_true: Any, on_false: Any) -> Any:
         lambda t, f: jnp.where(pred, t, f), on_true, on_false)
 
 
-def state_dict(scaler: ScalerState) -> dict:
+def state_dict(scaler) -> dict:
     """Serializable scaler state (reference: amp.state_dict(); the loss-scale
-    survives checkpoint/resume — upstream tests this in test_checkpointing)."""
+    survives checkpoint/resume — upstream tests this in test_checkpointing).
+    A sequence of scalers (num_losses > 1) serializes each in order, the way
+    apex's state_dict carries one ``loss_scalerN`` entry per loss."""
+    if isinstance(scaler, (tuple, list)):
+        return {"scalers": [state_dict(s) for s in scaler]}
     return {"scale": float(scaler.scale),
             "growth_counter": int(scaler.growth_counter),
             "dynamic": scaler.dynamic}
 
 
-def load_state_dict(scaler: ScalerState, d: dict) -> ScalerState:
+def load_state_dict(scaler, d: dict):
+    if isinstance(scaler, (tuple, list)):
+        if len(d["scalers"]) != len(scaler):
+            raise ValueError(
+                f"checkpoint carries {len(d['scalers'])} loss scalers but "
+                f"this run was initialized with num_losses={len(scaler)}")
+        return type(scaler)(
+            load_state_dict(s, sd) for s, sd in zip(scaler, d["scalers"]))
     scale = float(d["scale"])
     return scaler.replace(
         scale=jnp.asarray(scale, jnp.float32),

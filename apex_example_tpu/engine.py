@@ -163,24 +163,36 @@ def make_train_step(model, optimizer, policy: Policy,
             split = lambda a: a.reshape(k, a.shape[0] // k, *a.shape[1:])
             xk = jax.tree_util.tree_map(split, x)
             yk = jax.tree_util.tree_map(split, y)
-            gzero = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), diff_params)
+            head = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            tail = lambda t: jax.tree_util.tree_map(lambda a: a[1:], t)
 
-            def body(carry, mb):
-                stats, gsum, lsum, tsum = carry
-                x_mb, y_mb = mb
+            def micro(stats, x_mb, y_mb):
                 grads_mb, (loss_mb, logits_mb, stats) = jax.grad(
                     scaled_loss_for(stats, x_mb, y_mb),
                     has_aux=True)(diff_params)
-                gsum = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), gsum, grads_mb)
-                if compute_accuracy and isinstance(y, jnp.ndarray):
-                    tsum = tsum + _batch_top1(logits_mb, y_mb)
-                return (stats, gsum, lsum + loss_mb, tsum), None
+                gf = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads_mb)
+                t = (_batch_top1(logits_mb, y_mb)
+                     if compute_accuracy and isinstance(y, jnp.ndarray)
+                     else jnp.zeros((), jnp.float32))
+                return stats, gf, loss_mb, t
 
+            def body(carry, mb):
+                stats, gsum, lsum, tsum = carry
+                stats, gf, loss_mb, t = micro(stats, *mb)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, gf)
+                return (stats, gsum, lsum + loss_mb, tsum + t), None
+
+            # Prologue: microbatch 0 runs outside the scan so the carry's
+            # per-leaf shard-variance (vma) types are exactly those the body
+            # produces — a zeros-init carry would be mesh-invariant while
+            # grads/losses vary per shard (shard_map rejects the mismatch),
+            # and blanket-casting it varying would erase the invariant typing
+            # of implicitly-psummed grads that allreduce_grads relies on to
+            # skip the double reduction.
             (new_stats, gsum, lsum, tsum), _ = jax.lax.scan(
-                body, (state.batch_stats, gzero, jnp.zeros((), jnp.float32),
-                       jnp.zeros((), jnp.float32)), (xk, yk))
+                body, micro(state.batch_stats, *head((xk, yk))),
+                tail((xk, yk)))
             grads = jax.tree_util.tree_map(
                 lambda a, p: (a / k).astype(p.dtype), gsum, diff_params)
             loss = lsum / k

@@ -389,6 +389,11 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     key bias (finite values; use ~-1e9 for masked keys); returns
     (B, Sq, H, D) in q's dtype.  Softmax is fp32.  Falls back to the XLA
     reference off-TPU or when shapes don't tile (S % 128, tiny sequences).
+
+    ``bias`` is treated as a constant MASK: its VJP is hard-coded to zero
+    (on the kernel and fallback paths alike).  Do not route a *learned*
+    bias (ALiBi-style scores etc.) through it — the parameter would
+    silently never train.
     """
     o, _ = _flash_fwd(q, k, v, bias, causal, scale)
     return o

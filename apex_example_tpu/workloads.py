@@ -50,29 +50,83 @@ def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 def make_txl_train_step(model, optimizer, policy: Policy,
                         ddp: Optional[DDPConfig] = None,
                         axis_name: Optional[str] = None,
-                        max_grad_norm: float = 0.25):
+                        max_grad_norm: float = 0.25,
+                        grad_accum: int = 1):
     """Transformer-XL step: (state, mems, (inp, tgt)) → (state, mems', metrics).
 
     Mirrors the reference C5 recipe (SURVEY.md §1): FusedLayerNorm inside the
     model, global-norm grad clipping (the multi_tensor_l2norm path) before the
     update, segment recurrence via the mems carry.
+
+    ``grad_accum=K`` splits the batch into K microbatches of independent
+    *streams* (recurrence runs along time, not batch, so slicing the batch
+    axis — of both the tokens and the (layers, B, mem, d) memory — keeps
+    each stream's carry exact).  fp32 grads accumulate across microbatches,
+    the clip/allreduce/step run once on the mean — the same convention as
+    engine.make_train_step.
     """
     from apex_example_tpu.ops import clip_grad_norm
 
     opt = _wrap_optimizer(optimizer)
     ddp = ddp or DDPConfig()
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
     def train_step(state: TrainState, mems, batch):
         inp, tgt = batch
 
-        def scaled_loss_fn(params):
-            logits, new_mems = model.apply({"params": params}, inp,
-                                           mems=mems)
-            loss = lm_loss(logits, tgt)
-            return amp_lib.scale_loss(loss, state.scaler), (loss, new_mems)
+        def grads_for(mems_mb, inp_mb, tgt_mb):
+            def scaled_loss_fn(params):
+                logits, new_mems = model.apply({"params": params}, inp_mb,
+                                               mems=mems_mb)
+                loss = lm_loss(logits, tgt_mb)
+                return amp_lib.scale_loss(loss, state.scaler), (loss,
+                                                                new_mems)
+            return jax.grad(scaled_loss_fn, has_aux=True)(state.params)
 
-        grads, (loss, new_mems) = jax.grad(
-            scaled_loss_fn, has_aux=True)(state.params)
+        if grad_accum == 1:
+            grads, (loss, new_mems) = grads_for(mems, inp, tgt)
+        else:
+            k = grad_accum
+            if inp.shape[0] % k:
+                raise ValueError(f"batch {inp.shape[0]} not divisible by "
+                                 f"grad_accum {k}")
+            split = lambda a: a.reshape(k, a.shape[0] // k, *a.shape[1:])
+            # mems batch axis is dim 1 of (layers, B, mem, d).
+            mems_k = jax.tree_util.tree_map(
+                lambda m: jnp.moveaxis(
+                    m.reshape(m.shape[0], k, m.shape[1] // k, *m.shape[2:]),
+                    1, 0), mems)
+            def micro(mems_mb, inp_mb, tgt_mb):
+                g, (l, nm) = grads_for(mems_mb, inp_mb, tgt_mb)
+                return (jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.float32), g), l, nm)
+
+            def body(carry, mb):
+                gsum, lsum = carry
+                gf, l, nm = micro(*mb)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, gf)
+                return (gsum, lsum + l), nm
+
+            # Microbatch 0 runs outside the scan so the carry's per-leaf
+            # shard-variance types match what the body produces (see
+            # engine.make_train_step for the full rationale — a zeros init
+            # is mesh-invariant and shard_map's vma check rejects it).
+            xs = (mems_k, split(inp), split(tgt))
+            g0, l0, nm0 = micro(*jax.tree_util.tree_map(
+                lambda a: a[0], xs))
+            (gsum, lsum), new_mems_rest = jax.lax.scan(
+                body, (g0, l0),
+                jax.tree_util.tree_map(lambda a: a[1:], xs))
+            grads = jax.tree_util.tree_map(
+                lambda a, p: (a / k).astype(p.dtype), gsum, state.params)
+            loss = lsum / k
+            new_mems_k = jax.tree_util.tree_map(
+                lambda first, rest: jnp.concatenate([first[None], rest]),
+                nm0, new_mems_rest)
+            new_mems = jax.tree_util.tree_map(
+                lambda m: jnp.moveaxis(m, 0, 1).reshape(
+                    m.shape[1], -1, *m.shape[3:]), new_mems_k)
         if axis_name is not None:
             grads = allreduce_grads(grads, ddp, axis_name)
             loss = jax.lax.pmean(loss, axis_name)
@@ -103,12 +157,14 @@ def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                                 ddp: Optional[DDPConfig] = None,
                                 max_grad_norm: float = 0.25,
                                 axis_name: str = DATA_AXIS,
-                                donate: bool = True):
+                                donate: bool = True,
+                                grad_accum: int = 1):
     """DDP Transformer-XL step.  mems are sharded on their batch axis
     (dim 1 of (layers, B, mem, d)); state is replicated."""
     per_shard = make_txl_train_step(model, optimizer, policy, ddp=ddp,
                                     axis_name=axis_name,
-                                    max_grad_norm=max_grad_norm)
+                                    max_grad_norm=max_grad_norm,
+                                    grad_accum=grad_accum)
     mem_spec = P(None, axis_name)
     sharded = _shard_map(
         per_shard, mesh=mesh,

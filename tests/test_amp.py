@@ -201,3 +201,53 @@ class TestOpClassification:
         assert outs["O1"].dtype == jnp.float32
         assert outs["O2"].dtype == jnp.bfloat16
         assert outs["O3"].dtype == jnp.bfloat16
+
+
+class TestMultiLoss:
+    """num_losses > 1: one independent scaler per loss (reference:
+    amp.initialize(num_losses=N) + scale_loss(..., loss_id=i); upstream
+    exercises this in L0/run_amp/test_multiple_models_optimizers_losses)."""
+
+    def test_initialize_returns_tuple(self):
+        policy, scalers = amp.initialize("O2", loss_scale="dynamic",
+                                         num_losses=3)
+        assert isinstance(scalers, tuple) and len(scalers) == 3
+        assert all(s.dynamic for s in scalers)
+
+    def test_overflow_isolated_per_loss(self):
+        _, scalers = amp.initialize("O2", loss_scale="dynamic", num_losses=2)
+        s0 = float(scalers[0].scale)
+        good = {"w": jnp.ones((4,))}
+        bad = {"w": jnp.array([1.0, jnp.inf, 1.0, 1.0])}
+
+        @jax.jit
+        def step(scalers):
+            _, f0 = amp.unscale_grads(good, scalers, loss_id=0)
+            scalers = amp.update_scaler(scalers, f0, loss_id=0)
+            _, f1 = amp.unscale_grads(bad, scalers, loss_id=1)
+            scalers = amp.update_scaler(scalers, f1, loss_id=1)
+            return scalers
+
+        scalers = step(scalers)
+        assert float(scalers[0].scale) == s0          # clean loss: unchanged
+        assert float(scalers[1].scale) == s0 * 0.5    # overflowed: backoff
+        assert int(scalers[0].growth_counter) == 1
+        assert int(scalers[1].growth_counter) == 0
+
+    def test_scale_loss_uses_loss_id(self):
+        _, scalers = amp.initialize("O2", loss_scale="dynamic", num_losses=2)
+        scalers = (scalers[0].replace(scale=jnp.asarray(4.0, jnp.float32)),
+                   scalers[1])
+        assert float(amp.scale_loss(jnp.asarray(1.0), scalers,
+                                    loss_id=0)) == 4.0
+        assert float(amp.scale_loss(jnp.asarray(1.0), scalers,
+                                    loss_id=1)) == 2.0 ** 16
+
+    def test_state_dict_roundtrip(self):
+        _, scalers = amp.initialize("O2", loss_scale="dynamic", num_losses=2)
+        scalers = amp.update_scaler(scalers, jnp.asarray(False), loss_id=1)
+        d = amp.state_dict(scalers)
+        _, fresh = amp.initialize("O2", loss_scale="dynamic", num_losses=2)
+        restored = amp.load_state_dict(fresh, d)
+        assert float(restored[1].scale) == float(scalers[1].scale)
+        assert float(restored[0].scale) == float(scalers[0].scale)

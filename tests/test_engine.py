@@ -246,3 +246,72 @@ class TestGradAccum:
                 first = float(metrics["loss"])
         assert float(metrics["loss"]) < first
         assert int(state.step) == 6
+
+
+class TestShardedGradAccum:
+    """Regression: the scan carry inside a shard_map'd grad-accum step must
+    be cast shard-varying (engine.to_varying) — the initial zeros/stats are
+    mesh-invariant while the per-microbatch grads vary, and shard_map's vma
+    type check rejects the mismatch (this exact config once failed)."""
+
+    def test_ddp_accum_matches_no_accum(self, devices8):
+        """BERT (no batch-dependent state): K-microbatch accumulation under
+        shard_map reproduces the plain sharded step.  (BN models legitimately
+        differ — per-forward stats see the microbatch, apex semantics — so
+        the exactness check uses a stateless model; the BN path is covered
+        by test_resnet_accum_runs_and_learns and the smoke below.)"""
+        from apex_example_tpu.models.bert import bert_tiny
+        from apex_example_tpu.workloads import mlm_loss
+        policy, scaler = amp.initialize("O0")
+        mesh = make_data_mesh(devices=devices8)
+        model = bert_tiny()
+        opt = FusedSGD(lr=0.05, momentum=0.0)
+        ids = jnp.asarray(
+            np.random.RandomState(5).randint(0, 256, (16, 16)), jnp.int32)
+        batch = (ids, (ids, jnp.ones(ids.shape, jnp.float32)))
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   ids[:1], policy, scaler, train_kwargs={})
+        state2 = jax.tree_util.tree_map(lambda x: x.copy(), state)
+
+        mk = lambda k: make_sharded_train_step(
+            mesh, model, opt, policy, loss_fn=mlm_loss,
+            compute_accuracy=False, donate=False, grad_accum=k)
+        state, m1 = mk(1)(state, batch)
+        state2, m2 = mk(2)(state2, batch)  # 2 per shard → 2 microbatches
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(state2.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=1e-4)
+
+    def test_ddp_bn_accum_smoke(self, devices8):
+        policy, scaler = amp.initialize("O0")
+        mesh = make_data_mesh(devices=devices8)
+        model = tiny_model(bn_axis_name="data")
+        opt = FusedSGD(lr=0.05, momentum=0.0)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   tiny_batch()[0], policy, scaler)
+        step = make_sharded_train_step(mesh, model, opt, policy,
+                                       donate=False, grad_accum=2)
+        state, m = step(state, tiny_batch(0, bs=16))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_txl_ddp_accum_runs(self, devices8):
+        from apex_example_tpu.data import lm_batch
+        from apex_example_tpu.models.transformer_xl import transformer_xl_tiny
+        from apex_example_tpu.workloads import make_sharded_txl_train_step
+        policy, scaler = amp.initialize("O0")
+        mesh = make_data_mesh(devices=devices8)
+        model = transformer_xl_tiny()
+        opt = FusedSGD(lr=0.05, momentum=0.0)
+        toks = lm_batch(jnp.asarray(0), batch_size=16, seq_len=9,
+                        vocab_size=256, seed=3)
+        state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                   toks[:1, :8], policy, scaler,
+                                   train_kwargs={})
+        mems = model.init_mems(16)
+        step = make_sharded_txl_train_step(mesh, model, opt, policy,
+                                           donate=False, grad_accum=2)
+        state, mems, m = step(state, mems, (toks[:, :8], toks[:, 1:9]))
+        assert np.isfinite(float(m["loss"]))

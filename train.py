@@ -437,19 +437,19 @@ def lm_main(args, policy, scaler):
                                               grad_accum=args.grad_accum),
                               donate_argnums=(0,))
     else:
-        if args.grad_accum > 1:
-            raise SystemExit("--grad-accum is not wired for transformer_xl: "
-                             "recurrence memory advances per forward, so "
-                             "microbatch accumulation would change the "
-                             "segment stream semantics")
+        # grad accumulation slices the BATCH axis (independent streams), so
+        # each stream's recurrence carry stays exact — see
+        # workloads.make_txl_train_step.
         if n_dev > 1:
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_txl_train_step(
                 mesh, model, optimizer, policy,
-                max_grad_norm=args.max_grad_norm)
+                max_grad_norm=args.max_grad_norm,
+                grad_accum=args.grad_accum)
         else:
             step_fn = jax.jit(make_txl_train_step(
-                model, optimizer, policy, max_grad_norm=args.max_grad_norm),
+                model, optimizer, policy, max_grad_norm=args.max_grad_norm,
+                grad_accum=args.grad_accum),
                 donate_argnums=(0, 1))
 
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
