@@ -39,11 +39,12 @@ def initialize(opt_level: str = "O0", loss_scale=None,
     and the scaler state into the train step.  See harness/train.py for the
     end-to-end wiring.
 
-    ``num_losses > 1`` returns a tuple of independent scalers (a pytree —
-    carry it in the train state like the single one); pass ``loss_id`` to
-    ``scale_loss``/``unscale_grads``/``update_scaler``.  The reference keeps
-    one LossScaler per loss for the same reason: each loss has its own
-    overflow history.
+    ``num_losses > 1`` returns a tuple of independent scalers (a pytree);
+    pass ``loss_id`` to ``scale_loss``/``unscale_grads``/``update_scaler``.
+    The reference keeps one LossScaler per loss for the same reason: each
+    loss has its own overflow history.  This form is for CUSTOM multi-loss
+    train steps — the stock engine/workloads steps consume exactly one
+    scaler (their TrainState and metrics read ``scaler.scale`` directly).
     """
     import jax.numpy as jnp
     policy = get_policy(opt_level, loss_scale=loss_scale,

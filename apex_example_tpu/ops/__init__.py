@@ -6,7 +6,8 @@ on TPU) and an XLA reference implementation (CPU fallback + test golden).
 """
 
 from apex_example_tpu.ops.attention import (attention_reference,
-                                            flash_attention)
+                                            flash_attention,
+                                            flash_attention_with_lse)
 from apex_example_tpu.ops.layer_norm import (layer_norm,
                                              layer_norm_reference, rms_norm,
                                              rms_norm_reference)
@@ -19,7 +20,7 @@ from apex_example_tpu.ops.fused_optim import (
 
 __all__ = [
     "MultiTensorApply", "adam_update_leaf", "adam_update_leaf_reference",
-    "attention_reference", "flash_attention",
+    "attention_reference", "flash_attention", "flash_attention_with_lse",
     "clip_grad_norm", "lamb_stage1_leaf", "lamb_stage2_leaf", "layer_norm",
     "layer_norm_reference", "multi_tensor_axpby", "multi_tensor_l2norm",
     "multi_tensor_scale", "novograd_update_leaf", "rms_norm",

@@ -71,12 +71,10 @@ def _dot_f32(a, b, *, trans_a=False, trans_b=False):
 # XLA reference path (CPU fallback + kernel-test golden).
 # --------------------------------------------------------------------------
 
-def attention_reference(q, k, v, bias=None, causal=False,
-                        scale: Optional[float] = None):
-    """Naive attention.  q: (B, Sq, H, D); k/v: (B, Sk, H, D);
-    bias: (B, Sk) additive, already finite; returns (B, Sq, H, D)."""
-    if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
+def _scores_reference(q, k, bias, causal, scale):
+    """fp32 (B, H, Sq, Sk) scores: scaled QK^T, bias, causal mask — the one
+    place the reference-path score semantics live (the Pallas counterpart is
+    _scores)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
@@ -89,9 +87,31 @@ def attention_reference(q, k, v, bias=None, causal=False,
         mask = (lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
                 >= lax.broadcasted_iota(jnp.int32, (sq, sk), 1))
         s = jnp.where(mask, s, _MASK)
+    return s
+
+
+def attention_reference(q, k, v, bias=None, causal=False,
+                        scale: Optional[float] = None):
+    """Naive attention.  q: (B, Sq, H, D); k/v: (B, Sk, H, D);
+    bias: (B, Sk) additive, already finite; returns (B, Sq, H, D)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = _scores_reference(q, k, bias, causal, scale)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _reference_pair(q, k, v, bias, causal, scale):
+    """attention_reference's output plus its (B, H, Sq) row logsumexp, both
+    derived from ONE score tensor (keeps out and lse mutually consistent on
+    the fallback path — the ring combine weights depend on that)."""
+    s = _scores_reference(q, k, bias, causal, scale)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None]).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return out, lse
 
 
 # --------------------------------------------------------------------------
@@ -320,7 +340,8 @@ def _attn_fwd_pallas(q, k, v, bias, causal, scale, h):
     return o, lse
 
 
-def _attn_bwd_pallas(q, k, v, bias, causal, scale, h, o, lse, do):
+def _attn_bwd_pallas(q, k, v, bias, causal, scale, h, o, lse, do,
+                     dlse=None):
     _bind_pallas()
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -329,9 +350,15 @@ def _attn_bwd_pallas(q, k, v, bias, causal, scale, h, o, lse, do):
 
     # delta_i = sum_d dO_i O_i — the d(logsumexp) correction; a cheap fused
     # elementwise+reduce, left to XLA rather than a third kernel.  Carried
-    # (BH, 1, Sq) like lse (see the fwd kernel's tiling note).
+    # (BH, 1, Sq) like lse (see the fwd kernel's tiling note).  When the lse
+    # output itself carries a cotangent (flash_attention_with_lse — the ring
+    # combine differentiates through it), it folds in here: dS = P∘(dP − Δ)
+    # gains the term dlse_i·P_ij because ∂lse_i/∂S_ij = P_ij, i.e.
+    # Δ_i := Δ_i − dlse_i.
     dl = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                  axis=-1)[:, None, :]
+    if dlse is not None:
+        dl = dl - dlse
 
     mat = lambda bs, im: pl.BlockSpec((1, bs, d), im)
     row = lambda bs, im: pl.BlockSpec((1, 1, bs), im)
@@ -377,8 +404,63 @@ def _attn_bwd_pallas(q, k, v, bias, causal, scale, h, o, lse, do):
 
 
 # --------------------------------------------------------------------------
-# Public op with custom VJP.
+# Public ops with custom VJP.  flash_attention and flash_attention_with_lse
+# share one dispatch pipeline (_lse_fwd / _bwd_dispatch); the only
+# difference is whether the row logsumexp is exposed to the caller (and may
+# therefore carry a cotangent).
 # --------------------------------------------------------------------------
+
+def _lse_fwd(q, k, v, bias, causal, scale):
+    """Shared forward: (o, lse_public (B,H,Sq), lse_folded (BH,1,Sq)|None).
+
+    lse_folded is None exactly when the XLA reference path ran (the backward
+    then differentiates the reference instead of running the kernels)."""
+    if causal and q.shape[1] > k.shape[1]:
+        # Bottom-right alignment would leave the first Sq-Sk query rows with
+        # no visible keys at all — there is no meaningful gradient for such
+        # rows (and the kernel's recomputed-softmax backward would disagree
+        # with autodiff on them), so the configuration is rejected outright.
+        raise ValueError(
+            f"causal attention needs Sq <= Sk (bottom-right alignment), got "
+            f"Sq={q.shape[1]} > Sk={k.shape[1]}")
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    args = (q, k, v) + (() if bias is None else (bias,))
+    b, sq, h, d = q.shape
+    if not _kernel_ok(*args):
+        o, lse = _reference_pair(q, k, v, bias, causal, scale)
+        return o, lse, None
+    qf, kf, vf = (_pad_head(_fold(x)) for x in (q, k, v))
+    o, lse = _attn_fwd_pallas(qf, kf, vf, bias, causal, scale, h)
+    return (_unfold(o[..., :d], b, h), lse[:, 0, :].reshape(b, h, sq), lse)
+
+
+def _bwd_dispatch(causal, scale, res, do, dlse):
+    """Shared backward.  dlse is the lse cotangent (None for the plain op)."""
+    q, k, v, bias, o, lse_folded = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if lse_folded is None:
+        if dlse is None:
+            f = lambda q, k, v: attention_reference(q, k, v, bias, causal,
+                                                    scale)
+            _, vjp = jax.vjp(f, q, k, v)
+            dq, dk, dv = vjp(do)
+        else:
+            f = lambda q, k, v: _reference_pair(q, k, v, bias, causal, scale)
+            _, vjp = jax.vjp(f, q, k, v)
+            dq, dk, dv = vjp((do, dlse))
+    else:
+        b, sq, h, d = q.shape
+        qf, kf, vf, of, dof = (_pad_head(_fold(x)) for x in (q, k, v, o, do))
+        dlse_f = None if dlse is None else \
+            dlse.astype(jnp.float32).reshape(b * h, 1, sq)
+        dq, dk, dv = _attn_bwd_pallas(qf, kf, vf, bias, causal, scale, h,
+                                      of, lse_folded, dof, dlse=dlse_f)
+        dq, dk, dv = (_unfold(g[..., :d], b, h) for g in (dq, dk, dv))
+    dbias = None if bias is None else jnp.zeros_like(bias)  # constant mask
+    return dq, dk, dv, dbias
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def flash_attention(q, k, v, bias=None, causal: bool = False,
@@ -395,53 +477,47 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     bias (ALiBi-style scores etc.) through it — the parameter would
     silently never train.
     """
-    o, _ = _flash_fwd(q, k, v, bias, causal, scale)
+    o, _, _ = _lse_fwd(q, k, v, bias, causal, scale)
     return o
 
 
-def _flash_fwd(q, k, v, bias, causal, scale):
-    if causal and q.shape[1] > k.shape[1]:
-        # Bottom-right alignment would leave the first Sq-Sk query rows with
-        # no visible keys at all — there is no meaningful gradient for such
-        # rows (and the kernel's recomputed-softmax backward would disagree
-        # with autodiff on them), so the configuration is rejected outright.
-        raise ValueError(
-            f"causal attention needs Sq <= Sk (bottom-right alignment), got "
-            f"Sq={q.shape[1]} > Sk={k.shape[1]}")
-    if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
-    args = (q, k, v) + (() if bias is None else (bias,))
-    if not _kernel_ok(*args):
-        return attention_reference(q, k, v, bias, causal, scale), None
-    b, _, h, d = q.shape
-    qf, kf, vf = (_pad_head(_fold(x)) for x in (q, k, v))
-    o, lse = _attn_fwd_pallas(qf, kf, vf, bias, causal, scale, h)
-    return _unfold(o[..., :d], b, h), lse
-
-
 def _flash_fwd_vjp(q, k, v, bias, causal, scale):
-    o, lse = _flash_fwd(q, k, v, bias, causal, scale)
-    return o, (q, k, v, bias, o, lse)
+    o, _, lse_folded = _lse_fwd(q, k, v, bias, causal, scale)
+    return o, (q, k, v, bias, o, lse_folded)
 
 
 def _flash_bwd_vjp(causal, scale, res, do):
-    q, k, v, bias, o, lse = res
-    if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
-    if lse is None:
-        # Fallback path: differentiate the reference directly.
-        f = lambda q, k, v: attention_reference(q, k, v, bias, causal, scale)
-        _, vjp = jax.vjp(f, q, k, v)
-        dq, dk, dv = vjp(do)
-    else:
-        b, _, h, d = q.shape
-        qf, kf, vf, of, dof = (_pad_head(_fold(x))
-                               for x in (q, k, v, o, do))
-        dq, dk, dv = _attn_bwd_pallas(qf, kf, vf, bias, causal, scale, h,
-                                      of, lse, dof)
-        dq, dk, dv = (_unfold(g[..., :d], b, h) for g in (dq, dk, dv))
-    dbias = None if bias is None else jnp.zeros_like(bias)  # constant mask
-    return dq, dk, dv, dbias
+    return _bwd_dispatch(causal, scale, res, do, None)
 
 
 flash_attention.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention_with_lse(q, k, v, bias=None, causal: bool = False,
+                             scale: Optional[float] = None):
+    """:func:`flash_attention` that also returns the row logsumexp.
+
+    Returns ``(out, lse)`` with ``out``: (B, Sq, H, D) in q's dtype and
+    ``lse``: (B, H, Sq) fp32.  The composable form: ring/blockwise context
+    parallelism (parallel/context_parallel.py) merges per-chunk results with
+    the logsumexp-weighted combine.  Unlike the bias argument (constant
+    mask, zero VJP), ``lse`` is fully differentiable — the combine weights
+    backpropagate through it (the kernel backward absorbs the cotangent
+    into its Δ correction: ∂lse_i/∂S_ij = P_ij).
+    """
+    o, lse, _ = _lse_fwd(q, k, v, bias, causal, scale)
+    return o, lse
+
+
+def _flash_lse_fwd_vjp(q, k, v, bias, causal, scale):
+    o, lse_pub, lse_folded = _lse_fwd(q, k, v, bias, causal, scale)
+    return (o, lse_pub), (q, k, v, bias, o, lse_folded)
+
+
+def _flash_lse_bwd_vjp(causal, scale, res, cts):
+    do, dlse = cts
+    return _bwd_dispatch(causal, scale, res, do, dlse)
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd_vjp, _flash_lse_bwd_vjp)

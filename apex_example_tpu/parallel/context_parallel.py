@@ -62,7 +62,8 @@ def plain_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str = CONTEXT_AXIS, causal: bool = False,
-                   scale: Optional[float] = None) -> jnp.ndarray:
+                   scale: Optional[float] = None,
+                   use_flash: bool = True) -> jnp.ndarray:
     """Exact attention over a sequence sharded along ``axis_name``.
 
     Inputs are this device's [B, s, H, D] shards of the global [B, N*s, H, D]
@@ -72,11 +73,21 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     softmax rule, and rotates the chunk to the next neighbour.  Equivalent
     to (but never materializing) full softmax(QKᵀ)V.
 
+    ``use_flash=True`` (default) computes each chunk with the Pallas flash
+    kernel via :func:`~apex_example_tpu.ops.attention.flash_attention_with_lse`
+    and merges normalized per-chunk results by their logsumexp — so even the
+    *per-chunk* S/N × S/N score tile stays in VMEM.  The kernel op itself
+    falls back to the XLA reference off-TPU, so this path is safe everywhere;
+    ``use_flash=False`` keeps the self-contained inline fold (also the test
+    cross-check).
+
     With ``causal=True``, blocks entirely in the future are masked; the
     naive ring still *computes* those blocks (N−1 of 2N−1 block-steps wasted
     at worst) — the standard trade without zigzag load balancing, which is
     documented future work.
     """
+    if use_flash:
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
@@ -126,6 +137,49 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     acc, l, _ = block(acc, l, m, kc, vc, jnp.asarray(n - 1))
     out = acc / l[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale):
+    """Ring attention over flash-kernel chunks.
+
+    Chunk t=0 is the local (diagonal) block — under ``causal`` it gets the
+    kernel's static triangular mask (Sq == Sk per chunk, so bottom-right ==
+    standard).  Every later chunk is either entirely past (src < idx, fully
+    visible) or entirely future (fully masked): a whole-chunk validity
+    select on the chunk's logsumexp (lse → −∞ kills its combine weight)
+    expresses that without any in-kernel dynamic masking.  Merging
+    normalized chunk outputs (o₁,lse₁)⊕(o₂,lse₂) =
+    (w₁o₁+w₂o₂, logaddexp(lse₁,lse₂)), wᵢ = exp(lseᵢ−lse) — gradients flow
+    through the weights into each chunk's lse, which the kernel's VJP
+    absorbs into its Δ correction (ops/attention.py)."""
+    from apex_example_tpu.ops.attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    scale_ = scale if scale is not None else 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o0, lse0 = flash_attention_with_lse(q, k, v, None, causal, scale_)
+    out0 = o0.astype(jnp.float32)
+
+    def step(carry, t):
+        out, lse, kc, vc = carry
+        kc, vc = lax.ppermute((kc, vc), axis_name, perm)
+        ob, lb = flash_attention_with_lse(q, kc, vc, None, False, scale_)
+        if causal:
+            src = (idx - t) % n          # chunk t originated on device src
+            lb = jnp.where(src < idx, lb, _NEG_INF)
+        lse_new = jnp.logaddexp(lse, lb)
+        w_old = jnp.exp(lse - lse_new)   # (B, H, s) → broadcast over D
+        w_blk = jnp.exp(lb - lse_new)
+        out = (out * w_old.transpose(0, 2, 1)[..., None]
+               + ob.astype(jnp.float32) * w_blk.transpose(0, 2, 1)[..., None])
+        return (out, lse_new, kc, vc), None
+
+    (out, _, _, _), _ = lax.scan(step, (out0, lse0, k, v),
+                                 jnp.arange(1, n))
+    return out.astype(q.dtype)
 
 
 def seq_to_heads(x: jnp.ndarray, axis_name: str = CONTEXT_AXIS,

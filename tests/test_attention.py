@@ -190,3 +190,55 @@ def test_causal_rejects_more_queries_than_keys():
     q, k, v, _ = _inputs(sq=256, sk=128, seed=7)
     with pytest.raises(ValueError, match="Sq <= Sk"):
         flash_attention(q, k, v, None, True)
+
+
+class TestWithLse:
+    """flash_attention_with_lse: the composable (ring/blockwise) form — lse
+    values match logsumexp of the true scores, and the lse COTANGENT is
+    honored (the combine's weights differentiate through it)."""
+
+    def test_lse_matches_golden(self):
+        from apex_example_tpu.ops.attention import flash_attention_with_lse
+        q, k, v, _ = _inputs(seed=8)
+        out, lse = flash_attention_with_lse(q, k, v)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_golden(q, k, v, None, False)),
+                                   atol=2e-5, rtol=2e-5)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        np.testing.assert_allclose(
+            np.asarray(lse),
+            np.asarray(jax.scipy.special.logsumexp(s, axis=-1)),
+            atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_lse_cotangent(self, causal):
+        """Loss uses BOTH outputs; grads must match autodiff of an
+        independent (out, lse) computation."""
+        from apex_example_tpu.ops.attention import flash_attention_with_lse
+        q, k, v, _ = _inputs(sq=128, sk=128, h=1, seed=9)
+
+        def golden_pair(q, k, v):
+            qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) / np.sqrt(q.shape[-1])
+            if causal:
+                sq = q.shape[1]
+                s = jnp.where(np.tril(np.ones((sq, sq), bool)), s, -1e30)
+            lse = jax.scipy.special.logsumexp(s, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd",
+                             jnp.exp(s - lse[..., None]), vf)
+            return out, lse
+
+        def loss(fn):
+            def f(q, k, v):
+                o, lse = fn(q, k, v)
+                return (jnp.sum(jnp.square(o.astype(jnp.float32)))
+                        + jnp.sum(jnp.sin(lse)))
+            return f
+
+        gk = jax.grad(loss(lambda q, k, v: flash_attention_with_lse(
+            q, k, v, None, causal)), argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(loss(golden_pair), argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gk, gg, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4,
+                                       err_msg=f"d{name}")

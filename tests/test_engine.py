@@ -250,9 +250,12 @@ class TestGradAccum:
 
 class TestShardedGradAccum:
     """Regression: the scan carry inside a shard_map'd grad-accum step must
-    be cast shard-varying (engine.to_varying) — the initial zeros/stats are
-    mesh-invariant while the per-microbatch grads vary, and shard_map's vma
-    type check rejects the mismatch (this exact config once failed)."""
+    take its per-leaf shard-variance types from a real microbatch (the
+    prologue in make_train_step) — a zeros init is mesh-invariant and
+    rejected by shard_map's vma check, and blanket-casting the carry
+    varying instead erases the invariant typing of implicitly-psummed
+    grads that allreduce_grads keys on, which produced 8x-scaled gradients
+    on this exact config.  Do NOT 'fix' a vma mismatch here with pcast."""
 
     def test_ddp_accum_matches_no_accum(self, devices8):
         """BERT (no batch-dependent state): K-microbatch accumulation under
