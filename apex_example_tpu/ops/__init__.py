@@ -15,12 +15,18 @@ from apex_example_tpu.ops.multi_tensor import (
     MultiTensorApply, clip_grad_norm, multi_tensor_axpby, multi_tensor_l2norm,
     multi_tensor_scale, sqsum_leaf)
 from apex_example_tpu.ops.fused_optim import (
-    adam_update_leaf, adam_update_leaf_reference, lamb_stage1_leaf,
-    lamb_stage2_leaf, novograd_update_leaf, sgd_update_leaf)
+    adagrad_update_leaf, adagrad_update_leaf_reference, adam_update_leaf,
+    adam_update_leaf_reference, lamb_stage1_leaf, lamb_stage2_leaf,
+    novograd_update_leaf, sgd_update_leaf)
+from apex_example_tpu.ops.xentropy import (softmax_cross_entropy,
+                                           softmax_cross_entropy_reference)
 
 __all__ = [
-    "MultiTensorApply", "adam_update_leaf", "adam_update_leaf_reference",
+    "MultiTensorApply", "adagrad_update_leaf",
+    "adagrad_update_leaf_reference", "adam_update_leaf",
+    "adam_update_leaf_reference",
     "attention_reference", "flash_attention", "flash_attention_with_lse",
+    "softmax_cross_entropy", "softmax_cross_entropy_reference",
     "clip_grad_norm", "lamb_stage1_leaf", "lamb_stage2_leaf", "layer_norm",
     "layer_norm_reference", "multi_tensor_axpby", "multi_tensor_l2norm",
     "multi_tensor_scale", "novograd_update_leaf", "rms_norm",

@@ -368,3 +368,75 @@ def sgd_update_leaf(p, g, buf, *, lr, momentum, weight_decay, dampening=0.0,
         interpret=_interpret(),
     )(p2, g2, b2, scal)
     return _unpad(po, n, p), _unpad(bo, n, buf)
+
+
+# --------------------------------------------------------------------------
+# Adagrad (reference: apex/optimizers/fused_adagrad.py backed by
+# multi_tensor_adagrad.cu): h += g²; p -= lr·g/(√h + eps).  Weight decay is
+# L2-into-the-gradient by default, decoupled under adagrad_w_mode — the same
+# switch FusedAdam exposes.
+# --------------------------------------------------------------------------
+
+def _adagrad_kernel(p_ref, g_ref, h_ref, s_ref, po_ref, ho_ref, *,
+                    adagrad_w):
+    lr, eps, wd = (s_ref[i] for i in range(3))
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    h = h_ref[:].astype(jnp.float32)
+    if not adagrad_w:
+        g = g + wd * p
+    h = h + g * g
+    upd = g / (jnp.sqrt(h) + eps)
+    if adagrad_w:
+        upd = upd + wd * p
+    po_ref[:] = (p - lr * upd).astype(po_ref.dtype)
+    ho_ref[:] = h.astype(ho_ref.dtype)
+
+
+def adagrad_update_leaf(p, g, h, *, lr, eps, weight_decay,
+                        adagrad_w_mode: bool = False):
+    """One fused Adagrad step for one leaf.  Scalars may be traced."""
+    if not _use_pallas(p, g, h):
+        return adagrad_update_leaf_reference(
+            p, g, h, lr=lr, eps=eps, weight_decay=weight_decay,
+            adagrad_w_mode=adagrad_w_mode)
+
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p2, n = _to_lanes(p)
+    g2, _ = _to_lanes(g)
+    h2, _ = _to_lanes(h)
+    rows = p2.shape[0]
+    block, pad = _grid_rows(rows)
+    p2, g2, h2 = (_pad_rows(t, pad) for t in (p2, g2, h2))
+    grid = p2.shape[0] // block
+    scal = jnp.stack([jnp.asarray(s, jnp.float32) for s in
+                      (lr, eps, weight_decay)])
+    bspec = lambda: pl.BlockSpec((block, _LANES), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)
+    po, ho = pl.pallas_call(
+        functools.partial(_adagrad_kernel, adagrad_w=adagrad_w_mode),
+        grid=(grid,),
+        in_specs=[bspec(), bspec(), bspec(),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[bspec(), bspec()],
+        out_shape=[sds(p2.shape, p.dtype, p2, g2, h2),
+                   sds(p2.shape, h.dtype, p2, g2, h2)],
+        input_output_aliases={0: 0, 2: 1},
+        interpret=_interpret(),
+    )(p2, g2, h2, scal)
+    return _unpad(po, n, p), _unpad(ho, n, h)
+
+
+def adagrad_update_leaf_reference(p, g, h, *, lr, eps, weight_decay,
+                                  adagrad_w_mode: bool = False):
+    pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+    hf = h.astype(jnp.float32)
+    if not adagrad_w_mode:
+        gf = gf + weight_decay * pf
+    hf = hf + gf * gf
+    upd = gf / (jnp.sqrt(hf) + eps)
+    if adagrad_w_mode:
+        upd = upd + weight_decay * pf
+    return (pf - lr * upd).astype(p.dtype), hf.astype(h.dtype)

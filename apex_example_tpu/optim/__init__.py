@@ -4,13 +4,13 @@ from apex_example_tpu.optim.distributed import (DistributedFusedAdam,
                                                 ZeroAdamState,
                                                 make_zero_train_step)
 from apex_example_tpu.optim.fused import (
-    AdamState, FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD, LambState,
-    NovoGradState, SGDState)
+    AdagradState, AdamState, FusedAdagrad, FusedAdam, FusedLAMB,
+    FusedNovoGrad, FusedSGD, LambState, NovoGradState, SGDState)
 from apex_example_tpu.optim.schedules import (
     build_schedule, constant_lr, cosine_decay, polynomial_decay, step_decay)
 
-__all__ = ["AdamState", "DistributedFusedAdam", "FusedAdam", "FusedLAMB",
-           "FusedNovoGrad", "FusedSGD", "LambState", "NovoGradState",
-           "SGDState", "ZeroAdamState", "build_schedule", "constant_lr",
-           "cosine_decay", "make_zero_train_step", "polynomial_decay",
-           "step_decay"]
+__all__ = ["AdagradState", "AdamState", "DistributedFusedAdam",
+           "FusedAdagrad", "FusedAdam", "FusedLAMB", "FusedNovoGrad",
+           "FusedSGD", "LambState", "NovoGradState", "SGDState",
+           "ZeroAdamState", "build_schedule", "constant_lr", "cosine_decay",
+           "make_zero_train_step", "polynomial_decay", "step_decay"]

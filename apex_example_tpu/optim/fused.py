@@ -29,8 +29,8 @@ import jax.numpy as jnp
 import optax
 
 from apex_example_tpu.ops.fused_optim import (
-    adam_update_leaf, lamb_stage1_leaf, lamb_stage2_leaf,
-    novograd_update_leaf, sgd_update_leaf)
+    adagrad_update_leaf, adam_update_leaf, lamb_stage1_leaf,
+    lamb_stage2_leaf, novograd_update_leaf, sgd_update_leaf)
 from apex_example_tpu.ops.multi_tensor import (multi_tensor_l2norm,
                                                sqsum_leaf)
 
@@ -293,6 +293,52 @@ class FusedSGD:
             new_p.append(po), new_b.append(bo)
         unflat = treedef.unflatten
         return unflat(new_p), SGDState(step, unflat(new_b))
+
+    def as_optax(self) -> optax.GradientTransformation:
+        return _as_optax(self)
+
+
+class AdagradState(NamedTuple):
+    step: jnp.ndarray
+    sum_sq: Any
+
+
+class FusedAdagrad:
+    """Adagrad with a fused update kernel.
+
+    Reference: apex/optimizers/fused_adagrad.py (multi_tensor_adagrad.cu) —
+    apex's surface drops torch.optim.Adagrad's ``lr_decay``/
+    ``initial_accumulator_value`` and adds ``adagrad_w_mode`` (decoupled
+    weight decay); this frontend matches apex.
+    """
+
+    def __init__(self, lr: Schedule = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, adagrad_w_mode: bool = False):
+        self.lr, self.eps = lr, eps
+        self.weight_decay, self.adagrad_w_mode = weight_decay, adagrad_w_mode
+
+    def init(self, params) -> AdagradState:
+        return AdagradState(
+            step=jnp.zeros((), jnp.int32),
+            sum_sq=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params))
+
+    def apply(self, grads, state: AdagradState, params
+              ) -> Tuple[Any, AdagradState]:
+        step = state.step + 1
+        lr = _lr_at(self.lr, step)
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_h = treedef.flatten_up_to(state.sum_sq)
+        new_p, new_h = [], []
+        for p, g, h in zip(flat_p, flat_g, flat_h):
+            po, ho = adagrad_update_leaf(
+                p, g, h, lr=lr, eps=self.eps,
+                weight_decay=self.weight_decay,
+                adagrad_w_mode=self.adagrad_w_mode)
+            new_p.append(po), new_h.append(ho)
+        unflat = treedef.unflatten
+        return unflat(new_p), AdagradState(step, unflat(new_h))
 
     def as_optax(self) -> optax.GradientTransformation:
         return _as_optax(self)

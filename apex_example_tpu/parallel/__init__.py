@@ -11,8 +11,8 @@ from apex_example_tpu.parallel.context_parallel import (
     heads_to_seq, plain_attention, ring_attention, seq_to_heads,
     ulysses_attention)
 from apex_example_tpu.parallel.distributed import (
-    DDPConfig, DistributedDataParallel, allreduce_grads, broadcast_from_zero,
-    reduce_mean)
+    DDPConfig, DistributedDataParallel, Reducer, allreduce_grads,
+    broadcast_from_zero, reduce_mean)
 from apex_example_tpu.parallel.sync_batchnorm import (
     SyncBatchNorm, convert_syncbn_model)
 from apex_example_tpu.parallel.larc import LARC, larc
@@ -21,7 +21,8 @@ from apex_example_tpu.parallel.launch import (
 
 __all__ = [
     "CONTEXT_AXIS", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "DDPConfig",
-    "DistributedDataParallel", "LARC", "SyncBatchNorm", "allreduce_grads",
+    "DistributedDataParallel", "LARC", "Reducer", "SyncBatchNorm",
+    "allreduce_grads",
     "broadcast_from_zero", "convert_syncbn_model", "data_sharding",
     "heads_to_seq", "initialize_model_parallel", "is_main_process", "larc",
     "make_data_mesh", "maybe_initialize_distributed", "plain_attention",

@@ -152,3 +152,21 @@ class DistributedDataParallel:
         if self.module is None:
             raise ValueError("no module wrapped")
         return self.module(*args, **kwargs)
+
+
+class Reducer(DistributedDataParallel):
+    """apex.parallel.Reducer analog: MANUAL gradient (or buffer) allreduce.
+
+    The reference's Reducer (apex/parallel/__init__.py) is the opt-out from
+    DDP's automatic backward hooks — the user wraps the module and calls
+    ``reducer.reduce()`` themselves, e.g. once per N accumulation steps.
+    Here gradients are explicit values, so the class is the same idea with
+    the pytree passed in: call :meth:`reduce` inside shard_map whenever a
+    reduction should happen.  Same facade as the DDP class; ``reduce`` is
+    the apex-named spelling of ``allreduce``.
+    """
+
+    def __init__(self, module: Any = None, gradient_average: bool = True):
+        super().__init__(module, gradient_average=gradient_average)
+
+    reduce = DistributedDataParallel.allreduce

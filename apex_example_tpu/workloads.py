@@ -14,12 +14,12 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_example_tpu import amp as amp_lib
 from apex_example_tpu.amp.policy import Policy
 from apex_example_tpu.engine import TrainState, _wrap_optimizer
+from apex_example_tpu.ops.xentropy import softmax_cross_entropy
 from apex_example_tpu.parallel.distributed import DDPConfig, allreduce_grads
 from apex_example_tpu.parallel.mesh import DATA_AXIS
 
@@ -32,19 +32,19 @@ except ImportError:  # pragma: no cover
 def mlm_loss(logits: jnp.ndarray, target: Tuple[jnp.ndarray, jnp.ndarray]
              ) -> jnp.ndarray:
     """Masked-LM loss: mean CE over masked positions only (weights mark
-    them).  target = (labels, weights)."""
+    them).  target = (labels, weights).  Uses the fused-CE op: its backward
+    rematerializes the (B, S, V) probability tensor instead of saving it —
+    at vocab 30k that residual is the largest activation in the step
+    (ops/xentropy.py, the contrib-xentropy analog)."""
     labels, weights = target
-    ce = optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), labels)
+    ce = softmax_cross_entropy(logits, labels)
     denom = jnp.maximum(weights.sum(), 1.0)
     return (ce * weights).sum() / denom
 
 
 def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Next-token CE, mean over all positions (Transformer-XL objective)."""
-    ce = optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), labels)
-    return ce.mean()
+    return softmax_cross_entropy(logits, labels).mean()
 
 
 def make_txl_train_step(model, optimizer, policy: Policy,

@@ -381,3 +381,102 @@ class TestNovoGrad:
                                    atol=1e-6, rtol=1e-6)
         np.testing.assert_allclose(np.asarray(mo_k), np.asarray(mo_r),
                                    atol=1e-6, rtol=1e-6)
+
+
+class TestFusedAdagrad:
+    """FusedAdagrad vs torch.optim.Adagrad (apex's fused_adagrad drops
+    lr_decay; with lr_decay=0 the recurrences are identical)."""
+
+    def test_adagrad_vs_torch(self):
+        p = _rand(37, seed=40); g = _rand(37, seed=41)
+        jp = jnp.asarray(p); jh = jnp.zeros(37)
+        tp = torch.from_numpy(p.copy()).requires_grad_(True)
+        topt = torch.optim.Adagrad([tp], lr=0.05, eps=1e-10,
+                                   weight_decay=0.1)
+        for _ in range(3):
+            jp, jh = ops.adagrad_update_leaf(
+                jp, jnp.asarray(g), jh, lr=0.05, eps=1e-10,
+                weight_decay=0.1)
+            tp.grad = torch.from_numpy(g.copy())
+            topt.step()
+        np.testing.assert_allclose(np.asarray(jp), tp.detach().numpy(),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_kernel_matches_reference(self):
+        p = _rand(300, seed=42); g = _rand(300, seed=43)
+        h = np.abs(_rand(300, seed=44))
+        kw = dict(lr=0.01, eps=1e-10, weight_decay=0.01, adagrad_w_mode=True)
+        kp, kh = ops.adagrad_update_leaf(
+            jnp.asarray(p), jnp.asarray(g), jnp.asarray(h), **kw)
+        rp, rh = ops.adagrad_update_leaf_reference(
+            jnp.asarray(p), jnp.asarray(g), jnp.asarray(h), **kw)
+        np.testing.assert_allclose(np.asarray(kp), np.asarray(rp),
+                                   atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(kh), np.asarray(rh),
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_frontend_runs(self):
+        from apex_example_tpu.optim import FusedAdagrad
+        opt = FusedAdagrad(lr=0.1)
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        grads = jax.tree.map(jnp.ones_like, params)
+        state = opt.init(params)
+        new_p, state = opt.apply(grads, state, params)
+        assert int(state.step) == 1
+        assert float(new_p["w"][0, 0]) < 1.0
+
+
+class TestXentropy:
+    """Fused softmax-CE (contrib xentropy analog) vs torch cross_entropy:
+    values and gradients, with and without label smoothing."""
+
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_vs_torch(self, smoothing):
+        rng = np.random.RandomState(50)
+        logits = rng.randn(6, 17).astype(np.float32)
+        labels = rng.randint(0, 17, (6,))
+        jl = jnp.asarray(logits)
+        jy = jnp.asarray(labels)
+
+        loss = ops.softmax_cross_entropy(jl, jy, smoothing)
+        tl = torch.from_numpy(logits.copy()).requires_grad_(True)
+        tloss = torch.nn.functional.cross_entropy(
+            tl, torch.from_numpy(labels), reduction="none",
+            label_smoothing=smoothing)
+        np.testing.assert_allclose(np.asarray(loss),
+                                   tloss.detach().numpy(),
+                                   atol=1e-5, rtol=1e-5)
+
+        # Gradients of the mean loss.
+        gj = jax.grad(lambda l: ops.softmax_cross_entropy(
+            l, jy, smoothing).mean())(jl)
+        tloss.mean().backward()
+        np.testing.assert_allclose(np.asarray(gj), tl.grad.numpy(),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_matches_reference_and_optax(self):
+        import optax
+        rng = np.random.RandomState(51)
+        logits = jnp.asarray(rng.randn(4, 9, 31).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, 31, (4, 9)))
+        a = ops.softmax_cross_entropy(logits, labels)
+        b = ops.softmax_cross_entropy_reference(logits, labels)
+        c = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_no_probs_residual(self):
+        """The op's point: the saved residuals exclude the (N, V) probability
+        tensor — only logits (an input), labels, and the O(N) lse."""
+        logits = jnp.ones((8, 128))
+        labels = jnp.zeros((8,), jnp.int32)
+        _, vjp = jax.vjp(
+            lambda l: ops.softmax_cross_entropy(l, labels), logits)
+        # Residual arrays reachable from the vjp closure: anything with
+        # logits' (N, V) shape must BE logits itself (no extra V-sized
+        # tensor saved).
+        big = [x for x in jax.tree_util.tree_leaves(vjp)
+               if hasattr(x, "shape") and x.shape == logits.shape]
+        assert all(x is logits or (x == logits).all() for x in big)

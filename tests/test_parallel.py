@@ -196,3 +196,18 @@ def test_convert_syncbn_model():
     assert m.bn_axis_name is None
     m2 = convert_syncbn_model(m)
     assert m2.bn_axis_name == "data"
+
+
+def test_reducer_manual_allreduce(devices8):
+    """apex.parallel.Reducer analog: manual reduction == pmean."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from apex_example_tpu.parallel import Reducer
+    mesh = Mesh(np.asarray(devices8), ("data",))
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    red = Reducer()
+    out = shard_map(lambda t: red.reduce({"g": t})["g"],
+                    mesh=mesh, in_specs=P("data"), out_specs=P("data"))(x)
+    expect = np.broadcast_to(np.asarray(x).reshape(8, 2).mean(0), (8, 2))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
