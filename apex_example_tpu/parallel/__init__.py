@@ -8,6 +8,7 @@ from apex_example_tpu.parallel.mesh import (
     CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, data_sharding,
     initialize_model_parallel, make_data_mesh, replicated)
 from apex_example_tpu.parallel.context_parallel import (
+    ring_attention_zigzag, zigzag_shard, zigzag_unshard,
     heads_to_seq, plain_attention, ring_attention, seq_to_heads,
     ulysses_attention)
 from apex_example_tpu.parallel.distributed import (
@@ -26,6 +27,7 @@ __all__ = [
     "broadcast_from_zero", "convert_syncbn_model", "data_sharding",
     "heads_to_seq", "initialize_model_parallel", "is_main_process", "larc",
     "make_data_mesh", "maybe_initialize_distributed", "plain_attention",
-    "reduce_mean", "replicated", "ring_attention", "seq_to_heads",
+    "reduce_mean", "replicated", "ring_attention", "ring_attention_zigzag",
+    "seq_to_heads", "zigzag_shard", "zigzag_unshard",
     "ulysses_attention",
 ]

@@ -34,8 +34,9 @@ from jax import lax
 
 from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
 
-__all__ = ["plain_attention", "ring_attention", "ulysses_attention",
-           "seq_to_heads", "heads_to_seq"]
+__all__ = ["plain_attention", "ring_attention", "ring_attention_zigzag",
+           "ulysses_attention", "seq_to_heads", "heads_to_seq",
+           "zigzag_shard", "zigzag_unshard"]
 
 _NEG_INF = -1e30  # finite mask sentinel: keeps exp() NaN-free on all-masked
                   # blocks (every causal row sees its own diagonal at step 0,
@@ -81,10 +82,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``use_flash=False`` keeps the self-contained inline fold (also the test
     cross-check).
 
-    With ``causal=True``, blocks entirely in the future are masked; the
-    naive ring still *computes* those blocks (N−1 of 2N−1 block-steps wasted
-    at worst) — the standard trade without zigzag load balancing, which is
-    documented future work.
+    With ``causal=True``, blocks entirely in the future are masked but the
+    contiguous-layout ring still *computes* them (N−1 of 2N−1 block-steps
+    wasted at worst, and the live work is skewed toward late devices) — use
+    :func:`ring_attention_zigzag` for the load-balanced causal form.
     """
     if use_flash:
         return _ring_attention_flash(q, k, v, axis_name, causal, scale)
@@ -139,6 +140,19 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _merge_lse(state, o, l):
+    """Fold one normalized attention block (o, l) into the running
+    (out fp32 (B,S,H,D), lse (B,H,S)) state: out' = w·out + w_blk·o with
+    w = exp(lse − lse'), lse' = logaddexp(lse, l).  The single home of the
+    numerically delicate combine used by both ring variants; fully-masked
+    blocks arrive with l = −∞-ish and get weight exactly 0."""
+    out, lse = state
+    lse_new = jnp.logaddexp(lse, l)
+    w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+    w_blk = jnp.exp(l - lse_new).transpose(0, 2, 1)[..., None]
+    return out * w_old + o.astype(jnp.float32) * w_blk, lse_new
+
+
 def _ring_attention_flash(q, k, v, axis_name, causal, scale):
     """Ring attention over flash-kernel chunks.
 
@@ -170,12 +184,8 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
         if causal:
             src = (idx - t) % n          # chunk t originated on device src
             lb = jnp.where(src < idx, lb, _NEG_INF)
-        lse_new = jnp.logaddexp(lse, lb)
-        w_old = jnp.exp(lse - lse_new)   # (B, H, s) → broadcast over D
-        w_blk = jnp.exp(lb - lse_new)
-        out = (out * w_old.transpose(0, 2, 1)[..., None]
-               + ob.astype(jnp.float32) * w_blk.transpose(0, 2, 1)[..., None])
-        return (out, lse_new, kc, vc), None
+        out, lse = _merge_lse((out, lse), ob, lb)
+        return (out, lse, kc, vc), None
 
     (out, _, _, _), _ = lax.scan(step, (out0, lse0, k, v),
                                  jnp.arange(1, n))
@@ -222,3 +232,110 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
     out = inner(qh, kh, vh)
     return heads_to_seq(out, axis_name)
+
+
+# --------------------------------------------------------------------------
+# Zigzag causal ring attention.
+# --------------------------------------------------------------------------
+
+def zigzag_shard(x: jnp.ndarray, n: int, seq_dim: int = 1) -> jnp.ndarray:
+    """Reorder a global sequence into zigzag layout: split into 2n chunks;
+    device i owns chunks (i, 2n-1-i), concatenated.  Returns the full
+    reordered array (shard it P(axis) afterwards); inverse: zigzag_unshard.
+
+    Why zigzag: under causal masking with contiguous shards, early devices
+    skip most blocks and late devices compute all of them — the per-step
+    ppermute barrier makes every step as slow as the busiest device.  The
+    zigzag pairing gives every device one early and one late chunk, so the
+    per-step live work is identical everywhere (the standard load-balanced
+    causal ring layout)."""
+    s = x.shape[seq_dim]
+    if s % (2 * n):
+        raise ValueError(f"seq {s} not divisible by 2n={2 * n}")
+    chunks = jnp.split(x, 2 * n, axis=seq_dim)
+    order = [c for i in range(n) for c in (chunks[i], chunks[2 * n - 1 - i])]
+    return jnp.concatenate(order, axis=seq_dim)
+
+
+def zigzag_unshard(x: jnp.ndarray, n: int, seq_dim: int = 1) -> jnp.ndarray:
+    """Inverse of :func:`zigzag_shard`."""
+    chunks = jnp.split(x, 2 * n, axis=seq_dim)
+    order = [None] * (2 * n)
+    for i in range(n):
+        order[i] = chunks[2 * i]
+        order[2 * n - 1 - i] = chunks[2 * i + 1]
+    return jnp.concatenate(order, axis=seq_dim)
+
+
+def ring_attention_zigzag(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          axis_name: str = CONTEXT_AXIS,
+                          scale: Optional[float] = None) -> jnp.ndarray:
+    """Load-balanced CAUSAL ring attention over zigzag-sharded sequences.
+
+    Local shards are [B, 2c, H, D]: the first half is global chunk ``idx``,
+    the second half global chunk ``2n-1-idx`` (c = S_global / 2n) — produce
+    them with :func:`zigzag_shard` + P(axis) sharding.  Per ring step the
+    chunk-index algebra decides each of the four (q-chunk, kv-chunk) pairs
+    statically or per-device:
+
+    - (q_a, kv_b) is ALWAYS future (kv_b's global chunk ≥ n > q_a's) —
+      statically skipped, zero cost.
+    - (q_b, kv_a) is ALWAYS past — computed in full every step.
+    - of (q_a, kv_a) and (q_b, kv_b), exactly one is live per step
+      (src < idx vs src > idx) — a ``lax.cond`` computes only that one, so
+      every device runs the same amount of kernel work each step.
+
+    Per-chunk results merge by logsumexp exactly like
+    :func:`ring_attention`'s flash path.  Causal-only by construction (the
+    layout exists to balance the causal mask; use ring_attention for the
+    dense case).
+    """
+    from apex_example_tpu.ops.attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s2, h, d = q.shape
+    if s2 % 2:
+        raise ValueError(f"zigzag local seq must be even, got {s2}")
+    c = s2 // 2
+    scale_ = scale if scale is not None else 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    split = lambda t: (t[:, :c], t[:, c:])
+    qa, qb = split(q)
+
+    def attend(qc, kc, vc, causal):
+        o, l = flash_attention_with_lse(qc, kc, vc, None, causal, scale_)
+        return o.astype(jnp.float32), l
+
+    merge = _merge_lse
+
+    # Step 0: both diagonals causal, plus the always-past (q_b, kv_a).
+    ka0, kb0 = split(k)
+    va0, vb0 = split(v)
+    state_a = attend(qa, ka0, va0, True)
+    state_b = merge(attend(qb, kb0, vb0, True), *attend(qb, ka0, va0, False))
+
+    def step(carry, t):
+        state_a, state_b, kc, vc = carry
+        kc, vc = lax.ppermute((kc, vc), axis_name, perm)
+        ka, kb = split(kc)
+        va, vb = split(vc)
+        src = (idx - t) % n
+        # Always-past pair.
+        state_b = merge(state_b, *attend(qb, ka, va, False))
+
+        # Exactly one of (q_a, kv_a) / (q_b, kv_b) is live.
+        def a_live(sa, sb):
+            return merge(sa, *attend(qa, ka, va, False)), sb
+
+        def b_live(sa, sb):
+            return sa, merge(sb, *attend(qb, kb, vb, False))
+
+        state_a, state_b = lax.cond(src < idx, a_live, b_live,
+                                    state_a, state_b)
+        return (state_a, state_b, kc, vc), None
+
+    (state_a, state_b, _, _), _ = lax.scan(
+        step, (state_a, state_b, k, v), jnp.arange(1, n))
+    out = jnp.concatenate([state_a[0], state_b[0]], axis=1)
+    return out.astype(q.dtype)
