@@ -8,7 +8,8 @@ of the reference surface (``scale_loss``, ``state_dict``/``load_state_dict``,
 """
 
 from apex_example_tpu.amp.autocast import (ModuleDtypes, cast_args,
-                                           module_dtypes, op_dtype)
+                                           disable_casts, module_dtypes,
+                                           op_dtype)
 from apex_example_tpu.amp.lists import (register_float_function,
                                         register_half_function,
                                         register_promote_function)
@@ -19,7 +20,7 @@ from apex_example_tpu.amp.scaler import (
 
 __all__ = [
     "ModuleDtypes", "Policy", "ScalerState", "all_finite", "cast_args",
-    "get_policy", "initialize", "load_state_dict", "make_scaler",
+    "disable_casts", "get_policy", "initialize", "load_state_dict", "make_scaler",
     "module_dtypes", "op_dtype", "opt_level_table",
     "register_float_function", "register_half_function",
     "register_promote_function", "scale_loss", "select_tree", "state_dict",

@@ -29,13 +29,34 @@ entirely (pure half).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
 from apex_example_tpu.amp import lists
 from apex_example_tpu.amp.policy import Policy
+
+
+# amp.handle.disable_casts analog (apex/amp/handle.py): inside the context,
+# the O1 engine answers fp32 for every op class — the escape hatch for
+# custom fp32 regions.  Casts resolve at TRACE time (python), and traces may
+# run on several threads (parallel jit warmup), so the flag is thread-local
+# exactly like the reference's.
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Run a (traced) region with O1 call-site casting forced to fp32."""
+    saved = getattr(_TLS, "casts_disabled", False)
+    _TLS.casts_disabled = True
+    try:
+        yield
+    finally:
+        _TLS.casts_disabled = saved
 
 
 def op_dtype(policy: Policy, op: str,
@@ -48,6 +69,8 @@ def op_dtype(policy: Policy, op: str,
     """
     if not policy.cast_at_call_sites:
         return None
+    if getattr(_TLS, "casts_disabled", False):
+        return jnp.dtype(jnp.float32)
     cls = lists.classify(op)
     if cls == "half":
         return policy.compute_dtype

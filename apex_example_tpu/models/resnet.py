@@ -192,8 +192,24 @@ def resnet18(**kw) -> ResNet:
     return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock, **kw)
 
 
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock, **kw)
+
+
 def resnet50(**kw) -> ResNet:
     return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck, **kw)
 
 
-ARCHS = {"resnet18": resnet18, "resnet50": resnet50}
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck, **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 8, 36, 3], block_cls=Bottleneck, **kw)
+
+
+# The torchvision family surface the reference's --arch flag can name
+# (SURVEY.md §3.5: models are imported from torchvision in the reference;
+# stage sizes follow the He et al. table).
+ARCHS = {"resnet18": resnet18, "resnet34": resnet34, "resnet50": resnet50,
+         "resnet101": resnet101, "resnet152": resnet152}

@@ -307,13 +307,12 @@ def ring_attention_zigzag(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         o, l = flash_attention_with_lse(qc, kc, vc, None, causal, scale_)
         return o.astype(jnp.float32), l
 
-    merge = _merge_lse
-
     # Step 0: both diagonals causal, plus the always-past (q_b, kv_a).
     ka0, kb0 = split(k)
     va0, vb0 = split(v)
     state_a = attend(qa, ka0, va0, True)
-    state_b = merge(attend(qb, kb0, vb0, True), *attend(qb, ka0, va0, False))
+    state_b = _merge_lse(attend(qb, kb0, vb0, True),
+                         *attend(qb, ka0, va0, False))
 
     def step(carry, t):
         state_a, state_b, kc, vc = carry
@@ -322,14 +321,14 @@ def ring_attention_zigzag(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         va, vb = split(vc)
         src = (idx - t) % n
         # Always-past pair.
-        state_b = merge(state_b, *attend(qb, ka, va, False))
+        state_b = _merge_lse(state_b, *attend(qb, ka, va, False))
 
         # Exactly one of (q_a, kv_a) / (q_b, kv_b) is live.
         def a_live(sa, sb):
-            return merge(sa, *attend(qa, ka, va, False)), sb
+            return _merge_lse(sa, *attend(qa, ka, va, False)), sb
 
         def b_live(sa, sb):
-            return sa, merge(sb, *attend(qb, kb, vb, False))
+            return sa, _merge_lse(sb, *attend(qb, kb, vb, False))
 
         state_a, state_b = lax.cond(src < idx, a_live, b_live,
                                     state_a, state_b)

@@ -5,6 +5,7 @@ Exports the two schedule entry points (SURVEY.md §3.2) and the p2p helpers.
 
 from apex_example_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_no_pipelining,
+    get_forward_backward_func,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     pipeline_1f1b,
@@ -16,6 +17,7 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_with_interleaving",
     "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
     "pipeline_1f1b",
     "recv_backward", "recv_forward", "send_backward", "send_forward",
     "spmd_pipeline",

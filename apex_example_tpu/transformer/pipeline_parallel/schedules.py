@@ -491,3 +491,19 @@ def forward_backward_pipelining_with_interleaving(
     return pipeline_1f1b(stage_fn, last_stage_fn, stage_params, inputs,
                          targets, axis_name=axis_name,
                          num_chunks=num_chunks)
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size,
+                              pipeline_model_parallel_size):
+    """Schedule selector with the reference's exact decision table
+    (apex/transformer/pipeline_parallel/schedules/__init__.py):
+    pipeline size 1 → :func:`forward_backward_no_pipelining`; a virtual
+    (interleaved) size → the interleaved 1F1B variant (callers then pass
+    ``num_chunks=virtual_...``); otherwise plain 1F1B.  The returned
+    callables keep this package's functional signatures — grads come back
+    as values, not module mutations."""
+    if pipeline_model_parallel_size == 1:
+        return forward_backward_no_pipelining
+    if virtual_pipeline_model_parallel_size is not None:
+        return forward_backward_pipelining_with_interleaving
+    return forward_backward_pipelining_without_interleaving

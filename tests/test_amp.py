@@ -251,3 +251,15 @@ class TestMultiLoss:
         restored = amp.load_state_dict(fresh, d)
         assert float(restored[1].scale) == float(scalers[1].scale)
         assert float(restored[0].scale) == float(scalers[0].scale)
+
+
+def test_disable_casts_context():
+    """amp.handle.disable_casts analog: inside the context the O1 engine
+    answers fp32 for every op class; outside, whitelist ops go half."""
+    policy, _ = amp.initialize("O1")
+    assert amp.op_dtype(policy, "dense") == policy.compute_dtype
+    with amp.disable_casts():
+        assert amp.op_dtype(policy, "dense") == jnp.float32
+        x = jnp.ones((2, 2), jnp.float32)
+        assert amp.cast_args(policy, "dense", x).dtype == jnp.float32
+    assert amp.op_dtype(policy, "dense") == policy.compute_dtype

@@ -212,3 +212,17 @@ def test_txl_grad_accum_matches_full_batch():
         s1.params, s2.params)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4), m1, m2)
+
+
+def test_resnet_family_builders():
+    """torchvision family parity: every ARCHS entry builds and produces
+    fp32 logits (tiny spatial size keeps CPU cost trivial)."""
+    from apex_example_tpu.models import ARCHS
+    assert set(ARCHS) == {"resnet18", "resnet34", "resnet50", "resnet101",
+                          "resnet152"}
+    x = jnp.ones((1, 32, 32, 3))
+    for name in ("resnet34", "resnet101"):     # new entries; 18/50 covered
+        model = ARCHS[name](num_classes=7, num_filters=8, small_stem=True)
+        params = model.init(jax.random.PRNGKey(0), x, train=False)
+        out = model.apply(params, x, train=False)
+        assert out.shape == (1, 7) and out.dtype == jnp.float32

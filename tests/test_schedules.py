@@ -66,3 +66,19 @@ def test_fused_sgd_consumes_schedule():
     d2 = float(jnp.abs(p2["w"] - p1["w"]).mean())
     np.testing.assert_allclose(d1, 1.0, rtol=1e-6)
     np.testing.assert_allclose(d2, 0.1, rtol=1e-5)
+
+
+def test_get_forward_backward_func_decision_table():
+    """Reference decision table: pp==1 -> no_pipelining; virtual set ->
+    interleaved; else plain 1F1B."""
+    from apex_example_tpu.transformer.pipeline_parallel import (
+        forward_backward_no_pipelining,
+        forward_backward_pipelining_with_interleaving,
+        forward_backward_pipelining_without_interleaving,
+        get_forward_backward_func)
+    assert get_forward_backward_func(None, 1) \
+        is forward_backward_no_pipelining
+    assert get_forward_backward_func(None, 4) \
+        is forward_backward_pipelining_without_interleaving
+    assert get_forward_backward_func(2, 4) \
+        is forward_backward_pipelining_with_interleaving
