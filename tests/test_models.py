@@ -221,7 +221,7 @@ def test_resnet_family_builders():
     assert set(ARCHS) == {"resnet18", "resnet34", "resnet50", "resnet101",
                           "resnet152"}
     x = jnp.ones((1, 32, 32, 3))
-    for name in ("resnet34", "resnet101"):     # new entries; 18/50 covered
+    for name in ("resnet34", "resnet101", "resnet152"):  # 18/50 covered
         model = ARCHS[name](num_classes=7, num_filters=8, small_stem=True)
         params = model.init(jax.random.PRNGKey(0), x, train=False)
         out = model.apply(params, x, train=False)
