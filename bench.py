@@ -282,6 +282,34 @@ def bench_hostpipe(args):
           "images/sec/chip", host_rate / BASELINE_IMG_PER_SEC_PER_CHIP)
 
 
+def _tunnel_watchdog(timeout_s: float = 600.0):
+    """Fail fast with a diagnosis if the device never answers.
+
+    The axon tunnel can wedge (observed 2026-07-30: a killed long remote
+    compile left EVERY subsequent client blocked before its first op, ~0%
+    CPU).  A silent hang would surface only as an empty driver timeout; this
+    arms a timer that is disarmed after the first successful scalar
+    round-trip, and otherwise exits with a diagnostic on stderr.  600 s is
+    ~4x the worst cold ResNet-50 compile on this rig — a legitimate run
+    always completes the probe long before that.
+    """
+    import os
+    import threading
+
+    def blow():
+        print("BENCH ABORT: no device round-trip within "
+              f"{timeout_s:.0f}s — the TPU tunnel is wedged or unreachable "
+              "(see PERF.md 'rig pathology'); rerun when the backend "
+              "recovers", file=sys.stderr, flush=True)
+        os._exit(3)
+
+    timer = threading.Timer(timeout_s, blow)
+    timer.daemon = True
+    timer.start()
+    float(jnp.ones(()) + 1.0)          # scalar fetch = real tunnel barrier
+    timer.cancel()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="c2",
@@ -294,6 +322,7 @@ def main():
     ap.add_argument("--fused-attention", action="store_true",
                     help="c4: flash-attention kernel (ops/attention.py)")
     args = ap.parse_args()
+    _tunnel_watchdog()
 
     defaults = {          # (batch_size, image_size, seq_len)
         "c1": (256, 32, None), "c2": (256, 224, None),
