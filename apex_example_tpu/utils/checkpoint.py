@@ -31,10 +31,20 @@ class CheckpointManager:
 
     def save(self, state: TrainState, step: Optional[int] = None,
              wait: bool = True) -> None:
+        """``wait=False`` returns as soon as the device arrays are snapshot
+        and lets orbax's background thread do the serialization/IO — the
+        async-checkpoint mode (train.py --async-checkpoint): training
+        overlaps the write, at the cost of holding one extra copy of the
+        state until it lands.  A later save (or close) joins the pending
+        write first, so checkpoints never interleave."""
         step = int(state.step) if step is None else step
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
+        """Join any pending async save."""
+        self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

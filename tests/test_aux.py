@@ -103,3 +103,38 @@ def test_fault_injection_kill_and_resume(tmp_path):
     assert "resumed from step 3 (epoch 1)" in out2, out2
     # It continued (epoch 1 and 2 ran, a later checkpoint was written).
     assert "saved checkpoint at step 9" in out2, out2
+
+
+def test_async_checkpoint_save_restore(tmp_path):
+    """--async-checkpoint semantics: save(wait=False) returns immediately,
+    wait_until_finished joins the background write, restore round-trips."""
+    import jax
+    import jax.numpy as jnp
+    from apex_example_tpu import amp
+    from apex_example_tpu.engine import create_train_state, make_train_step
+    from apex_example_tpu.models.resnet import BasicBlock, ResNet
+    from apex_example_tpu.optim import FusedSGD
+    from apex_example_tpu.utils.checkpoint import CheckpointManager
+
+    policy, scaler = amp.initialize("O0")
+    model = ResNet(stage_sizes=[1], block_cls=BasicBlock, num_classes=4,
+                   num_filters=8, small_stem=True)
+    opt = FusedSGD(lr=0.1)
+    x = jnp.ones((4, 16, 16, 3))
+    y = jnp.zeros((4,), jnp.int32)
+    state = create_train_state(jax.random.PRNGKey(0), model, opt, x[:1],
+                               policy, scaler)
+    step = jax.jit(make_train_step(model, opt, policy))
+    state, _ = step(state, (x, y))
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(state, wait=False)          # async: returns before IO lands
+    state, _ = step(state, (x, y))       # training continues meanwhile
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 1
+
+    fresh = create_train_state(jax.random.PRNGKey(1), model, opt, x[:1],
+                               policy, scaler)
+    restored = mgr.restore(fresh)
+    assert int(restored.step) == 1
+    mgr.close()

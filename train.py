@@ -104,6 +104,9 @@ def parse_args(argv=None):
     # harness
     p.add_argument("--resume", default="", help="checkpoint dir to resume")
     p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="don't block training on checkpoint IO (orbax "
+                        "background write; joined before the next save)")
     p.add_argument("--host-pipeline", action="store_true",
                    help="feed batches from the native C++ prefetcher "
                         "(csrc/; the reference's fast_collate analog) "
@@ -366,13 +369,15 @@ def main(argv=None):
             if mgr is not None and is_main_process():
                 # Reference: rank 0 writes the checkpoint (SURVEY.md §4.5);
                 # state is replicated so one host's copy is the full state.
-                mgr.save(state)
+                mgr.save(state, wait=not args.async_checkpoint)
                 print(f"saved checkpoint at step {int(state.step)}")
     finally:
         if prefetcher is not None:
             prefetcher.close()
         if writer is not None:
             writer.close()
+        if mgr is not None:
+            mgr.wait_until_finished()
 
     if args.prof:
         jax.profiler.stop_trace()
@@ -467,34 +472,43 @@ def lm_main(args, policy, scaler):
         jax.profiler.start_trace("/tmp/apex_tpu_trace")
 
     global_step = int(state.step)
-    for epoch in range(start_epoch, args.epochs):
-        losses = AverageMeter("loss")
-        thr = Throughput(warmup_steps=2)
-        for i in range(args.steps_per_epoch):
-            batch = batch_fn(global_step)
-            if is_bert:
-                state, metrics = step_fn(state, batch)
-            else:
-                state, mems, metrics = step_fn(state, mems, batch)
-            global_step += 1
-            thr.step(args.batch_size * args.seq_len)
-            if (i + 1) % args.print_freq == 0 or i + 1 == args.steps_per_epoch:
-                losses.update(float(metrics["loss"]))
-                extra = (f"ppl {float(metrics['ppl']):.1f} " if "ppl" in
-                         metrics else "")
-                print(f"epoch {epoch} step {i + 1}/{args.steps_per_epoch} "
-                      f"{losses} {extra}{thr.rate:.0f} tok/s "
-                      f"scale {float(metrics['scale']):.0f}")
-                if writer is not None:
-                    writer.add_scalar("train/loss", losses.val, global_step)
-                    writer.add_scalar("train/tok_per_sec", thr.rate,
-                                      global_step)
-        if mgr is not None and is_main_process():
-            mgr.save(state)
-            print(f"saved checkpoint at step {int(state.step)}")
-
-    if writer is not None:
-        writer.close()
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            losses = AverageMeter("loss")
+            thr = Throughput(warmup_steps=2)
+            for i in range(args.steps_per_epoch):
+                batch = batch_fn(global_step)
+                if is_bert:
+                    state, metrics = step_fn(state, batch)
+                else:
+                    state, mems, metrics = step_fn(state, mems, batch)
+                global_step += 1
+                thr.step(args.batch_size * args.seq_len)
+                if (i + 1) % args.print_freq == 0 \
+                        or i + 1 == args.steps_per_epoch:
+                    losses.update(float(metrics["loss"]))
+                    extra = (f"ppl {float(metrics['ppl']):.1f} " if "ppl" in
+                             metrics else "")
+                    print(f"epoch {epoch} step {i + 1}/"
+                          f"{args.steps_per_epoch} "
+                          f"{losses} {extra}{thr.rate:.0f} tok/s "
+                          f"scale {float(metrics['scale']):.0f}")
+                    if writer is not None:
+                        writer.add_scalar("train/loss", losses.val,
+                                          global_step)
+                        writer.add_scalar("train/tok_per_sec", thr.rate,
+                                          global_step)
+            if mgr is not None and is_main_process():
+                mgr.save(state, wait=not args.async_checkpoint)
+                print(f"saved checkpoint at step {int(state.step)}")
+    finally:
+        # Join pending async checkpoint writes even when unwinding on an
+        # exception — an announced save must exist on disk (main() gives
+        # its image path the same protection).
+        if writer is not None:
+            writer.close()
+        if mgr is not None:
+            mgr.wait_until_finished()
     if args.prof:
         jax.profiler.stop_trace()
         print("profile written to /tmp/apex_tpu_trace")
