@@ -463,20 +463,7 @@ def _bwd_dispatch(causal, scale, res, do, dlse):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_attention(q, k, v, bias=None, causal: bool = False,
-                    scale: Optional[float] = None):
-    """Memory-efficient multi-head attention.
-
-    q: (B, Sq, H, D); k, v: (B, Sk, H, D); bias: optional (B, Sk) additive
-    key bias (finite values; use ~-1e9 for masked keys); returns
-    (B, Sq, H, D) in q's dtype.  Softmax is fp32.  Falls back to the XLA
-    reference off-TPU or when shapes don't tile (S % 128, tiny sequences).
-
-    ``bias`` is treated as a constant MASK: its VJP is hard-coded to zero
-    (on the kernel and fallback paths alike).  Do not route a *learned*
-    bias (ALiBi-style scores etc.) through it — the parameter would
-    silently never train.
-    """
+def _flash_attention_op(q, k, v, bias, causal, scale):
     o, _, _ = _lse_fwd(q, k, v, bias, causal, scale)
     return o
 
@@ -490,22 +477,32 @@ def _flash_bwd_vjp(causal, scale, res, do):
     return _bwd_dispatch(causal, scale, res, do, None)
 
 
-flash_attention.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+_flash_attention_op.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def flash_attention(q, k, v, bias=None, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Memory-efficient multi-head attention.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D); bias: optional (B, Sk) additive
+    key bias (finite values; use ~-1e9 for masked keys); returns
+    (B, Sq, H, D) in q's dtype.  Softmax is fp32.  Falls back to the XLA
+    reference off-TPU or when shapes don't tile (S % 128, tiny sequences).
+
+    ``bias`` is treated as a constant MASK: ``lax.stop_gradient`` is
+    applied to it at this boundary, so differentiating w.r.t. a bias input
+    yields structurally zero gradients on every path (kernel and fallback
+    alike).  Do not route a *learned* bias (ALiBi-style scores etc.)
+    through it — the parameter would not train; use explicit scores for
+    that.
+    """
+    if bias is not None:
+        bias = lax.stop_gradient(bias)
+    return _flash_attention_op(q, k, v, bias, causal, scale)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_attention_with_lse(q, k, v, bias=None, causal: bool = False,
-                             scale: Optional[float] = None):
-    """:func:`flash_attention` that also returns the row logsumexp.
-
-    Returns ``(out, lse)`` with ``out``: (B, Sq, H, D) in q's dtype and
-    ``lse``: (B, H, Sq) fp32.  The composable form: ring/blockwise context
-    parallelism (parallel/context_parallel.py) merges per-chunk results with
-    the logsumexp-weighted combine.  Unlike the bias argument (constant
-    mask, zero VJP), ``lse`` is fully differentiable — the combine weights
-    backpropagate through it (the kernel backward absorbs the cotangent
-    into its Δ correction: ∂lse_i/∂S_ij = P_ij).
-    """
+def _flash_attention_with_lse_op(q, k, v, bias, causal, scale):
     o, lse, _ = _lse_fwd(q, k, v, bias, causal, scale)
     return o, lse
 
@@ -520,4 +517,22 @@ def _flash_lse_bwd_vjp(causal, scale, res, cts):
     return _bwd_dispatch(causal, scale, res, do, dlse)
 
 
-flash_attention_with_lse.defvjp(_flash_lse_fwd_vjp, _flash_lse_bwd_vjp)
+_flash_attention_with_lse_op.defvjp(_flash_lse_fwd_vjp, _flash_lse_bwd_vjp)
+
+
+def flash_attention_with_lse(q, k, v, bias=None, causal: bool = False,
+                             scale: Optional[float] = None):
+    """:func:`flash_attention` that also returns the row logsumexp.
+
+    Returns ``(out, lse)`` with ``out``: (B, Sq, H, D) in q's dtype and
+    ``lse``: (B, H, Sq) fp32.  The composable form: ring/blockwise context
+    parallelism (parallel/context_parallel.py) merges per-chunk results with
+    the logsumexp-weighted combine.  Unlike the bias argument (constant
+    mask, stop_gradient'ed at this boundary exactly like
+    :func:`flash_attention`), ``lse`` is fully differentiable — the combine
+    weights backpropagate through it (the kernel backward absorbs the
+    cotangent into its Δ correction: ∂lse_i/∂S_ij = P_ij).
+    """
+    if bias is not None:
+        bias = lax.stop_gradient(bias)
+    return _flash_attention_with_lse_op(q, k, v, bias, causal, scale)
