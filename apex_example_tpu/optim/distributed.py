@@ -155,10 +155,30 @@ class DistributedFusedAdam:
         p_shard = lax.dynamic_slice(_flatten(params, padded),
                                     (idx * shard,), (shard,))
 
+        # Finite check AFTER the reduce — the fp16 dynamic-scaling contract.
+        # A nonfinite grad element lands in exactly one replica's shard after
+        # psum_scatter, so the per-shard flag alone would diverge across
+        # replicas (each skipping or stepping on its own) and de-synchronize
+        # the gathered params.  psum-ing the flag makes the skip decision
+        # identical everywhere: every replica steps, or none does.  The psum
+        # output is mesh-invariant, so the select below provably keeps the
+        # replicated-params out-spec.
+        shard_ok = jnp.all(jnp.isfinite(g_shard)).astype(jnp.float32)
+        finite = lax.psum(shard_ok, self.axis_name) == world
+
         po, mo, vo = adam_update_leaf(
             p_shard, g_shard, state.mu, state.nu, lr=lr, beta1=b1, beta2=b2,
             eps=self.eps, weight_decay=self.weight_decay, bias_c1=c1,
             bias_c2=c2, adam_w_mode=self.adam_w_mode)
+
+        # Overflow ⇒ the whole sharded update is dropped (params, m, v and
+        # the bias-correction step all keep their old values) — the same
+        # "skip optimizer.step()" select the engine applies for replicated
+        # optimizers, enforced here where the shard structure is known.
+        po = jnp.where(finite, po, p_shard)
+        mo = jnp.where(finite, mo, state.mu)
+        vo = jnp.where(finite, vo, state.nu)
+        step = jnp.where(finite, step, state.step)
 
         # Gather the updated shards back to replicated parameters.  The psum
         # of per-replica scattered writes is the vma-typed form of the
@@ -187,14 +207,15 @@ def make_zero_train_step(mesh: Mesh, model, optimizer: DistributedFusedAdam,
 
     axis = optimizer.axis_name
     loss_fn = loss_fn or cross_entropy_loss
-    if policy.uses_dynamic_scaling:
-        # The engine's skip-step select keys on the PER-REPLICA finite flag of
-        # unreduced grads; under ZeRO a replica-local inf would make skip
-        # decisions diverge across replicas and de-synchronize params.  bf16
-        # O0-O2 (static scale 1.0) never needs the skip; fp16 dynamic scaling
-        # with ZeRO would need the finite check moved after reduce-scatter.
-        raise NotImplementedError(
-            "make_zero_train_step does not support dynamic loss scaling")
+    # Dynamic loss scaling composes safely here on two grounds:
+    #  - On this engine path grads reach the optimizer already implicitly
+    #    psum-ed (jax.grad w.r.t. replicated params inside shard_map), so the
+    #    engine's unscale/finite flag is mesh-invariant — every replica makes
+    #    the same skip decision and updates the scaler identically.
+    #  - Independently, DistributedFusedAdam.apply re-checks finiteness on
+    #    the post-reduce shard and psums the flag, so even the raw
+    #    reduce-scatter path (varying grads) skips in lockstep.  A skipped
+    #    step is therefore a no-op on params AND on the sharded (m, v, step).
     # axis_name=None: the inner step must NOT psum grads (the optimizer's
     # reduce-scatter is the reduction); loss/metrics get pmean-ed below.
     per_shard = make_train_step(model, optimizer, policy, axis_name=None,
