@@ -59,8 +59,12 @@ def create_train_state(rng, model, optimizer, sample_batch, policy: Policy,
     Params are stored in ``policy.param_dtype`` — fp32 for O0–O2 (they double
     as apex's "master weights"), half for O3.
     """
-    variables = model.init(rng, sample_batch, **(train_kwargs or
-                                                 {"train": False}))
+    from flax.core import meta
+    variables = meta.unbox(model.init(rng, sample_batch, **(train_kwargs or
+                                                            {"train": False})))
+    # unbox: TP layers wrap params in flax Partitioned boxes (metadata for
+    # gspmd_state_shardings); the train state carries plain arrays — a no-op
+    # for non-partitioned models.
     params = variables["params"]
     if policy.param_dtype != jnp.float32:
         params = jax.tree_util.tree_map(
@@ -300,6 +304,115 @@ def make_sharded_train_step(mesh: Mesh, model, optimizer, policy: Policy,
         in_specs=(P(), (P(axis_name), P(axis_name))),
         out_specs=(P(), P()))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def _opt_state_specs(optimizer, abs_params, param_specs):
+    """PartitionSpec tree for an optimizer state.
+
+    The fused-optimizer states (AdamState etc.) are NamedTuples whose fields
+    are either scalars or whole subtrees mirroring the params tree (mu/nu/
+    momentum buffers): any node with the params' tree structure inherits the
+    params' specs elementwise, everything else replicates.  Recursion covers
+    optax-style nested tuples of such states.
+    """
+    params_def = jax.tree_util.tree_structure(abs_params)
+    abs_state = jax.eval_shape(optimizer.init, abs_params)
+
+    def walk(node):
+        if jax.tree_util.tree_structure(node) == params_def:
+            return param_specs
+        if isinstance(node, tuple):
+            sub = [walk(c) for c in node]
+            # NamedTuple ctors take fields positionally; plain tuples take
+            # one iterable.
+            return type(node)(*sub) if hasattr(node, "_fields") \
+                else tuple(sub)
+        if isinstance(node, (list,)):
+            return [walk(c) for c in node]
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return P()                           # scalar / unrecognized leaf
+    return walk(abs_state)
+
+
+def gspmd_state_shardings(mesh: Mesh, model, optimizer, sample_batch,
+                          policy: Policy, scaler=None,
+                          train_kwargs: Optional[dict] = None) -> TrainState:
+    """NamedSharding pytree for this model's TrainState under GSPMD.
+
+    Param specs come from the flax partitioning metadata the TP layers
+    attach (``nn.with_partitioning``); optimizer-state subtrees mirror
+    them; step/scaler/batch_stats replicate.  Feed the result to
+    jit ``in_shardings``/``out_shardings`` (prefix semantics: a bare P()
+    stands for a replicated subtree).
+    """
+    import flax.linen as nn
+    from flax.core import meta
+
+    init = lambda r: model.init(r, sample_batch,
+                                **(train_kwargs or {"train": False}))
+    abs_vars = jax.eval_shape(init, jax.random.PRNGKey(0))
+    specs = nn.get_partition_spec(abs_vars)
+    param_specs = specs["params"]
+    abs_params = meta.unbox(abs_vars)["params"]
+    spec_state = TrainState(
+        step=P(), params=param_specs, batch_stats=P(),
+        opt_state=_opt_state_specs(optimizer, abs_params, param_specs),
+        scaler=P())
+    to_sharding = lambda s: NamedSharding(mesh, s)
+    return jax.tree_util.tree_map(to_sharding, spec_state,
+                                  is_leaf=lambda v: isinstance(v, P))
+
+
+def create_gspmd_train_state(rng, mesh: Mesh, model, optimizer, sample_batch,
+                             policy: Policy, scaler=None,
+                             train_kwargs: Optional[dict] = None):
+    """(state, state_shardings): TrainState initialized directly into its
+    GSPMD placement — params/optimizer state land sharded (no host-side
+    full materialization beyond tracing)."""
+    shardings = gspmd_state_shardings(mesh, model, optimizer, sample_batch,
+                                      policy, scaler, train_kwargs)
+    init = jax.jit(
+        lambda r: create_train_state(r, model, optimizer, sample_batch,
+                                     policy, scaler, train_kwargs),
+        out_shardings=shardings)
+    return init(rng), shardings
+
+
+def make_gspmd_train_step(mesh: Mesh, model, optimizer, policy: Policy,
+                          state_shardings: TrainState,
+                          loss_fn: Callable = cross_entropy_loss,
+                          compute_accuracy: bool = True,
+                          donate: bool = True):
+    """Tensor/sequence-parallel train step — the *annotate, don't
+    orchestrate* counterpart of :func:`make_sharded_train_step`.
+
+    The per-example program is the plain single-device step; parallelism
+    comes entirely from shardings: params carry the TP layers' partitioning
+    metadata (column/row/vocab over ``model``), the batch shards over
+    ``data``, and GSPMD inserts the Megatron collectives (all-gather /
+    reduce-scatter / all-reduce on ICI) at the layers' constraint points.
+    Reference: apex.transformer's explicit f/g autograd functions
+    (SURVEY.md §3.2) — here they are compiler-derived from the sharding
+    lattice.  Gradient reduction over ``data`` needs no collective in the
+    program: under jit the batch is one logical array, so the grads ARE the
+    global grads.
+
+    Requires the mesh registered via ``parallel_state.set_mesh`` (or
+    ``initialize_model_parallel``) at trace time, so the models'
+    ``constrain`` points bind to it.  On multi-chip TPU runs combine with
+    ``ops._config.set_force_xla(True)``: pallas custom calls are opaque to
+    the SPMD partitioner, the XLA reference forms partition cleanly.
+    """
+    step = make_train_step(model, optimizer, policy, axis_name=None,
+                           loss_fn=loss_fn,
+                           compute_accuracy=compute_accuracy)
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+    metrics_sh = NamedSharding(mesh, P())
+    return jax.jit(step,
+                   in_shardings=(state_shardings, batch_sh),
+                   out_shardings=(state_shardings, metrics_sh),
+                   donate_argnums=(0,) if donate else ())
 
 
 def _replicate_mean(tree, axis_name: str):

@@ -19,12 +19,33 @@ TPU-native specifics:
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Union
 
 import jax.numpy as jnp
 from flax import linen as nn
 
 from apex_example_tpu.normalization import FusedLayerNorm
+
+# Measured fused-vs-XLA crossover on the v5e rig (PERF.md attention table):
+# the flash kernel loses below ~2k tokens (XLA's fusions keep the small
+# score tensor cheap; the kernel adds launch/blocking overhead) and wins
+# above (O(S·D) HBM vs the naive path's O(S²) probability tensor).
+FLASH_AUTO_MIN_SEQ = 2048
+
+
+def _resolve_fused_attention(setting: Union[bool, str], seq_len: int,
+                             softmax_dtype) -> bool:
+    """The fused_attention policy: explicit bool wins; "auto" keys on the
+    measured crossover.  The kernel's softmax is always fp32, so any
+    half-softmax contract (O3) forces the naive path."""
+    if softmax_dtype != jnp.float32:
+        return False
+    if isinstance(setting, bool):
+        return setting
+    if setting == "auto":
+        return seq_len >= FLASH_AUTO_MIN_SEQ
+    raise ValueError(f"fused_attention must be bool or 'auto', "
+                     f"got {setting!r}")
 
 
 class BertSelfAttention(nn.Module):
@@ -39,26 +60,61 @@ class BertSelfAttention(nn.Module):
     # the softmax contract is fp32 — the kernel always computes fp32 softmax,
     # so routing O3's half-softmax through it would silently upgrade
     # precision.  The op itself falls back to the XLA reference off-TPU.
-    fused_attention: bool = False
+    # "auto" (default) applies the measured crossover: kernel at seq >=
+    # FLASH_AUTO_MIN_SEQ, XLA einsum path below.
+    fused_attention: Union[bool, str] = "auto"
+    # Megatron-style tensor parallelism (GSPMD form): q/k/v are column-
+    # parallel (heads shard over the ``model`` axis), the output projection
+    # is row-parallel.  Param names/shapes are identical to the dense path —
+    # checkpoints interchange.  sequence_parallel additionally keeps the
+    # activations outside the TP block sequence-sharded (Megatron-SP).
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
         d = self.hidden_size
         h = self.num_heads
         hd = d // h
-        dense = lambda name: nn.Dense(d, dtype=self.dtype,
-                                      param_dtype=self.param_dtype,
-                                      name=name)
-        q = dense("query")(x).reshape(*x.shape[:-1], h, hd)
-        k = dense("key")(x).reshape(*x.shape[:-1], h, hd)
-        v = dense("value")(x).reshape(*x.shape[:-1], h, hd)
-        if self.fused_attention and self.softmax_dtype == jnp.float32:
+        use_kernel = _resolve_fused_attention(
+            self.fused_attention, x.shape[1], self.softmax_dtype)
+        if self.tensor_parallel:
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                ColumnParallelLinear, RowParallelLinear, batch_axis,
+                constrain)
+            dense_in = lambda name: ColumnParallelLinear(
+                d, gather_output=False,
+                sequence_parallel=self.sequence_parallel,
+                dtype=self.dtype, param_dtype=self.param_dtype, name=name)
+            dense_out = RowParallelLinear(
+                d, input_is_parallel=True,
+                sequence_parallel=self.sequence_parallel,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                name="output")
+            # Heads shard over 'model': the (…, d)->(…, h, hd) reshape keeps
+            # h outer, so the column-sharded feature dim becomes a sharded
+            # head dim (hd stays whole — it is the MXU lane dim).
+            head_spec = lambda t: constrain(t, batch_axis(), None, "model",
+                                            None)
+        else:
+            dense_in = lambda name: nn.Dense(d, dtype=self.dtype,
+                                             param_dtype=self.param_dtype,
+                                             name=name)
+            dense_out = nn.Dense(d, dtype=self.dtype,
+                                 param_dtype=self.param_dtype, name="output")
+            head_spec = lambda t: t
+        q = head_spec(dense_in("query")(x).reshape(*x.shape[:-1], h, hd))
+        k = head_spec(dense_in("key")(x).reshape(*x.shape[:-1], h, hd))
+        v = head_spec(dense_in("value")(x).reshape(*x.shape[:-1], h, hd))
+        if use_kernel and not self.tensor_parallel:
+            # (TP runs the einsum path: pallas_call is opaque to the SPMD
+            # partitioner, while the einsums partition over the head dim.)
             from apex_example_tpu.ops.attention import flash_attention
             key_bias = None if mask_bias is None \
                 else mask_bias[:, 0, 0, :].astype(jnp.float32)
             ctx = flash_attention(q, k, v, key_bias,
                                   scale=1.0 / float(hd) ** 0.5)
-            return dense("output")(ctx.reshape(*x.shape[:-1], d))
+            return dense_out(ctx.reshape(*x.shape[:-1], d))
         sd = self.softmax_dtype
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(sd)
         logits = logits / jnp.sqrt(hd).astype(sd)
@@ -71,7 +127,7 @@ class BertSelfAttention(nn.Module):
         probs = nn.softmax(logits, axis=-1).astype(self.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         ctx = ctx.reshape(*x.shape[:-1], d)
-        return dense("output")(ctx)
+        return dense_out(ctx)
 
 
 class BertLayer(nn.Module):
@@ -82,7 +138,9 @@ class BertLayer(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     ln_dtype: Optional[jnp.dtype] = None     # LN I/O; None follows dtype
     softmax_dtype: jnp.dtype = jnp.float32
-    fused_attention: bool = False
+    fused_attention: Union[bool, str] = "auto"
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -95,15 +153,33 @@ class BertLayer(nn.Module):
                                  self.dtype, self.param_dtype,
                                  self.softmax_dtype,
                                  fused_attention=self.fused_attention,
+                                 tensor_parallel=self.tensor_parallel,
+                                 sequence_parallel=self.sequence_parallel,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))
         x = x.astype(self.dtype)
-        y = nn.Dense(self.intermediate_size, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="intermediate")(x)
-        y = nn.gelu(y, approximate=False)
-        y = nn.Dense(self.hidden_size, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="output")(y)
+        if self.tensor_parallel:
+            # Megatron MLP: column (sharded GELU features) -> row (the
+            # all-reduce — or, under sequence_parallel, the reduce-scatter
+            # onto sequence shards — lands at the row output constraint).
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                ColumnParallelLinear, RowParallelLinear)
+            y = ColumnParallelLinear(
+                self.intermediate_size, gather_output=False,
+                sequence_parallel=self.sequence_parallel, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="intermediate")(x)
+            y = nn.gelu(y, approximate=False)
+            y = RowParallelLinear(
+                self.hidden_size, input_is_parallel=True,
+                sequence_parallel=self.sequence_parallel, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="output")(y)
+        else:
+            y = nn.Dense(self.intermediate_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="intermediate")(x)
+            y = nn.gelu(y, approximate=False)
+            y = nn.Dense(self.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="output")(y)
         x = FusedLayerNorm(dtype=ln_io, name="output_ln")(
             (x + y).astype(ln_io))
         return x.astype(self.dtype)
@@ -122,7 +198,12 @@ class BertForMaskedLM(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     ln_dtype: Optional[jnp.dtype] = None
     softmax_dtype: jnp.dtype = jnp.float32
-    fused_attention: bool = False
+    fused_attention: Union[bool, str] = "auto"
+    # Megatron TP over the GSPMD 'model' mesh axis: vocab-sharded embeddings
+    # + tied parallel LM head, column/row attention and MLP.  Consumed by
+    # engine.make_gspmd_train_step / train.py --tensor-parallel.
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
 
     @nn.compact
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
@@ -130,9 +211,17 @@ class BertForMaskedLM(nn.Module):
         del train  # no dropout in the pretraining benchmark path
         ln_io = self.ln_dtype or self.dtype
         b, L = input_ids.shape
-        word_emb = nn.Embed(self.vocab_size, self.hidden_size,
-                            dtype=self.dtype, param_dtype=self.param_dtype,
-                            name="word_embeddings")
+        if self.tensor_parallel:
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                VocabParallelEmbedding)
+            word_emb = VocabParallelEmbedding(
+                self.vocab_size, self.hidden_size, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="word_embeddings")
+        else:
+            word_emb = nn.Embed(self.vocab_size, self.hidden_size,
+                                dtype=self.dtype,
+                                param_dtype=self.param_dtype,
+                                name="word_embeddings")
         x = word_emb(input_ids)
         pos = jnp.arange(L)[None, :]
         x = x + nn.Embed(self.max_position, self.hidden_size,
@@ -153,16 +242,24 @@ class BertForMaskedLM(nn.Module):
                           self.param_dtype, self.ln_dtype,
                           self.softmax_dtype,
                           fused_attention=self.fused_attention,
+                          tensor_parallel=self.tensor_parallel,
+                          sequence_parallel=self.sequence_parallel,
                           name=f"layer_{i}")(x, mask_bias)
 
-        # MLM head: dense+gelu+LN, then tied decoder.
+        # MLM head: dense+gelu+LN, then tied decoder.  Under TP the decoder
+        # is the parallel LM head (vocab-sharded logits — the CE's logsumexp
+        # reduction over vocab becomes a psum, GSPMD's lowering of
+        # Megatron's vocab_parallel_cross_entropy).
         x = nn.Dense(self.hidden_size, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="mlm_dense")(x)
         x = nn.gelu(x, approximate=False)
         x = FusedLayerNorm(dtype=ln_io, name="mlm_ln")(
             x.astype(ln_io)).astype(self.dtype)
         logits = word_emb.attend(x)
-        logits = logits + self.param("mlm_bias", nn.initializers.zeros,
+        bias_init = nn.initializers.zeros
+        if self.tensor_parallel:
+            bias_init = nn.with_partitioning(bias_init, ("model",))
+        logits = logits + self.param("mlm_bias", bias_init,
                                      (self.vocab_size,), jnp.float32)
         return logits.astype(jnp.float32)
 

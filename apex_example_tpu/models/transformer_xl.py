@@ -48,6 +48,11 @@ class RelMultiHeadAttn(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32   # blacklist op; O3 runs it half
+    # Megatron TP (GSPMD form, same contract as models/bert.py): q/k/v/r
+    # column-parallel (heads shard over 'model'), o row-parallel, the
+    # (h, hd) rel-position biases sharded on h.  Param names/shapes match
+    # the dense path — checkpoints interchange.
+    tensor_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, mem, pos_emb):
@@ -58,25 +63,35 @@ class RelMultiHeadAttn(nn.Module):
         klen = qlen + mlen
         h, hd = self.num_heads, self.d_model // self.num_heads
 
+        if self.tensor_parallel:
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                ColumnParallelLinear, batch_axis, constrain)
+            ba = batch_axis()
+            dense_in = lambda name: ColumnParallelLinear(
+                d, use_bias=False, gather_output=False, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=name)
+            # heads shard over 'model' after the (…, d)->(…, h, hd) reshape
+            hspec = lambda t: constrain(
+                t, *(([ba] if t.ndim == 4 else []) + [None, "model", None]))
+            bias_init = nn.with_partitioning(nn.initializers.zeros,
+                                             ("model", None))
+        else:
+            dense_in = lambda name: nn.Dense(
+                d, use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=name)
+            hspec = lambda t: t
+            bias_init = nn.initializers.zeros
+
         cat = jnp.concatenate([mem.astype(x.dtype), x], axis=1)
-        q = nn.Dense(d, use_bias=False, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="q")(x)
-        k = nn.Dense(d, use_bias=False, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="k")(cat)
-        v = nn.Dense(d, use_bias=False, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="v")(cat)
-        r = nn.Dense(d, use_bias=False, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="r")(
-            pos_emb.astype(self.dtype))
+        q = hspec(dense_in("q")(x).reshape(b, qlen, h, hd))
+        k = hspec(dense_in("k")(cat).reshape(b, klen, h, hd))
+        v = hspec(dense_in("v")(cat).reshape(b, klen, h, hd))
+        r = hspec(dense_in("r")(pos_emb.astype(self.dtype))
+                  .reshape(klen, h, hd))
 
-        q = q.reshape(b, qlen, h, hd)
-        k = k.reshape(b, klen, h, hd)
-        v = v.reshape(b, klen, h, hd)
-        r = r.reshape(klen, h, hd)
-
-        u = self.param("u_bias", nn.initializers.zeros, (h, hd),
+        u = self.param("u_bias", bias_init, (h, hd),
                        self.param_dtype).astype(self.dtype)
-        w = self.param("v_bias", nn.initializers.zeros, (h, hd),
+        w = self.param("v_bias", bias_init, (h, hd),
                        self.param_dtype).astype(self.dtype)
 
         # content score AC: (q + u) · k ; position score BD: (q + v) · r
@@ -95,6 +110,14 @@ class RelMultiHeadAttn(nn.Module):
 
         probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, qlen, d)
+        if self.tensor_parallel:
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                RowParallelLinear)
+            return RowParallelLinear(d, use_bias=False,
+                                     input_is_parallel=True,
+                                     dtype=self.dtype,
+                                     param_dtype=self.param_dtype,
+                                     name="o")(ctx)
         return nn.Dense(d, use_bias=False, dtype=self.dtype,
                         param_dtype=self.param_dtype, name="o")(ctx)
 
@@ -107,20 +130,35 @@ class TXLLayer(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     ln_dtype: Optional[jnp.dtype] = None     # LN I/O; None follows dtype
     softmax_dtype: jnp.dtype = jnp.float32
+    tensor_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, mem, pos_emb):
         ln_io = self.ln_dtype or self.dtype
         a = RelMultiHeadAttn(self.d_model, self.num_heads, self.dtype,
                              self.param_dtype, self.softmax_dtype,
+                             tensor_parallel=self.tensor_parallel,
                              name="attn")(x, mem, pos_emb)
         x = FusedLayerNorm(dtype=ln_io, name="attn_ln")(
             (x + a).astype(ln_io)).astype(self.dtype)
-        y = nn.Dense(self.d_inner, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="ff1")(x)
-        y = nn.relu(y)
-        y = nn.Dense(self.d_model, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="ff2")(y)
+        if self.tensor_parallel:
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                ColumnParallelLinear, RowParallelLinear)
+            y = ColumnParallelLinear(self.d_inner, gather_output=False,
+                                     dtype=self.dtype,
+                                     param_dtype=self.param_dtype,
+                                     name="ff1")(x)
+            y = nn.relu(y)
+            y = RowParallelLinear(self.d_model, input_is_parallel=True,
+                                  dtype=self.dtype,
+                                  param_dtype=self.param_dtype,
+                                  name="ff2")(y)
+        else:
+            y = nn.Dense(self.d_inner, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ff1")(x)
+            y = nn.relu(y)
+            y = nn.Dense(self.d_model, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ff2")(y)
         x = FusedLayerNorm(dtype=ln_io, name="ff_ln")(
             (x + y).astype(ln_io)).astype(self.dtype)
         return x
@@ -145,6 +183,10 @@ class TransformerXL(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     ln_dtype: Optional[jnp.dtype] = None
     softmax_dtype: jnp.dtype = jnp.float32
+    # Megatron TP over the GSPMD 'model' mesh axis (same contract as
+    # models/bert.py): vocab-sharded embedding + tied parallel LM head,
+    # column/row attention (incl. the r projection and u/v biases) and FFN.
+    tensor_parallel: bool = False
 
     def init_mems(self, batch_size: int) -> jnp.ndarray:
         return jnp.zeros((self.num_layers, batch_size, self.mem_len,
@@ -161,8 +203,16 @@ class TransformerXL(nn.Module):
         mlen = mems.shape[2]
         klen = qlen + mlen
 
-        emb = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
-                       param_dtype=self.param_dtype, name="word_emb")
+        if self.tensor_parallel:
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                VocabParallelEmbedding)
+            emb = VocabParallelEmbedding(self.vocab_size, self.d_model,
+                                         dtype=self.dtype,
+                                         param_dtype=self.param_dtype,
+                                         name="word_emb")
+        else:
+            emb = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                           param_dtype=self.param_dtype, name="word_emb")
         x = emb(input_ids) * jnp.sqrt(self.d_model).astype(self.dtype)
 
         # Sinusoidal relative position encodings for distances klen-1 .. 0.
@@ -184,6 +234,7 @@ class TransformerXL(nn.Module):
             x = TXLLayer(self.d_model, self.num_heads, self.d_inner,
                          self.dtype, self.param_dtype, self.ln_dtype,
                          self.softmax_dtype,
+                         tensor_parallel=self.tensor_parallel,
                          name=f"layer_{i}")(x, mems[i], pos_emb)
 
         logits = emb.attend(x).astype(jnp.float32)

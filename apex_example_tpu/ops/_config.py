@@ -4,6 +4,20 @@ import jax
 
 INTERPRET = False  # run Pallas kernels in interpreter mode (CPU tests)
 
+# Force the XLA reference implementations even on TPU.  The GSPMD tensor-
+# parallel path (engine.make_gspmd_train_step) sets this: pallas_call custom
+# calls are opaque to the SPMD partitioner, so inside a plain jit over a
+# multi-axis mesh they would be wrapped in gather/replicate instead of
+# partitioned — the XLA-native forms partition cleanly.  shard_map paths
+# (DP/ZeRO/ring) are unaffected: there the kernels run per-shard by
+# construction and keep the pallas dispatch.
+FORCE_XLA = False
+
+
+def set_force_xla(value: bool) -> None:
+    global FORCE_XLA
+    FORCE_XLA = bool(value)
+
 
 def interpret() -> bool:
     return INTERPRET
@@ -12,6 +26,8 @@ def interpret() -> bool:
 def use_pallas() -> bool:
     """Pallas path on TPU (or under the interpreter); XLA reference
     implementations elsewhere."""
+    if FORCE_XLA:
+        return False
     return INTERPRET or jax.default_backend() in ("tpu", "axon")
 
 
@@ -21,6 +37,8 @@ def use_pallas_for(*operands) -> bool:
     the HLO interpreter evaluates the kernel body with vma-typed values and
     trips on mixed varying/invariant arithmetic.  Real mosaic lowering erases
     vma at the pallas_call boundary, so TPU always keeps the kernel."""
+    if FORCE_XLA:
+        return False
     if INTERPRET:
         return not any(
             getattr(jax.typeof(x), "vma", frozenset()) for x in operands)

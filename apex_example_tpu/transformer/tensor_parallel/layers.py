@@ -196,13 +196,17 @@ class VocabParallelEmbedding(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     embedding_init: Initializer = nn.initializers.normal(stddev=0.02)
 
-    @nn.compact
-    def __call__(self, ids):
-        table = self.param(
+    def setup(self):
+        # setup() (not @nn.compact) so ``attend`` can reuse the table — the
+        # tied-decoder pattern nn.Embed supports; param name/shape match
+        # nn.Embed, so checkpoints interchange with the non-TP model.
+        self.embedding = self.param(
             "embedding",
             nn.with_partitioning(self.embedding_init, (self.axis_name, None)),
             (self.num_embeddings, self.features), self.param_dtype)
-        y = jnp.take(table, ids, axis=0)
+
+    def __call__(self, ids):
+        y = jnp.take(self.embedding, ids, axis=0)
         if self.dtype is not None:
             y = y.astype(self.dtype)
         b = batch_axis()
@@ -211,3 +215,15 @@ class VocabParallelEmbedding(nn.Module):
         else:
             y = constrain(y, b, *([None] * (y.ndim - 1)))
         return y
+
+    def attend(self, x):
+        """Tied decoder: ``x @ table.T`` with the VOCAB dim of the logits
+        sharded over the model axis (the table is row-sharded, so each shard
+        produces its vocab slice locally — Megatron's parallel LM head).  A
+        vocab-sharded-aware loss (XLA cross entropy under GSPMD, or
+        :func:`..cross_entropy.vocab_parallel_cross_entropy` under shard_map)
+        consumes the logits without re-gathering the (…, V) tensor."""
+        table = self.embedding
+        y = x @ table.astype(x.dtype).T
+        b = batch_axis()
+        return constrain(y, b, *([None] * (y.ndim - 2)), self.axis_name)

@@ -172,3 +172,26 @@ def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
         out_specs=(P(), mem_spec, P()))
     return jax.jit(sharded,
                    donate_argnums=(0, 1) if donate else ())
+
+
+def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
+                              state_shardings,
+                              max_grad_norm: float = 0.25,
+                              donate: bool = True):
+    """Tensor-parallel Transformer-XL step (the train.py --tensor-parallel
+    path): same *annotate, don't orchestrate* contract as
+    ``engine.make_gspmd_train_step`` — the plain single-device TXL step
+    jitted with the TP layers' param shardings, batch AND the (layers, B,
+    mem, d) memory carry sharded on 'data', Megatron collectives inserted
+    by GSPMD at the layers' constraint points."""
+    from jax.sharding import NamedSharding
+
+    step = make_txl_train_step(model, optimizer, policy, axis_name=None,
+                               max_grad_norm=max_grad_norm)
+    mems_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+    metrics_sh = NamedSharding(mesh, P())
+    return jax.jit(step,
+                   in_shardings=(state_shardings, mems_sh, batch_sh),
+                   out_shardings=(state_shardings, mems_sh, metrics_sh),
+                   donate_argnums=(0, 1) if donate else ())

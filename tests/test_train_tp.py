@@ -1,0 +1,195 @@
+"""train.py-level tensor parallelism (SURVEY.md §3.2 rebuild stance,
+VERDICT r2 item 4): the GSPMD TP path must (a) be numerically identical to
+the dense single-device model given the same params, (b) train end-to-end
+through the CLI on a (data, model) CPU mesh.
+
+The param trees of the TP and dense BERT variants are structurally identical
+(same names/shapes — column/row/vocab layers only attach partitioning
+metadata), which is what lets (a) literally feed one's params to the other.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import mlm_batch
+from apex_example_tpu.engine import (create_gspmd_train_state,
+                                     create_train_state,
+                                     make_gspmd_train_step, make_train_step)
+from apex_example_tpu.models.bert import bert_tiny
+from apex_example_tpu.optim import FusedAdam
+from apex_example_tpu.transformer import parallel_state
+from apex_example_tpu.workloads import mlm_loss
+
+TP, SEQ, BATCH = 4, 16, 8
+
+
+def _batch(i, vocab):
+    ids, labels, w = mlm_batch(jnp.asarray(i, jnp.int32), batch_size=BATCH,
+                               seq_len=SEQ, vocab_size=vocab,
+                               mask_token_id=vocab - 1, seed=0)
+    return ids, (labels, w)
+
+
+@pytest.fixture()
+def tp_mesh(devices8):
+    mesh = parallel_state.initialize_model_parallel(tensor_parallel=TP,
+                                                    devices=devices8)
+    yield mesh
+    parallel_state.set_mesh(None)
+
+
+@pytest.mark.parametrize("sequence_parallel", [False, True])
+def test_tp_train_matches_dense(tp_mesh, sequence_parallel):
+    """3 train steps on the (data=2, model=4) mesh == 3 single-device dense
+    steps, fed the same initial params and batches."""
+    from apex_example_tpu.optim import FusedSGD
+    policy, scaler = amp.initialize("O0")
+    dense = bert_tiny()
+    tp_model = bert_tiny(tensor_parallel=True,
+                         sequence_parallel=sequence_parallel)
+    V = dense.vocab_size
+    # SGD, not Adam: Adam's near-zero-grad updates behave like sign(g)·lr,
+    # so fp32 reduction-order noise flips individual elements by ±lr (the
+    # ZeRO suite documents the same) — SGD keeps the update linear in g and
+    # the end states comparable elementwise.
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+
+    sample = _batch(0, V)[0][:1]
+    state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                 sample, policy, scaler)
+    step_d = jax.jit(make_train_step(dense, opt(), policy, loss_fn=mlm_loss,
+                                     compute_accuracy=False))
+
+    state_t, shardings = create_gspmd_train_state(
+        jax.random.PRNGKey(0), tp_mesh, tp_model, opt(), sample, policy,
+        scaler)
+    # Same starting point: the dense params ARE a valid TP state (identical
+    # tree); placed onto the mesh per the TP shardings.
+    state_t = state_t.replace(
+        params=jax.device_put(state_d.params, shardings.params))
+    step_t = make_gspmd_train_step(tp_mesh, tp_model, opt(), policy,
+                                   shardings, loss_fn=mlm_loss,
+                                   compute_accuracy=False, donate=False)
+
+    for i in range(3):
+        b = _batch(i, V)
+        state_d, m_d = step_d(state_d, b)
+        state_t, m_t = step_t(state_t, b)
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_t["loss"]),
+                                   rtol=2e-5)
+
+    # End state agrees too (reduction-order noise only).
+    for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
+                    jax.tree_util.tree_leaves(state_t.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_tp_params_actually_shard(tp_mesh):
+    policy, scaler = amp.initialize("O0")
+    model = bert_tiny(tensor_parallel=True)
+    sample = _batch(0, model.vocab_size)[0][:1]
+    state, _ = create_gspmd_train_state(
+        jax.random.PRNGKey(0), tp_mesh, model, FusedAdam(lr=1e-3), sample,
+        policy, scaler)
+    emb = state.params["word_embeddings"]["embedding"]
+    k1 = state.params["layer_0"]["intermediate"]["kernel"]
+    # vocab rows / FFN output features sharded TP-ways
+    assert emb.addressable_shards[0].data.shape[0] == emb.shape[0] // TP
+    assert k1.addressable_shards[0].data.shape[1] == k1.shape[1] // TP
+    # optimizer state shards along with its param
+    mu1 = state.opt_state.mu["layer_0"]["intermediate"]["kernel"]
+    assert mu1.addressable_shards[0].data.shape[1] == k1.shape[1] // TP
+
+
+def test_train_py_cli_tensor_parallel(devices8):
+    """The VERDICT contract: ``train.py --arch bert_* --tensor-parallel N``
+    trains on the CPU mesh (CLI path end to end)."""
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    argv = ["--arch", "bert_tiny", "--tensor-parallel", "2",
+            "--batch-size", str(BATCH), "--seq-len", str(SEQ),
+            "--epochs", "1", "--steps-per-epoch", "3", "--opt", "adam",
+            "--opt-level", "O0", "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_txl_tp_train_matches_dense(tp_mesh):
+    """Transformer-XL under GSPMD TP: 3 recurrent steps (mems carried) match
+    the dense single-device trajectory given the same params."""
+    from apex_example_tpu.data import lm_batch
+    from apex_example_tpu.models.transformer_xl import transformer_xl_tiny
+    from apex_example_tpu.optim import FusedSGD
+    from apex_example_tpu.workloads import (make_gspmd_txl_train_step,
+                                            make_txl_train_step)
+    policy, scaler = amp.initialize("O0")
+    dense = transformer_xl_tiny()
+    tp_model = transformer_xl_tiny(tensor_parallel=True)
+    V = dense.vocab_size
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+
+    def batch(i):
+        toks = lm_batch(jnp.asarray(i, jnp.int32), batch_size=BATCH,
+                        seq_len=SEQ, vocab_size=V, seed=0)
+        return toks[:, :-1], toks[:, 1:]
+
+    sample = batch(0)[0][:1]
+    state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                 sample, policy, scaler)
+    step_d = jax.jit(make_txl_train_step(dense, opt(), policy))
+    mems_d = dense.init_mems(BATCH)
+
+    state_t, shardings = create_gspmd_train_state(
+        jax.random.PRNGKey(0), tp_mesh, tp_model, opt(), sample, policy,
+        scaler)
+    state_t = state_t.replace(
+        params=jax.device_put(state_d.params, shardings.params))
+    step_t = make_gspmd_txl_train_step(tp_mesh, tp_model, opt(), policy,
+                                       shardings, donate=False)
+    mems_t = tp_model.init_mems(BATCH)
+
+    for i in range(3):
+        b = batch(i)
+        state_d, mems_d, m_d = step_d(state_d, mems_d, b)
+        state_t, mems_t, m_t = step_t(state_t, mems_t, b)
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_t["loss"]),
+                                   rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(mems_d), np.asarray(mems_t),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_train_py_cli_txl_tensor_parallel(devices8):
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    argv = ["--arch", "transformer_xl_tiny", "--tensor-parallel", "2",
+            "--batch-size", str(BATCH), "--seq-len", str(SEQ),
+            "--epochs", "1", "--steps-per-epoch", "3", "--opt", "adam",
+            "--opt-level", "O0", "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_train_py_tp_rejections():
+    import train as train_mod
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "resnet18", "--tensor-parallel", "2"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "transformer_xl_tiny",
+                        "--tensor-parallel", "2", "--sequence-parallel"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "bert_tiny", "--tensor-parallel", "2",
+                        "--fused-attention"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "bert_tiny", "--tensor-parallel", "2",
+                        "--grad-accum", "2"])
