@@ -21,12 +21,21 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from flax import linen as nn
 
 from apex_example_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
 ModuleDef = Any
+
+# Residual-selection experiments for the memory-bound backward (PERF.md):
+# tag conv outputs so a checkpoint policy can pin exactly them as the saved
+# set — BN normalize + ReLU are then REMATERIALIZED in backward instead of
+# their outputs being stored/reloaded through HBM.  checkpoint_name is an
+# identity outside a remat region.
+_CONV_OUT = "conv_out"
 
 
 class BasicBlock(nn.Module):
@@ -39,14 +48,17 @@ class BasicBlock(nn.Module):
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = checkpoint_name(y, _CONV_OUT)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters, (3, 3))(y)
+        y = checkpoint_name(y, _CONV_OUT)
         y = self.norm()(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters, (1, 1),
                                  (self.strides, self.strides),
                                  name="downsample_conv")(residual)
+            residual = checkpoint_name(residual, _CONV_OUT)
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(y + residual)
 
@@ -61,17 +73,21 @@ class Bottleneck(nn.Module):
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1))(x)
+        y = checkpoint_name(y, _CONV_OUT)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = checkpoint_name(y, _CONV_OUT)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
+        y = checkpoint_name(y, _CONV_OUT)
         y = self.norm()(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters * 4, (1, 1),
                                  (self.strides, self.strides),
                                  name="downsample_conv")(residual)
+            residual = checkpoint_name(residual, _CONV_OUT)
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(y + residual)
 
@@ -135,6 +151,16 @@ class ResNet(nn.Module):
     # gain at this batch), so the default stays the plain 7×7 stem; the
     # option (and its equivalence proof in test_models.py) remain available.
     stem_space_to_depth: bool = False
+    # Rematerialization experiments for the HBM-bound backward (PERF.md
+    # byte accounting; jax.checkpoint — the reference has no analog, its
+    # equivalent is torch.utils.checkpoint which apex never integrates):
+    #   "none"  — XLA chooses the saved set (default).
+    #   "conv"  — save ONLY conv outputs per block; BN normalize + ReLU are
+    #             recomputed in backward (drops the stored x̂/ReLU
+    #             activations the BN-backward fusions otherwise reload).
+    #   "block" — save only block inputs; the whole block forward is
+    #             recomputed in backward (max traffic cut, max recompute).
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -174,11 +200,30 @@ class ResNet(nn.Module):
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
+        block_cls = self.block_cls
+        if self.remat == "block":
+            block_cls = nn.remat(block_cls, prevent_cse=False)
+        elif self.remat == "conv":
+            block_cls = nn.remat(
+                block_cls, prevent_cse=False,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    _CONV_OUT))
+        elif self.remat != "none":
+            raise ValueError(f"remat must be none|conv|block, got "
+                             f"{self.remat!r}")
+        n = 0
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block_cls(self.num_filters * 2 ** i, strides,
-                                   conv=conv, norm=norm)(x)
+                # Explicit name: nn.remat's wrapper class would otherwise
+                # auto-name modules "CheckpointBottleneck_i", changing param
+                # paths (and so init RNG streams / checkpoint layout) vs the
+                # non-remat model.  Pinning the default-style name keeps
+                # remat a pure backward-schedule choice.
+                x = block_cls(self.num_filters * 2 ** i, strides,
+                              conv=conv, norm=norm,
+                              name=f"{self.block_cls.__name__}_{n}")(x)
+                n += 1
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,

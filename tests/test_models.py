@@ -226,3 +226,83 @@ def test_resnet_family_builders():
         params = model.init(jax.random.PRNGKey(0), x, train=False)
         out = model.apply(params, x, train=False)
         assert out.shape == (1, 7) and out.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("remat", ["conv", "block"])
+def test_resnet_remat_is_pure_schedule_choice(remat):
+    """remat variants (PERF.md HBM-traffic experiments) must not change the
+    math: identical param tree (names pinned through nn.remat's wrapper) and
+    identical loss/grads vs remat='none'."""
+    from apex_example_tpu.models.resnet import Bottleneck, ResNet
+    kw = dict(stage_sizes=[1, 1], block_cls=Bottleneck, num_filters=8,
+              small_stem=True, num_classes=5)
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 16, 16, 3),
+                    jnp.float32)
+
+    def run(r):
+        m = ResNet(remat=r, **kw)
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+
+        def loss(p):
+            out, _ = m.apply({"params": p,
+                              "batch_stats": v["batch_stats"]},
+                             x, train=True, mutable=["batch_stats"])
+            return jnp.sum(out ** 2)
+        l, g = jax.jit(jax.value_and_grad(loss))(v["params"])
+        return v["params"], float(l), g
+
+    p0, l0, g0 = run("none")
+    p1, l1, g1 = run(remat)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), p0, p1)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestFlashAutoCrossover:
+    """fused_attention="auto" keys on the measured ~2k crossover
+    (models/bert.py FLASH_AUTO_MIN_SEQ; PERF.md attention table)."""
+
+    def test_policy_resolution(self):
+        from apex_example_tpu.models.bert import (FLASH_AUTO_MIN_SEQ,
+                                                  _resolve_fused_attention)
+        f32, bf16 = jnp.float32, jnp.bfloat16
+        assert _resolve_fused_attention("auto", 128, f32) is False
+        assert _resolve_fused_attention("auto", FLASH_AUTO_MIN_SEQ, f32) \
+            is True
+        assert _resolve_fused_attention("auto", 8192, f32) is True
+        # explicit bool wins over the crossover
+        assert _resolve_fused_attention(True, 128, f32) is True
+        assert _resolve_fused_attention(False, 8192, f32) is False
+        # half softmax (O3) always forces the naive path
+        assert _resolve_fused_attention("auto", 8192, bf16) is False
+        assert _resolve_fused_attention(True, 8192, bf16) is False
+        with pytest.raises(ValueError):
+            _resolve_fused_attention("yes", 128, f32)
+
+    def test_auto_routes_through_kernel_above_crossover(self, monkeypatch):
+        """Count flash_attention op invocations at trace time: 0 below the
+        crossover, one per layer at/above it."""
+        from apex_example_tpu.models import bert as bert_mod
+        from apex_example_tpu.ops import attention as attn_mod
+        calls = []
+        real = attn_mod.flash_attention
+
+        def spy(*a, **k):
+            calls.append(a[0].shape)
+            return real(*a, **k)
+        monkeypatch.setattr(attn_mod, "flash_attention", spy)
+
+        monkeypatch.setattr(bert_mod, "FLASH_AUTO_MIN_SEQ", 32)
+        model = bert_tiny()    # fused_attention defaults to "auto"
+        ids16 = jnp.zeros((2, 16), jnp.int32)
+        v = model.init(jax.random.PRNGKey(0), ids16, train=False)
+        jax.eval_shape(lambda: model.apply(v, ids16, train=False))
+        assert calls == []
+        ids32 = jnp.zeros((2, 32), jnp.int32)
+        jax.eval_shape(lambda: model.apply(v, ids32, train=False))
+        assert len(calls) == model.num_layers
