@@ -77,6 +77,15 @@ def _load():
         lib.apex_prefetcher_next.argtypes = [ctypes.c_void_p, fp, i32p]
         lib.apex_prefetcher_free.restype = None
         lib.apex_prefetcher_free.argtypes = [ctypes.c_void_p]
+        lib.apex_lm_prefetcher_new.restype = ctypes.c_void_p
+        lib.apex_lm_prefetcher_new.argtypes = [i64, i64, i64, u64, i64, i32,
+                                               i32, ctypes.c_float,
+                                               ctypes.c_float]
+        lib.apex_lm_prefetcher_next.restype = i64
+        lib.apex_lm_prefetcher_next.argtypes = [ctypes.c_void_p, i32p, i32p,
+                                                fp]
+        lib.apex_lm_prefetcher_free.restype = None
+        lib.apex_lm_prefetcher_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -250,6 +259,66 @@ class NativePrefetcher:
     def close(self):
         if self._h is not None:
             self._lib.apex_prefetcher_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeLMPrefetcher:
+    """Background producer of LM / masked-LM token batches (C++ worker).
+
+    The language-model counterpart of :class:`NativePrefetcher` (train.py
+    ``--host-pipeline`` for ``bert_*``/``transformer_xl``): affine-bigram
+    streams with the same learnable structure as
+    ``data.synthetic.lm_batch``, deterministic in (seed, batch index),
+    ``start_index`` resumes mid-stream.
+
+    Yields ``(input_ids, labels, weights)`` int32/int32/float32 of shape
+    (batch, seq_len):
+      - ``mlm=True``: BERT 15% / 80-10-10 masking; labels hold the original
+        token everywhere; weights are 1.0 exactly at masked positions.
+      - ``mlm=False``: causal next-token form; labels are the shifted
+        targets, weights all ones.
+    """
+
+    def __init__(self, batch: int, seq_len: int, vocab_size: int,
+                 mlm: bool, mask_token_id: int = -1, seed: int = 0,
+                 start_index: int = 0, mask_prob: float = 0.15,
+                 noise_p: float = 0.1):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native host runtime unavailable "
+                               "(g++ build failed?)")
+        if mlm and mask_token_id < 0:
+            raise ValueError("mlm=True needs a mask_token_id")
+        self._lib = lib
+        self.batch, self.seq_len = batch, seq_len
+        self._h = lib.apex_lm_prefetcher_new(
+            batch, seq_len, vocab_size, seed, start_index, int(mlm),
+            mask_token_id, mask_prob, noise_p)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h is None:
+            raise StopIteration
+        ids = np.empty((self.batch, self.seq_len), np.int32)
+        lab = np.empty((self.batch, self.seq_len), np.int32)
+        w = np.empty((self.batch, self.seq_len), np.float32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        self._lib.apex_lm_prefetcher_next(
+            self._h, ids.ctypes.data_as(i32p), lab.ctypes.data_as(i32p),
+            _fptr(w))
+        return ids, lab, w
+
+    def close(self):
+        if self._h is not None:
+            self._lib.apex_lm_prefetcher_free(self._h)
             self._h = None
 
     def __del__(self):  # pragma: no cover

@@ -288,4 +288,154 @@ void apex_prefetcher_free(void* handle) {
   delete p;
 }
 
+// ---------------------------------------------------------------------------
+// LM / MLM token prefetcher: the language-model counterpart of the image
+// producer above (train.py --host-pipeline for bert_*/transformer_xl).
+// Streams have the same learnable affine-bigram structure as
+// data/synthetic.py lm_batch — t_{k+1} = (31·t_k + 17) mod V with
+// noise_p random flips — so models genuinely train from this pipeline.
+// mlm=1 additionally applies BERT's 15% / 80-10-10 masking and emits
+// (input_ids, labels=original, weights=mask); mlm=0 emits next-token
+// (inputs, targets) with weights all-ones.  Deterministic in (seed, batch
+// index); start_index resumes mid-stream exactly like the image form.
+// ---------------------------------------------------------------------------
+
+struct LmPrefetcher {
+  int64_t batch, seq, vocab;
+  uint64_t seed;
+  int mlm;
+  int32_t mask_token;
+  float mask_prob, noise_p;
+  std::vector<int32_t> ids[2], lab[2];
+  std::vector<float> w[2];
+  int64_t slot_index[2];
+  int filled[2];
+  int64_t next_index;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<bool> stop;
+
+  static inline float u01(uint64_t r) {
+    return (float)(r >> 40) * (1.0f / 16777216.0f);
+  }
+
+  void produce(int s, int64_t bi) {
+    const uint64_t bseed = splitmix64(seed ^ (0x11abcdefULL + (uint64_t)bi));
+    for (int64_t b = 0; b < batch; ++b) {
+      const uint64_t rseed = splitmix64(bseed + (uint64_t)b);
+      // affine-bigram stream with noise flips, one extra token so both the
+      // causal (inputs/targets offset by one) and the MLM form fit in seq.
+      int64_t t = (int64_t)(splitmix64(rseed) % (uint64_t)vocab);
+      int32_t* row_i = &ids[s][(size_t)(b * seq)];
+      int32_t* row_l = &lab[s][(size_t)(b * seq)];
+      float* row_w = &w[s][(size_t)(b * seq)];
+      for (int64_t k = 0; k < seq + 1; ++k) {
+        const uint64_t r = splitmix64(rseed ^ (0x5eedULL + (uint64_t)k * 2));
+        int64_t nxt = (31 * t + 17) % vocab;
+        if (u01(r) < noise_p) {
+          nxt = (int64_t)(splitmix64(r) % (uint64_t)vocab);
+        }
+        if (mlm) {
+          if (k >= seq) break;
+          const uint64_t m = splitmix64(rseed ^ (0xa11ULL + (uint64_t)k));
+          row_l[k] = (int32_t)t;
+          row_w[k] = 0.0f;
+          row_i[k] = (int32_t)t;
+          if (u01(m) < mask_prob) {                  // masked position
+            row_w[k] = 1.0f;
+            const float u = u01(splitmix64(m));
+            if (u < 0.8f) {
+              row_i[k] = mask_token;                 // 80% [MASK]
+            } else if (u < 0.9f) {                   // 10% random token
+              row_i[k] = (int32_t)(splitmix64(m ^ 0x77ULL) %
+                                   (uint64_t)vocab);
+            }                                        // 10% unchanged
+          }
+        } else {
+          if (k < seq) row_i[k] = (int32_t)t;        // inputs  = t_0..t_{L-1}
+          if (k >= 1) {                              // targets = t_1..t_L
+            row_l[k - 1] = (int32_t)t;
+            row_w[k - 1] = 1.0f;
+          }
+        }
+        t = nxt;
+      }
+    }
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (!stop.load()) {
+      int s = -1;
+      if (!filled[0]) s = 0;
+      else if (!filled[1]) s = 1;
+      if (s < 0) {
+        cv.wait(lk);
+        continue;
+      }
+      const int64_t bi = next_index++;
+      lk.unlock();
+      produce(s, bi);
+      lk.lock();
+      slot_index[s] = bi;
+      filled[s] = 1;
+      cv.notify_all();
+    }
+  }
+};
+
+void* apex_lm_prefetcher_new(int64_t batch, int64_t seq_len, int64_t vocab,
+                             uint64_t seed, int64_t start_index, int32_t mlm,
+                             int32_t mask_token, float mask_prob,
+                             float noise_p) {
+  auto* p = new LmPrefetcher();
+  p->batch = batch; p->seq = seq_len; p->vocab = vocab; p->seed = seed;
+  p->mlm = mlm; p->mask_token = mask_token;
+  p->mask_prob = mask_prob; p->noise_p = noise_p;
+  for (int s = 0; s < 2; ++s) {
+    p->ids[s].resize((size_t)(batch * seq_len));
+    p->lab[s].resize((size_t)(batch * seq_len));
+    p->w[s].resize((size_t)(batch * seq_len));
+    p->filled[s] = 0;
+    p->slot_index[s] = -1;
+  }
+  p->next_index = start_index;
+  p->stop.store(false);
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+int64_t apex_lm_prefetcher_next(void* handle, int32_t* ids_out,
+                                int32_t* lab_out, float* w_out) {
+  auto* p = (LmPrefetcher*)handle;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv.wait(lk, [p] { return p->filled[0] || p->filled[1]; });
+  int s;
+  if (p->filled[0] && p->filled[1])
+    s = p->slot_index[0] < p->slot_index[1] ? 0 : 1;
+  else
+    s = p->filled[0] ? 0 : 1;
+  const int64_t bi = p->slot_index[s];
+  std::memcpy(ids_out, p->ids[s].data(),
+              p->ids[s].size() * sizeof(int32_t));
+  std::memcpy(lab_out, p->lab[s].data(),
+              p->lab[s].size() * sizeof(int32_t));
+  std::memcpy(w_out, p->w[s].data(), p->w[s].size() * sizeof(float));
+  p->filled[s] = 0;
+  p->cv.notify_all();
+  return bi;
+}
+
+void apex_lm_prefetcher_free(void* handle) {
+  auto* p = (LmPrefetcher*)handle;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop.store(true);
+    p->cv.notify_all();
+  }
+  p->worker.join();
+  delete p;
+}
+
 }  // extern "C"

@@ -111,3 +111,68 @@ class TestPrefetcher:
         across = np.linalg.norm(flat[lab == 0] - mean1, axis=1).mean()
         p.close()
         assert across > within * 1.02
+
+
+class TestLMPrefetcher:
+    """Native LM/MLM token producer (csrc apex_lm_prefetcher_*; train.py
+    --host-pipeline for the LM archs)."""
+
+    def test_mlm_determinism_and_resume(self):
+        if not hr.available():
+            pytest.skip("native runtime not buildable")
+        a = hr.NativeLMPrefetcher(4, 16, 256, mlm=True, mask_token_id=255,
+                                  seed=3)
+        _, b1 = next(a), next(a)
+        a.close()
+        # start_index resumes the exact stream (checkpoint-resume contract)
+        b = hr.NativeLMPrefetcher(4, 16, 256, mlm=True, mask_token_id=255,
+                                  seed=3, start_index=1)
+        c1 = next(b)
+        b.close()
+        for x, y in zip(b1, c1):
+            np.testing.assert_array_equal(x, y)
+
+    def test_mlm_masking_contract(self):
+        if not hr.available():
+            pytest.skip("native runtime not buildable")
+        p = hr.NativeLMPrefetcher(8, 64, 256, mlm=True, mask_token_id=255,
+                                  seed=0)
+        ids, lab, w = next(p)
+        p.close()
+        assert set(np.unique(w)) <= {0.0, 1.0}
+        assert 0.05 < w.mean() < 0.30            # ~15% masked
+        # unmasked positions pass through untouched
+        np.testing.assert_array_equal(ids[w == 0], lab[w == 0])
+        # masked positions are mostly [MASK] (80/10/10)
+        masked = ids[w == 1]
+        assert (masked == 255).mean() > 0.6
+        assert lab.min() >= 0 and lab.max() < 256
+
+    def test_causal_form_is_shifted_bigram_stream(self):
+        if not hr.available():
+            pytest.skip("native runtime not buildable")
+        p = hr.NativeLMPrefetcher(2, 8, 64, mlm=False, seed=1)
+        ids, lab, w = next(p)
+        p.close()
+        assert (w == 1.0).all()
+        # targets are inputs shifted by one...
+        np.testing.assert_array_equal(ids[:, 1:], lab[:, :-1])
+        # ...and follow the learnable affine-bigram map up to noise_p flips
+        assert (lab == (31 * ids + 17) % 64).mean() > 0.7
+
+    def test_mlm_rejects_missing_mask_token(self):
+        if not hr.available():
+            pytest.skip("native runtime not buildable")
+        with pytest.raises(ValueError):
+            hr.NativeLMPrefetcher(2, 8, 64, mlm=True)
+
+
+def test_train_py_lm_host_pipeline():
+    """CLI end to end: BERT trains from the native token stream."""
+    if not hr.available():
+        pytest.skip("native runtime not buildable")
+    import train as train_mod
+    assert train_mod.main(
+        ["--arch", "bert_tiny", "--host-pipeline", "--batch-size", "8",
+         "--seq-len", "16", "--epochs", "1", "--steps-per-epoch", "3",
+         "--opt", "adam", "--opt-level", "O0", "--print-freq", "1"]) == 0
