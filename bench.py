@@ -78,7 +78,7 @@ def chain_rate(step, state, batch, steps: int, items_per_step: int,
 
 def _image_setup(policy, scaler, *, arch: str, batch_size: int,
                  image_size: int, num_classes: int,
-                 syncbn: bool = False):
+                 syncbn: bool = False, remat: str = "none"):
     from apex_example_tpu.data import image_batch
     from apex_example_tpu.engine import create_train_state
     from apex_example_tpu.models import ARCHS
@@ -87,7 +87,7 @@ def _image_setup(policy, scaler, *, arch: str, batch_size: int,
     model = ARCHS[arch](
         num_classes=num_classes, dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype, bn_dtype=policy.bn_dtype,
-        bn_axis_name="data" if syncbn else None)
+        bn_axis_name="data" if syncbn else None, remat=remat)
     opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
     batch = image_batch(jnp.asarray(0), batch_size=batch_size,
                         image_size=image_size, channels=3,
@@ -105,7 +105,8 @@ def bench_image_single(args, *, arch: str, opt_level: str, image_size: int,
     policy, scaler = amp.initialize(opt_level)
     model, opt, batch, state = _image_setup(
         policy, scaler, arch=arch, batch_size=args.batch_size,
-        image_size=image_size, num_classes=num_classes)
+        image_size=image_size, num_classes=num_classes,
+        remat=getattr(args, "remat", "none"))
     batch = jax.tree_util.tree_map(
         lambda x: jax.device_put(x, jax.devices()[0]), batch)
     step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
@@ -158,9 +159,11 @@ def bench_c4(args):
 
     policy, scaler = amp.initialize("O2")
     md = amp.module_dtypes(policy)
+    # flag set => force the kernel; absent => "auto" (kernel at seq >= the
+    # measured ~2k crossover, XLA path below — models/bert.py)
     model = bert_base(dtype=md.compute, param_dtype=md.param,
                       ln_dtype=md.ln_io, softmax_dtype=md.softmax,
-                      fused_attention=args.fused_attention)
+                      fused_attention=args.fused_attention or "auto")
     opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
     bs, seq = args.batch_size, args.seq_len
     V = model.vocab_size
@@ -289,10 +292,16 @@ def _tunnel_watchdog(timeout_s: float = 600.0):
     compile left EVERY subsequent client blocked before its first op, ~0%
     CPU).  A silent hang would surface only as an empty driver timeout; this
     arms a timer that is disarmed after the first successful scalar
-    round-trip, and otherwise exits with a diagnostic on stderr.  600 s is
-    ~4x the worst cold ResNet-50 compile on this rig — a legitimate run
-    always completes the probe long before that.
+    round-trip, and otherwise exits with a diagnostic on stderr.  The probe
+    is a trivial scalar add — its compile is negligible, so the timer never
+    races a legitimately long *workload* compile (those happen after the
+    watchdog is already disarmed).  The default 600 s is ~4x the worst cold
+    ResNet-50 compile on this rig; ``--watchdog-timeout`` overrides it and
+    0 disables the watchdog entirely (e.g. slower rigs, cold remote-compile
+    caches).
     """
+    if timeout_s <= 0:
+        return
     import os
     import threading
 
@@ -321,8 +330,15 @@ def main():
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--fused-attention", action="store_true",
                     help="c4: flash-attention kernel (ops/attention.py)")
+    ap.add_argument("--watchdog-timeout", type=float, default=600.0,
+                    help="seconds before the first-device-round-trip "
+                         "watchdog aborts (0 disables)")
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "conv", "block"],
+                    help="c1/c2 rematerialization variant (PERF.md HBM "
+                         "traffic experiments)")
     args = ap.parse_args()
-    _tunnel_watchdog()
+    _tunnel_watchdog(args.watchdog_timeout)
 
     defaults = {          # (batch_size, image_size, seq_len)
         "c1": (256, 32, None), "c2": (256, 224, None),

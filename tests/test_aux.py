@@ -138,3 +138,18 @@ def test_async_checkpoint_save_restore(tmp_path):
     restored = mgr.restore(fresh)
     assert int(restored.step) == 1
     mgr.close()
+
+
+def test_ddp_resume_through_train_cli(tmp_path, devices8):
+    """Resume under a mesh: orbax restores INTO the template's shardings, so
+    a single-device-committed template used to make the sharded step raise
+    'incompatible devices' on the first post-resume step (found by driving
+    train.py end to end; train.mesh_restore_template is the fix)."""
+    import train as train_mod
+    ck = str(tmp_path / "ck")
+    base = ["--arch", "resnet18", "--opt-level", "O2", "--sync_bn",
+            "--steps-per-epoch", "2", "--batch-size", "16",
+            "--print-freq", "1"]
+    assert train_mod.main(base + ["--epochs", "1",
+                                  "--checkpoint-dir", ck]) == 0
+    assert train_mod.main(base + ["--epochs", "2", "--resume", ck]) == 0

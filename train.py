@@ -101,12 +101,25 @@ def parse_args(argv=None):
     p.add_argument("--gradient-predivide-factor", type=float, default=1.0)
     p.add_argument("--num-devices", type=int, default=None,
                    help="devices to use (default: all)")
+    # Megatron-style model parallelism (apex.transformer parity, GSPMD form)
+    p.add_argument("--tensor-parallel", type=int, default=1, metavar="TP",
+                   help="shard attention heads / MLP features / vocab over "
+                        "a 'model' mesh axis of this size (BERT archs); "
+                        "remaining devices form the data axis")
+    p.add_argument("--sequence-parallel", action="store_true",
+                   help="with --tensor-parallel: keep activations outside "
+                        "the TP blocks sequence-sharded (Megatron-SP)")
     # harness
     p.add_argument("--resume", default="", help="checkpoint dir to resume")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--async-checkpoint", action="store_true",
                    help="don't block training on checkpoint IO (orbax "
                         "background write; joined before the next save)")
+    p.add_argument("--remat", default="none",
+                   choices=["none", "conv", "block"],
+                   help="rematerialization for image archs: 'conv' saves "
+                        "only conv outputs (BN/ReLU recomputed in backward)"
+                        ", 'block' saves only block inputs")
     p.add_argument("--host-pipeline", action="store_true",
                    help="feed batches from the native C++ prefetcher "
                         "(csrc/; the reference's fast_collate analog) "
@@ -162,6 +175,24 @@ def make_writer(args):
     return SummaryWriter(args.tensorboard)
 
 
+def mesh_restore_template(state, mesh, zero_optimizer=None):
+    """Resume under a mesh: orbax restores INTO the template's shardings,
+    and a fresh ``create_train_state`` template is committed to a single
+    device — the sharded step would then reject the restored state
+    ("incompatible devices").  Re-place the template replicated over the
+    mesh (ZeRO optimizer state: sharded over the data axis) before restore.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    sh = jax.tree_util.tree_map(lambda _: rep, state)
+    if zero_optimizer is not None:
+        opt_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), zero_optimizer.state_spec(),
+            is_leaf=lambda v: isinstance(v, P))
+        sh = sh.replace(opt_state=opt_sh)
+    return jax.device_put(state, sh)
+
+
 def build_optimizer(args):
     lr = build_lr(args)
     if args.opt == "sgd":
@@ -203,13 +234,14 @@ def main(argv=None):
         raise SystemExit("--fused-attention requires fp32 softmax "
                          "(opt levels O0-O2); O3 runs softmax half")
     if args.arch in LM_ARCHS:
-        if args.host_pipeline:
-            raise SystemExit("--host-pipeline is only wired for the image "
-                             "workloads; LM archs use on-device token "
-                             "generators")
         if args.zero:
             raise SystemExit("--zero is only wired for the image workloads")
         return lm_main(args, policy, scaler)
+
+    if args.tensor_parallel > 1:
+        raise SystemExit("--tensor-parallel is wired for the transformer "
+                         "archs (bert_*, transformer_xl*); image models "
+                         "scale by DP/--zero")
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
     devices = select_devices(args)
@@ -224,7 +256,8 @@ def main(argv=None):
         param_dtype=md.param,
         bn_dtype=md.bn_stats,
         bn_io_dtype=md.bn_io,
-        bn_axis_name="data" if (args.sync_bn and n_dev > 1) else None)
+        bn_axis_name="data" if (args.sync_bn and n_dev > 1) else None,
+        remat=args.remat)
 
     if args.zero:
         if n_dev < 2:
@@ -285,6 +318,9 @@ def main(argv=None):
     start_epoch = 0
     if args.resume:
         rmgr = CheckpointManager(args.resume)
+        if n_dev > 1:
+            state = mesh_restore_template(
+                state, mesh, optimizer if args.zero else None)
         state = rmgr.restore(state)
         start_epoch = int(state.step) // args.steps_per_epoch
         print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
@@ -387,9 +423,46 @@ def main(argv=None):
 
 def lm_main(args, policy, scaler):
     """C4 (BERT-base MLM + FusedLAMB) and C5 (Transformer-XL) workloads."""
-    devices = select_devices(args)
-    n_dev = len(devices)
+    try:
+        return _lm_main_impl(args, policy, scaler)
+    finally:
+        if args.tensor_parallel > 1:
+            # Undo the TP path's process-global kernel-dispatch override and
+            # mesh registration even when SETUP raises (bad --resume dir,
+            # indivisible batch, ...): a programmatic caller must not
+            # inherit them.
+            from apex_example_tpu.ops import _config as ops_config
+            from apex_example_tpu.transformer import parallel_state
+            ops_config.set_force_xla(False)
+            parallel_state.set_mesh(None)
+
+
+def _lm_main_impl(args, policy, scaler):
+    tp = args.tensor_parallel
     is_bert = args.arch.startswith("bert")
+    if tp > 1:
+        if args.sequence_parallel and not is_bert:
+            raise SystemExit("--sequence-parallel is wired for the BERT "
+                             "archs (transformer_xl's recurrence carry is "
+                             "batch-sharded, not sequence-sharded)")
+        if args.fused_attention:
+            raise SystemExit("--tensor-parallel runs the SPMD-partitionable "
+                             "einsum attention; drop --fused-attention")
+        if args.grad_accum != 1:
+            raise SystemExit("--tensor-parallel does not compose with "
+                             "--grad-accum")
+        devices = jax.devices()[:args.num_devices] if args.num_devices \
+            else jax.devices()
+        if len(devices) % tp:
+            raise SystemExit(f"--tensor-parallel {tp} does not divide "
+                             f"{len(devices)} devices")
+        if args.batch_size % max(1, len(devices) // tp):
+            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
+                             f"by the data-axis size {len(devices) // tp}")
+        n_dev = len(devices)
+    else:
+        devices = select_devices(args)
+        n_dev = len(devices)
     builder = {"bert_base": bert_base, "bert_tiny": bert_tiny,
                "transformer_xl": transformer_xl_base,
                "transformer_xl_tiny": transformer_xl_tiny}[args.arch]
@@ -403,7 +476,14 @@ def lm_main(args, policy, scaler):
         # logits are q·r terms, not an additive bias — blockwise attention
         # for it needs the rel-shift inside the kernel; its long-context
         # story is the segment recurrence itself, SURVEY.md §6.)
-        mkw["fused_attention"] = args.fused_attention
+        # flag set => force the kernel; absent => the measured-crossover
+        # "auto" default (kernel at seq >= 2048; models/bert.py)
+        mkw["fused_attention"] = args.fused_attention or "auto"
+        if tp > 1:
+            mkw["tensor_parallel"] = True
+            mkw["sequence_parallel"] = args.sequence_parallel
+    elif tp > 1:
+        mkw["tensor_parallel"] = True
     model = builder(**mkw)
     optimizer = build_optimizer(args)
 
@@ -424,12 +504,46 @@ def lm_main(args, policy, scaler):
             return toks[:, :-1], toks[:, 1:]
 
     sample = batch_fn(0)[0]
-    state = create_train_state(jax.random.PRNGKey(args.seed), model,
-                               optimizer, sample[:1], policy, scaler,
-                               train_kwargs={} if not is_bert else None)
-    mems = None if is_bert else model.init_mems(args.batch_size)
+    if tp > 1:
+        # GSPMD tensor parallelism: one (pipe, data, context, model) mesh,
+        # params carrying the TP layers' partitioning metadata, the plain
+        # single-device step jitted with those shardings — collectives are
+        # compiler-inserted at the layers' constraint points (engine.
+        # make_gspmd_train_step).  Pallas custom calls are opaque to the
+        # SPMD partitioner, so the TP path pins the XLA reference ops.
+        from apex_example_tpu.engine import (create_gspmd_train_state,
+                                             make_gspmd_train_step)
+        from apex_example_tpu.ops import _config as ops_config
+        from apex_example_tpu.transformer import parallel_state
+        from apex_example_tpu.workloads import make_gspmd_txl_train_step
+        # Restored by lm_main's outer finally: retracing happens inside the
+        # run loop, so the flag must live for the whole run.
+        ops_config.set_force_xla(True)
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_parallel=tp, devices=devices)
+        state, shardings = create_gspmd_train_state(
+            jax.random.PRNGKey(args.seed), mesh, model, optimizer,
+            sample[:1], policy, scaler)
+        if is_bert:
+            step_fn = make_gspmd_train_step(mesh, model, optimizer, policy,
+                                            shardings, loss_fn=mlm_loss,
+                                            compute_accuracy=False)
+            mems = None
+        else:
+            step_fn = make_gspmd_txl_train_step(
+                mesh, model, optimizer, policy, shardings,
+                max_grad_norm=args.max_grad_norm)
+            mems = model.init_mems(args.batch_size)
+        print(f"TP over {tp} devices, DP over {n_dev // tp}: {mesh}")
+    else:
+        state = create_train_state(jax.random.PRNGKey(args.seed), model,
+                                   optimizer, sample[:1], policy, scaler,
+                                   train_kwargs={} if not is_bert else None)
+        mems = None if is_bert else model.init_mems(args.batch_size)
 
-    if is_bert:
+    if tp > 1:
+        pass                                   # step_fn built above
+    elif is_bert:
         if n_dev > 1:
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_train_step(
@@ -464,6 +578,10 @@ def lm_main(args, policy, scaler):
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
         # resume (matches the reference harness, which does not persist them).
+        if tp == 1 and n_dev > 1:
+            # (tp > 1 templates are already mesh-placed by
+            # create_gspmd_train_state; DP templates are not.)
+            state = mesh_restore_template(state, mesh)
         state = CheckpointManager(args.resume).restore(state)
         start_epoch = int(state.step) // args.steps_per_epoch
         print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
@@ -472,6 +590,27 @@ def lm_main(args, policy, scaler):
         jax.profiler.start_trace("/tmp/apex_tpu_trace")
 
     global_step = int(state.step)
+    prefetcher = None
+    if args.host_pipeline:
+        # Native C++ token stream (the image path's LM counterpart):
+        # created AFTER resume so start_index continues the exact stream.
+        from apex_example_tpu import host_runtime
+        if not host_runtime.available():
+            raise SystemExit("--host-pipeline: native runtime not buildable")
+        prefetcher = host_runtime.NativeLMPrefetcher(
+            batch=args.batch_size, seq_len=args.seq_len, vocab_size=V,
+            mlm=is_bert, mask_token_id=V - 1 if is_bert else -1,
+            seed=args.seed, start_index=global_step)
+
+        if is_bert:
+            def batch_fn(i):
+                ids, labels, w = next(prefetcher)
+                return jnp.asarray(ids), (jnp.asarray(labels),
+                                          jnp.asarray(w))
+        else:
+            def batch_fn(i):
+                ids, labels, _ = next(prefetcher)
+                return jnp.asarray(ids), jnp.asarray(labels)
     try:
         for epoch in range(start_epoch, args.epochs):
             losses = AverageMeter("loss")
@@ -505,6 +644,8 @@ def lm_main(args, policy, scaler):
         # Join pending async checkpoint writes even when unwinding on an
         # exception — an announced save must exist on disk (main() gives
         # its image path the same protection).
+        if prefetcher is not None:
+            prefetcher.close()
         if writer is not None:
             writer.close()
         if mgr is not None:
