@@ -19,3 +19,24 @@ def sds(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     except TypeError:  # older jax without vma kwarg
         return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def align_param_grad(g, param):
+    """psum a custom-VJP *parameter* cotangent over mesh axes the parameter
+    is invariant in but the computed grad varies in.
+
+    For regular primitives jax's vma-aware AD inserts exactly this psum when
+    transposing the implicit broadcast of a replicated parameter; a
+    custom_vjp backward bypasses that machinery, so its parameter grads
+    would stay shard-varying — which both breaks vma typing under composed
+    transforms (scan-over-backward in the pipeline schedules) and differs
+    from what every non-custom op produces.  No-op outside shard_map or when
+    the variances already agree.  Downstream reductions stay correct:
+    allreduce_grads infers per-leaf from the aval whether a grad is already
+    summed.
+    """
+    from jax import lax
+    gv = getattr(jax.typeof(g), "vma", frozenset())
+    pv = getattr(jax.typeof(param), "vma", frozenset())
+    extra = tuple(sorted(gv - pv))
+    return lax.psum(g, extra) if extra else g

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_example_tpu.ops._vma import sds
+from apex_example_tpu.ops._vma import align_param_grad, sds
 
 from apex_example_tpu.ops import _config as _cfg
 
@@ -257,6 +257,11 @@ def _layer_norm_bwd_vjp(eps, res, dy):
         c1 = jnp.mean(wdy, axis=-1, keepdims=True)
         c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
         dx = (rstd[:, None] * (wdy - c1 - xhat * c2)).astype(x.dtype)
+    # Mesh-invariant gamma/beta get mesh-invariant (psum-ed) grads — the
+    # reduction regular primitives receive from vma-aware AD (see
+    # _vma.align_param_grad).
+    dg = align_param_grad(dg, gamma)
+    db = align_param_grad(db, gamma)
     return (dx.reshape(shape), dg.astype(gamma.dtype), db.astype(gamma.dtype))
 
 
@@ -331,6 +336,7 @@ def _rms_norm_bwd_vjp(eps, res, dy):
         dg = jnp.sum(dyf * xhat, axis=0)
         c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
         dx = (rstd[:, None] * (wdy - xhat * c2)).astype(x.dtype)
+    dg = align_param_grad(dg, gamma)
     return dx.reshape(shape), dg.astype(gamma.dtype)
 
 
