@@ -1,0 +1,237 @@
+"""Pipeline-parallel BERT training step (train.py --pipeline-parallel).
+
+Reference: apex.transformer's pipeline_parallel package drives Megatron-LM
+models through its schedules; the in-tree schedules here
+(pipeline_parallel/schedules.py) were previously exercised on synthetic
+stage functions only.  This module closes the integration gap for a real
+workload: BERT-for-MLM, stages = contiguous blocks of encoder layers,
+driven through the SPMD ring schedule over a ('pipe', 'data') mesh.
+
+Design (TPU-native, *uniform-schedule* form):
+
+- The encoder layers — where the FLOPs and params live — are stacked into
+  one [num_layers, ...] pytree and sharded P('pipe') on the stacked dim:
+  each stage owns num_layers/S contiguous layers and scans over them.
+- Embedding and MLM head are REPLICATED-COMPUTE: every stage evaluates
+  them, but only stage 0 consumes the embedded activations (the ring
+  schedule's injection mask) and only the last stage consumes the head
+  (the loss mask), so the masked cotangents + the automatic psum of
+  invariant-param grads yield exactly the right gradients — including the
+  tied decoder, whose table grad is the psum of the stage-0 embedding
+  contribution and the last-stage decode contribution.  This trades a
+  little redundant forward compute for a schedule with NO special-cased
+  first/last stage (Megatron instead places the embedding on stage 0 and
+  shares it with the last stage via a dedicated all-reduce).
+- Data parallelism composes on the 'data' mesh axis: the global batch
+  shards over it, per-shard microbatches feed the ring, grads of
+  replicated params psum over both axes automatically.
+
+The param tree is IDENTICAL in content to the dense
+``models.bert.BertForMaskedLM`` tree (``pack_params``/``unpack_params``
+convert), so checkpoints interchange and tests compare trajectories
+against the single-device model directly.
+
+Scope: static loss scaling (bf16 O0–O2).  Dynamic-scaling skip-step under
+PP would need the finite flag threaded through the schedule's masked
+buffers; the reference's schedules do not compose with apex AMP's dynamic
+scaler either (Megatron uses its own grad scaler).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_example_tpu import amp as amp_lib
+from apex_example_tpu.amp.policy import Policy
+from apex_example_tpu.engine import TrainState, _wrap_optimizer
+from apex_example_tpu.models.bert import BertForMaskedLM, BertLayer
+from apex_example_tpu.ops.layer_norm import layer_norm
+from apex_example_tpu.ops.xentropy import softmax_cross_entropy
+from apex_example_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+from apex_example_tpu.transformer.pipeline_parallel.schedules import (
+    spmd_pipeline)
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_REST_KEYS = ("word_embeddings", "position_embeddings", "embeddings_ln",
+              "mlm_dense", "mlm_ln", "mlm_bias")
+
+
+def pack_params(dense_params: Dict[str, Any], num_layers: int
+                ) -> Dict[str, Any]:
+    """Dense BertForMaskedLM tree -> {'rest': ..., 'layers': stacked}."""
+    layers = [dense_params[f"layer_{i}"] for i in range(num_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    return {"rest": {k: dense_params[k] for k in _REST_KEYS},
+            "layers": stacked}
+
+
+def unpack_params(packed: Dict[str, Any], num_layers: int) -> Dict[str, Any]:
+    out = dict(packed["rest"])
+    for i in range(num_layers):
+        out[f"layer_{i}"] = jax.tree_util.tree_map(
+            lambda x: x[i], packed["layers"])
+    return out
+
+
+def _embed(rest, ids, model: BertForMaskedLM):
+    """Embedding + post-embedding LN, matching BertForMaskedLM.__call__."""
+    dtype = model.dtype
+    ln_io = model.ln_dtype or dtype
+    L = ids.shape[-1]
+    x = jnp.take(rest["word_embeddings"]["embedding"], ids,
+                 axis=0).astype(dtype)
+    x = x + rest["position_embeddings"]["embedding"][:L][None].astype(dtype)
+    x = layer_norm(x.astype(ln_io), rest["embeddings_ln"]["scale"],
+                   rest["embeddings_ln"]["bias"])
+    return x.astype(dtype)
+
+
+def _head_loss_sum(rest, y, labels, weights, model: BertForMaskedLM):
+    """MLM head (dense+gelu+LN, tied decoder) + weighted CE *sum*, matching
+    BertForMaskedLM.__call__.  Returns the un-normalized Σ ce·w: the global
+    masked-position denominator is applied outside the pipeline so the loss
+    equals workloads.mlm_loss on the full batch exactly (a per-microbatch
+    mean-of-means would weight microbatches with different masked counts
+    unequally)."""
+    dtype = model.dtype
+    ln_io = model.ln_dtype or dtype
+    x = y.astype(dtype) @ rest["mlm_dense"]["kernel"].astype(dtype) \
+        + rest["mlm_dense"]["bias"].astype(dtype)
+    x = jax.nn.gelu(x, approximate=False)
+    x = layer_norm(x.astype(ln_io), rest["mlm_ln"]["scale"],
+                   rest["mlm_ln"]["bias"]).astype(dtype)
+    logits = x @ rest["word_embeddings"]["embedding"].astype(dtype).T
+    logits = logits.astype(jnp.float32) + rest["mlm_bias"]
+    ce = softmax_cross_entropy(logits, labels)
+    return (ce * weights).sum()
+
+
+def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer
+                            ) -> TrainState:
+    """NamedSharding pytree for a packed-params TrainState: layers shard
+    their stacked dim over 'pipe', everything else replicates, optimizer
+    state mirrors its params-shaped fields.  Used both to place the initial
+    state and as the orbax restore template (cf. train.mesh_restore_template
+    for the DP paths)."""
+    from apex_example_tpu.engine import _opt_state_specs
+    tmap = jax.tree_util.tree_map
+    params_specs = {
+        "rest": tmap(lambda _: P(), state.params["rest"]),
+        "layers": tmap(lambda _: P(PIPE_AXIS), state.params["layers"]),
+    }
+    abs_params = tmap(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                      state.params)
+    spec_state = TrainState(
+        step=P(), params=params_specs,
+        batch_stats=tmap(lambda _: P(), state.batch_stats),
+        opt_state=_opt_state_specs(optimizer, abs_params, params_specs),
+        scaler=tmap(lambda _: P(), state.scaler))
+    from jax.sharding import NamedSharding
+    return tmap(lambda s: NamedSharding(mesh, s), spec_state,
+                is_leaf=lambda v: isinstance(v, P))
+
+
+def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
+                            policy: Policy, microbatches: int,
+                            donate: bool = True):
+    """Jitted (state, (ids, (labels, weights))) -> (state, metrics) over a
+    ('pipe', 'data') mesh.  ``state.params`` is the packed tree with
+    ``layers`` leaves carrying the leading [num_layers] stacked dim (shard
+    P('pipe')); batch shards over 'data' and is split into ``microbatches``
+    ring slots per shard.
+    """
+    if policy.uses_dynamic_scaling:
+        raise NotImplementedError(
+            "pipeline parallelism supports static loss scaling only (the "
+            "skip-step flag is not threaded through the schedule buffers)")
+    S = mesh.shape[PIPE_AXIS]
+    if model.num_layers % S:
+        raise ValueError(f"num_layers {model.num_layers} not divisible by "
+                         f"pipeline size {S}")
+    per_stage = model.num_layers // S
+    opt = _wrap_optimizer(optimizer)
+    layer_mod = BertLayer(model.hidden_size, model.num_heads,
+                          model.intermediate_size, model.dtype,
+                          model.param_dtype, model.ln_dtype,
+                          model.softmax_dtype,
+                          fused_attention=model.fused_attention)
+
+    def per_shard(state: TrainState, batch):
+        ids, (labels, weights) = batch
+        M = microbatches
+        b = ids.shape[0]
+        if b % M:
+            raise ValueError(f"per-shard batch {b} not divisible by "
+                             f"microbatches {M}")
+        mb = lambda a: a.reshape(M, b // M, *a.shape[1:])
+
+        def stage_fn(stage_layers, x):
+            # stage_layers leaves: [per_stage, ...] — scan applies them in
+            # order (this stage's contiguous block of encoder layers).  The
+            # injected activation is pipe-invariant while the layer params
+            # vary over pipe; align the scan carry's vma typing up front.
+            if PIPE_AXIS not in getattr(jax.typeof(x), "vma", frozenset()):
+                x = lax.pcast(x, PIPE_AXIS, to="varying")
+
+            def body(h, p):
+                return layer_mod.apply({"params": p}, h, None), None
+            y, _ = lax.scan(body, x, stage_layers)
+            return y
+
+        def scaled_loss_fn(params):
+            rest = params["rest"]
+            x = _embed(rest, ids, model)          # replicated compute
+            # Global masked-position denominator: per-microbatch SUMS ride
+            # the schedule (scaled by M to cancel its mean), the psum stitches
+            # the shards — the result equals mlm_loss on the full batch.
+            denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
+            loss = spmd_pipeline(
+                stage_fn,
+                lambda y, tgt: _head_loss_sum(rest, y, tgt[0], tgt[1],
+                                              model) * M / denom,
+                params["layers"], mb(x), (mb(labels), mb(weights)))
+            loss = lax.psum(loss, DATA_AXIS)
+            return amp_lib.scale_loss(loss, state.scaler), loss
+
+        grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
+        grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
+        # layers grads vary over 'pipe' (each stage owns its block), so the
+        # all-leaves finite flag does too; make it mesh-invariant for the
+        # replicated metrics/scaler.
+        grads_finite = lax.pmean(
+            grads_finite.astype(jnp.float32), PIPE_AXIS) == 1.0
+        new_params, new_opt_state = opt.apply(grads, state.opt_state,
+                                              state.params)
+        scaler = amp_lib.update_scaler(state.scaler, grads_finite)
+        metrics = {"loss": loss, "scale": scaler.scale,
+                   "grads_finite": grads_finite.astype(jnp.float32)}
+        return TrainState(step=state.step + 1, params=new_params,
+                          batch_stats=state.batch_stats,
+                          opt_state=new_opt_state, scaler=scaler), metrics
+
+    # Prefix specs: layers shard their stacked dim over 'pipe'; everything
+    # else (embedding/head params, optimizer scalars) replicates.  The
+    # optimizer state mirrors the params tree per-field
+    # (engine._opt_state_specs), so the same {'rest': P(), 'layers':
+    # P('pipe')} prefix applies inside each of its (mu, nu, ...) fields.
+    from apex_example_tpu.engine import _opt_state_specs
+    params_spec = {"rest": P(), "layers": P(PIPE_AXIS)}
+    probe = {"rest": jax.ShapeDtypeStruct((), jnp.float32),
+             "layers": jax.ShapeDtypeStruct((), jnp.float32)}
+    opt_spec = _opt_state_specs(optimizer, probe, params_spec)
+    state_spec = TrainState(step=P(), params=params_spec, batch_stats=P(),
+                            opt_state=opt_spec, scaler=P())
+    sharded = _shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(state_spec, (P(DATA_AXIS), (P(DATA_AXIS), P(DATA_AXIS)))),
+        out_specs=(state_spec, P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
