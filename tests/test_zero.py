@@ -180,3 +180,25 @@ def test_zero_fp16_dynamic_scaling_skips_in_lockstep(devices8):
         for a, b in zip(jax.tree_util.tree_leaves(p_before),
                         jax.tree_util.tree_leaves(state.params)))
     assert moved
+
+
+def test_train_py_cli_bert_zero(devices8):
+    """CLI end to end: BERT MLM under ZeRO-1 state sharding."""
+    import train as train_mod
+    assert train_mod.main(
+        ["--arch", "bert_tiny", "--zero", "--opt", "adam",
+         "--batch-size", "16", "--seq-len", "16", "--epochs", "1",
+         "--steps-per-epoch", "3", "--opt-level", "O0",
+         "--print-freq", "1"]) == 0
+
+
+def test_train_py_zero_rejections():
+    import train as train_mod
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "transformer_xl_tiny", "--zero",
+                        "--opt", "adam"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "bert_tiny", "--zero", "--opt", "lamb"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "bert_tiny", "--zero", "--opt", "adam",
+                        "--tensor-parallel", "2"])

@@ -109,6 +109,14 @@ def parse_args(argv=None):
     p.add_argument("--sequence-parallel", action="store_true",
                    help="with --tensor-parallel: keep activations outside "
                         "the TP blocks sequence-sharded (Megatron-SP)")
+    p.add_argument("--pipeline-parallel", type=int, default=1, metavar="PP",
+                   help="split BERT's encoder layers into this many stages "
+                        "driven by the SPMD ring schedule "
+                        "(transformer/bert_pipeline.py); remaining devices "
+                        "form the data axis")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="ring slots per data shard under "
+                        "--pipeline-parallel")
     # harness
     p.add_argument("--resume", default="", help="checkpoint dir to resume")
     p.add_argument("--checkpoint-dir", default="")
@@ -203,6 +211,32 @@ def build_optimizer(args):
     return FusedLAMB(lr=lr, weight_decay=args.weight_decay)
 
 
+def pick_devices(args):
+    """Device list without main()'s batch-divisibility check (the TP/PP
+    paths divide the batch by their data-axis size instead)."""
+    return jax.devices()[:args.num_devices] if args.num_devices \
+        else jax.devices()
+
+
+def build_zero_optimizer(args, n_dev):
+    """DistributedFusedAdam for the --zero paths (image and BERT alike)."""
+    if n_dev < 2:
+        raise SystemExit("--zero needs >1 device (state shards over "
+                         "the data axis)")
+    if args.opt != "adam":
+        raise SystemExit("--zero is wired for --opt adam "
+                         "(DistributedFusedAdam)")
+    if args.grad_accum != 1:
+        raise SystemExit("--zero does not support --grad-accum")
+    if args.gradient_predivide_factor != 1.0:
+        raise SystemExit("--zero does not support "
+                         "--gradient-predivide-factor (the reduction "
+                         "lives inside the sharded optimizer)")
+    return DistributedFusedAdam(lr=build_lr(args),
+                                weight_decay=args.weight_decay,
+                                world=n_dev)
+
+
 def main(argv=None):
     args = parse_args(argv)
     # Multi-host rendezvous (no-op single-host): must precede first device
@@ -234,14 +268,15 @@ def main(argv=None):
         raise SystemExit("--fused-attention requires fp32 softmax "
                          "(opt levels O0-O2); O3 runs softmax half")
     if args.arch in LM_ARCHS:
-        if args.zero:
-            raise SystemExit("--zero is only wired for the image workloads")
         return lm_main(args, policy, scaler)
 
     if args.tensor_parallel > 1:
         raise SystemExit("--tensor-parallel is wired for the transformer "
                          "archs (bert_*, transformer_xl*); image models "
                          "scale by DP/--zero")
+    if args.pipeline_parallel > 1:
+        raise SystemExit("--pipeline-parallel is wired for the BERT archs; "
+                         "image models scale by DP/--zero")
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
     devices = select_devices(args)
@@ -259,24 +294,8 @@ def main(argv=None):
         bn_axis_name="data" if (args.sync_bn and n_dev > 1) else None,
         remat=args.remat)
 
-    if args.zero:
-        if n_dev < 2:
-            raise SystemExit("--zero needs >1 device (state shards over "
-                             "the data axis)")
-        if args.opt != "adam":
-            raise SystemExit("--zero is wired for --opt adam "
-                             "(DistributedFusedAdam)")
-        if args.grad_accum != 1:
-            raise SystemExit("--zero does not support --grad-accum")
-        if args.gradient_predivide_factor != 1.0:
-            raise SystemExit("--zero does not support "
-                             "--gradient-predivide-factor (the reduction "
-                             "lives inside the sharded optimizer)")
-        optimizer = DistributedFusedAdam(lr=build_lr(args),
-                                         weight_decay=args.weight_decay,
-                                         world=n_dev)
-    else:
-        optimizer = build_optimizer(args)
+    optimizer = build_zero_optimizer(args, n_dev) if args.zero \
+        else build_optimizer(args)
     if args.host_pipeline:
         from apex_example_tpu import host_runtime
         if not host_runtime.available():
@@ -426,10 +445,10 @@ def lm_main(args, policy, scaler):
     try:
         return _lm_main_impl(args, policy, scaler)
     finally:
-        if args.tensor_parallel > 1:
-            # Undo the TP path's process-global kernel-dispatch override and
-            # mesh registration even when SETUP raises (bad --resume dir,
-            # indivisible batch, ...): a programmatic caller must not
+        if args.tensor_parallel > 1 or args.pipeline_parallel > 1:
+            # Undo the TP/PP paths' process-global kernel-dispatch override
+            # and mesh registration even when SETUP raises (bad --resume
+            # dir, indivisible batch, ...): a programmatic caller must not
             # inherit them.
             from apex_example_tpu.ops import _config as ops_config
             from apex_example_tpu.transformer import parallel_state
@@ -439,7 +458,38 @@ def lm_main(args, policy, scaler):
 
 def _lm_main_impl(args, policy, scaler):
     tp = args.tensor_parallel
+    pp = args.pipeline_parallel
     is_bert = args.arch.startswith("bert")
+    if pp > 1:
+        if not is_bert:
+            raise SystemExit("--pipeline-parallel is wired for the BERT "
+                             "archs (transformer_xl's recurrence carry "
+                             "spans all layers every segment)")
+        if tp > 1 or args.zero:
+            raise SystemExit("--pipeline-parallel does not compose with "
+                             "--tensor-parallel/--zero yet; pick one "
+                             "sharding strategy")
+        if args.opt == "lamb":
+            raise SystemExit("--pipeline-parallel is wired for --opt "
+                             "adam/sgd: stages hold stacked per-layer "
+                             "params, which would give LAMB one cross-layer "
+                             "trust ratio instead of per-tensor ratios")
+        if args.grad_accum != 1:
+            raise SystemExit("--pipeline-parallel owns microbatching "
+                             "(--microbatches); drop --grad-accum")
+        if policy.uses_dynamic_scaling:
+            raise SystemExit("--pipeline-parallel supports static loss "
+                             "scaling only (the skip-step flag is not "
+                             "threaded through the schedule buffers)")
+    if args.zero:
+        if not is_bert:
+            raise SystemExit("--zero is wired for the image and BERT "
+                             "workloads (transformer_xl's step owns its "
+                             "own grad-clip path)")
+        if tp > 1:
+            raise SystemExit("--zero does not compose with "
+                             "--tensor-parallel (state shards over data; "
+                             "TP shards params over model)")
     if tp > 1:
         if args.sequence_parallel and not is_bert:
             raise SystemExit("--sequence-parallel is wired for the BERT "
@@ -451,14 +501,27 @@ def _lm_main_impl(args, policy, scaler):
         if args.grad_accum != 1:
             raise SystemExit("--tensor-parallel does not compose with "
                              "--grad-accum")
-        devices = jax.devices()[:args.num_devices] if args.num_devices \
-            else jax.devices()
+        devices = pick_devices(args)
         if len(devices) % tp:
             raise SystemExit(f"--tensor-parallel {tp} does not divide "
                              f"{len(devices)} devices")
         if args.batch_size % max(1, len(devices) // tp):
             raise SystemExit(f"--batch-size {args.batch_size} not divisible "
                              f"by the data-axis size {len(devices) // tp}")
+        n_dev = len(devices)
+    elif pp > 1:
+        devices = pick_devices(args)
+        if len(devices) % pp:
+            raise SystemExit(f"--pipeline-parallel {pp} does not divide "
+                             f"{len(devices)} devices")
+        data = max(1, len(devices) // pp)
+        if args.batch_size % data:
+            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
+                             f"by the data-axis size {data}")
+        if (args.batch_size // data) % args.microbatches:
+            raise SystemExit(f"per-shard batch {args.batch_size // data} "
+                             f"not divisible by --microbatches "
+                             f"{args.microbatches}")
         n_dev = len(devices)
     else:
         devices = select_devices(args)
@@ -485,7 +548,8 @@ def _lm_main_impl(args, policy, scaler):
     elif tp > 1:
         mkw["tensor_parallel"] = True
     model = builder(**mkw)
-    optimizer = build_optimizer(args)
+    optimizer = build_zero_optimizer(args, n_dev) if args.zero \
+        else build_optimizer(args)
 
     V = model.vocab_size
     if is_bert:
@@ -535,16 +599,50 @@ def _lm_main_impl(args, policy, scaler):
                 max_grad_norm=args.max_grad_norm)
             mems = model.init_mems(args.batch_size)
         print(f"TP over {tp} devices, DP over {n_dev // tp}: {mesh}")
+    elif pp > 1:
+        # Pipeline parallelism: encoder layers stacked and sharded over the
+        # 'pipe' mesh axis, driven by the SPMD ring schedule
+        # (transformer/bert_pipeline.py); remaining devices data-parallel.
+        from apex_example_tpu.engine import TrainState
+        from apex_example_tpu.transformer import parallel_state
+        from apex_example_tpu.transformer.bert_pipeline import (
+            bert_pp_state_shardings, make_bert_pp_train_step, pack_params)
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_parallel=pp, devices=devices)
+        if model.num_layers % pp:
+            raise SystemExit(f"--pipeline-parallel {pp} does not divide "
+                             f"{model.num_layers} encoder layers")
+        dense_state = create_train_state(jax.random.PRNGKey(args.seed),
+                                         model, optimizer, sample[:1],
+                                         policy, scaler)
+        packed = pack_params(dense_state.params, model.num_layers)
+        state = TrainState(step=dense_state.step, params=packed,
+                           batch_stats={},
+                           opt_state=optimizer.init(packed),
+                           scaler=dense_state.scaler)
+        state = jax.device_put(
+            state, bert_pp_state_shardings(mesh, state, optimizer))
+        step_fn = make_bert_pp_train_step(mesh, model, optimizer, policy,
+                                          microbatches=args.microbatches)
+        mems = None
+        print(f"PP over {pp} stages, DP over {n_dev // pp}, "
+              f"{args.microbatches} microbatches/shard: {mesh}")
     else:
         state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                    optimizer, sample[:1], policy, scaler,
                                    train_kwargs={} if not is_bert else None)
         mems = None if is_bert else model.init_mems(args.batch_size)
 
-    if tp > 1:
+    if tp > 1 or pp > 1:
         pass                                   # step_fn built above
     elif is_bert:
-        if n_dev > 1:
+        if args.zero:
+            mesh = make_data_mesh(devices=devices)
+            step_fn = make_zero_train_step(mesh, model, optimizer, policy,
+                                           loss_fn=mlm_loss,
+                                           compute_accuracy=False)
+            print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
+        elif n_dev > 1:
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_train_step(
                 mesh, model, optimizer, policy, loss_fn=mlm_loss,
@@ -578,10 +676,11 @@ def _lm_main_impl(args, policy, scaler):
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
         # resume (matches the reference harness, which does not persist them).
-        if tp == 1 and n_dev > 1:
-            # (tp > 1 templates are already mesh-placed by
-            # create_gspmd_train_state; DP templates are not.)
-            state = mesh_restore_template(state, mesh)
+        if tp == 1 and pp == 1 and n_dev > 1:
+            # (tp/pp > 1 templates are already mesh-placed above; DP
+            # templates are not.)
+            state = mesh_restore_template(
+                state, mesh, optimizer if args.zero else None)
         state = CheckpointManager(args.resume).restore(state)
         start_epoch = int(state.step) // args.steps_per_epoch
         print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
