@@ -383,7 +383,8 @@ def make_gspmd_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                           state_shardings: TrainState,
                           loss_fn: Callable = cross_entropy_loss,
                           compute_accuracy: bool = True,
-                          donate: bool = True):
+                          donate: bool = True,
+                          grad_accum: int = 1):
     """Tensor/sequence-parallel train step — the *annotate, don't
     orchestrate* counterpart of :func:`make_sharded_train_step`.
 
@@ -406,7 +407,8 @@ def make_gspmd_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     """
     step = make_train_step(model, optimizer, policy, axis_name=None,
                            loss_fn=loss_fn,
-                           compute_accuracy=compute_accuracy)
+                           compute_accuracy=compute_accuracy,
+                           grad_accum=grad_accum)
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))
     metrics_sh = NamedSharding(mesh, P())
     return jax.jit(step,

@@ -153,6 +153,35 @@ def make_txl_train_step(model, optimizer, policy: Policy,
     return train_step
 
 
+def make_bert_eval_step(model):
+    """(params, (ids, (labels, weights))) -> {loss, masked_acc}: MLM loss
+    and accuracy over masked positions only — the LM counterpart of the
+    image harness's eval loop (engine.make_eval_step; SURVEY.md §3.5)."""
+    def eval_step(params, batch) -> Dict:
+        ids, (labels, weights) = batch
+        logits = model.apply({"params": params}, ids, train=False)
+        hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        denom = jnp.maximum(weights.sum(), 1.0)
+        return {"loss": mlm_loss(logits, (labels, weights)),
+                "masked_acc": (hit * weights).sum() / denom * 100.0}
+    return eval_step
+
+
+def make_txl_eval_step(model):
+    """(params, mems, (inp, tgt)) -> (new_mems, {loss}): held-out next-token
+    loss, threading the recurrence memory exactly like training (the
+    reference evaluates TXL with mems carried).  Perplexity belongs at the
+    AGGREGATE level — exp(mean loss), computed by the caller over all eval
+    batches; a per-batch exp would make the averaged number Jensen-biased
+    toward outlier batches."""
+    def eval_step(params, mems, batch):
+        inp, tgt = batch
+        logits, new_mems = model.apply({"params": params}, inp,
+                                       mems=mems, train=False)
+        return new_mems, {"loss": lm_loss(logits, tgt)}
+    return eval_step
+
+
 def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                                 ddp: Optional[DDPConfig] = None,
                                 max_grad_norm: float = 0.25,
@@ -177,7 +206,8 @@ def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
 def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                               state_shardings,
                               max_grad_norm: float = 0.25,
-                              donate: bool = True):
+                              donate: bool = True,
+                              grad_accum: int = 1):
     """Tensor-parallel Transformer-XL step (the train.py --tensor-parallel
     path): same *annotate, don't orchestrate* contract as
     ``engine.make_gspmd_train_step`` — the plain single-device TXL step
@@ -187,7 +217,8 @@ def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     from jax.sharding import NamedSharding
 
     step = make_txl_train_step(model, optimizer, policy, axis_name=None,
-                               max_grad_norm=max_grad_norm)
+                               max_grad_norm=max_grad_norm,
+                               grad_accum=grad_accum)
     mems_sh = NamedSharding(mesh, P(None, DATA_AXIS))
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))
     metrics_sh = NamedSharding(mesh, P())

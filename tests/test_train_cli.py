@@ -1,0 +1,46 @@
+"""train.py CLI coverage for the LM eval loops (SURVEY.md §3.5: the
+reference harness's validation pass, extended to the LM archs): MLM
+masked-accuracy eval, TXL perplexity eval with mems threading, and the
+host-pipeline's one-shot held-out streams."""
+
+import pytest
+
+import train as train_mod
+
+BASE = ["--batch-size", "8", "--seq-len", "16", "--epochs", "1",
+        "--steps-per-epoch", "3", "--opt", "adam", "--opt-level", "O0",
+        "--print-freq", "2", "--eval", "--eval-batches", "2"]
+
+
+def test_bert_eval(capsys):
+    assert train_mod.main(["--arch", "bert_tiny", "--num-devices", "1"]
+                          + BASE) == 0
+    out = capsys.readouterr().out
+    assert "EVAL" in out and "masked_acc" in out
+
+
+def test_txl_eval(capsys):
+    assert train_mod.main(["--arch", "transformer_xl_tiny",
+                           "--num-devices", "1"] + BASE) == 0
+    out = capsys.readouterr().out
+    assert "EVAL" in out and "ppl" in out
+
+
+def test_bert_eval_host_pipeline(capsys):
+    from apex_example_tpu import host_runtime
+    if not host_runtime.available():
+        pytest.skip("native runtime not buildable")
+    assert train_mod.main(["--arch", "bert_tiny", "--host-pipeline",
+                           "--num-devices", "1"] + BASE) == 0
+    assert "masked_acc" in capsys.readouterr().out
+
+
+def test_bert_eval_under_pp(devices8, capsys):
+    from apex_example_tpu.transformer import parallel_state
+    try:
+        assert train_mod.main(["--arch", "bert_tiny",
+                               "--pipeline-parallel", "2",
+                               "--microbatches", "2"] + BASE) == 0
+    finally:
+        parallel_state.set_mesh(None)
+    assert "masked_acc" in capsys.readouterr().out

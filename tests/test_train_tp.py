@@ -190,6 +190,19 @@ def test_train_py_tp_rejections():
     with pytest.raises(SystemExit):
         train_mod.main(["--arch", "bert_tiny", "--tensor-parallel", "2",
                         "--fused-attention"])
-    with pytest.raises(SystemExit):
-        train_mod.main(["--arch", "bert_tiny", "--tensor-parallel", "2",
-                        "--grad-accum", "2"])
+
+
+def test_train_py_cli_tp_with_grad_accum(devices8):
+    """--grad-accum composes with --tensor-parallel under GSPMD (plain-jit
+    microbatching; no shard_map carry constraints)."""
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    argv = ["--arch", "bert_tiny", "--tensor-parallel", "2",
+            "--grad-accum", "2", "--batch-size", str(BATCH),
+            "--seq-len", str(SEQ), "--epochs", "1", "--steps-per-epoch",
+            "2", "--opt", "adam", "--opt-level", "O0", "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)

@@ -239,6 +239,11 @@ def build_zero_optimizer(args, n_dev):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.grad_accum > 1 and args.batch_size % args.grad_accum:
+        # Uniform rejection for every path (the microbatch split would
+        # otherwise surface as a reshape TypeError deep inside tracing).
+        raise SystemExit(f"--batch-size {args.batch_size} not divisible by "
+                         f"--grad-accum {args.grad_accum}")
     # Multi-host rendezvous (no-op single-host): must precede first device
     # use.  Launch contract in parallel/launch.py — JAX_COORDINATOR_ADDRESS
     # or the reference's MASTER_ADDR/PORT + WORLD_SIZE/RANK (hosts).
@@ -498,9 +503,6 @@ def _lm_main_impl(args, policy, scaler):
         if args.fused_attention:
             raise SystemExit("--tensor-parallel runs the SPMD-partitionable "
                              "einsum attention; drop --fused-attention")
-        if args.grad_accum != 1:
-            raise SystemExit("--tensor-parallel does not compose with "
-                             "--grad-accum")
         devices = pick_devices(args)
         if len(devices) % tp:
             raise SystemExit(f"--tensor-parallel {tp} does not divide "
@@ -567,6 +569,10 @@ def _lm_main_impl(args, policy, scaler):
                             seed=args.seed)
             return toks[:, :-1], toks[:, 1:]
 
+    # Index-driven generators serve the held-out eval range directly; the
+    # host-pipeline block below swaps in a one-shot-stream form.
+    eval_batch_fn = batch_fn
+
     sample = batch_fn(0)[0]
     if tp > 1:
         # GSPMD tensor parallelism: one (pipe, data, context, model) mesh,
@@ -591,12 +597,14 @@ def _lm_main_impl(args, policy, scaler):
         if is_bert:
             step_fn = make_gspmd_train_step(mesh, model, optimizer, policy,
                                             shardings, loss_fn=mlm_loss,
-                                            compute_accuracy=False)
+                                            compute_accuracy=False,
+                                            grad_accum=args.grad_accum)
             mems = None
         else:
             step_fn = make_gspmd_txl_train_step(
                 mesh, model, optimizer, policy, shardings,
-                max_grad_norm=args.max_grad_norm)
+                max_grad_norm=args.max_grad_norm,
+                grad_accum=args.grad_accum)
             mems = model.init_mems(args.batch_size)
         print(f"TP over {tp} devices, DP over {n_dev // tp}: {mesh}")
     elif pp > 1:
@@ -669,6 +677,22 @@ def _lm_main_impl(args, policy, scaler):
                 grad_accum=args.grad_accum),
                 donate_argnums=(0, 1))
 
+    eval_fn = None
+    if args.eval:
+        from apex_example_tpu.workloads import (make_bert_eval_step,
+                                                make_txl_eval_step)
+        if is_bert:
+            core = make_bert_eval_step(model)
+            if pp > 1:
+                from apex_example_tpu.transformer.bert_pipeline import (
+                    unpack_params)
+                eval_fn = jax.jit(lambda p, b: core(
+                    unpack_params(p, model.num_layers), b))
+            else:
+                eval_fn = jax.jit(core)
+        else:
+            eval_fn = jax.jit(make_txl_eval_step(model))
+
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
         else None
     writer = make_writer(args)
@@ -710,6 +734,22 @@ def _lm_main_impl(args, policy, scaler):
             def batch_fn(i):
                 ids, labels, _ = next(prefetcher)
                 return jnp.asarray(ids), jnp.asarray(labels)
+
+        def eval_batch_fn(i):
+            # One-shot stream at the held-out index (deterministic in i
+            # alone, like the image path's eval prefetcher).
+            pf = host_runtime.NativeLMPrefetcher(
+                batch=args.batch_size, seq_len=args.seq_len, vocab_size=V,
+                mlm=is_bert, mask_token_id=V - 1 if is_bert else -1,
+                seed=args.seed, start_index=i)
+            try:
+                ids, labels, w = next(pf)
+            finally:
+                pf.close()
+            if is_bert:
+                return jnp.asarray(ids), (jnp.asarray(labels),
+                                          jnp.asarray(w))
+            return jnp.asarray(ids), jnp.asarray(labels)
     try:
         for epoch in range(start_epoch, args.epochs):
             losses = AverageMeter("loss")
@@ -736,6 +776,34 @@ def _lm_main_impl(args, policy, scaler):
                                           global_step)
                         writer.add_scalar("train/tok_per_sec", thr.rate,
                                           global_step)
+            if eval_fn is not None:
+                # Held-out token streams at a disjoint index range (the
+                # image path's contract); TXL threads fresh eval mems.
+                # TXL ppl = exp(mean loss) over all eval batches (the
+                # corpus-level metric; a mean of per-batch exps would be
+                # Jensen-biased toward outlier batches).
+                import math
+                el = AverageMeter("loss")
+                e2 = AverageMeter("masked_acc")
+                emems = None if is_bert else model.init_mems(args.batch_size)
+                for j in range(args.eval_batches):
+                    b = eval_batch_fn(
+                        10_000_000 + epoch * args.eval_batches + j)
+                    if is_bert:
+                        em = eval_fn(state.params, b)
+                        e2.update(float(em["masked_acc"]))
+                    else:
+                        emems, em = eval_fn(state.params, emems, b)
+                    el.update(float(em["loss"]))
+                metric = ("masked_acc", e2.avg) if is_bert \
+                    else ("ppl", math.exp(el.avg))
+                print(f"epoch {epoch} EVAL loss {el.avg:.4f} "
+                      f"{metric[0]} {metric[1]:.2f} "
+                      f"({args.eval_batches} batches)")
+                if writer is not None:
+                    writer.add_scalar("eval/loss", el.avg, global_step)
+                    writer.add_scalar(f"eval/{metric[0]}", metric[1],
+                                      global_step)
             if mgr is not None and is_main_process():
                 mgr.save(state, wait=not args.async_checkpoint)
                 print(f"saved checkpoint at step {int(state.step)}")
