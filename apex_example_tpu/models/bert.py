@@ -70,6 +70,12 @@ class BertSelfAttention(nn.Module):
     # activations outside the TP block sequence-sharded (Megatron-SP).
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    # Ring context parallelism (shard_map form): the sequence is sharded
+    # over the 'context' mesh axis; q/k/v projections are per-token local,
+    # attention runs as a ppermute KV ring whose per-chunk scores stay in
+    # VMEM (parallel/context_parallel.ring_attention, flash-composed) —
+    # the long-context training path (no reference analog).
+    context_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -106,6 +112,19 @@ class BertSelfAttention(nn.Module):
         q = head_spec(dense_in("query")(x).reshape(*x.shape[:-1], h, hd))
         k = head_spec(dense_in("key")(x).reshape(*x.shape[:-1], h, hd))
         v = head_spec(dense_in("value")(x).reshape(*x.shape[:-1], h, hd))
+        if self.context_parallel:
+            # Same projections as the dense path (identical param tree);
+            # only the attention computation changes: a ppermute KV ring
+            # over the 'context'-sharded sequence.
+            from apex_example_tpu.parallel.context_parallel import (
+                ring_attention)
+            if mask_bias is not None:
+                raise ValueError("context_parallel BERT does not support an "
+                                 "attention mask (the benchmark MLM path "
+                                 "uses none); masking would need per-chunk "
+                                 "key-bias rotation in the ring")
+            ctx = ring_attention(q, k, v, scale=1.0 / float(hd) ** 0.5)
+            return dense_out(ctx.reshape(*x.shape[:-1], d))
         if use_kernel and not self.tensor_parallel:
             # (TP runs the einsum path: pallas_call is opaque to the SPMD
             # partitioner, while the einsums partition over the head dim.)
@@ -141,6 +160,7 @@ class BertLayer(nn.Module):
     fused_attention: Union[bool, str] = "auto"
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    context_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -155,6 +175,7 @@ class BertLayer(nn.Module):
                                  fused_attention=self.fused_attention,
                                  tensor_parallel=self.tensor_parallel,
                                  sequence_parallel=self.sequence_parallel,
+                                 context_parallel=self.context_parallel,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))
@@ -204,11 +225,22 @@ class BertForMaskedLM(nn.Module):
     # engine.make_gspmd_train_step / train.py --tensor-parallel.
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    # Ring context parallelism: __call__ runs inside shard_map with the
+    # 'context' axis bound, input_ids holding THIS shard's sequence slice;
+    # position ids offset by the shard index, attention rides the KV ring.
+    # Consumed by workloads.make_bert_cp_train_step / --context-parallel.
+    context_parallel: bool = False
 
     @nn.compact
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
                  train: bool = True):
         del train  # no dropout in the pretraining benchmark path
+        if self.tensor_parallel and self.context_parallel:
+            raise ValueError("tensor_parallel and context_parallel do not "
+                             "compose yet (GSPMD vs shard_map forms)")
+        if self.context_parallel and attention_mask is not None:
+            raise ValueError("context_parallel BERT does not support an "
+                             "attention mask")
         ln_io = self.ln_dtype or self.dtype
         b, L = input_ids.shape
         if self.tensor_parallel:
@@ -224,6 +256,12 @@ class BertForMaskedLM(nn.Module):
                                 name="word_embeddings")
         x = word_emb(input_ids)
         pos = jnp.arange(L)[None, :]
+        if self.context_parallel:
+            # input_ids hold this context shard's slice; global positions
+            # offset by the shard index (bound by the enclosing shard_map).
+            from jax import lax as _lax
+            from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+            pos = pos + _lax.axis_index(CONTEXT_AXIS) * L
         x = x + nn.Embed(self.max_position, self.hidden_size,
                          dtype=self.dtype, param_dtype=self.param_dtype,
                          name="position_embeddings")(pos)
@@ -244,6 +282,7 @@ class BertForMaskedLM(nn.Module):
                           fused_attention=self.fused_attention,
                           tensor_parallel=self.tensor_parallel,
                           sequence_parallel=self.sequence_parallel,
+                          context_parallel=self.context_parallel,
                           name=f"layer_{i}")(x, mask_bias)
 
         # MLM head: dense+gelu+LN, then tied decoder.  Under TP the decoder

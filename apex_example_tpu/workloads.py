@@ -203,6 +203,45 @@ def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                    donate_argnums=(0, 1) if donate else ())
 
 
+def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
+                            donate: bool = True):
+    """Ring context-parallel BERT MLM step over a ('data', 'context') mesh
+    (train.py --context-parallel) — the long-context training path.
+
+    The global (B, L) batch shards batch-over-'data' and
+    sequence-over-'context'; per-token work (embeddings, LN, FFN, head)
+    runs on local shards, attention rides the ppermute KV ring
+    (parallel/context_parallel.ring_attention, flash-composed so even
+    per-chunk score tiles stay in VMEM).  The MLM loss is the globally
+    normalized weighted CE (psum-ed sums over both axes — per-shard
+    masked counts differ, so a mean-of-means would misweight shards);
+    params are replicated over both axes, so their grads arrive
+    implicitly psum-ed (incl. the custom-VJP LayerNorm via
+    _vma.align_param_grad) and every replica applies the identical
+    update.  No reference analog (SURVEY.md §3.2: CP absent there).
+    """
+    from apex_example_tpu.engine import TrainState, make_train_step
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+
+    def cp_mlm_loss(logits, target):
+        labels, weights = target
+        axes = (DATA_AXIS, CONTEXT_AXIS)
+        ce = softmax_cross_entropy(logits, labels)
+        num = jax.lax.psum((ce * weights).sum(), axes)
+        den = jnp.maximum(jax.lax.psum(weights.sum(), axes), 1.0)
+        return num / den
+
+    per_shard = make_train_step(model, optimizer, policy, axis_name=None,
+                                loss_fn=cp_mlm_loss, compute_accuracy=False)
+    sharded = _shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), (P(DATA_AXIS, CONTEXT_AXIS),
+                        (P(DATA_AXIS, CONTEXT_AXIS),
+                         P(DATA_AXIS, CONTEXT_AXIS)))),
+        out_specs=(P(), P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
 def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                               state_shardings,
                               max_grad_norm: float = 0.25,

@@ -1,0 +1,125 @@
+"""Context-parallel BERT training (workloads.make_bert_cp_train_step;
+train.py --context-parallel): ring attention over a ('data', 'context')
+mesh driving the full MLM train step — the long-context training path (no
+reference analog; SURVEY.md §3.2 CP row).
+
+The CP model's param tree is identical to the dense one (the ring branch
+reuses the same query/key/value/output projections), so tests initialize
+via the dense twin and pin trajectory equality.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from apex_example_tpu import amp
+from apex_example_tpu.data import mlm_batch
+from apex_example_tpu.engine import create_train_state, make_train_step
+from apex_example_tpu.models.bert import bert_tiny
+from apex_example_tpu.optim import FusedAdam, FusedSGD
+from apex_example_tpu.workloads import make_bert_cp_train_step, mlm_loss
+
+B, L = 4, 32      # context=4 -> local seq 8
+
+
+def _batch(i, vocab):
+    ids, lab, w = mlm_batch(jnp.asarray(i, jnp.int32), batch_size=B,
+                            seq_len=L, vocab_size=vocab,
+                            mask_token_id=vocab - 1, seed=0)
+    return ids, (lab, w)
+
+
+def test_cp_train_matches_dense(devices8):
+    """3 steps on a (data=2, context=4) mesh == 3 dense single-device
+    steps: the ring attention, the shard-offset position embeddings, and
+    the globally normalized MLM loss all line up."""
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("data", "context"))
+    policy, scaler = amp.initialize("O0")
+    dense = bert_tiny()
+    cp_model = bert_tiny(context_parallel=True)
+    V = dense.vocab_size
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+
+    sample = _batch(0, V)[0][:1]
+    state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                 sample, policy, scaler)
+    step_d = jax.jit(make_train_step(dense, opt(), policy, loss_fn=mlm_loss,
+                                     compute_accuracy=False))
+    state_c = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                 sample, policy, scaler)
+    step_c = make_bert_cp_train_step(mesh, cp_model, opt(), policy,
+                                     donate=False)
+    for i in range(3):
+        b = _batch(i, V)
+        state_d, m_d = step_d(state_d, b)
+        state_c, m_c = step_c(state_c, b)
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_c["loss"]),
+                                   rtol=3e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
+                    jax.tree_util.tree_leaves(state_c.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_cp_o2_bf16_trains(devices8):
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("data", "context"))
+    policy, scaler = amp.initialize("O2")
+    md = amp.module_dtypes(policy)
+    kw = dict(dtype=md.compute, param_dtype=md.param, ln_dtype=md.ln_io,
+              softmax_dtype=md.softmax)
+    dense = bert_tiny(**kw)
+    cp_model = bert_tiny(context_parallel=True, **kw)
+    V = dense.vocab_size
+    opt = FusedAdam(lr=3e-3)
+    state = create_train_state(jax.random.PRNGKey(0), dense, opt,
+                               _batch(0, V)[0][:1], policy, scaler)
+    step = make_bert_cp_train_step(mesh, cp_model, opt, policy,
+                                   donate=False)
+    # Overfit ONE batch: per-step losses on fresh random batches are too
+    # noisy at this tiny scale for a monotonicity check.
+    b = _batch(0, V)
+    losses = []
+    for _ in range(6):
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.7 * losses[0], losses
+
+
+def test_cp_model_rejects_mask():
+    m = bert_tiny(context_parallel=True)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError):
+        jax.eval_shape(lambda: m.init(jax.random.PRNGKey(0), ids,
+                                      attention_mask=jnp.ones((1, 8))))
+
+
+def test_train_py_cli_context_parallel(devices8):
+    import train as train_mod
+    from apex_example_tpu.transformer import parallel_state
+    argv = ["--arch", "bert_tiny", "--context-parallel", "4",
+            "--batch-size", str(B), "--seq-len", str(L), "--epochs", "1",
+            "--steps-per-epoch", "3", "--opt", "adam", "--opt-level", "O0",
+            "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        parallel_state.set_mesh(None)
+
+
+def test_train_py_cp_rejections():
+    import train as train_mod
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "resnet18", "--context-parallel", "2"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "transformer_xl_tiny",
+                        "--context-parallel", "2"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "bert_tiny", "--context-parallel", "2",
+                        "--tensor-parallel", "2"])
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "bert_tiny", "--context-parallel", "3",
+                        "--seq-len", "16"])

@@ -44,3 +44,18 @@ def test_bert_eval_under_pp(devices8, capsys):
     finally:
         parallel_state.set_mesh(None)
     assert "masked_acc" in capsys.readouterr().out
+
+
+def test_long_seq_bumps_position_table(devices8):
+    """seq_len beyond the arch's max_position default must auto-grow the
+    position table (the nn.Embed gather silently clamps otherwise) — the
+    long-context path's correctness depends on it, dense and CP alike."""
+    from apex_example_tpu.transformer import parallel_state
+    base = ["--arch", "bert_tiny", "--batch-size", "4", "--seq-len", "256",
+            "--epochs", "1", "--steps-per-epoch", "2", "--opt", "adam",
+            "--opt-level", "O0", "--print-freq", "1"]
+    assert train_mod.main(base + ["--num-devices", "1"]) == 0
+    try:
+        assert train_mod.main(base + ["--context-parallel", "4"]) == 0
+    finally:
+        parallel_state.set_mesh(None)

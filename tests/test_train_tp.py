@@ -206,3 +206,41 @@ def test_train_py_cli_tp_with_grad_accum(devices8):
     finally:
         ops_config.set_force_xla(False)
         parallel_state.set_mesh(None)
+
+
+def test_tp_fp16_dynamic_scaling_skips_globally(tp_mesh):
+    """fp16 dynamic scaling under GSPMD TP: the program is one logical jit,
+    so the finite flag and skip decision are global by construction — a
+    poisoned batch rolls the whole (TP-sharded) state back and halves the
+    scale, and a clean step then trains."""
+    policy, scaler = amp.initialize("O2", loss_scale="dynamic",
+                                    half_dtype=jnp.float16,
+                                    init_scale=2.0 ** 4)
+    model = bert_tiny(tensor_parallel=True, dtype=jnp.float16)
+    V = model.vocab_size
+    opt = FusedAdam(lr=1e-3)
+    sample = _batch(0, V)[0][:1]
+    state, shardings = create_gspmd_train_state(
+        jax.random.PRNGKey(0), tp_mesh, model, opt, sample, policy, scaler)
+    step = make_gspmd_train_step(tp_mesh, model, opt, policy, shardings,
+                                 loss_fn=mlm_loss, compute_accuracy=False,
+                                 donate=False)
+
+    ids, (labels, w) = _batch(0, V)
+    # Poison the loss via a weight spike: inf weight -> nonfinite loss/grads
+    w_bad = w.at[0, 0].set(jnp.inf)
+    p_before = jax.tree_util.tree_map(lambda p: np.asarray(p), state.params)
+    state, m = step(state, (ids, (labels, w_bad)))
+    assert float(m["grads_finite"]) == 0.0
+    assert float(state.scaler.scale) == 2.0 ** 3
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    state, m = step(state, (ids, (labels, w)))
+    assert float(m["grads_finite"]) == 1.0
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                        jax.tree_util.tree_leaves(state.params)))
+    assert moved

@@ -117,6 +117,11 @@ def parse_args(argv=None):
     p.add_argument("--microbatches", type=int, default=4,
                    help="ring slots per data shard under "
                         "--pipeline-parallel")
+    p.add_argument("--context-parallel", type=int, default=1, metavar="CP",
+                   help="shard BERT's sequence over a 'context' mesh axis "
+                        "of this size (ppermute KV-ring attention — the "
+                        "long-context training path); remaining devices "
+                        "form the data axis")
     # harness
     p.add_argument("--resume", default="", help="checkpoint dir to resume")
     p.add_argument("--checkpoint-dir", default="")
@@ -282,6 +287,9 @@ def main(argv=None):
     if args.pipeline_parallel > 1:
         raise SystemExit("--pipeline-parallel is wired for the BERT archs; "
                          "image models scale by DP/--zero")
+    if args.context_parallel > 1:
+        raise SystemExit("--context-parallel is wired for the BERT archs "
+                         "(sequence sharding; images have no sequence)")
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
     devices = select_devices(args)
@@ -450,11 +458,12 @@ def lm_main(args, policy, scaler):
     try:
         return _lm_main_impl(args, policy, scaler)
     finally:
-        if args.tensor_parallel > 1 or args.pipeline_parallel > 1:
-            # Undo the TP/PP paths' process-global kernel-dispatch override
-            # and mesh registration even when SETUP raises (bad --resume
-            # dir, indivisible batch, ...): a programmatic caller must not
-            # inherit them.
+        if (args.tensor_parallel > 1 or args.pipeline_parallel > 1
+                or args.context_parallel > 1):
+            # Undo the TP/PP/CP paths' process-global kernel-dispatch
+            # override and mesh registration even when SETUP raises (bad
+            # --resume dir, indivisible batch, ...): a programmatic caller
+            # must not inherit them.
             from apex_example_tpu.ops import _config as ops_config
             from apex_example_tpu.transformer import parallel_state
             ops_config.set_force_xla(False)
@@ -464,7 +473,32 @@ def lm_main(args, policy, scaler):
 def _lm_main_impl(args, policy, scaler):
     tp = args.tensor_parallel
     pp = args.pipeline_parallel
+    cp = args.context_parallel
     is_bert = args.arch.startswith("bert")
+    if cp > 1:
+        if not is_bert:
+            raise SystemExit("--context-parallel is wired for the BERT "
+                             "archs (transformer_xl's long-context story "
+                             "is its segment recurrence)")
+        if tp > 1 or pp > 1 or args.zero:
+            raise SystemExit("--context-parallel does not compose with "
+                             "--tensor-parallel/--pipeline-parallel/--zero "
+                             "yet; pick one sharding strategy")
+        if args.fused_attention:
+            raise SystemExit("--context-parallel composes the flash kernel "
+                             "inside its KV ring already; drop "
+                             "--fused-attention")
+        if args.grad_accum != 1:
+            raise SystemExit("--context-parallel does not compose with "
+                             "--grad-accum")
+        if args.seq_len % cp:
+            raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
+                             f"--context-parallel {cp}")
+        if args.eval:
+            raise SystemExit("--eval is not wired for --context-parallel "
+                             "(the eval pass runs the dense model on the "
+                             "full sequence — exactly what CP exists to "
+                             "avoid at long context)")
     if pp > 1:
         if not is_bert:
             raise SystemExit("--pipeline-parallel is wired for the BERT "
@@ -525,6 +559,15 @@ def _lm_main_impl(args, policy, scaler):
                              f"not divisible by --microbatches "
                              f"{args.microbatches}")
         n_dev = len(devices)
+    elif cp > 1:
+        devices = pick_devices(args)
+        if len(devices) % cp:
+            raise SystemExit(f"--context-parallel {cp} does not divide "
+                             f"{len(devices)} devices")
+        if args.batch_size % max(1, len(devices) // cp):
+            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
+                             f"by the data-axis size {len(devices) // cp}")
+        n_dev = len(devices)
     else:
         devices = select_devices(args)
         n_dev = len(devices)
@@ -544,6 +587,12 @@ def _lm_main_impl(args, policy, scaler):
         # flag set => force the kernel; absent => the measured-crossover
         # "auto" default (kernel at seq >= 2048; models/bert.py)
         mkw["fused_attention"] = args.fused_attention or "auto"
+        # Long sequences need a position table that covers them — the
+        # nn.Embed gather otherwise silently CLAMPS out-of-range position
+        # ids to the last row (no error, garbage embeddings).
+        arch_maxpos = {"bert_base": 512, "bert_tiny": 128}[args.arch]
+        if args.seq_len > arch_maxpos:
+            mkw["max_position"] = args.seq_len
         if tp > 1:
             mkw["tensor_parallel"] = True
             mkw["sequence_parallel"] = args.sequence_parallel
@@ -635,13 +684,28 @@ def _lm_main_impl(args, policy, scaler):
         mems = None
         print(f"PP over {pp} stages, DP over {n_dev // pp}, "
               f"{args.microbatches} microbatches/shard: {mesh}")
+    elif cp > 1:
+        # Ring context parallelism: init via the DENSE twin (identical param
+        # tree; the CP module's collectives only trace inside shard_map),
+        # step from the CP twin (workloads.make_bert_cp_train_step).
+        from apex_example_tpu.transformer import parallel_state
+        from apex_example_tpu.workloads import make_bert_cp_train_step
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel=cp, devices=devices)
+        model_cp = builder(**mkw, context_parallel=True)
+        state = create_train_state(jax.random.PRNGKey(args.seed), model,
+                                   optimizer, sample[:1], policy, scaler)
+        step_fn = make_bert_cp_train_step(mesh, model_cp, optimizer, policy)
+        mems = None
+        print(f"CP over {cp} sequence shards (local seq "
+              f"{args.seq_len // cp}), DP over {n_dev // cp}: {mesh}")
     else:
         state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                    optimizer, sample[:1], policy, scaler,
                                    train_kwargs={} if not is_bert else None)
         mems = None if is_bert else model.init_mems(args.batch_size)
 
-    if tp > 1 or pp > 1:
+    if tp > 1 or pp > 1 or cp > 1:
         pass                                   # step_fn built above
     elif is_bert:
         if args.zero:
@@ -701,8 +765,9 @@ def _lm_main_impl(args, policy, scaler):
         # TXL mems are transient per-segment activations and restart cold on
         # resume (matches the reference harness, which does not persist them).
         if tp == 1 and pp == 1 and n_dev > 1:
-            # (tp/pp > 1 templates are already mesh-placed above; DP
-            # templates are not.)
+            # (tp/pp > 1 templates are already mesh-placed above; DP and CP
+            # templates are not — CP state is replicated, so the replicated
+            # template is the right restore target for it too.)
             state = mesh_restore_template(
                 state, mesh, optimizer if args.zero else None)
         state = CheckpointManager(args.resume).restore(state)
