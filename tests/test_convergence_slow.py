@@ -1,6 +1,8 @@
 """The full {O0, O2} × {1, 8 devices} convergence matrix at accuracy.py's
 ci-preset scale, as a CI-on-request target (SURVEY.md §5 integration tier;
 VERDICT r2 item 8): ``pytest -m slow tests/test_convergence_slow.py``.
+Measured green 2026-07-30: 75 min uncontended on the 8-logical-CPU rig
+(budget ≥2 h when sharing the box).
 
 The fast suite's matrix (test_convergence_matrix.py) uses a tiny model; this
 one runs the REAL ci preset cells through accuracy.run_one — the same code
