@@ -65,14 +65,21 @@ def larc(trust_coefficient: float = 0.02, clip: bool = True,
 
 
 class LARC:
-    """apex-shaped facade over :func:`larc` for ctor-surface parity."""
+    """apex-shaped facade over :func:`larc` for ctor-surface parity.
+
+    ``weight_decay`` belongs HERE, not on the inner optimizer: apex's LARC
+    zeroes the group's wd, folds it into the trust-ratio denominator
+    (adaptive = trust·‖p‖/(‖g‖ + wd·‖p‖ + eps)) and scales (g + wd·p) by
+    the ratio — wd applied after the scaling would be a different update.
+    """
 
     def __init__(self, optimizer: optax.GradientTransformation,
                  trust_coefficient: float = 0.02, clip: bool = True,
-                 eps: float = 1e-8, lr: float = None):
+                 eps: float = 1e-8, lr: float = None,
+                 weight_decay: float = 0.0):
         self.transform = optax.chain(
             larc(trust_coefficient=trust_coefficient, clip=clip, eps=eps,
-                 lr=lr),
+                 lr=lr, weight_decay=weight_decay),
             optimizer)
 
     def init(self, params):

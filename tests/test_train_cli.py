@@ -59,3 +59,33 @@ def test_long_seq_bumps_position_table(devices8):
         assert train_mod.main(base + ["--context-parallel", "4"]) == 0
     finally:
         parallel_state.set_mesh(None)
+
+
+@pytest.mark.parametrize("opt", ["novograd", "adagrad"])
+def test_extra_fused_optimizers_from_cli(opt):
+    """apex's remaining fused optimizers are harness-reachable."""
+    assert train_mod.main(
+        ["--arch", "resnet18", "--opt", opt, "--num-devices", "1",
+         "--batch-size", "16", "--epochs", "1", "--steps-per-epoch", "2",
+         "--opt-level", "O0", "--print-freq", "1"]) == 0
+
+
+def test_larc_from_cli():
+    """apex.parallel.LARC wraps the optimizer from the CLI (SSL recipes)."""
+    assert train_mod.main(
+        ["--arch", "resnet18", "--opt", "sgd", "--larc",
+         "--num-devices", "1", "--batch-size", "16", "--epochs", "1",
+         "--steps-per-epoch", "2", "--opt-level", "O0",
+         "--print-freq", "1"]) == 0
+
+
+def test_larc_zero_rejected():
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "resnet18", "--larc", "--zero",
+                        "--opt", "adam"])
+
+
+def test_larc_pp_rejected():
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "bert_tiny", "--pipeline-parallel", "2",
+                        "--larc"])

@@ -38,10 +38,11 @@ from apex_example_tpu.models import ARCHS
 from apex_example_tpu.models.bert import bert_base, bert_tiny
 from apex_example_tpu.models.transformer_xl import (transformer_xl_base,
                                                     transformer_xl_tiny)
-from apex_example_tpu.optim import (DistributedFusedAdam, FusedAdam,
-                                    FusedLAMB, FusedSGD, build_schedule,
+from apex_example_tpu.optim import (DistributedFusedAdam, FusedAdagrad,
+                                    FusedAdam, FusedLAMB, FusedNovoGrad,
+                                    FusedSGD, build_schedule,
                                     make_zero_train_step)
-from apex_example_tpu.parallel import (DDPConfig, is_main_process,
+from apex_example_tpu.parallel import (DDPConfig, LARC, is_main_process,
                                        make_data_mesh,
                                        maybe_initialize_distributed)
 from apex_example_tpu.utils import AverageMeter, Throughput
@@ -82,7 +83,13 @@ def parse_args(argv=None):
     p.add_argument("--lr-min", type=float, default=0.0)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", "--wd", type=float, default=1e-4)
-    p.add_argument("--opt", default="sgd", choices=["sgd", "adam", "lamb"])
+    p.add_argument("--opt", default="sgd",
+                   choices=["sgd", "adam", "lamb", "novograd", "adagrad"])
+    p.add_argument("--larc", action="store_true",
+                   help="wrap the optimizer in LARC layer-wise adaptive "
+                        "rate control (parallel/larc.py; apex.parallel.LARC)")
+    p.add_argument("--larc-trust", type=float, default=0.02,
+                   help="LARC trust coefficient")
     # amp surface (apex parity)
     p.add_argument("--opt-level", default="O0",
                    choices=["O0", "O1", "O2", "O3"])
@@ -208,12 +215,28 @@ def mesh_restore_template(state, mesh, zero_optimizer=None):
 
 def build_optimizer(args):
     lr = build_lr(args)
+    # Under LARC, weight decay moves INTO the trust ratio (apex zeroes the
+    # group's wd and folds it into the LARC denominator; wd applied by the
+    # inner optimizer after the scaling would be a different update).
+    wd = 0.0 if args.larc else args.weight_decay
     if args.opt == "sgd":
-        return FusedSGD(lr=lr, momentum=args.momentum,
-                        weight_decay=args.weight_decay)
-    if args.opt == "adam":
-        return FusedAdam(lr=lr, weight_decay=args.weight_decay)
-    return FusedLAMB(lr=lr, weight_decay=args.weight_decay)
+        opt = FusedSGD(lr=lr, momentum=args.momentum, weight_decay=wd)
+    elif args.opt == "adam":
+        opt = FusedAdam(lr=lr, weight_decay=wd)
+    elif args.opt == "novograd":
+        opt = FusedNovoGrad(lr=lr, weight_decay=wd)
+    elif args.opt == "adagrad":
+        opt = FusedAdagrad(lr=lr, weight_decay=wd)
+    else:
+        opt = FusedLAMB(lr=lr, weight_decay=wd)
+    if args.larc:
+        # apex recipe shape: LARC wraps the inner optimizer and scales each
+        # leaf's update by the trust ratio ||p||/||g|| (parallel/larc.py).
+        # Clip mode needs the outer lr; under an LR schedule the BASE lr
+        # bounds the ratio (apex clamps against the per-step group lr).
+        opt = LARC(opt.as_optax(), trust_coefficient=args.larc_trust,
+                   lr=args.lr, weight_decay=args.weight_decay)
+    return opt
 
 
 def pick_devices(args):
@@ -225,6 +248,9 @@ def pick_devices(args):
 
 def build_zero_optimizer(args, n_dev):
     """DistributedFusedAdam for the --zero paths (image and BERT alike)."""
+    if args.larc:
+        raise SystemExit("--larc does not compose with --zero (the sharded "
+                         "optimizer owns its update)")
     if n_dev < 2:
         raise SystemExit("--zero needs >1 device (state shards over "
                          "the data axis)")
@@ -508,11 +534,12 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--pipeline-parallel does not compose with "
                              "--tensor-parallel/--zero yet; pick one "
                              "sharding strategy")
-        if args.opt == "lamb":
-            raise SystemExit("--pipeline-parallel is wired for --opt "
+        if args.opt == "lamb" or args.larc:
+            raise SystemExit("--pipeline-parallel is wired for plain --opt "
                              "adam/sgd: stages hold stacked per-layer "
-                             "params, which would give LAMB one cross-layer "
-                             "trust ratio instead of per-tensor ratios")
+                             "params, which would give LAMB/LARC one "
+                             "cross-layer trust ratio instead of per-tensor "
+                             "ratios")
         if args.grad_accum != 1:
             raise SystemExit("--pipeline-parallel owns microbatching "
                              "(--microbatches); drop --grad-accum")
