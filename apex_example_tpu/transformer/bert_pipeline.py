@@ -31,10 +31,15 @@ The param tree is IDENTICAL in content to the dense
 convert), so checkpoints interchange and tests compare trajectories
 against the single-device model directly.
 
-Scope: static loss scaling (bf16 O0–O2).  Dynamic-scaling skip-step under
-PP would need the finite flag threaded through the schedule's masked
-buffers; the reference's schedules do not compose with apex AMP's dynamic
-scaler either (Megatron uses its own grad scaler).
+Dynamic loss scaling (fp16 O1/O2) composes with the schedule without any
+per-microbatch plumbing: an overflow anywhere in the schedule poisons the
+ACCUMULATED grads (inf/nan propagates through the scan and the psums), so
+the post-schedule finite check sees it; rest-param grads are psum'd over
+pipe+data (making their flag mesh-invariant already) and the stage-local
+layer-grad flags are pmean'd over 'pipe', so every stage takes the same
+all-or-none skip — the same protocol the TP and ZeRO paths use.  This goes
+beyond the reference, whose pipeline schedules do not compose with apex
+AMP's dynamic scaler (Megatron uses its own grad scaler).
 """
 
 from __future__ import annotations
@@ -149,10 +154,6 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     P('pipe')); batch shards over 'data' and is split into ``microbatches``
     ring slots per shard.
     """
-    if policy.uses_dynamic_scaling:
-        raise NotImplementedError(
-            "pipeline parallelism supports static loss scaling only (the "
-            "skip-step flag is not threaded through the schedule buffers)")
     S = mesh.shape[PIPE_AXIS]
     if model.num_layers % S:
         raise ValueError(f"num_layers {model.num_layers} not divisible by "
@@ -211,6 +212,14 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             grads_finite.astype(jnp.float32), PIPE_AXIS) == 1.0
         new_params, new_opt_state = opt.apply(grads, state.opt_state,
                                               state.params)
+        if policy.uses_dynamic_scaling:
+            # Overflow => all-or-none skip on every stage: the flag is
+            # mesh-invariant (pmean above), so each stage's where-select
+            # takes the same branch and the sharded state stays consistent.
+            new_params = amp_lib.select_tree(grads_finite, new_params,
+                                             state.params)
+            new_opt_state = amp_lib.select_tree(grads_finite, new_opt_state,
+                                                state.opt_state)
         scaler = amp_lib.update_scaler(state.scaler, grads_finite)
         metrics = {"loss": loss, "scale": scaler.scale,
                    "grads_finite": grads_finite.astype(jnp.float32)}

@@ -114,12 +114,51 @@ def test_pp_o2_bf16_trains(devices8):
     assert losses[-1] < losses[0], losses
 
 
-def test_pp_rejects_dynamic_scaling(devices8):
+def test_pp_fp16_dynamic_scaling_skips_globally(devices8):
+    """fp16 dynamic scaling under PP: an overflow anywhere in the schedule
+    poisons the accumulated grads, the pipe-pmean'd finite flag is mesh-
+    invariant, and every stage takes the same all-or-none skip — scale
+    halves, the sharded state rolls back bit-exactly, and the next clean
+    step trains (mirror of test_tp_fp16_dynamic_scaling_skips_globally)."""
     mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("pipe", "data"))
-    policy, _ = amp.initialize("O2", loss_scale="dynamic")
-    with pytest.raises(NotImplementedError):
-        make_bert_pp_train_step(mesh, bert_tiny(), FusedAdam(lr=1e-3),
-                                policy, microbatches=2)
+    policy, scaler = amp.initialize("O2", loss_scale="dynamic",
+                                    half_dtype=jnp.float16,
+                                    init_scale=2.0 ** 4)
+    model = bert_tiny(dtype=jnp.float16)
+    V = model.vocab_size
+    opt = FusedAdam(lr=1e-3)
+    state_d = create_train_state(jax.random.PRNGKey(0), model, opt,
+                                 _batch(0, V)[0][:1], policy, scaler)
+    state = _pp_state(state_d, model, opt)
+    state = jax.device_put(state, bert_pp_state_shardings(mesh, state, opt))
+    step = make_bert_pp_train_step(mesh, model, opt, policy,
+                                   microbatches=2, donate=False)
+
+    ids, (labels, w) = _batch(0, V)
+    w_bad = w.at[0, 0].set(jnp.inf)
+    p_before = jax.tree_util.tree_map(lambda p: np.asarray(p), state.params)
+    o_before = jax.tree_util.tree_map(lambda p: np.asarray(p),
+                                      state.opt_state)
+    state, m = step(state, (ids, (labels, w_bad)))
+    assert float(m["grads_finite"]) == 0.0
+    assert float(state.scaler.scale) == 2.0 ** 3
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The skip must also roll back the optimizer state — a missed rollback
+    # leaves nan in mu/nu that the next step's grads cannot reveal.
+    for a, b in zip(jax.tree_util.tree_leaves(o_before),
+                    jax.tree_util.tree_leaves(state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    state, m = step(state, (ids, (labels, w)))
+    assert float(m["grads_finite"]) == 1.0
+    moved = False
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(state.params)):
+        assert np.isfinite(np.asarray(b)).all()
+        moved = moved or not np.array_equal(np.asarray(a), np.asarray(b))
+    assert moved
 
 
 def test_train_py_cli_pipeline_parallel(devices8):

@@ -230,17 +230,25 @@ def test_tp_fp16_dynamic_scaling_skips_globally(tp_mesh):
     # Poison the loss via a weight spike: inf weight -> nonfinite loss/grads
     w_bad = w.at[0, 0].set(jnp.inf)
     p_before = jax.tree_util.tree_map(lambda p: np.asarray(p), state.params)
+    o_before = jax.tree_util.tree_map(lambda p: np.asarray(p),
+                                      state.opt_state)
     state, m = step(state, (ids, (labels, w_bad)))
     assert float(m["grads_finite"]) == 0.0
     assert float(state.scaler.scale) == 2.0 ** 3
     for a, b in zip(jax.tree_util.tree_leaves(p_before),
                     jax.tree_util.tree_leaves(state.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The skip must also roll back the optimizer state — a missed rollback
+    # leaves nan in mu/nu that the next step's grads cannot reveal.
+    for a, b in zip(jax.tree_util.tree_leaves(o_before),
+                    jax.tree_util.tree_leaves(state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     state, m = step(state, (ids, (labels, w)))
     assert float(m["grads_finite"]) == 1.0
-    moved = any(
-        not np.array_equal(np.asarray(a), np.asarray(b))
-        for a, b in zip(jax.tree_util.tree_leaves(p_before),
-                        jax.tree_util.tree_leaves(state.params)))
+    moved = False
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(state.params)):
+        assert np.isfinite(np.asarray(b)).all()
+        moved = moved or not np.array_equal(np.asarray(a), np.asarray(b))
     assert moved

@@ -543,10 +543,6 @@ def _lm_main_impl(args, policy, scaler):
         if args.grad_accum != 1:
             raise SystemExit("--pipeline-parallel owns microbatching "
                              "(--microbatches); drop --grad-accum")
-        if policy.uses_dynamic_scaling:
-            raise SystemExit("--pipeline-parallel supports static loss "
-                             "scaling only (the skip-step flag is not "
-                             "threaded through the schedule buffers)")
     if args.zero:
         if not is_bert:
             raise SystemExit("--zero is wired for the image and BERT "
