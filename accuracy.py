@@ -159,6 +159,10 @@ def main(argv=None):
     artifact = {
         "preset": args.preset, "arch": arch, "steps": steps,
         "batch_size": bs, "eval_batches": ev,
+        # The smallest top-1 step the eval set can resolve (one example
+        # flipping).  A credible "<0.1% gap" verdict needs quantum << 0.1
+        # (VERDICT r3: 1024 eval examples made the quantum EQUAL the bar).
+        "top1_quantum_pct": 100.0 / (ev * bs),
         "label_noise": args.label_noise, "seeds": seeds,
         "top1_fp32": mean([per_seed[s]["O0"]["top1"] for s in seeds])
         if "O0" in levels else None,

@@ -311,15 +311,26 @@ def _opt_state_specs(optimizer, abs_params, param_specs):
 
     The fused-optimizer states (AdamState etc.) are NamedTuples whose fields
     are either scalars or whole subtrees mirroring the params tree (mu/nu/
-    momentum buffers): any node with the params' tree structure inherits the
-    params' specs elementwise, everything else replicates.  Recursion covers
+    momentum buffers): any node with the params' tree structure AND leaf
+    shapes inherits the params' specs elementwise, everything else
+    replicates.  The shape check matters: NovoGrad's ``nu`` mirrors the
+    params TREE but holds per-tensor scalars — structure alone would hand
+    its scalars the params' (possibly sharded) specs.  Recursion covers
     optax-style nested tuples of such states.
     """
     params_def = jax.tree_util.tree_structure(abs_params)
+    param_leaves = jax.tree_util.tree_leaves(abs_params)
     abs_state = jax.eval_shape(optimizer.init, abs_params)
 
+    def params_shaped(node):
+        if jax.tree_util.tree_structure(node) != params_def:
+            return False
+        return all(getattr(l, "shape", None) == p.shape
+                   for l, p in zip(jax.tree_util.tree_leaves(node),
+                                   param_leaves))
+
     def walk(node):
-        if jax.tree_util.tree_structure(node) == params_def:
+        if params_shaped(node):
             return param_specs
         if isinstance(node, tuple):
             sub = [walk(c) for c in node]

@@ -116,6 +116,15 @@ class BertSelfAttention(nn.Module):
             # Same projections as the dense path (identical param tree);
             # only the attention computation changes: a ppermute KV ring
             # over the 'context'-sharded sequence.
+            if self.softmax_dtype != jnp.float32:
+                # ring_attention always computes its online softmax in fp32;
+                # silently upgrading O3's half-softmax contract would make
+                # CP runs incomparable with the dense O3 model (mirror of
+                # _resolve_fused_attention's fp32-softmax gate).
+                raise ValueError(
+                    "context_parallel attention computes fp32 softmax; "
+                    f"softmax_dtype={self.softmax_dtype} (O3 half-softmax) "
+                    "does not compose with it")
             from apex_example_tpu.parallel.context_parallel import (
                 ring_attention)
             if mask_bias is not None:

@@ -102,6 +102,41 @@ class LambState(NamedTuple):
     nu: Any
 
 
+def lamb_step_scalars(lamb: "FusedLAMB", step):
+    """(bias_c1, bias_c2, lr) at ``step`` for a FusedLAMB config — shared by
+    :meth:`FusedLAMB.apply` and the pipeline form
+    (transformer.bert_pipeline.PipelineFusedLAMB), whose contract is that
+    per-layer updates match this module's bitwise."""
+    b1, b2 = lamb.betas
+    t = step.astype(jnp.float32)
+    if lamb.bias_correction:
+        c1 = 1.0 / (1.0 - jnp.power(b1, t))
+        c2 = 1.0 / (1.0 - jnp.power(b2, t))
+    else:
+        c1 = c2 = jnp.asarray(1.0, jnp.float32)
+    return c1, c2, _lr_at(lamb.lr, step)
+
+
+def lamb_clip_scale(lamb: "FusedLAMB", gnorm):
+    """Gradient scale implementing LAMB's global-norm clip, given the
+    (caller-assembled) global grad norm."""
+    return jnp.where(gnorm > lamb.max_grad_norm,
+                     lamb.max_grad_norm / (gnorm + 1e-6), 1.0)
+
+
+def lamb_update_leaf(lamb: "FusedLAMB", p, g, m, v, c1, c2, lr, gscale):
+    """stage1 → per-TENSOR trust ratio → stage2 for one leaf; returns
+    (p', m', v').  Trust ratio: ||p|| / ||u|| when both positive else 1
+    (apex lamb_stage_2 semantics)."""
+    u, mo, vo, p_sq, u_sq = lamb_stage1_leaf(
+        p, g, m, v, beta1=lamb.betas[0], beta2=lamb.betas[1], eps=lamb.eps,
+        weight_decay=lamb.weight_decay, bias_c1=c1, bias_c2=c2,
+        grad_scale=gscale)
+    w_norm, u_norm = jnp.sqrt(p_sq), jnp.sqrt(u_sq)
+    ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+    return lamb_stage2_leaf(p, u, lr * ratio), mo, vo
+
+
 class FusedLAMB:
     """LAMB with the reference's two-stage fused structure.
 
@@ -129,20 +164,11 @@ class FusedLAMB:
     def apply(self, grads, state: LambState, params
               ) -> Tuple[Any, LambState]:
         step = state.step + 1
-        b1, b2 = self.betas
-        t = step.astype(jnp.float32)
-        if self.bias_correction:
-            c1 = 1.0 / (1.0 - jnp.power(b1, t))
-            c2 = 1.0 / (1.0 - jnp.power(b2, t))
-        else:
-            c1 = c2 = jnp.asarray(1.0, jnp.float32)
-        lr = _lr_at(self.lr, step)
+        c1, c2, lr = lamb_step_scalars(self, step)
 
         # Global grad clip on the multi_tensor_l2norm path (SURVEY.md §3.4).
         if self.max_grad_norm and self.max_grad_norm > 0:
-            gnorm = multi_tensor_l2norm(grads)
-            gscale = jnp.where(gnorm > self.max_grad_norm,
-                               self.max_grad_norm / (gnorm + 1e-6), 1.0)
+            gscale = lamb_clip_scale(self, multi_tensor_l2norm(grads))
         else:
             gscale = jnp.asarray(1.0, jnp.float32)
 
@@ -153,17 +179,9 @@ class FusedLAMB:
 
         new_p, new_m, new_v = [], [], []
         for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
-            u, mo, vo, p_sq, u_sq = lamb_stage1_leaf(
-                p, g, m, v, beta1=b1, beta2=b2, eps=self.eps,
-                weight_decay=self.weight_decay, bias_c1=c1, bias_c2=c2,
-                grad_scale=gscale)
-            w_norm, u_norm = jnp.sqrt(p_sq), jnp.sqrt(u_sq)
-            # Trust ratio: ||p|| / ||u|| when both positive else 1 (apex
-            # lamb_stage_2 semantics).
-            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
-                              w_norm / u_norm, 1.0)
-            new_p.append(lamb_stage2_leaf(p, u, lr * ratio))
-            new_m.append(mo), new_v.append(vo)
+            po, mo, vo = lamb_update_leaf(self, p, g, m, v, c1, c2, lr,
+                                          gscale)
+            new_p.append(po), new_m.append(mo), new_v.append(vo)
         unflat = treedef.unflatten
         return unflat(new_p), LambState(step, unflat(new_m), unflat(new_v))
 

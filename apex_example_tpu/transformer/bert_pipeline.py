@@ -25,6 +25,19 @@ Design (TPU-native, *uniform-schedule* form):
 - Data parallelism composes on the 'data' mesh axis: the global batch
   shards over it, per-shard microbatches feed the ring, grads of
   replicated params psum over both axes automatically.
+- Tensor parallelism composes on the 'model' mesh axis (reference:
+  apex.transformer.parallel_state exists precisely to run TP+PP+DP
+  jointly, SURVEY.md:149-151).  TPU-native form: the shard_map is manual
+  over ('pipe', 'data') ONLY (``axis_names``), leaving 'model' an
+  *automatic* axis inside the body — so the stage function runs the same
+  GSPMD TP layers (column/row-parallel, ``tensor_parallel=True``) as the
+  pure-TP path, with their sharding constraints binding to the still-auto
+  model axis and GSPMD inserting the Megatron collectives inside each
+  ring tick.  Stacked layer params shard over BOTH axes: P('pipe') on the
+  stacked dim via in_specs, column/row metadata over 'model' riding along
+  as the arrays' auto-axis sharding.  Embedding and MLM head stay
+  replicated-compute over 'model' (their FLOPs are a rounding error at
+  BERT scale; the encoder is where TP pays).
 
 The param tree is IDENTICAL in content to the dense
 ``models.bert.BertForMaskedLM`` tree (``pack_params``/``unpack_params``
@@ -57,7 +70,8 @@ from apex_example_tpu.engine import TrainState, _wrap_optimizer
 from apex_example_tpu.models.bert import BertForMaskedLM, BertLayer
 from apex_example_tpu.ops.layer_norm import layer_norm
 from apex_example_tpu.ops.xentropy import softmax_cross_entropy
-from apex_example_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+from apex_example_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                            PIPE_AXIS)
 from apex_example_tpu.transformer.pipeline_parallel.schedules import (
     spmd_pipeline)
 
@@ -120,18 +134,49 @@ def _head_loss_sum(rest, y, labels, weights, model: BertForMaskedLM):
     return (ce * weights).sum()
 
 
-def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer
+def _tp_layer_specs(model: BertForMaskedLM):
+    """Per-leaf PartitionSpecs of ONE encoder layer under TP (the flax
+    with_partitioning metadata of the column/row-parallel layers), shaped
+    like an entry of the packed ``layers`` subtree minus the stacked dim."""
+    import flax.linen as nn
+    layer_mod = BertLayer(model.hidden_size, model.num_heads,
+                          model.intermediate_size, model.dtype,
+                          model.param_dtype, model.ln_dtype,
+                          model.softmax_dtype,
+                          fused_attention=model.fused_attention,
+                          tensor_parallel=True,
+                          sequence_parallel=model.sequence_parallel)
+    abs_x = jax.ShapeDtypeStruct((1, 8, model.hidden_size), model.dtype)
+    abs_vars = jax.eval_shape(
+        lambda r, x: layer_mod.init(r, x, None),
+        jax.random.PRNGKey(0), abs_x)
+    return nn.get_partition_spec(abs_vars)["params"]
+
+
+def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer,
+                            model: Optional[BertForMaskedLM] = None
                             ) -> TrainState:
     """NamedSharding pytree for a packed-params TrainState: layers shard
     their stacked dim over 'pipe', everything else replicates, optimizer
     state mirrors its params-shaped fields.  Used both to place the initial
-    state and as the orbax restore template (cf. train.mesh_restore_template
-    for the DP paths)."""
+    state and as the orbax restore template (cf.
+    utils.checkpoint.restore_under_mesh for the DP/ZeRO/CP paths).
+
+    With a ``tensor_parallel`` model, layer leaves additionally shard over
+    'model' per the TP layers' column/row partitioning metadata —
+    P('pipe', …, 'model', …) — the jointly-sharded placement of the TP×PP
+    composition (rest/embedding/head still replicate)."""
     from apex_example_tpu.engine import _opt_state_specs
     tmap = jax.tree_util.tree_map
+    if model is not None and model.tensor_parallel:
+        layer_specs = tmap(lambda s: P(PIPE_AXIS, *tuple(s)),
+                           _tp_layer_specs(model),
+                           is_leaf=lambda v: isinstance(v, P))
+    else:
+        layer_specs = tmap(lambda _: P(PIPE_AXIS), state.params["layers"])
     params_specs = {
         "rest": tmap(lambda _: P(), state.params["rest"]),
-        "layers": tmap(lambda _: P(PIPE_AXIS), state.params["layers"]),
+        "layers": layer_specs,
     }
     abs_params = tmap(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                       state.params)
@@ -143,6 +188,81 @@ def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer
     from jax.sharding import NamedSharding
     return tmap(lambda s: NamedSharding(mesh, s), spec_state,
                 is_leaf=lambda v: isinstance(v, P))
+
+
+class PipelineFusedLAMB:
+    """FusedLAMB for the packed ``{'rest', 'layers'}`` pipeline tree.
+
+    Plain FusedLAMB on the packed tree would be silently wrong twice over
+    (which is why :func:`make_bert_pp_train_step` rejects it): a stacked
+    ``[num_layers, …]`` leaf would get ONE cross-layer trust ratio where
+    the dense model computes one per layer's tensor, and the global
+    gradient-norm clip would see only THIS stage's layer grads.  This
+    wrapper restores the dense semantics exactly:
+
+    - stacked leaves run LAMB stage 1/2 per layer slice (a static unrolled
+      loop over the stage's ``per_stage`` layers — the same per-leaf fused
+      kernels the dense path runs, so trust ratios match it bitwise);
+    - the clip norm is assembled globally: Σ‖g‖² of the (pipe-invariant)
+      rest leaves plus a psum over 'pipe' of the stage-local layer Σ‖g‖².
+
+    ``apply`` must run inside shard_map with ``axis_name`` bound (the PP
+    per-shard step); ``init`` works on any tree and simply mirrors it.
+    Under TP×PP the model axis stays automatic, so the per-layer norms are
+    full logical reductions — GSPMD inserts the model-axis psums.
+    """
+
+    def __init__(self, lamb, axis_name: str = PIPE_AXIS):
+        from apex_example_tpu.optim.fused import FusedLAMB
+        if not isinstance(lamb, FusedLAMB):
+            raise TypeError(f"PipelineFusedLAMB wraps FusedLAMB, got "
+                            f"{type(lamb).__name__}")
+        self.lamb = lamb
+        self.axis_name = axis_name
+
+    def init(self, params):
+        return self.lamb.init(params)
+
+    def apply(self, grads, state, params):
+        from apex_example_tpu.ops.multi_tensor import sqsum_leaf
+        from apex_example_tpu.optim.fused import (LambState, lamb_clip_scale,
+                                                  lamb_step_scalars,
+                                                  lamb_update_leaf)
+        L = self.lamb
+        step = state.step + 1
+        c1, c2, lr = lamb_step_scalars(L, step)
+
+        tleaves = jax.tree_util.tree_leaves
+        if L.max_grad_norm and L.max_grad_norm > 0:
+            rest_sq = sum(sqsum_leaf(g) for g in tleaves(grads["rest"]))
+            layer_sq = sum(sqsum_leaf(g) for g in tleaves(grads["layers"]))
+            # psum → pipe-invariant, so the shared clip scale (and with it
+            # every rest-leaf update) stays invariant too.
+            gscale = lamb_clip_scale(
+                L, jnp.sqrt(rest_sq + lax.psum(layer_sq, self.axis_name)))
+        else:
+            gscale = jnp.asarray(1.0, jnp.float32)
+
+        def one(p, g, m, v):
+            return lamb_update_leaf(L, p, g, m, v, c1, c2, lr, gscale)
+
+        def stacked(p, g, m, v):
+            outs = [one(p[l], g[l], m[l], v[l]) for l in range(p.shape[0])]
+            return tuple(jnp.stack([o[i] for o in outs]) for i in range(3))
+
+        def sweep(fn, sub):
+            flat_p, treedef = jax.tree_util.tree_flatten(params[sub])
+            flat = [treedef.flatten_up_to(t[sub])
+                    for t in (grads, state.mu, state.nu)]
+            outs = [fn(p, g, m, v) for p, g, m, v in zip(flat_p, *flat)]
+            return tuple(treedef.unflatten([o[i] for o in outs])
+                         for i in range(3))
+
+        rp, rm, rv = sweep(one, "rest")
+        sp, sm, sv = sweep(stacked, "layers")
+        return ({"rest": rp, "layers": sp},
+                LambState(step, {"rest": rm, "layers": sm},
+                          {"rest": rv, "layers": sv}))
 
 
 def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
@@ -158,13 +278,33 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     if model.num_layers % S:
         raise ValueError(f"num_layers {model.num_layers} not divisible by "
                          f"pipeline size {S}")
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if model.tensor_parallel and tp <= 1:
+        raise ValueError("tensor_parallel model under PP needs a mesh with "
+                         f"a nontrivial '{MODEL_AXIS}' axis")
+    if tp > 1 and not model.tensor_parallel:
+        raise ValueError(f"mesh has '{MODEL_AXIS}' size {tp} but the model "
+                         "was built without tensor_parallel=True")
     per_stage = model.num_layers // S
+    from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
+    if isinstance(optimizer, FusedLAMB):
+        raise ValueError(
+            "bare FusedLAMB under PP would collapse each stacked "
+            "[num_layers, ...] leaf into ONE cross-layer trust ratio and "
+            "clip on a stage-local grad norm; wrap it in PipelineFusedLAMB")
+    if isinstance(optimizer, FusedNovoGrad):
+        raise ValueError(
+            "FusedNovoGrad under PP would collapse its per-TENSOR second "
+            "moment (EMA of ||g||²) across each stage's stacked layers; "
+            "no pipeline form exists yet")
     opt = _wrap_optimizer(optimizer)
     layer_mod = BertLayer(model.hidden_size, model.num_heads,
                           model.intermediate_size, model.dtype,
                           model.param_dtype, model.ln_dtype,
                           model.softmax_dtype,
-                          fused_attention=model.fused_attention)
+                          fused_attention=model.fused_attention,
+                          tensor_parallel=model.tensor_parallel,
+                          sequence_parallel=model.sequence_parallel)
 
     def per_shard(state: TrainState, batch):
         ids, (labels, weights) = batch
@@ -239,8 +379,16 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     opt_spec = _opt_state_specs(optimizer, probe, params_spec)
     state_spec = TrainState(step=P(), params=params_spec, batch_stats=P(),
                             opt_state=opt_spec, scaler=P())
+    kw = {}
+    if tp > 1:
+        # TP×PP: manual over (pipe, data) only — 'model' (and 'context')
+        # stay automatic, so the TP layers' GSPMD constraints inside the
+        # body bind to them.  The specs name manual axes; the layer leaves'
+        # model-axis sharding rides along from the arrays' placement
+        # (bert_pp_state_shardings).
+        kw["axis_names"] = {PIPE_AXIS, DATA_AXIS}
     sharded = _shard_map(
         per_shard, mesh=mesh,
         in_specs=(state_spec, (P(DATA_AXIS), (P(DATA_AXIS), P(DATA_AXIS)))),
-        out_specs=(state_spec, P()))
+        out_specs=(state_spec, P()), **kw)
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

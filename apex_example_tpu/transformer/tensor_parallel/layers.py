@@ -41,6 +41,15 @@ __all__ = ["ColumnParallelLinear", "RowParallelLinear",
            "param_partition_specs"]
 
 
+def _manual_axes() -> frozenset:
+    """Mesh axes the current trace is *manual* over (bound by an enclosing
+    shard_map).  Empty outside shard_map.  Constraints must not name these:
+    inside the body the arrays are per-shard slices and the axis is already
+    consumed by the shard_map's in_specs."""
+    am = jax.sharding.get_abstract_mesh()
+    return frozenset(getattr(am, "manual_axes", ()) or ())
+
+
 def constrain(x: jnp.ndarray, *spec) -> jnp.ndarray:
     """Sharding-constrain ``x`` against the current parallel_state mesh.
 
@@ -50,19 +59,28 @@ def constrain(x: jnp.ndarray, *spec) -> jnp.ndarray:
     it is how gather_output / the row-parallel reduction point are pinned.
     Axes named in the spec but absent from (or trivial in) the mesh are
     dropped, so layer code can name ``model``/``data`` unconditionally.
+
+    Inside a *partially-manual* shard_map (the TP×PP composition: manual
+    over pipe/data, auto over model) the manual axes are likewise dropped
+    and the constraint binds to the trace's abstract mesh — the same layer
+    code then shards only the still-automatic axes.
     """
     mesh = parallel_state.get_mesh()
     if mesh is None or all(s <= 1 for s in mesh.shape.values()):
         return x
+    manual = _manual_axes()
 
     def live(a):
-        return a if a is None or mesh.shape.get(a, 1) > 1 else None
+        return a if a is None or (mesh.shape.get(a, 1) > 1
+                                  and a not in manual) else None
 
     spec = tuple(
         tuple(filter(None, (live(a) for a in e))) or None
         if isinstance(e, tuple) else live(e)
         for e in spec)
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    target = jax.sharding.get_abstract_mesh() if manual else mesh
+    return jax.lax.with_sharding_constraint(x, NamedSharding(target,
+                                                             P(*spec)))
 
 
 def batch_axis() -> Optional[str]:
@@ -70,9 +88,11 @@ def batch_axis() -> Optional[str]:
 
     Activations in a mixed DP+TP mesh are batch-sharded over ``data``;
     constraints must say so or they would force an all-gather of the batch.
+    None when the data axis is manual (shard_map already split the batch).
     """
     mesh = parallel_state.get_mesh()
-    if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+    if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1 \
+            and DATA_AXIS not in _manual_axes():
         return DATA_AXIS
     return None
 

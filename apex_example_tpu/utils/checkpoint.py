@@ -64,3 +64,32 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+def restore_under_mesh(mgr: CheckpointManager, state: TrainState, mesh,
+                       zero_optimizer=None) -> TrainState:
+    """Restore a checkpoint into a state that will run under ``mesh``.
+
+    The trap (every mesh-resume path hits it): orbax restores INTO the
+    template's shardings, and a fresh ``create_train_state`` template is
+    committed to a single device — a sharded train step would then reject
+    the restored state ("incompatible devices").  Re-place the template
+    replicated over the mesh first (the DP/CP contract: state replicated,
+    batch sharded), then restore.  With a ZeRO ``zero_optimizer``
+    (DistributedFusedAdam), its optimizer state is placed per the
+    optimizer's own ``state_spec()`` — sharded over the data axis — so the
+    restored shards land where the ZeRO step expects them.
+
+    Templates that are ALREADY mesh-placed (the TP/PP paths place theirs
+    via gspmd/bert_pp state shardings) do not need this; restore into them
+    directly.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    sh = jax.tree_util.tree_map(lambda _: rep, state)
+    if zero_optimizer is not None:
+        opt_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), zero_optimizer.state_spec(),
+            is_leaf=lambda v: isinstance(v, P))
+        sh = sh.replace(opt_state=opt_sh)
+    return mgr.restore(jax.device_put(state, sh))

@@ -204,7 +204,7 @@ def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
 
 
 def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
-                            donate: bool = True):
+                            donate: bool = True, grad_accum: int = 1):
     """Ring context-parallel BERT MLM step over a ('data', 'context') mesh
     (train.py --context-parallel) — the long-context training path.
 
@@ -231,8 +231,13 @@ def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
         den = jnp.maximum(jax.lax.psum(weights.sum(), axes), 1.0)
         return num / den
 
+    # grad_accum=K: the engine's microbatch scan splits the LOCAL batch dim;
+    # each microbatch's loss is normalized by ITS OWN global (psum-ed)
+    # masked count, so K-microbatch CP equals K-microbatch dense exactly
+    # (both average per-microbatch globally-normalized losses).
     per_shard = make_train_step(model, optimizer, policy, axis_name=None,
-                                loss_fn=cp_mlm_loss, compute_accuracy=False)
+                                loss_fn=cp_mlm_loss, compute_accuracy=False,
+                                grad_accum=grad_accum)
     sharded = _shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(), (P(DATA_AXIS, CONTEXT_AXIS),
@@ -240,6 +245,37 @@ def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                          P(DATA_AXIS, CONTEXT_AXIS)))),
         out_specs=(P(), P()))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_bert_cp_eval_step(mesh: Mesh, model):
+    """Sequence-sharded held-out eval under the same KV ring as CP training
+    (train.py --context-parallel --eval).
+
+    Without this, the CP path could train at a context length the dense
+    eval forward cannot touch: a single-device eval materializes the
+    (L, L) score tensor CP exists to shard.  Shapes, collectives and the
+    globally psum-normalized loss/masked-acc mirror
+    :func:`make_bert_cp_train_step`'s forward exactly; the metrics are
+    bit-comparable to the dense eval on the same params (tested).
+    """
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+
+    def per_shard(params, batch):
+        ids, (labels, weights) = batch
+        logits = model.apply({"params": params}, ids, train=False)
+        axes = (DATA_AXIS, CONTEXT_AXIS)
+        ce = softmax_cross_entropy(logits, labels)
+        den = jnp.maximum(jax.lax.psum(weights.sum(), axes), 1.0)
+        hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        return {"loss": jax.lax.psum((ce * weights).sum(), axes) / den,
+                "masked_acc": jax.lax.psum((hit * weights).sum(), axes)
+                / den * 100.0}
+
+    spec = P(DATA_AXIS, CONTEXT_AXIS)
+    sharded = _shard_map(per_shard, mesh=mesh,
+                         in_specs=(P(), (spec, (spec, spec))),
+                         out_specs=P())
+    return jax.jit(sharded)
 
 
 def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,

@@ -144,7 +144,7 @@ def test_ddp_resume_through_train_cli(tmp_path, devices8):
     """Resume under a mesh: orbax restores INTO the template's shardings, so
     a single-device-committed template used to make the sharded step raise
     'incompatible devices' on the first post-resume step (found by driving
-    train.py end to end; train.mesh_restore_template is the fix)."""
+    train.py end to end; utils.checkpoint.restore_under_mesh is the fix)."""
     import train as train_mod
     ck = str(tmp_path / "ck")
     base = ["--arch", "resnet18", "--opt-level", "O2", "--sync_bn",
@@ -153,3 +153,34 @@ def test_ddp_resume_through_train_cli(tmp_path, devices8):
     assert train_mod.main(base + ["--epochs", "1",
                                   "--checkpoint-dir", ck]) == 0
     assert train_mod.main(base + ["--epochs", "2", "--resume", ck]) == 0
+
+
+def test_zero_resume_through_train_cli(tmp_path, devices8):
+    """ZeRO resume: restore_under_mesh places the optimizer state per the
+    ZeRO optimizer's own state_spec (data-sharded), so the restored shards
+    land where the sharded step expects them."""
+    import train as train_mod
+    ck = str(tmp_path / "ck")
+    base = ["--arch", "bert_tiny", "--zero", "--opt", "adam",
+            "--opt-level", "O0", "--steps-per-epoch", "2",
+            "--batch-size", "8", "--seq-len", "16", "--print-freq", "1"]
+    assert train_mod.main(base + ["--epochs", "1",
+                                  "--checkpoint-dir", ck]) == 0
+    assert train_mod.main(base + ["--epochs", "2", "--resume", ck]) == 0
+
+
+def test_cp_resume_through_train_cli(tmp_path, devices8):
+    """Context-parallel resume: CP state is replicated, so the replicated
+    restore_under_mesh template is its restore target too."""
+    import train as train_mod
+    from apex_example_tpu.transformer import parallel_state
+    ck = str(tmp_path / "ck")
+    base = ["--arch", "bert_tiny", "--context-parallel", "4",
+            "--opt", "adam", "--opt-level", "O0", "--steps-per-epoch", "2",
+            "--batch-size", "8", "--seq-len", "16", "--print-freq", "1"]
+    try:
+        assert train_mod.main(base + ["--epochs", "1",
+                                      "--checkpoint-dir", ck]) == 0
+        assert train_mod.main(base + ["--epochs", "2", "--resume", ck]) == 0
+    finally:
+        parallel_state.set_mesh(None)

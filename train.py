@@ -46,7 +46,8 @@ from apex_example_tpu.parallel import (DDPConfig, LARC, is_main_process,
                                        make_data_mesh,
                                        maybe_initialize_distributed)
 from apex_example_tpu.utils import AverageMeter, Throughput
-from apex_example_tpu.utils.checkpoint import CheckpointManager
+from apex_example_tpu.utils.checkpoint import (CheckpointManager,
+                                               restore_under_mesh)
 from apex_example_tpu.workloads import (make_sharded_txl_train_step,
                                         make_txl_train_step, mlm_loss)
 
@@ -193,24 +194,6 @@ def make_writer(args):
         return None
     from tensorboardX import SummaryWriter
     return SummaryWriter(args.tensorboard)
-
-
-def mesh_restore_template(state, mesh, zero_optimizer=None):
-    """Resume under a mesh: orbax restores INTO the template's shardings,
-    and a fresh ``create_train_state`` template is committed to a single
-    device — the sharded step would then reject the restored state
-    ("incompatible devices").  Re-place the template replicated over the
-    mesh (ZeRO optimizer state: sharded over the data axis) before restore.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    rep = NamedSharding(mesh, P())
-    sh = jax.tree_util.tree_map(lambda _: rep, state)
-    if zero_optimizer is not None:
-        opt_sh = jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), zero_optimizer.state_spec(),
-            is_leaf=lambda v: isinstance(v, P))
-        sh = sh.replace(opt_state=opt_sh)
-    return jax.device_put(state, sh)
 
 
 def build_optimizer(args):
@@ -377,9 +360,10 @@ def main(argv=None):
     if args.resume:
         rmgr = CheckpointManager(args.resume)
         if n_dev > 1:
-            state = mesh_restore_template(
-                state, mesh, optimizer if args.zero else None)
-        state = rmgr.restore(state)
+            state = restore_under_mesh(
+                rmgr, state, mesh, optimizer if args.zero else None)
+        else:
+            state = rmgr.restore(state)
         start_epoch = int(state.step) // args.steps_per_epoch
         print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
 
@@ -514,32 +498,32 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--context-parallel composes the flash kernel "
                              "inside its KV ring already; drop "
                              "--fused-attention")
-        if args.grad_accum != 1:
-            raise SystemExit("--context-parallel does not compose with "
-                             "--grad-accum")
+        if amp.module_dtypes(policy).softmax != jnp.float32:
+            raise SystemExit("--context-parallel computes fp32 softmax in "
+                             "its KV ring; O3's half-softmax contract does "
+                             "not compose (opt levels O0-O2 only)")
         if args.seq_len % cp:
             raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                              f"--context-parallel {cp}")
-        if args.eval:
-            raise SystemExit("--eval is not wired for --context-parallel "
-                             "(the eval pass runs the dense model on the "
-                             "full sequence — exactly what CP exists to "
-                             "avoid at long context)")
     if pp > 1:
         if not is_bert:
             raise SystemExit("--pipeline-parallel is wired for the BERT "
                              "archs (transformer_xl's recurrence carry "
                              "spans all layers every segment)")
-        if tp > 1 or args.zero:
+        if args.zero:
             raise SystemExit("--pipeline-parallel does not compose with "
-                             "--tensor-parallel/--zero yet; pick one "
-                             "sharding strategy")
-        if args.opt == "lamb" or args.larc:
-            raise SystemExit("--pipeline-parallel is wired for plain --opt "
-                             "adam/sgd: stages hold stacked per-layer "
-                             "params, which would give LAMB/LARC one "
-                             "cross-layer trust ratio instead of per-tensor "
-                             "ratios")
+                             "--zero (ZeRO shards optimizer state over "
+                             "data; PP already shards it over pipe)")
+        if args.larc:
+            raise SystemExit("--larc does not compose with "
+                             "--pipeline-parallel (the LARC wrapper computes "
+                             "per-leaf trust ratios, which collapse on "
+                             "stacked per-layer params; --opt lamb has a "
+                             "PP form that keeps per-layer ratios)")
+        if args.opt == "novograd":
+            raise SystemExit("--opt novograd does not compose with "
+                             "--pipeline-parallel (its per-tensor second "
+                             "moment collapses on stacked per-layer params)")
         if args.grad_accum != 1:
             raise SystemExit("--pipeline-parallel owns microbatching "
                              "(--microbatches); drop --grad-accum")
@@ -553,6 +537,7 @@ def _lm_main_impl(args, policy, scaler):
                              "--tensor-parallel (state shards over data; "
                              "TP shards params over model)")
     if tp > 1:
+        # (pure TP and the TP×PP composition alike)
         if args.sequence_parallel and not is_bert:
             raise SystemExit("--sequence-parallel is wired for the BERT "
                              "archs (transformer_xl's recurrence carry is "
@@ -560,20 +545,12 @@ def _lm_main_impl(args, policy, scaler):
         if args.fused_attention:
             raise SystemExit("--tensor-parallel runs the SPMD-partitionable "
                              "einsum attention; drop --fused-attention")
+    if pp > 1:
         devices = pick_devices(args)
-        if len(devices) % tp:
-            raise SystemExit(f"--tensor-parallel {tp} does not divide "
-                             f"{len(devices)} devices")
-        if args.batch_size % max(1, len(devices) // tp):
-            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
-                             f"by the data-axis size {len(devices) // tp}")
-        n_dev = len(devices)
-    elif pp > 1:
-        devices = pick_devices(args)
-        if len(devices) % pp:
-            raise SystemExit(f"--pipeline-parallel {pp} does not divide "
-                             f"{len(devices)} devices")
-        data = max(1, len(devices) // pp)
+        if len(devices) % (pp * tp):
+            raise SystemExit(f"--pipeline-parallel {pp} x --tensor-parallel "
+                             f"{tp} does not divide {len(devices)} devices")
+        data = max(1, len(devices) // (pp * tp))
         if args.batch_size % data:
             raise SystemExit(f"--batch-size {args.batch_size} not divisible "
                              f"by the data-axis size {data}")
@@ -582,14 +559,28 @@ def _lm_main_impl(args, policy, scaler):
                              f"not divisible by --microbatches "
                              f"{args.microbatches}")
         n_dev = len(devices)
+    elif tp > 1:
+        devices = pick_devices(args)
+        if len(devices) % tp:
+            raise SystemExit(f"--tensor-parallel {tp} does not divide "
+                             f"{len(devices)} devices")
+        if args.batch_size % max(1, len(devices) // tp):
+            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
+                             f"by the data-axis size {len(devices) // tp}")
+        n_dev = len(devices)
     elif cp > 1:
         devices = pick_devices(args)
         if len(devices) % cp:
             raise SystemExit(f"--context-parallel {cp} does not divide "
                              f"{len(devices)} devices")
-        if args.batch_size % max(1, len(devices) // cp):
+        cp_data = max(1, len(devices) // cp)
+        if args.batch_size % cp_data:
             raise SystemExit(f"--batch-size {args.batch_size} not divisible "
-                             f"by the data-axis size {len(devices) // cp}")
+                             f"by the data-axis size {cp_data}")
+        if (args.batch_size // cp_data) % args.grad_accum:
+            raise SystemExit(f"per-shard batch {args.batch_size // cp_data} "
+                             f"not divisible by --grad-accum "
+                             f"{args.grad_accum}")
         n_dev = len(devices)
     else:
         devices = select_devices(args)
@@ -646,7 +637,58 @@ def _lm_main_impl(args, policy, scaler):
     eval_batch_fn = batch_fn
 
     sample = batch_fn(0)[0]
-    if tp > 1:
+    if pp > 1:
+        # Pipeline parallelism: encoder layers stacked and sharded over the
+        # 'pipe' mesh axis, driven by the SPMD ring schedule
+        # (transformer/bert_pipeline.py); remaining devices data-parallel.
+        # With --tensor-parallel the layer leaves ALSO shard over 'model'
+        # and the shard_map stays manual over (pipe, data) only, so the
+        # GSPMD TP layers run inside each ring tick (the reference's
+        # parallel_state exists precisely to run TP+PP+DP jointly,
+        # SURVEY.md:149-151).
+        from apex_example_tpu.engine import TrainState
+        from apex_example_tpu.ops import _config as ops_config
+        from apex_example_tpu.transformer import parallel_state
+        from apex_example_tpu.transformer.bert_pipeline import (
+            PipelineFusedLAMB, bert_pp_state_shardings,
+            make_bert_pp_train_step, pack_params)
+        if args.opt == "lamb":
+            # C4's optimizer rides the pipeline with per-LAYER trust ratios
+            # and a pipe-global clip norm (bare FusedLAMB would collapse
+            # both on the stacked per-stage params).
+            optimizer = PipelineFusedLAMB(optimizer)
+        if tp > 1:
+            # Pallas custom calls are opaque to the SPMD partitioner; the
+            # model axis stays automatic inside the PP shard_map, so pin
+            # the XLA reference ops (restored by lm_main's outer finally).
+            ops_config.set_force_xla(True)
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_parallel=tp, pipeline_parallel=pp, devices=devices)
+        if model.num_layers % pp:
+            raise SystemExit(f"--pipeline-parallel {pp} does not divide "
+                             f"{model.num_layers} encoder layers")
+        # jit the init: under a traced program the TP layers' batch-axis
+        # constraints tolerate the size-1 init sample (GSPMD pads); the
+        # eager path would reject 1 % data != 0.
+        dense_state = jax.jit(
+            lambda r: create_train_state(r, model, optimizer, sample[:1],
+                                         policy, scaler)
+        )(jax.random.PRNGKey(args.seed))
+        packed = pack_params(dense_state.params, model.num_layers)
+        state = TrainState(step=dense_state.step, params=packed,
+                           batch_stats={},
+                           opt_state=optimizer.init(packed),
+                           scaler=dense_state.scaler)
+        state = jax.device_put(
+            state, bert_pp_state_shardings(mesh, state, optimizer,
+                                           model=model))
+        step_fn = make_bert_pp_train_step(mesh, model, optimizer, policy,
+                                          microbatches=args.microbatches)
+        mems = None
+        print(f"PP over {pp} stages, TP over {tp}, DP over "
+              f"{n_dev // (pp * tp)}, {args.microbatches} "
+              f"microbatches/shard: {mesh}")
+    elif tp > 1:
         # GSPMD tensor parallelism: one (pipe, data, context, model) mesh,
         # params carrying the TP layers' partitioning metadata, the plain
         # single-device step jitted with those shardings — collectives are
@@ -679,34 +721,6 @@ def _lm_main_impl(args, policy, scaler):
                 grad_accum=args.grad_accum)
             mems = model.init_mems(args.batch_size)
         print(f"TP over {tp} devices, DP over {n_dev // tp}: {mesh}")
-    elif pp > 1:
-        # Pipeline parallelism: encoder layers stacked and sharded over the
-        # 'pipe' mesh axis, driven by the SPMD ring schedule
-        # (transformer/bert_pipeline.py); remaining devices data-parallel.
-        from apex_example_tpu.engine import TrainState
-        from apex_example_tpu.transformer import parallel_state
-        from apex_example_tpu.transformer.bert_pipeline import (
-            bert_pp_state_shardings, make_bert_pp_train_step, pack_params)
-        mesh = parallel_state.initialize_model_parallel(
-            pipeline_parallel=pp, devices=devices)
-        if model.num_layers % pp:
-            raise SystemExit(f"--pipeline-parallel {pp} does not divide "
-                             f"{model.num_layers} encoder layers")
-        dense_state = create_train_state(jax.random.PRNGKey(args.seed),
-                                         model, optimizer, sample[:1],
-                                         policy, scaler)
-        packed = pack_params(dense_state.params, model.num_layers)
-        state = TrainState(step=dense_state.step, params=packed,
-                           batch_stats={},
-                           opt_state=optimizer.init(packed),
-                           scaler=dense_state.scaler)
-        state = jax.device_put(
-            state, bert_pp_state_shardings(mesh, state, optimizer))
-        step_fn = make_bert_pp_train_step(mesh, model, optimizer, policy,
-                                          microbatches=args.microbatches)
-        mems = None
-        print(f"PP over {pp} stages, DP over {n_dev // pp}, "
-              f"{args.microbatches} microbatches/shard: {mesh}")
     elif cp > 1:
         # Ring context parallelism: init via the DENSE twin (identical param
         # tree; the CP module's collectives only trace inside shard_map),
@@ -718,7 +732,8 @@ def _lm_main_impl(args, policy, scaler):
         model_cp = builder(**mkw, context_parallel=True)
         state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                    optimizer, sample[:1], policy, scaler)
-        step_fn = make_bert_cp_train_step(mesh, model_cp, optimizer, policy)
+        step_fn = make_bert_cp_train_step(mesh, model_cp, optimizer, policy,
+                                          grad_accum=args.grad_accum)
         mems = None
         print(f"CP over {cp} sequence shards (local seq "
               f"{args.seq_len // cp}), DP over {n_dev // cp}: {mesh}")
@@ -769,14 +784,21 @@ def _lm_main_impl(args, policy, scaler):
         from apex_example_tpu.workloads import (make_bert_eval_step,
                                                 make_txl_eval_step)
         if is_bert:
-            core = make_bert_eval_step(model)
-            if pp > 1:
+            if cp > 1:
+                # Sequence-sharded eval under the same KV ring as training
+                # — held-out loss AT the training context length (a dense
+                # eval forward would materialize the (L, L) scores CP
+                # exists to shard).
+                from apex_example_tpu.workloads import make_bert_cp_eval_step
+                eval_fn = make_bert_cp_eval_step(mesh, model_cp)
+            elif pp > 1:
                 from apex_example_tpu.transformer.bert_pipeline import (
                     unpack_params)
+                core = make_bert_eval_step(model)
                 eval_fn = jax.jit(lambda p, b: core(
                     unpack_params(p, model.num_layers), b))
             else:
-                eval_fn = jax.jit(core)
+                eval_fn = jax.jit(make_bert_eval_step(model))
         else:
             eval_fn = jax.jit(make_txl_eval_step(model))
 
@@ -791,9 +813,11 @@ def _lm_main_impl(args, policy, scaler):
             # (tp/pp > 1 templates are already mesh-placed above; DP and CP
             # templates are not — CP state is replicated, so the replicated
             # template is the right restore target for it too.)
-            state = mesh_restore_template(
-                state, mesh, optimizer if args.zero else None)
-        state = CheckpointManager(args.resume).restore(state)
+            state = restore_under_mesh(
+                CheckpointManager(args.resume), state, mesh,
+                optimizer if args.zero else None)
+        else:
+            state = CheckpointManager(args.resume).restore(state)
         start_epoch = int(state.step) // args.steps_per_epoch
         print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
 
