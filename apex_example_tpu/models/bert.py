@@ -244,9 +244,10 @@ class BertForMaskedLM(nn.Module):
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
                  train: bool = True):
         del train  # no dropout in the pretraining benchmark path
-        if self.tensor_parallel and self.context_parallel:
-            raise ValueError("tensor_parallel and context_parallel do not "
-                             "compose yet (GSPMD vs shard_map forms)")
+        if self.sequence_parallel and self.context_parallel:
+            raise ValueError("sequence_parallel shards activations along "
+                             "the sequence dim the context axis already "
+                             "owns; CP composes with plain tensor_parallel")
         if self.context_parallel and attention_mask is not None:
             raise ValueError("context_parallel BERT does not support an "
                              "attention mask")

@@ -68,6 +68,23 @@ def initialize_model_parallel(tensor_parallel: int = 1,
     return Mesh(arr, (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS))
 
 
+def require_model_axis_match(mesh: Mesh, model_is_tp: bool) -> int:
+    """Validate a model's ``tensor_parallel`` flag against the mesh's
+    'model' axis; returns that axis's size.  Shared by the partially-manual
+    compositions (TP×PP in transformer/bert_pipeline.py, CP×TP in
+    workloads.py): both leave 'model' automatic inside shard_map, so a
+    flag/mesh mismatch would otherwise fail far from its cause (or
+    silently train unsharded)."""
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if tp > 1 and not model_is_tp:
+        raise ValueError(f"mesh has '{MODEL_AXIS}' size {tp} but the model "
+                         "was built without tensor_parallel=True")
+    if model_is_tp and tp <= 1:
+        raise ValueError("tensor_parallel model needs a mesh with a "
+                         f"nontrivial '{MODEL_AXIS}' axis")
+    return tp
+
+
 def data_sharding(mesh: Mesh, *batch_axes: int, ndim: int = None):
     """NamedSharding that splits axis 0 (the batch) over ``data``."""
     spec = [None] * (ndim if ndim is not None else max(batch_axes, default=0) + 1)

@@ -70,8 +70,7 @@ from apex_example_tpu.engine import TrainState, _wrap_optimizer
 from apex_example_tpu.models.bert import BertForMaskedLM, BertLayer
 from apex_example_tpu.ops.layer_norm import layer_norm
 from apex_example_tpu.ops.xentropy import softmax_cross_entropy
-from apex_example_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
-                                            PIPE_AXIS)
+from apex_example_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 from apex_example_tpu.transformer.pipeline_parallel.schedules import (
     spmd_pipeline)
 
@@ -278,13 +277,8 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     if model.num_layers % S:
         raise ValueError(f"num_layers {model.num_layers} not divisible by "
                          f"pipeline size {S}")
-    tp = mesh.shape.get(MODEL_AXIS, 1)
-    if model.tensor_parallel and tp <= 1:
-        raise ValueError("tensor_parallel model under PP needs a mesh with "
-                         f"a nontrivial '{MODEL_AXIS}' axis")
-    if tp > 1 and not model.tensor_parallel:
-        raise ValueError(f"mesh has '{MODEL_AXIS}' size {tp} but the model "
-                         "was built without tensor_parallel=True")
+    from apex_example_tpu.parallel.mesh import require_model_axis_match
+    tp = require_model_axis_match(mesh, model.tensor_parallel)
     per_stage = model.num_layers // S
     from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
     if isinstance(optimizer, FusedLAMB):
@@ -386,6 +380,11 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         # body bind to them.  The specs name manual axes; the layer leaves'
         # model-axis sharding rides along from the arrays' placement
         # (bert_pp_state_shardings).
+        if not hasattr(jax, "shard_map"):  # pragma: no cover
+            raise RuntimeError(
+                "the TP×PP composition needs jax.shard_map's axis_names "
+                "(jax >= 0.7); the jax.experimental fallback cannot "
+                "express a partially-manual mesh")
         kw["axis_names"] = {PIPE_AXIS, DATA_AXIS}
     sharded = _shard_map(
         per_shard, mesh=mesh,

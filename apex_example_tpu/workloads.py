@@ -204,7 +204,8 @@ def make_sharded_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
 
 
 def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
-                            donate: bool = True, grad_accum: int = 1):
+                            donate: bool = True, grad_accum: int = 1,
+                            state_shardings=None):
     """Ring context-parallel BERT MLM step over a ('data', 'context') mesh
     (train.py --context-parallel) — the long-context training path.
 
@@ -243,8 +244,35 @@ def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
         in_specs=(P(), (P(DATA_AXIS, CONTEXT_AXIS),
                         (P(DATA_AXIS, CONTEXT_AXIS),
                          P(DATA_AXIS, CONTEXT_AXIS)))),
-        out_specs=(P(), P()))
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+        out_specs=(P(), P()), **_cp_axis_names(mesh, model))
+    jkw = {}
+    if state_shardings is not None:
+        # CP×TP: pin the returned state to its model-axis placement
+        # (engine.gspmd_state_shardings) — the shard_map's out_specs only
+        # govern the MANUAL axes, and with 'model' automatic the compiler
+        # would otherwise be free to hand the updated params back
+        # replicated, silently losing the TP sharding after one step.
+        from jax.sharding import NamedSharding
+        jkw["out_shardings"] = (state_shardings, NamedSharding(mesh, P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else (), **jkw)
+
+
+def _cp_axis_names(mesh: Mesh, model) -> dict:
+    """shard_map kwargs for the CP step: with a nontrivial 'model' axis the
+    map goes manual over (data, context) ONLY, leaving 'model' automatic so
+    the GSPMD TP layers (tensor_parallel=True) run inside the ring — the
+    same partially-manual composition the TP×PP path uses
+    (transformer/bert_pipeline.py).  Param model-axis shardings ride along
+    from the arrays' placement (engine.gspmd_state_shardings)."""
+    from apex_example_tpu.parallel.mesh import (CONTEXT_AXIS,
+                                                require_model_axis_match)
+    tp = require_model_axis_match(mesh, model.tensor_parallel)
+    if tp > 1 and not hasattr(jax, "shard_map"):  # pragma: no cover
+        raise RuntimeError(
+            "the CP×TP composition needs jax.shard_map's axis_names "
+            "(jax >= 0.7); the jax.experimental fallback cannot express "
+            "a partially-manual mesh")
+    return {"axis_names": {DATA_AXIS, CONTEXT_AXIS}} if tp > 1 else {}
 
 
 def make_bert_cp_eval_step(mesh: Mesh, model):
@@ -274,7 +302,7 @@ def make_bert_cp_eval_step(mesh: Mesh, model):
     spec = P(DATA_AXIS, CONTEXT_AXIS)
     sharded = _shard_map(per_shard, mesh=mesh,
                          in_specs=(P(), (spec, (spec, spec))),
-                         out_specs=P())
+                         out_specs=P(), **_cp_axis_names(mesh, model))
     return jax.jit(sharded)
 
 

@@ -490,10 +490,14 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--context-parallel is wired for the BERT "
                              "archs (transformer_xl's long-context story "
                              "is its segment recurrence)")
-        if tp > 1 or pp > 1 or args.zero:
+        if pp > 1 or args.zero:
             raise SystemExit("--context-parallel does not compose with "
-                             "--tensor-parallel/--pipeline-parallel/--zero "
-                             "yet; pick one sharding strategy")
+                             "--pipeline-parallel/--zero yet")
+        if args.sequence_parallel:
+            raise SystemExit("--sequence-parallel shards activations along "
+                             "the sequence dim --context-parallel already "
+                             "owns; CP composes with plain "
+                             "--tensor-parallel")
         if args.fused_attention:
             raise SystemExit("--context-parallel composes the flash kernel "
                              "inside its KV ring already; drop "
@@ -545,40 +549,25 @@ def _lm_main_impl(args, policy, scaler):
         if args.fused_attention:
             raise SystemExit("--tensor-parallel runs the SPMD-partitionable "
                              "einsum attention; drop --fused-attention")
-    if pp > 1:
+    if tp > 1 or pp > 1 or cp > 1:
+        # One shared shard-arithmetic check for every model-parallel
+        # composition: the data axis absorbs what pp*tp*cp leaves over
+        # (mesh.initialize_model_parallel's contract).
         devices = pick_devices(args)
-        if len(devices) % (pp * tp):
-            raise SystemExit(f"--pipeline-parallel {pp} x --tensor-parallel "
-                             f"{tp} does not divide {len(devices)} devices")
-        data = max(1, len(devices) // (pp * tp))
+        denom = pp * tp * cp
+        if len(devices) % denom:
+            raise SystemExit(f"pp {pp} x tp {tp} x cp {cp} = {denom} does "
+                             f"not divide {len(devices)} devices")
+        data = max(1, len(devices) // denom)
         if args.batch_size % data:
             raise SystemExit(f"--batch-size {args.batch_size} not divisible "
                              f"by the data-axis size {data}")
-        if (args.batch_size // data) % args.microbatches:
+        if pp > 1 and (args.batch_size // data) % args.microbatches:
             raise SystemExit(f"per-shard batch {args.batch_size // data} "
                              f"not divisible by --microbatches "
                              f"{args.microbatches}")
-        n_dev = len(devices)
-    elif tp > 1:
-        devices = pick_devices(args)
-        if len(devices) % tp:
-            raise SystemExit(f"--tensor-parallel {tp} does not divide "
-                             f"{len(devices)} devices")
-        if args.batch_size % max(1, len(devices) // tp):
-            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
-                             f"by the data-axis size {len(devices) // tp}")
-        n_dev = len(devices)
-    elif cp > 1:
-        devices = pick_devices(args)
-        if len(devices) % cp:
-            raise SystemExit(f"--context-parallel {cp} does not divide "
-                             f"{len(devices)} devices")
-        cp_data = max(1, len(devices) // cp)
-        if args.batch_size % cp_data:
-            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
-                             f"by the data-axis size {cp_data}")
-        if (args.batch_size // cp_data) % args.grad_accum:
-            raise SystemExit(f"per-shard batch {args.batch_size // cp_data} "
+        if cp > 1 and (args.batch_size // data) % args.grad_accum:
+            raise SystemExit(f"per-shard batch {args.batch_size // data} "
                              f"not divisible by --grad-accum "
                              f"{args.grad_accum}")
         n_dev = len(devices)
@@ -688,7 +677,7 @@ def _lm_main_impl(args, policy, scaler):
         print(f"PP over {pp} stages, TP over {tp}, DP over "
               f"{n_dev // (pp * tp)}, {args.microbatches} "
               f"microbatches/shard: {mesh}")
-    elif tp > 1:
+    elif tp > 1 and cp == 1:
         # GSPMD tensor parallelism: one (pipe, data, context, model) mesh,
         # params carrying the TP layers' partitioning metadata, the plain
         # single-device step jitted with those shardings — collectives are
@@ -722,21 +711,38 @@ def _lm_main_impl(args, policy, scaler):
             mems = model.init_mems(args.batch_size)
         print(f"TP over {tp} devices, DP over {n_dev // tp}: {mesh}")
     elif cp > 1:
-        # Ring context parallelism: init via the DENSE twin (identical param
-        # tree; the CP module's collectives only trace inside shard_map),
-        # step from the CP twin (workloads.make_bert_cp_train_step).
+        # Ring context parallelism: init via the twin WITHOUT
+        # context_parallel (identical param tree; the CP module's
+        # collectives only trace inside shard_map), step from the CP twin
+        # (workloads.make_bert_cp_train_step).  With --tensor-parallel the
+        # shard_map stays manual over (data, context) only and the GSPMD
+        # TP layers run inside the KV ring (model axis automatic; the same
+        # partially-manual composition as TP×PP) — long context AND wide
+        # models jointly.
+        from apex_example_tpu.ops import _config as ops_config
         from apex_example_tpu.transformer import parallel_state
         from apex_example_tpu.workloads import make_bert_cp_train_step
+        if tp > 1:
+            ops_config.set_force_xla(True)
         mesh = parallel_state.initialize_model_parallel(
-            context_parallel=cp, devices=devices)
+            tensor_parallel=tp, context_parallel=cp, devices=devices)
         model_cp = builder(**mkw, context_parallel=True)
-        state = create_train_state(jax.random.PRNGKey(args.seed), model,
-                                   optimizer, sample[:1], policy, scaler)
+        cp_shardings = None
+        if tp > 1:
+            from apex_example_tpu.engine import create_gspmd_train_state
+            state, cp_shardings = create_gspmd_train_state(
+                jax.random.PRNGKey(args.seed), mesh, model, optimizer,
+                sample[:1], policy, scaler)
+        else:
+            state = create_train_state(jax.random.PRNGKey(args.seed), model,
+                                       optimizer, sample[:1], policy, scaler)
         step_fn = make_bert_cp_train_step(mesh, model_cp, optimizer, policy,
-                                          grad_accum=args.grad_accum)
+                                          grad_accum=args.grad_accum,
+                                          state_shardings=cp_shardings)
         mems = None
         print(f"CP over {cp} sequence shards (local seq "
-              f"{args.seq_len // cp}), DP over {n_dev // cp}: {mesh}")
+              f"{args.seq_len // cp}), TP over {tp}, DP over "
+              f"{n_dev // (cp * tp)}: {mesh}")
     else:
         state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                    optimizer, sample[:1], policy, scaler,
