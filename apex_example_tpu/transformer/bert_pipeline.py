@@ -72,7 +72,7 @@ from apex_example_tpu.ops.layer_norm import layer_norm
 from apex_example_tpu.ops.xentropy import softmax_cross_entropy
 from apex_example_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 from apex_example_tpu.transformer.pipeline_parallel.schedules import (
-    spmd_pipeline)
+    pipeline_1f1b, spmd_pipeline)
 
 try:
     from jax import shard_map as _shard_map
@@ -97,6 +97,49 @@ def unpack_params(packed: Dict[str, Any], num_layers: int) -> Dict[str, Any]:
     for i in range(num_layers):
         out[f"layer_{i}"] = jax.tree_util.tree_map(
             lambda x: x[i], packed["layers"])
+    return out
+
+
+def _1f1b_order(num_layers: int, stages: int, num_chunks: int):
+    """Dense-layer index for each (stage, chunk, slot): global stage
+    v·S+s owns the contiguous dense block [(v·S+s)·per, +per) — the
+    interleaved-virtual-stage assignment (device s holds chunks {v·S+s})."""
+    if num_layers % (stages * num_chunks):
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by stages {stages} x "
+            f"chunks {num_chunks} — layers would be silently dropped")
+    per = num_layers // (stages * num_chunks)
+    return [[(v * stages + s) * per + i
+             for v in range(num_chunks) for i in range(per)]
+            for s in range(stages)], per
+
+
+def pack_params_1f1b(dense_params: Dict[str, Any], num_layers: int,
+                     stages: int, num_chunks: int = 1) -> Dict[str, Any]:
+    """Dense tree -> {'rest', 'layers'} ARRANGED for the 1F1B schedules:
+    layer leaves are [S, V, per, ...] with [s, v, i] holding dense layer
+    (v·S+s)·per + i, so a P('pipe') shard hands device s exactly its
+    chunks.  (The ring pack's contiguous [num_layers, ...] stack cannot
+    express the interleaved assignment — chunk v·S+s for v>0 is not a
+    contiguous slice of device s's shard.)"""
+    order, per = _1f1b_order(num_layers, stages, num_chunks)
+    rows = [jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs).reshape(num_chunks, per, *xs[0].shape),
+        *[dense_params[f"layer_{j}"] for j in row]) for row in order]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *rows)
+    return {"rest": {k: dense_params[k] for k in _REST_KEYS},
+            "layers": stacked}
+
+
+def unpack_params_1f1b(packed: Dict[str, Any], num_layers: int,
+                       stages: int, num_chunks: int = 1) -> Dict[str, Any]:
+    out = dict(packed["rest"])
+    order, per = _1f1b_order(num_layers, stages, num_chunks)
+    for s, row in enumerate(order):
+        for slot, j in enumerate(row):
+            v, i = divmod(slot, per)
+            out[f"layer_{j}"] = jax.tree_util.tree_map(
+                lambda x, s=s, v=v, i=i: x[s, v, i], packed["layers"])
     return out
 
 
@@ -211,13 +254,19 @@ class PipelineFusedLAMB:
     full logical reductions — GSPMD inserts the model-axis psums.
     """
 
-    def __init__(self, lamb, axis_name: str = PIPE_AXIS):
+    def __init__(self, lamb, axis_name: str = PIPE_AXIS,
+                 stacked_dims: int = 1):
         from apex_example_tpu.optim.fused import FusedLAMB
         if not isinstance(lamb, FusedLAMB):
             raise TypeError(f"PipelineFusedLAMB wraps FusedLAMB, got "
                             f"{type(lamb).__name__}")
         self.lamb = lamb
         self.axis_name = axis_name
+        # Leading per-layer index dims on each stacked leaf: 1 for the ring
+        # pack ([num_layers, ...]), 3 for the 1F1B arranged pack
+        # ([S, V, per, ...]) — every one of them must be unrolled or a
+        # whole [V, per] block would share one trust ratio.
+        self.stacked_dims = stacked_dims
 
     def init(self, params):
         return self.lamb.init(params)
@@ -246,8 +295,16 @@ class PipelineFusedLAMB:
             return lamb_update_leaf(L, p, g, m, v, c1, c2, lr, gscale)
 
         def stacked(p, g, m, v):
-            outs = [one(p[l], g[l], m[l], v[l]) for l in range(p.shape[0])]
-            return tuple(jnp.stack([o[i] for o in outs]) for i in range(3))
+            lead = p.shape[:self.stacked_dims]
+            n = 1
+            for s in lead:
+                n *= s
+            rs = lambda t: t.reshape((n,) + p.shape[self.stacked_dims:])
+            pf, gf, mf, vf = rs(p), rs(g), rs(m), rs(v)
+            outs = [one(pf[l], gf[l], mf[l], vf[l]) for l in range(n)]
+            return tuple(
+                jnp.stack([o[i] for o in outs]).reshape(p.shape)
+                for i in range(3))
 
         def sweep(fn, sub):
             flat_p, treedef = jax.tree_util.tree_flatten(params[sub])
@@ -266,20 +323,49 @@ class PipelineFusedLAMB:
 
 def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                             policy: Policy, microbatches: int,
-                            donate: bool = True):
+                            donate: bool = True, schedule: str = "ring",
+                            num_chunks: int = 1):
     """Jitted (state, (ids, (labels, weights))) -> (state, metrics) over a
     ('pipe', 'data') mesh.  ``state.params`` is the packed tree with
-    ``layers`` leaves carrying the leading [num_layers] stacked dim (shard
+    ``layers`` leaves carrying a leading stacked-stage dim (shard
     P('pipe')); batch shards over 'data' and is split into ``microbatches``
     ring slots per shard.
+
+    ``schedule`` picks the pipeline program (all three trajectory-match
+    the dense model; reference: the three apex schedule entry points):
+
+    - "ring" (default): the SPMD ring (:func:`schedules.spmd_pipeline`),
+      backward derived by autodiff.  State layout: ``pack_params``'s
+      [num_layers, ...] stack.  The only schedule that composes with
+      tensor parallelism.
+    - "1f1b": TRUE 1F1B (:func:`schedules.pipeline_1f1b`) — bounded
+      in-flight activations independent of the microbatch count.
+      Embedding runs batched OUTSIDE the schedule (its backward completes
+      through the returned input cotangents); the parametrized head rides
+      the loss cell via ``head_params``.  State layout:
+      ``pack_params_1f1b``'s arranged [S, V, per, ...] stack.
+    - "interleaved": 1F1B with ``num_chunks`` virtual stages per device
+      (the reference's interleaved variant; needs microbatches % S == 0
+      and num_layers % (S·num_chunks) == 0).
     """
     S = mesh.shape[PIPE_AXIS]
-    if model.num_layers % S:
+    if schedule not in ("ring", "1f1b", "interleaved"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    V = num_chunks if schedule == "interleaved" else 1
+    if schedule == "interleaved" and num_chunks < 2:
+        raise ValueError("interleaved schedule needs num_chunks >= 2")
+    if model.num_layers % (S * V):
         raise ValueError(f"num_layers {model.num_layers} not divisible by "
-                         f"pipeline size {S}")
+                         f"pipeline size {S} x chunks {V}")
     from apex_example_tpu.parallel.mesh import require_model_axis_match
     tp = require_model_axis_match(mesh, model.tensor_parallel)
-    per_stage = model.num_layers // S
+    if tp > 1 and schedule != "ring":
+        raise ValueError(
+            "tensor parallelism composes with the ring schedule only: the "
+            "1F1B schedules run stage cells inside lax.cond with per-stage "
+            "predicates, where the TP layers' auto-axis collectives cannot "
+            "live")
+    per_stage = model.num_layers // (S * V)
     from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
     if isinstance(optimizer, FusedLAMB):
         raise ValueError(
@@ -300,44 +386,30 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                           tensor_parallel=model.tensor_parallel,
                           sequence_parallel=model.sequence_parallel)
 
-    def per_shard(state: TrainState, batch):
-        ids, (labels, weights) = batch
+    def stage_fn(stage_layers, x):
+        # stage_layers leaves: [per_stage, ...] — scan applies them in
+        # order (this stage's contiguous block of encoder layers).  The
+        # injected activation is pipe-invariant while the layer params
+        # vary over pipe; align the scan carry's vma typing up front.
+        if PIPE_AXIS not in getattr(jax.typeof(x), "vma", frozenset()):
+            x = lax.pcast(x, PIPE_AXIS, to="varying")
+
+        def body(h, p):
+            return layer_mod.apply({"params": p}, h, None), None
+        y, _ = lax.scan(body, x, stage_layers)
+        return y
+
+    def _split(ids):
         M = microbatches
         b = ids.shape[0]
         if b % M:
             raise ValueError(f"per-shard batch {b} not divisible by "
                              f"microbatches {M}")
-        mb = lambda a: a.reshape(M, b // M, *a.shape[1:])
+        return M, b, lambda a: a.reshape(M, b // M, *a.shape[1:])
 
-        def stage_fn(stage_layers, x):
-            # stage_layers leaves: [per_stage, ...] — scan applies them in
-            # order (this stage's contiguous block of encoder layers).  The
-            # injected activation is pipe-invariant while the layer params
-            # vary over pipe; align the scan carry's vma typing up front.
-            if PIPE_AXIS not in getattr(jax.typeof(x), "vma", frozenset()):
-                x = lax.pcast(x, PIPE_AXIS, to="varying")
-
-            def body(h, p):
-                return layer_mod.apply({"params": p}, h, None), None
-            y, _ = lax.scan(body, x, stage_layers)
-            return y
-
-        def scaled_loss_fn(params):
-            rest = params["rest"]
-            x = _embed(rest, ids, model)          # replicated compute
-            # Global masked-position denominator: per-microbatch SUMS ride
-            # the schedule (scaled by M to cancel its mean), the psum stitches
-            # the shards — the result equals mlm_loss on the full batch.
-            denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
-            loss = spmd_pipeline(
-                stage_fn,
-                lambda y, tgt: _head_loss_sum(rest, y, tgt[0], tgt[1],
-                                              model) * M / denom,
-                params["layers"], mb(x), (mb(labels), mb(weights)))
-            loss = lax.psum(loss, DATA_AXIS)
-            return amp_lib.scale_loss(loss, state.scaler), loss
-
-        grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
+    def finish(state: TrainState, grads, loss):
+        """Unscale → (all-or-none) update → scaler bookkeeping — shared by
+        every schedule's per-shard step."""
         grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
         # layers grads vary over 'pipe' (each stage owns its block), so the
         # all-leaves finite flag does too; make it mesh-invariant for the
@@ -360,6 +432,76 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         return TrainState(step=state.step + 1, params=new_params,
                           batch_stats=state.batch_stats,
                           opt_state=new_opt_state, scaler=scaler), metrics
+
+    def per_shard_ring(state: TrainState, batch):
+        ids, (labels, weights) = batch
+        M, b, mb = _split(ids)
+
+        def scaled_loss_fn(params):
+            rest = params["rest"]
+            x = _embed(rest, ids, model)          # replicated compute
+            # Global masked-position denominator: per-microbatch SUMS ride
+            # the schedule (scaled by M to cancel its mean), the psum stitches
+            # the shards — the result equals mlm_loss on the full batch.
+            denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
+            loss = spmd_pipeline(
+                stage_fn,
+                lambda y, tgt: _head_loss_sum(rest, y, tgt[0], tgt[1],
+                                              model) * M / denom,
+                params["layers"], mb(x), (mb(labels), mb(weights)))
+            loss = lax.psum(loss, DATA_AXIS)
+            return amp_lib.scale_loss(loss, state.scaler), loss
+
+        grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
+        return finish(state, grads, loss)
+
+    def per_shard_1f1b(state: TrainState, batch):
+        """True-1F1B/interleaved cell: the schedule is a VALUE program
+        (manual vjp per tick), so the embedding/head backward is assembled
+        around it — embed batched outside with its vjp saved, head params
+        ride the loss cell, and the schedule's returned input cotangents
+        close the embedding chain.  Data-axis grad reduction is implicit:
+        params enter data-INVARIANT, so each vjp's AD inserts the data
+        psum (safe inside the schedule's cond — the action tables vary
+        over 'pipe' only, every data shard takes the same branch); the
+        pipe axis, over which the predicates DO vary, is kept local and
+        reduced with the two explicit psums below."""
+        ids, (labels, weights) = batch
+        M, b, mb = _split(ids)
+        rest = state.params["rest"]
+        x, vjp_embed = jax.vjp(lambda r: _embed(r, ids, model), rest)
+        denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
+
+        def last_fn(hp, y, tgt):
+            raw = _head_loss_sum(hp, y, tgt[0], tgt[1], model) * M / denom
+            return amp_lib.scale_loss(raw, state.scaler)
+
+        layers = jax.tree_util.tree_map(lambda l: l[0],
+                                        state.params["layers"])  # [V, …]
+        if V == 1:
+            layers = jax.tree_util.tree_map(lambda l: l[0], layers)
+        sloss, glayers, ghead, dxa = pipeline_1f1b(
+            stage_fn, last_fn, layers, mb(x),
+            (mb(labels), mb(weights)), num_chunks=V, head_params=rest)
+        if V == 1:
+            glayers = jax.tree_util.tree_map(lambda g: g[None], glayers)
+        glayers = jax.tree_util.tree_map(lambda g: g[None], glayers)
+        # Cross-pipe collection: head grads live on the last stage, input
+        # cotangents on stage 0 — exact zeros elsewhere.
+        ghead = jax.tree_util.tree_map(lambda g: lax.psum(g, PIPE_AXIS),
+                                       ghead)
+        dxa = lax.psum(dxa, PIPE_AXIS)
+        (g_embed,) = vjp_embed(
+            dxa.reshape(b, *x.shape[1:]).astype(x.dtype))
+        grads = {"rest": jax.tree_util.tree_map(
+                    lambda a, c: a + c.astype(a.dtype), ghead, g_embed),
+                 "layers": glayers}
+        sloss = lax.psum(sloss, DATA_AXIS)
+        loss = sloss if state.scaler.identity \
+            else sloss / state.scaler.scale
+        return finish(state, grads, loss)
+
+    per_shard = per_shard_ring if schedule == "ring" else per_shard_1f1b
 
     # Prefix specs: layers shard their stacked dim over 'pipe'; everything
     # else (embedding/head params, optimizer scalars) replicates.  The

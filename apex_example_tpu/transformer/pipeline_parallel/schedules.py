@@ -299,7 +299,8 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                   inputs: jnp.ndarray,
                   targets: Any,
                   axis_name: str = PIPE_AXIS,
-                  num_chunks: int = 1) -> Tuple[jnp.ndarray, Any]:
+                  num_chunks: int = 1,
+                  head_params: Any = None):
     """True 1F1B pipeline: explicit warmup/steady/drain microbatch ordering
     with bounded in-flight activations.  Must run inside shard_map.
 
@@ -334,6 +335,21 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 
     Returns ``(mean loss, grads)`` with grads shaped like
     ``stage_params``.
+
+    With ``head_params`` (the real-workload hookup: embedding feeds
+    ``inputs``, a parametrized head closes the loss), ``last_stage_fn``
+    takes ``(head_params, y, target)`` and the return grows to
+    ``(mean loss, grads, head_grads, input_grads)``:
+
+    - ``head_grads``: d(mean loss)/d(head_params), nonzero ONLY on the
+      last stage (callers psum over ``axis_name``; other stages
+      contribute exact zeros).
+    - ``input_grads``: [M, ...] cotangents of ``inputs`` — the first
+      stage's per-microbatch dx, which the schedule would otherwise
+      discard at the ring seam — nonzero ONLY on stage 0 (psum
+      likewise).  Feed them to the embedding's vjp to complete the
+      backward; the schedule itself stays a non-differentiable value
+      program.
     """
     S = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -362,6 +378,17 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     fwd_tbl = jnp.asarray(fwd_tbl, jnp.int32)
     bwd_tbl = jnp.asarray(bwd_tbl, jnp.int32)
 
+    if head_params is not None:
+        # Differentiating w.r.t. a pipe-INVARIANT value makes AD insert the
+        # invariance-restoring psum right there — a collective inside a
+        # cond whose predicate VARIES per device.  Cast to varying first:
+        # the loss-cell grads stay local (masked zeros off the last stage)
+        # and the caller performs the one explicit psum at the end.
+        head_params = jax.tree_util.tree_map(
+            lambda p: lax.pcast(p, axis_name, to="varying")
+            if axis_name not in getattr(jax.typeof(p), "vma", frozenset())
+            else p, head_params)
+
     def _idx(stack, i):
         return lax.dynamic_index_in_dim(
             stack, jnp.clip(i, 0, stack.shape[0] - 1), keepdims=False)
@@ -375,9 +402,26 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     def _upd2(stack, val, c, k):
         return _upd(stack, _upd(_idx(stack, c), val, k), c)
 
+    # Activation-valued zeros must carry the SAME varying type as the real
+    # compute: over the pipe axis AND over whatever other manual axes the
+    # inputs/targets vary on (e.g. 'data' in the BERT integration — batch
+    # shards make every activation, dx and loss cell data-varying).
+    # Param-GRAD zeros stay pipe-only: stage/head params enter invariant
+    # on the other axes, so their cotangents arrive implicitly psum-ed
+    # there (safe inside the cond — the action tables vary over pipe only,
+    # every other-axis shard takes the same branch).
+    def _vma_of(t):
+        s = set()
+        for leaf in jax.tree_util.tree_leaves(t):
+            s |= set(getattr(jax.typeof(leaf), "vma", frozenset()))
+        return s
+
+    act_axes = tuple(sorted({axis_name} | _vma_of(inputs) | _vma_of(targets)))
+
     def _vzeros(shape, dtype):
-        # Zeros with the shard-varying type: cond branches must agree with
-        # the real-compute branch, whose outputs vary across the pipe axis.
+        return lax.pcast(jnp.zeros(shape, dtype), act_axes, to="varying")
+
+    def _pzeros(shape, dtype):
         return lax.pcast(jnp.zeros(shape, dtype), axis_name, to="varying")
 
     zeros_act = lambda *lead: _vzeros(lead + act_shape, act_dtype)
@@ -386,7 +430,7 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                             to="varying"), params)
 
     def tick(carry, rows):
-        fwd_reg, bwd_reg, xbuf, gacc, lacc = carry
+        fwd_reg, bwd_reg, xbuf, gacc, lacc, aux = carry
         frow, brow = rows
         af = jnp.take(frow, idx)
         ab = jnp.take(brow, idx)
@@ -416,26 +460,71 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             xb, cot_in, tgt = opr
             pb = params_for(cb)
             yb, vjp = jax.vjp(stage_fn, pb, xb)
-            lval, dy_loss = jax.value_and_grad(
-                lambda yy: last_stage_fn(yy, tgt))(yb)
-            dy = jnp.where(is_last, dy_loss.astype(act_dtype), cot_in)
+
+            # Only the LAST stage's cell needs the loss backward; nesting
+            # the cond spares every other stage the head computation (for
+            # a parametrized head that is a full [vocab, hidden]-cotangent
+            # backward per tick, thrown away S·V−1 times out of S·V).
+            # Legal for the same reason the outer do_b cond is: the
+            # predicate varies over the pipe axis only, and the implicit
+            # data-axis grad psums inside agree on the branch everywhere.
+            def loss_cell(opr2):
+                yb2, tgt2 = opr2
+                if head_params is None:
+                    lv, dyl = jax.value_and_grad(
+                        lambda yy: last_stage_fn(yy, tgt2))(yb2)
+                    return (lv.astype(jnp.float32),
+                            dyl.astype(act_dtype), ())
+                lv, (dh2, dyl) = jax.value_and_grad(
+                    lambda hp, yy: last_stage_fn(hp, yy, tgt2),
+                    argnums=(0, 1))(head_params, yb2)
+                return lv.astype(jnp.float32), dyl.astype(act_dtype), dh2
+
+            def loss_skip(opr2):
+                dh0 = () if head_params is None else jax.tree_util.tree_map(
+                    lambda p: _pzeros(p.shape, p.dtype), head_params)
+                return (_vzeros((), jnp.float32),
+                        _vzeros(act_shape, act_dtype), dh0)
+
+            lval, dy_loss, dh = lax.cond(is_last, loss_cell, loss_skip,
+                                         (yb, tgt))
+            dy = jnp.where(is_last, dy_loss, cot_in)
             dp, dx = vjp(dy.astype(yb.dtype))
-            return dp, dx.astype(act_dtype), \
-                jnp.where(is_last, lval, 0.0).astype(jnp.float32)
+            return dp, dx.astype(act_dtype), lval, dh
 
         def skip_bwd(opr):
+            dh = () if head_params is None else jax.tree_util.tree_map(
+                lambda p: _pzeros(p.shape, p.dtype), head_params)
             return (jax.tree_util.tree_map(
-                        lambda p: _vzeros(p.shape[1:], p.dtype), params),
+                        lambda p: _pzeros(p.shape[1:], p.dtype), params),
                     _vzeros(act_shape, act_dtype),
-                    _vzeros((), jnp.float32))
+                    _vzeros((), jnp.float32), dh)
 
-        dp, dx, lval = lax.cond(do_b, run_bwd, skip_bwd,
-                                (xb, _idx2(bwd_reg, cb, kb % bdepth), tgt))
+        dp, dx, lval, dh = lax.cond(
+            do_b, run_bwd, skip_bwd,
+            (xb, _idx2(bwd_reg, cb, kb % bdepth), tgt))
         gacc = jax.tree_util.tree_map(
             lambda a, d: jnp.where(
                 do_b, _upd(a, _idx(a, cb) + d.astype(jnp.float32), cb), a),
             gacc, dp)
         lacc = lacc + lval
+        if head_params is not None:
+            gh, dxa = aux
+            # Head grads exist only where the loss cell really ran (last
+            # stage, last chunk); input cotangents only where the stage-0
+            # backward retired the injected microbatch — exact zeros
+            # elsewhere, so a psum over the pipe axis recovers both.
+            gh = jax.tree_util.tree_map(
+                lambda a, d: jnp.where(do_b & is_last,
+                                       a + d.astype(jnp.float32), a),
+                gh, dh)
+            is_first = (idx == 0) & (cb == 0)
+            dxa = jnp.where(
+                do_b & is_first,
+                lax.dynamic_update_index_in_dim(
+                    dxa, dx, jnp.clip(kb, 0, M - 1), 0),
+                dxa)
+            aux = (gh, dxa)
 
         # ---- ring exchange (unconditional; receivers mask).
         y_in = send_forward(y, axis_name)
@@ -455,12 +544,20 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             (ab_in >= 0) & (c_rb >= 0),
             _upd2(bwd_reg, dx_in, jnp.clip(c_rb, 0, V - 1), kb_in % bdepth),
             bwd_reg)
-        return (fwd_reg, bwd_reg, xbuf, gacc, lacc), None
+        return (fwd_reg, bwd_reg, xbuf, gacc, lacc, aux), None
 
+    aux0 = ()
+    if head_params is not None:
+        aux0 = (jax.tree_util.tree_map(
+                    lambda p: lax.pcast(jnp.zeros(p.shape, jnp.float32),
+                                        axis_name, to="varying"),
+                    head_params),
+                _vzeros((M,) + act_shape, act_dtype))
     carry0 = (zeros_act(V, fdepth), zeros_act(V, bdepth),
               zeros_act(V, xdepth), gzero,
-              lax.pcast(jnp.zeros((), jnp.float32), axis_name, to="varying"))
-    (_, _, _, gacc, lacc), _ = lax.scan(
+              _vzeros((), jnp.float32),       # lacc: loss cells vary like
+              aux0)                           # the activations
+    (_, _, _, gacc, lacc, aux), _ = lax.scan(
         tick, carry0, (fwd_tbl, bwd_tbl))
 
     loss = lax.psum(lacc, axis_name) / M
@@ -468,7 +565,13 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         lambda a, p: (a / M).astype(p.dtype), gacc, params)
     if V == 1:
         grads = jax.tree_util.tree_map(lambda g: g[0], grads)
-    return loss, grads
+    if head_params is None:
+        return loss, grads
+    gh, dxa = aux
+    head_grads = jax.tree_util.tree_map(
+        lambda a, p: (a / M).astype(p.dtype), gh, head_params)
+    input_grads = (dxa.astype(jnp.float32) / M).astype(act_dtype)
+    return loss, grads, head_grads, input_grads
 
 
 def forward_backward_pipelining_without_interleaving(

@@ -85,7 +85,8 @@ def probe(timeout: float = 150.0) -> bool:
         return False
     ok = p.returncode == 0 and "PROBE OK" in p.stdout
     print(p.stdout.strip() if ok else
-          f"probe rc={p.returncode}\n{p.stdout}\n{p.stderr}"[-500:])
+          f"probe rc={p.returncode}\nstdout: {p.stdout[-250:]}\n"
+          f"stderr: {p.stderr[-250:]}")
     return ok
 
 

@@ -125,6 +125,16 @@ def parse_args(argv=None):
     p.add_argument("--microbatches", type=int, default=4,
                    help="ring slots per data shard under "
                         "--pipeline-parallel")
+    p.add_argument("--pipeline-schedule", default="ring",
+                   choices=["ring", "1f1b", "interleaved"],
+                   help="pipeline program: SPMD ring (autodiff backward; "
+                        "composes with TP), true 1F1B (bounded in-flight "
+                        "activations), or interleaved virtual stages "
+                        "(apex's three schedule entry points)")
+    p.add_argument("--virtual-stages", type=int, default=None,
+                   help="chunks per device for --pipeline-schedule "
+                        "interleaved (default 2; rejected with other "
+                        "schedules rather than silently ignored)")
     p.add_argument("--context-parallel", type=int, default=1, metavar="CP",
                    help="shard BERT's sequence over a 'context' mesh axis "
                         "of this size (ppermute KV-ring attention — the "
@@ -528,6 +538,23 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--opt novograd does not compose with "
                              "--pipeline-parallel (its per-tensor second "
                              "moment collapses on stacked per-layer params)")
+        if tp > 1 and args.pipeline_schedule != "ring":
+            raise SystemExit("--tensor-parallel composes with "
+                             "--pipeline-schedule ring only (the 1F1B "
+                             "schedules run stage cells inside lax.cond, "
+                             "where the TP collectives cannot live)")
+        if args.virtual_stages is not None \
+                and args.pipeline_schedule != "interleaved":
+            raise SystemExit("--virtual-stages only applies to "
+                             "--pipeline-schedule interleaved")
+        if args.pipeline_schedule == "interleaved":
+            if args.virtual_stages is not None and args.virtual_stages < 2:
+                raise SystemExit("--pipeline-schedule interleaved needs "
+                                 "--virtual-stages >= 2")
+            if args.microbatches % pp:
+                raise SystemExit(f"--pipeline-schedule interleaved needs "
+                                 f"--microbatches ({args.microbatches}) "
+                                 f"divisible by --pipeline-parallel ({pp})")
         if args.grad_accum != 1:
             raise SystemExit("--pipeline-parallel owns microbatching "
                              "(--microbatches); drop --grad-accum")
@@ -640,12 +667,17 @@ def _lm_main_impl(args, policy, scaler):
         from apex_example_tpu.transformer import parallel_state
         from apex_example_tpu.transformer.bert_pipeline import (
             PipelineFusedLAMB, bert_pp_state_shardings,
-            make_bert_pp_train_step, pack_params)
+            make_bert_pp_train_step, pack_params, pack_params_1f1b)
+        pp_sched = args.pipeline_schedule
+        pp_chunks = (args.virtual_stages or 2) \
+            if pp_sched == "interleaved" else 1
         if args.opt == "lamb":
             # C4's optimizer rides the pipeline with per-LAYER trust ratios
             # and a pipe-global clip norm (bare FusedLAMB would collapse
-            # both on the stacked per-stage params).
-            optimizer = PipelineFusedLAMB(optimizer)
+            # both on the stacked per-stage params).  The 1F1B arranged
+            # pack carries 3 leading per-layer index dims ([S, V, per]).
+            optimizer = PipelineFusedLAMB(
+                optimizer, stacked_dims=1 if pp_sched == "ring" else 3)
         if tp > 1:
             # Pallas custom calls are opaque to the SPMD partitioner; the
             # model axis stays automatic inside the PP shard_map, so pin
@@ -653,8 +685,9 @@ def _lm_main_impl(args, policy, scaler):
             ops_config.set_force_xla(True)
         mesh = parallel_state.initialize_model_parallel(
             tensor_parallel=tp, pipeline_parallel=pp, devices=devices)
-        if model.num_layers % pp:
-            raise SystemExit(f"--pipeline-parallel {pp} does not divide "
+        if model.num_layers % (pp * pp_chunks):
+            raise SystemExit(f"--pipeline-parallel {pp} x --virtual-stages "
+                             f"{pp_chunks} does not divide "
                              f"{model.num_layers} encoder layers")
         # jit the init: under a traced program the TP layers' batch-axis
         # constraints tolerate the size-1 init sample (GSPMD pads); the
@@ -663,7 +696,11 @@ def _lm_main_impl(args, policy, scaler):
             lambda r: create_train_state(r, model, optimizer, sample[:1],
                                          policy, scaler)
         )(jax.random.PRNGKey(args.seed))
-        packed = pack_params(dense_state.params, model.num_layers)
+        if pp_sched == "ring":
+            packed = pack_params(dense_state.params, model.num_layers)
+        else:
+            packed = pack_params_1f1b(dense_state.params, model.num_layers,
+                                      pp, pp_chunks)
         state = TrainState(step=dense_state.step, params=packed,
                            batch_stats={},
                            opt_state=optimizer.init(packed),
@@ -672,11 +709,14 @@ def _lm_main_impl(args, policy, scaler):
             state, bert_pp_state_shardings(mesh, state, optimizer,
                                            model=model))
         step_fn = make_bert_pp_train_step(mesh, model, optimizer, policy,
-                                          microbatches=args.microbatches)
+                                          microbatches=args.microbatches,
+                                          schedule=pp_sched,
+                                          num_chunks=pp_chunks)
         mems = None
-        print(f"PP over {pp} stages, TP over {tp}, DP over "
-              f"{n_dev // (pp * tp)}, {args.microbatches} "
-              f"microbatches/shard: {mesh}")
+        print(f"PP over {pp} stages ({pp_sched}"
+              + (f", V={pp_chunks}" if pp_chunks > 1 else "")
+              + f"), TP over {tp}, DP over {n_dev // (pp * tp)}, "
+              f"{args.microbatches} microbatches/shard: {mesh}")
     elif tp > 1 and cp == 1:
         # GSPMD tensor parallelism: one (pipe, data, context, model) mesh,
         # params carrying the TP layers' partitioning metadata, the plain
@@ -799,10 +839,14 @@ def _lm_main_impl(args, policy, scaler):
                 eval_fn = make_bert_cp_eval_step(mesh, model_cp)
             elif pp > 1:
                 from apex_example_tpu.transformer.bert_pipeline import (
-                    unpack_params)
+                    unpack_params, unpack_params_1f1b)
                 core = make_bert_eval_step(model)
-                eval_fn = jax.jit(lambda p, b: core(
-                    unpack_params(p, model.num_layers), b))
+                if pp_sched == "ring":
+                    unp = lambda p: unpack_params(p, model.num_layers)
+                else:
+                    unp = lambda p: unpack_params_1f1b(
+                        p, model.num_layers, pp, pp_chunks)
+                eval_fn = jax.jit(lambda p, b: core(unp(p), b))
             else:
                 eval_fn = jax.jit(make_bert_eval_step(model))
         else:
