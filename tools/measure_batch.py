@@ -40,21 +40,32 @@ PROBE = ("import jax, jax.numpy as jnp, time\n"
          "print('PROBE OK %.1fs' % (time.time() - t0), float(y[0, 0]))\n")
 
 # (key, argv-after-"bench.py", subprocess timeout seconds)
+#
+# ORDER MATTERS (learned 2026-07-31 03:55–04:12): all known-cheap-compile
+# items run FIRST, every long-compile experiment LAST.  The first campaign
+# attempt put remat right after c2; c2 landed (2566.8 img/s, 322 s) but
+# c2_remat_conv's rematerialized-backward XLA compile exceeded the 900 s
+# item timeout (plain c2's compile is ~60-90 s), the subprocess kill hit
+# mid-remote-compile, and the tunnel wedged for every subsequent client —
+# the same pathology as the 07-30 day-long outage.  bench.py's watchdog
+# cannot guard this window: it disarms after the first trivial scalar op,
+# which precedes the workload compile.  So the defense is ordering + a
+# timeout that outlasts the worst plausible compile.
 ITEMS = [
     ("c2",            ["--config", "c2"], 900),
-    ("c2_remat_conv", ["--config", "c2", "--remat", "conv"], 900),
-    ("c2_remat_block", ["--config", "c2", "--remat", "block"], 900),
     ("c1",            ["--config", "c1"], 900),
     ("c4",            ["--config", "c4"], 900),
+    ("c5",            ["--config", "c5"], 900),
+    ("hostpipe",      ["--config", "hostpipe"], 900),
+    # ---- long-compile experiments: nothing queues behind these ----
+    ("c2_remat_conv", ["--config", "c2", "--remat", "conv"], 2700),
+    ("c2_remat_block", ["--config", "c2", "--remat", "block"], 2700),
     # seq-8192 compiles a big Pallas grid through the remote-compile path:
-    # generous timeouts, and bench.py's watchdog widened to match.  This is
-    # the item whose mid-compile kill wedged the tunnel for a day (PERF.md
-    # outage record) — the timeout must outlast the worst compile.
+    # this is the item whose mid-compile kill wedged the tunnel for a day
+    # (PERF.md outage record) — the timeout must outlast the worst compile.
     ("c4_seq8192",    ["--config", "c4", "--seq-len", "8192",
                        "--batch-size", "2", "--watchdog-timeout", "1800"],
      2700),
-    ("c5",            ["--config", "c5"], 900),
-    ("hostpipe",      ["--config", "hostpipe"], 900),
 ]
 
 

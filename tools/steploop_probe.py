@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Step-loop probe: is any of the C2 step time host-dispatch bubbles?
+
+bench.py's two-point chain dispatches each jitted step from the host
+through the axon tunnel; differencing two chain lengths cancels the
+*fixed* fetch round-trip but cannot cancel a *per-step* dispatch cost if
+the tunnel fails to pipeline enqueues behind execution.  The byte
+accounting (PERF.md) says the measured step already sits at the HBM
+roofline — i.e. predicts NO bubbles — but that inference has never been
+tested directly.
+
+This probe jits ONE XLA program that runs K train steps in a
+`lax.fori_loop` (the batch is device-resident and reused, exactly like
+bench.py's single-chip path), so the device executes K steps back to
+back with zero host involvement.  Comparing img/s against bench.py's
+number arbitrates:
+
+  - steploop ~= chain   -> dispatch pipelines fine; chain number is pure
+                           device throughput (the roofline story stands).
+  - steploop >> chain   -> the tunnel leaves per-step bubbles; the
+                           steploop form is the honest device number and
+                           bench.py should grow a --steps-per-call mode.
+
+Usage: python tools/steploop_probe.py [--batch-size 256] [--k 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--k", type=int, default=20,
+                    help="steps fused into one XLA program")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed invocations of the fused program")
+    args = ap.parse_args()
+
+    from apex_example_tpu import amp
+    from apex_example_tpu.engine import make_train_step
+    from bench import _image_setup, chain_rate
+
+    policy, scaler = amp.initialize("O2")
+    model, opt, batch, state = _image_setup(
+        policy, scaler, arch="resnet50", batch_size=args.batch_size,
+        image_size=224, num_classes=1000)
+    batch = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), batch)
+
+    step = make_train_step(model, opt, policy)
+
+    def body(_, carry):
+        state, _metrics = carry
+        return step(state, batch)
+
+    @jax.jit
+    def k_steps(state):
+        # run step once outside the loop to get a metrics carry of the
+        # right structure, then K-1 more inside the loop
+        carry = step(state, batch)
+        return lax.fori_loop(0, args.k - 1, body, carry)
+
+    # warmup/compile
+    state, metrics = k_steps(state)
+    loss0 = float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        state, metrics = k_steps(state)
+    _ = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    rate = args.reps * args.k * args.batch_size / dt
+    print(f"steploop: K={args.k} reps={args.reps} "
+          f"rate={rate:.1f} img/s (loss0={loss0:.4f})")
+
+    # reference: the same setup through the per-step dispatch chain
+    policy2, scaler2 = amp.initialize("O2")
+    model2, opt2, batch2, state2 = _image_setup(
+        policy2, scaler2, arch="resnet50", batch_size=args.batch_size,
+        image_size=224, num_classes=1000)
+    batch2 = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), batch2)
+    jstep = jax.jit(make_train_step(model2, opt2, policy2),
+                    donate_argnums=(0,))
+    for _ in range(2):
+        state2, m2 = jstep(state2, batch2)
+    float(m2["loss"])
+    crate = chain_rate(jstep, state2, batch2, 30, args.batch_size,
+                       lambda m: float(m["loss"]))
+    print(f"chain:    rate={crate:.1f} img/s")
+    print(f"ratio steploop/chain = {rate / crate:.3f}")
+
+
+if __name__ == "__main__":
+    main()
