@@ -351,9 +351,16 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     S = mesh.shape[PIPE_AXIS]
     if schedule not in ("ring", "1f1b", "interleaved"):
         raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "interleaved":
+        if num_chunks < 2:
+            raise ValueError("interleaved schedule needs num_chunks >= 2")
+    elif num_chunks != 1:
+        # Reject rather than ignore (the same contract train.py states for
+        # --virtual-stages): a caller asking for virtual stages on a
+        # non-interleaved schedule would otherwise silently get none.
+        raise ValueError(f"num_chunks={num_chunks} only applies to the "
+                         f"interleaved schedule, not {schedule!r}")
     V = num_chunks if schedule == "interleaved" else 1
-    if schedule == "interleaved" and num_chunks < 2:
-        raise ValueError("interleaved schedule needs num_chunks >= 2")
     if model.num_layers % (S * V):
         raise ValueError(f"num_layers {model.num_layers} not divisible by "
                          f"pipeline size {S} x chunks {V}")
@@ -365,7 +372,6 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             "1F1B schedules run stage cells inside lax.cond with per-stage "
             "predicates, where the TP layers' auto-axis collectives cannot "
             "live")
-    per_stage = model.num_layers // (S * V)
     from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
     if isinstance(optimizer, FusedLAMB):
         raise ValueError(
@@ -377,6 +383,19 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             "FusedNovoGrad under PP would collapse its per-TENSOR second "
             "moment (EMA of ||g||²) across each stage's stacked layers; "
             "no pipeline form exists yet")
+    if isinstance(optimizer, PipelineFusedLAMB):
+        # The wrapper's leading-index-dim count must match this schedule's
+        # param layout: the ring pack stacks [num_layers, ...] (1 dim), the
+        # 1F1B/interleaved arranged pack stacks [S, V, per, ...] (3 dims).
+        # A mismatch trains silently wrong — either one trust ratio per
+        # whole [V, per] block, or per-row ratios of a layout that does
+        # not exist.
+        want = 1 if schedule == "ring" else 3
+        if optimizer.stacked_dims != want:
+            raise ValueError(
+                f"PipelineFusedLAMB(stacked_dims={optimizer.stacked_dims}) "
+                f"does not match the {schedule!r} schedule's param layout "
+                f"(needs stacked_dims={want})")
     opt = _wrap_optimizer(optimizer)
     layer_mod = BertLayer(model.hidden_size, model.num_heads,
                           model.intermediate_size, model.dtype,

@@ -276,6 +276,32 @@ def test_pp_bare_lamb_rejected(devices8):
                                 policy, microbatches=2)
 
 
+def test_pp_factory_layout_rejections(devices8):
+    """The factory rejects (rather than silently ignores/mistrains):
+    num_chunks on a non-interleaved schedule, and a PipelineFusedLAMB
+    whose stacked_dims does not match the schedule's param layout."""
+    from apex_example_tpu.optim import FusedLAMB
+    from apex_example_tpu.transformer.bert_pipeline import PipelineFusedLAMB
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("pipe", "data"))
+    policy, _ = amp.initialize("O0")
+    with pytest.raises(ValueError, match="interleaved"):
+        make_bert_pp_train_step(mesh, bert_tiny(), None, policy,
+                                microbatches=2, schedule="1f1b",
+                                num_chunks=4)
+    # ring layout is [num_layers, ...]: stacked_dims must be 1
+    with pytest.raises(ValueError, match="stacked_dims"):
+        make_bert_pp_train_step(
+            mesh, bert_tiny(),
+            PipelineFusedLAMB(FusedLAMB(lr=1e-3), stacked_dims=3),
+            policy, microbatches=2, schedule="ring")
+    # 1F1B arranged layout is [S, V, per, ...]: stacked_dims must be 3
+    with pytest.raises(ValueError, match="stacked_dims"):
+        make_bert_pp_train_step(
+            mesh, bert_tiny(),
+            PipelineFusedLAMB(FusedLAMB(lr=1e-3), stacked_dims=1),
+            policy, microbatches=2, schedule="1f1b")
+
+
 def test_train_py_cli_pp_lamb(devices8):
     """C4's FusedLAMB rides the pipeline from the CLI."""
     import train as train_mod

@@ -74,13 +74,26 @@ def main():
     # warmup/compile
     state, metrics = k_steps(state)
     loss0 = float(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(args.reps):
-        state, metrics = k_steps(state)
-    _ = float(metrics["loss"])
-    dt = time.perf_counter() - t0
-    rate = args.reps * args.k * args.batch_size / dt
-    print(f"steploop: K={args.k} reps={args.reps} "
+
+    # Two-point differencing over rep counts, exactly like bench.py's
+    # chain_rate: each timed window ends in one scalar fetch (the only
+    # real barrier through the tunnel), and differencing two window
+    # lengths cancels that fetch RTT — otherwise the uncancelled RTT
+    # biases the steploop rate low and can mask the very bubble signal
+    # this probe exists to detect.
+    def run(reps, state):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state, metrics = k_steps(state)
+        float(metrics["loss"])
+        return time.perf_counter() - t0, state
+
+    r1 = max(args.reps // 3, 1)
+    r2 = max(args.reps, r1 + 1)
+    t1, state = run(r1, state)
+    t2, state = run(r2, state)
+    rate = (r2 - r1) * args.k * args.batch_size / max(t2 - t1, 1e-9)
+    print(f"steploop: K={args.k} reps={r1}/{r2} "
           f"rate={rate:.1f} img/s (loss0={loss0:.4f})")
 
     # reference: the same setup through the per-step dispatch chain
