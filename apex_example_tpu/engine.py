@@ -105,7 +105,8 @@ def make_train_step(model, optimizer, policy: Policy,
                     axis_name: Optional[str] = None,
                     loss_fn: Callable = cross_entropy_loss,
                     compute_accuracy: bool = True,
-                    grad_accum: int = 1):
+                    grad_accum: int = 1,
+                    finite_reduce_axes=None):
     """Build the single-device (or per-shard) train step.
 
     ``optimizer`` is a fused optimizer (init/apply) from
@@ -119,6 +120,15 @@ def make_train_step(model, optimizer, policy: Policy,
     ``message_size``/accumulation): BN running stats update per forward,
     grads average over microbatches, the allreduce happens once on the
     accumulated grads (delay_allreduce-style).
+
+    ``finite_reduce_axes``: mesh axis name(s) to AND the dynamic-scaling
+    finite flag over.  Needed whenever some PARAM grads are legitimately
+    shard-varying inside a shard_map (e.g. expert-parallel MoE weights,
+    where each shard owns its expert): a local overflow must skip the
+    update and halve the scale on EVERY shard, or the replicated scaler
+    state diverges across the mesh.  Replicated-param-only steps (DDP,
+    CP) don't need it — their grads arrive psum-ed, so the flag is
+    already mesh-invariant.
     """
     opt = _wrap_optimizer(optimizer)
     ddp = ddp or DDPConfig()
@@ -211,6 +221,13 @@ def make_train_step(model, optimizer, policy: Policy,
                 loss = jax.lax.pmean(loss, axis_name)
         with jax.named_scope("unscale_check"):
             grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
+            if finite_reduce_axes is not None:
+                # all-or-none across shards: pmean == 1.0 is an AND, and
+                # the collective makes the flag (and with it the scaler
+                # update and skip decision) mesh-invariant.
+                grads_finite = jax.lax.pmean(
+                    grads_finite.astype(jnp.float32),
+                    finite_reduce_axes) == 1.0
 
         with jax.named_scope("optimizer"):
             new_params, new_opt_state = opt.apply(grads, state.opt_state,

@@ -170,6 +170,12 @@ class BertLayer(nn.Module):
     tensor_parallel: bool = False
     sequence_parallel: bool = False
     context_parallel: bool = False
+    # Switch-MoE FFN: >0 replaces the dense MLP with moe_experts experts
+    # (transformer/expert_parallel.MoEMLP).  When >0 the layer returns
+    # (x, aux_loss) — the load-balancing term belongs in the objective.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_axis_name: str = "expert"
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -204,6 +210,13 @@ class BertLayer(nn.Module):
                 self.hidden_size, input_is_parallel=True,
                 sequence_parallel=self.sequence_parallel, dtype=self.dtype,
                 param_dtype=self.param_dtype, name="output")(y)
+        elif self.moe_experts:
+            from apex_example_tpu.transformer.expert_parallel import MoEMLP
+            y, aux = MoEMLP(self.hidden_size, self.intermediate_size,
+                            self.moe_experts,
+                            capacity_factor=self.moe_capacity_factor,
+                            dtype=self.dtype, param_dtype=self.param_dtype,
+                            axis_name=self.moe_axis_name, name="moe")(x)
         else:
             y = nn.Dense(self.intermediate_size, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="intermediate")(x)
@@ -212,7 +225,8 @@ class BertLayer(nn.Module):
                          param_dtype=self.param_dtype, name="output")(y)
         x = FusedLayerNorm(dtype=ln_io, name="output_ln")(
             (x + y).astype(ln_io))
-        return x.astype(self.dtype)
+        x = x.astype(self.dtype)
+        return (x, aux) if self.moe_experts else x
 
 
 class BertForMaskedLM(nn.Module):
@@ -239,11 +253,28 @@ class BertForMaskedLM(nn.Module):
     # position ids offset by the shard index, attention rides the KV ring.
     # Consumed by workloads.make_bert_cp_train_step / --context-parallel.
     context_parallel: bool = False
+    # Switch-MoE encoder FFNs (expert parallelism over moe_axis_name —
+    # train.py --moe-experts binds it to the 'data' axis, DeepSpeed-MoE
+    # style).  When >0 __call__ returns (logits, aux): the load-balancing
+    # loss is part of the objective and rides the output contract.
+    # Consumed by workloads.make_bert_moe_train_step.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_axis_name: str = "expert"
 
     @nn.compact
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
                  train: bool = True):
         del train  # no dropout in the pretraining benchmark path
+        if self.moe_experts and (self.tensor_parallel
+                                 or self.sequence_parallel
+                                 or self.context_parallel):
+            # The MoE all_to_all dispatch assumes every local token routes
+            # over the full expert set; TP/SP/CP re-shard the very dims the
+            # dispatch indexes (features / sequence).  Composition needs a
+            # designed layout, not a silent overlap — reject.
+            raise ValueError("moe_experts does not compose with "
+                             "tensor/sequence/context parallelism yet")
         if self.sequence_parallel and self.context_parallel:
             raise ValueError("sequence_parallel shards activations along "
                              "the sequence dim the context axis already "
@@ -284,6 +315,7 @@ class BertForMaskedLM(nn.Module):
         else:
             mask_bias = None
 
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.num_layers):
             x = BertLayer(self.hidden_size, self.num_heads,
                           self.intermediate_size, self.dtype,
@@ -293,7 +325,13 @@ class BertForMaskedLM(nn.Module):
                           tensor_parallel=self.tensor_parallel,
                           sequence_parallel=self.sequence_parallel,
                           context_parallel=self.context_parallel,
+                          moe_experts=self.moe_experts,
+                          moe_capacity_factor=self.moe_capacity_factor,
+                          moe_axis_name=self.moe_axis_name,
                           name=f"layer_{i}")(x, mask_bias)
+            if self.moe_experts:
+                x, aux = x
+                aux_total = aux_total + aux
 
         # MLM head: dense+gelu+LN, then tied decoder.  Under TP the decoder
         # is the parallel LM head (vocab-sharded logits — the CE's logsumexp
@@ -310,7 +348,10 @@ class BertForMaskedLM(nn.Module):
             bias_init = nn.with_partitioning(bias_init, ("model",))
         logits = logits + self.param("mlm_bias", bias_init,
                                      (self.vocab_size,), jnp.float32)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if self.moe_experts:
+            return logits, aux_total / self.num_layers
+        return logits
 
 
 def bert_base(**kw) -> BertForMaskedLM:

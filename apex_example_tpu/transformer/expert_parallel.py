@@ -34,6 +34,8 @@ from __future__ import annotations
 
 from typing import NamedTuple, Tuple
 
+import flax.linen as nn
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -163,3 +165,68 @@ def moe_forward_dense_reference(params: MoEParams, x: jnp.ndarray,
     y = jnp.einsum("ecd,tec->td", out.astype(jnp.float32),
                    combine).astype(x.dtype)
     return y, aux
+
+
+def _axis_is_bound(axis_name: str) -> bool:
+    """Trace-time: is ``axis_name`` a live manual mesh axis here?
+
+    Lets one module body serve both worlds: under the EP shard_map the
+    collectives run; in eager/plain-jit contexts (init, dense eval, the
+    golden tests) the dense reference runs.  Resolution happens at trace
+    time, so jit sees a single static branch.
+    """
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+class MoEMLP(nn.Module):
+    """Switch-MoE replacement for a transformer FFN block (flax).
+
+    Logical params: router [d, E], stacked expert weights w_in [E, d, h] /
+    w_out [E, h, d].  Outside any mesh the dense reference runs on the full
+    stack (init, golden tests, single-device eval).  Inside a shard_map
+    with ``axis_name`` bound, the caller shards the stacked weights over
+    that axis (P(axis) on dim 0 — one expert per device; see
+    ``workloads.bert_moe_state_specs``) and the all_to_all dispatch runs.
+
+    Returns ``(y, aux)`` — the load-balancing aux loss is part of the
+    training objective (Switch eq. 4), so it is returned rather than sown:
+    the model's output contract carries it to the loss function explicitly.
+    """
+
+    hidden_size: int
+    intermediate_size: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    axis_name: str = EXPERT_AXIS
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        d, h, E = self.hidden_size, self.intermediate_size, self.n_experts
+        init = nn.initializers.normal(1.0 / float(d) ** 0.5)
+        dist = _axis_is_bound(self.axis_name)
+        # flax verifies declared param shapes against the provided values
+        # at apply time; inside the EP shard_map the stacked [E, ...]
+        # arrays arrive SLICED to this device's expert, so the declared
+        # leading dim is the local one.  Init always runs outside the mesh
+        # (dist=False) and stores the full stack.
+        e_local = 1 if dist else E
+        params = MoEParams(
+            w_router=self.param("router", init, (d, E), self.param_dtype),
+            w_in=self.param("w_in", init, (e_local, d, h),
+                            self.param_dtype),
+            w_out=self.param("w_out", init, (e_local, h, d),
+                             self.param_dtype))
+        flat = x.reshape(-1, d).astype(self.dtype)
+        if dist:
+            y, aux = moe_forward(params, flat, self.capacity_factor,
+                                 self.axis_name, activation=nn.gelu)
+        else:
+            y, aux = moe_forward_dense_reference(
+                params, flat, self.capacity_factor, activation=nn.gelu)
+        return y.reshape(x.shape).astype(self.dtype), aux

@@ -329,3 +329,148 @@ def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                    in_shardings=(state_shardings, mems_sh, batch_sh),
                    out_shardings=(state_shardings, mems_sh, metrics_sh),
                    donate_argnums=(0, 1) if donate else ())
+
+
+# ------------------- Expert-parallel (MoE) BERT --------------------------
+#
+# The harness face of transformer/expert_parallel.py (train.py
+# --moe-experts): switch-MoE encoder FFNs with one expert per device over
+# the 'data' axis — EP rides the DP devices the way DeepSpeed-MoE does, so
+# no new mesh axis is needed and every token still trains on its home
+# shard.  No reference analog (SURVEY.md §3.2: EP documented as absent
+# there); this is the same "library feature -> harness-reachable" move the
+# CP path made in round 3.
+
+def _moe_param_spec_tree(params):
+    """P(DATA_AXIS) for the stacked [E, ...] expert weights (leaves under a
+    'moe' module named w_in/w_out — one expert per data-axis device), P()
+    for everything else (router, attention, embeddings, head: replicated,
+    their grads arrive implicitly psum-ed)."""
+    def spec(path, _leaf):
+        keys = {getattr(p, "key", None) for p in path}
+        if "moe" in keys and ("w_in" in keys or "w_out" in keys):
+            return P(DATA_AXIS)
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def bert_moe_state_specs(state: TrainState, optimizer) -> TrainState:
+    """PartitionSpec TrainState for the EP step: expert stacks shard over
+    'data', optimizer state mirrors its params-shaped fields
+    (engine._opt_state_specs), all else replicates."""
+    from apex_example_tpu.engine import _opt_state_specs
+    tmap = jax.tree_util.tree_map
+    pspecs = _moe_param_spec_tree(state.params)
+    abs_params = tmap(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                      state.params)
+    return TrainState(
+        step=P(), params=pspecs,
+        batch_stats=tmap(lambda _: P(), state.batch_stats),
+        opt_state=_opt_state_specs(optimizer, abs_params, pspecs),
+        scaler=tmap(lambda _: P(), state.scaler))
+
+
+def bert_moe_state_shardings(mesh: Mesh, state: TrainState, optimizer
+                             ) -> TrainState:
+    """NamedSharding tree for device_put / the orbax restore template."""
+    from jax.sharding import NamedSharding
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        bert_moe_state_specs(state, optimizer),
+        is_leaf=lambda v: isinstance(v, P))
+
+
+def _check_moe_model(mesh: Mesh, model, optimizer=None):
+    E = mesh.shape[DATA_AXIS]
+    if not model.moe_experts:
+        raise ValueError("model has moe_experts=0; build it with "
+                         "moe_experts=<data-axis size>")
+    if model.moe_experts != E:
+        raise ValueError(
+            f"moe_experts={model.moe_experts} must equal the data-axis "
+            f"size {E} (one expert per device — the all_to_all splits the "
+            f"[E, C, d] dispatch buffer E-ways over the axis)")
+    if model.moe_axis_name != DATA_AXIS:
+        raise ValueError(
+            f"model.moe_axis_name={model.moe_axis_name!r} but the EP step "
+            f"maps over {DATA_AXIS!r}; build the model with "
+            f"moe_axis_name=DATA_AXIS or MoEMLP silently falls back to "
+            f"its dense reference path")
+    if optimizer is not None:
+        from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
+        if isinstance(optimizer, (FusedLAMB, FusedNovoGrad)):
+            raise ValueError(
+                f"{type(optimizer).__name__} computes per-TENSOR statistics "
+                "(trust ratio / ||g||^2 EMA); on the EP-sharded [E, ...] "
+                "expert stacks each shard would see only its slice, "
+                "silently diverging from the dense-model semantics — use "
+                "adam/sgd/adagrad under --moe-experts")
+
+
+def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
+                             state_template: TrainState,
+                             aux_weight: float = 1e-2,
+                             donate: bool = True, grad_accum: int = 1):
+    """Expert-parallel BERT MLM step over the 'data' axis (train.py
+    --moe-experts).
+
+    The model returns (logits, aux); the objective is the globally
+    psum-normalized masked CE plus ``aux_weight`` x the Switch
+    load-balancing loss (already pmean-ed over the axis inside
+    moe_forward).  Replicated-param grads arrive implicitly psum-ed
+    through the psum-ed loss (the CP-step mechanism); the expert stacks'
+    grads stay shard-local — each device owns its expert.  The dynamic-
+    scaling finite flag is pmean-ed over 'data'
+    (engine.make_train_step(finite_reduce_axes=...)): a local overflow in
+    one expert's grads must skip the step and halve the scale on EVERY
+    shard or the replicated scaler state diverges.
+    """
+    from apex_example_tpu.engine import make_train_step
+    _check_moe_model(mesh, model, optimizer)
+
+    def moe_mlm_loss(out, target):
+        logits, aux = out
+        labels, weights = target
+        ce = softmax_cross_entropy(logits, labels)
+        num = jax.lax.psum((ce * weights).sum(), DATA_AXIS)
+        den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
+        return num / den + jnp.asarray(aux_weight, jnp.float32) * aux
+
+    per_shard = make_train_step(model, optimizer, policy, axis_name=None,
+                                loss_fn=moe_mlm_loss,
+                                compute_accuracy=False,
+                                grad_accum=grad_accum,
+                                finite_reduce_axes=DATA_AXIS)
+    # state_template fixes the spec TREE only (the per-leaf expert-vs-
+    # replicated split); shapes/values are irrelevant, so the pre-
+    # device_put host state works fine.
+    spec_state = bert_moe_state_specs(state_template, optimizer)
+    b = P(DATA_AXIS)
+    sharded = _shard_map(per_shard, mesh=mesh,
+                         in_specs=(spec_state, (b, (b, b))),
+                         out_specs=(spec_state, P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_bert_moe_eval_step(mesh: Mesh, model, params_template):
+    """Expert-parallel held-out eval: same mesh, same all_to_all dispatch,
+    metrics psum-normalized globally (mirrors make_bert_cp_eval_step's
+    contract; --moe-experts --eval)."""
+    _check_moe_model(mesh, model)
+
+    def per_shard(params, batch):
+        ids, (labels, weights) = batch
+        logits, _aux = model.apply({"params": params}, ids, train=False)
+        ce = softmax_cross_entropy(logits, labels)
+        den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
+        hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        return {"loss": jax.lax.psum((ce * weights).sum(), DATA_AXIS) / den,
+                "masked_acc": jax.lax.psum((hit * weights).sum(), DATA_AXIS)
+                / den * 100.0}
+
+    b = P(DATA_AXIS)
+    sharded = _shard_map(per_shard, mesh=mesh,
+                         in_specs=(_moe_param_spec_tree(params_template),
+                                   (b, (b, b))),
+                         out_specs=P())
+    return jax.jit(sharded)

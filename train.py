@@ -140,6 +140,18 @@ def parse_args(argv=None):
                         "of this size (ppermute KV-ring attention — the "
                         "long-context training path); remaining devices "
                         "form the data axis")
+    p.add_argument("--moe-experts", type=int, default=0, metavar="E",
+                   help="switch-MoE BERT encoder FFNs with E experts, one "
+                        "per device over the 'data' axis (expert "
+                        "parallelism via all_to_all dispatch; requires "
+                        "E == device count)")
+    p.add_argument("--moe-aux-weight", type=float, default=1e-2,
+                   help="weight of the Switch load-balancing aux loss in "
+                        "the --moe-experts objective")
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                   help="per-expert token capacity multiplier under "
+                        "--moe-experts (overflow tokens ride the residual "
+                        "only)")
     # harness
     p.add_argument("--resume", default="", help="checkpoint dir to resume")
     p.add_argument("--checkpoint-dir", default="")
@@ -309,6 +321,9 @@ def main(argv=None):
     if args.context_parallel > 1:
         raise SystemExit("--context-parallel is wired for the BERT archs "
                          "(sequence sharding; images have no sequence)")
+    if args.moe_experts:
+        raise SystemExit("--moe-experts is wired for the BERT archs "
+                         "(switch-MoE replaces the transformer FFN)")
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
     devices = select_devices(args)
@@ -495,6 +510,22 @@ def _lm_main_impl(args, policy, scaler):
     pp = args.pipeline_parallel
     cp = args.context_parallel
     is_bert = args.arch.startswith("bert")
+    if args.moe_experts:
+        if not is_bert:
+            raise SystemExit("--moe-experts is wired for the BERT archs "
+                             "(switch-MoE replaces the encoder FFN)")
+        if tp > 1 or pp > 1 or cp > 1 or args.sequence_parallel \
+                or args.zero:
+            raise SystemExit("--moe-experts does not compose with "
+                             "--tensor/sequence/pipeline/context-parallel "
+                             "or --zero yet (the all_to_all dispatch "
+                             "assumes every local token routes over the "
+                             "full expert set on the data axis)")
+        if args.opt in ("lamb", "novograd") or args.larc:
+            raise SystemExit("--opt lamb/novograd and --larc compute "
+                             "per-tensor statistics that collapse on the "
+                             "EP-sharded [E, ...] expert stacks; use adam/"
+                             "sgd/adagrad with --moe-experts")
     if cp > 1:
         if not is_bert:
             raise SystemExit("--context-parallel is wired for the BERT "
@@ -626,6 +657,12 @@ def _lm_main_impl(args, policy, scaler):
         if tp > 1:
             mkw["tensor_parallel"] = True
             mkw["sequence_parallel"] = args.sequence_parallel
+        if args.moe_experts:
+            from apex_example_tpu.parallel.mesh import DATA_AXIS
+            mkw["moe_experts"] = args.moe_experts
+            mkw["moe_capacity_factor"] = args.moe_capacity_factor
+            # bind the MoE collectives to the axis the EP step maps over
+            mkw["moe_axis_name"] = DATA_AXIS
     elif tp > 1:
         mkw["tensor_parallel"] = True
     model = builder(**mkw)
@@ -783,13 +820,42 @@ def _lm_main_impl(args, policy, scaler):
         print(f"CP over {cp} sequence shards (local seq "
               f"{args.seq_len // cp}), TP over {tp}, DP over "
               f"{n_dev // (cp * tp)}: {mesh}")
+    elif args.moe_experts:
+        # Expert parallelism: one switch expert per device over the 'data'
+        # axis (workloads.make_bert_moe_train_step).  Init runs the dense-
+        # reference MoE path (no mesh axis bound), yielding the full
+        # [E, ...] stacks; device_put shards them one-expert-per-device.
+        from apex_example_tpu.workloads import (bert_moe_state_shardings,
+                                                make_bert_moe_train_step)
+        if args.moe_experts != n_dev:
+            raise SystemExit(f"--moe-experts {args.moe_experts} must equal "
+                             f"the device count {n_dev} (one expert per "
+                             f"device over the data axis)")
+        if args.batch_size % n_dev:
+            raise SystemExit(f"--batch-size {args.batch_size} not "
+                             f"divisible by {n_dev} devices")
+        if (args.batch_size // n_dev) % args.grad_accum:
+            raise SystemExit(f"per-shard batch {args.batch_size // n_dev} "
+                             f"not divisible by --grad-accum "
+                             f"{args.grad_accum}")
+        mesh = make_data_mesh(devices=devices)
+        state = create_train_state(jax.random.PRNGKey(args.seed), model,
+                                   optimizer, sample[:1], policy, scaler)
+        state = jax.device_put(
+            state, bert_moe_state_shardings(mesh, state, optimizer))
+        step_fn = make_bert_moe_train_step(
+            mesh, model, optimizer, policy, state_template=state,
+            aux_weight=args.moe_aux_weight, grad_accum=args.grad_accum)
+        mems = None
+        print(f"MoE over {n_dev} experts (1/device, capacity factor "
+              f"{args.moe_capacity_factor}), DP over {n_dev}: {mesh}")
     else:
         state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                    optimizer, sample[:1], policy, scaler,
                                    train_kwargs={} if not is_bert else None)
         mems = None if is_bert else model.init_mems(args.batch_size)
 
-    if tp > 1 or pp > 1 or cp > 1:
+    if tp > 1 or pp > 1 or cp > 1 or args.moe_experts:
         pass                                   # step_fn built above
     elif is_bert:
         if args.zero:
@@ -847,6 +913,13 @@ def _lm_main_impl(args, policy, scaler):
                     unp = lambda p: unpack_params_1f1b(
                         p, model.num_layers, pp, pp_chunks)
                 eval_fn = jax.jit(lambda p, b: core(unp(p), b))
+            elif args.moe_experts:
+                # Same mesh + all_to_all dispatch as training: a dense
+                # eval would need the expert stacks gathered onto one
+                # device and would route with a different (global)
+                # capacity.
+                from apex_example_tpu.workloads import make_bert_moe_eval_step
+                eval_fn = make_bert_moe_eval_step(mesh, model, state.params)
             else:
                 eval_fn = jax.jit(make_bert_eval_step(model))
         else:
@@ -859,10 +932,10 @@ def _lm_main_impl(args, policy, scaler):
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
         # resume (matches the reference harness, which does not persist them).
-        if tp == 1 and pp == 1 and n_dev > 1:
-            # (tp/pp > 1 templates are already mesh-placed above; DP and CP
-            # templates are not — CP state is replicated, so the replicated
-            # template is the right restore target for it too.)
+        if tp == 1 and pp == 1 and not args.moe_experts and n_dev > 1:
+            # (tp/pp > 1 and MoE templates are already mesh-placed above;
+            # DP and CP templates are not — CP state is replicated, so the
+            # replicated template is the right restore target for it too.)
             state = restore_under_mesh(
                 CheckpointManager(args.resume), state, mesh,
                 optimizer if args.zero else None)
