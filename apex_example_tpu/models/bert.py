@@ -76,6 +76,11 @@ class BertSelfAttention(nn.Module):
     # VMEM (parallel/context_parallel.ring_attention, flash-composed) —
     # the long-context training path (no reference analog).
     context_parallel: bool = False
+    # Causal (decoder-only) masking: position t attends to keys <= t.  On
+    # the einsum path a triangular bias; the flash kernel and the KV ring
+    # take it natively (their blockwise/chunkwise skip logic).  Consumed
+    # by models/gpt.py.
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -132,7 +137,12 @@ class BertSelfAttention(nn.Module):
                                  "attention mask (the benchmark MLM path "
                                  "uses none); masking would need per-chunk "
                                  "key-bias rotation in the ring")
-            ctx = ring_attention(q, k, v, scale=1.0 / float(hd) ** 0.5)
+            # causal=True: contiguous sequence chunks; blocks entirely in
+            # the future are skipped, the diagonal chunk masks blockwise
+            # (GPT's CP path; ring_attention_zigzag is the load-balanced
+            # variant for when throughput matters).
+            ctx = ring_attention(q, k, v, causal=self.causal,
+                                 scale=1.0 / float(hd) ** 0.5)
             return dense_out(ctx.reshape(*x.shape[:-1], d))
         if use_kernel and not self.tensor_parallel:
             # (TP runs the einsum path: pallas_call is opaque to the SPMD
@@ -140,17 +150,22 @@ class BertSelfAttention(nn.Module):
             from apex_example_tpu.ops.attention import flash_attention
             key_bias = None if mask_bias is None \
                 else mask_bias[:, 0, 0, :].astype(jnp.float32)
-            ctx = flash_attention(q, k, v, key_bias,
+            ctx = flash_attention(q, k, v, key_bias, causal=self.causal,
                                   scale=1.0 / float(hd) ** 0.5)
             return dense_out(ctx.reshape(*x.shape[:-1], d))
         sd = self.softmax_dtype
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(sd)
         logits = logits / jnp.sqrt(hd).astype(sd)
+        neg = -1e9 if sd == jnp.float32 else -1e4
+        if self.causal:
+            S = x.shape[1]
+            tri = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            logits = jnp.where(tri[None, None], logits,
+                               jnp.asarray(neg, sd))
         if mask_bias is not None:
             # Clamp before the cast: -1e9 overflows to -inf in fp16 and a
             # fully-masked row would softmax to NaN (cf. transformer_xl's
             # mask fill).  -1e4 is "minus infinity enough" for half dtypes.
-            neg = -1e9 if sd == jnp.float32 else -1e4
             logits = logits + jnp.maximum(mask_bias, neg).astype(sd)
         probs = nn.softmax(logits, axis=-1).astype(self.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -176,6 +191,7 @@ class BertLayer(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -191,6 +207,7 @@ class BertLayer(nn.Module):
                                  tensor_parallel=self.tensor_parallel,
                                  sequence_parallel=self.sequence_parallel,
                                  context_parallel=self.context_parallel,
+                                 causal=self.causal,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))

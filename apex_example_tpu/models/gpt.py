@@ -1,0 +1,138 @@
+"""GPT-style decoder-only causal LM.
+
+Reference status: the reference family's LM workloads are BERT (bidirectional
+MLM) and Transformer-XL (causal via segment recurrence); a plain decoder-only
+GPT is ABSENT there.  It is added here because it is the natural flagship for
+the framework's long-context machinery: causal flash attention
+(ops/attention.py), the causal ppermute KV ring (parallel/context_parallel),
+Megatron TP/SP (transformer/tensor_parallel), ZeRO, and switch-MoE FFNs all
+compose with it through the same module flags BERT uses — the model is the
+composition demo, not new machinery.
+
+Architecture: learned token+position embeddings -> N post-LN transformer
+layers (models/bert.BertLayer with causal=True) -> final dense+gelu+LN ->
+tied decoder head (vocab logits, fp32).  The objective is next-token CE
+(workloads.lm_loss) on an input/target pair shifted by one token — train.py
+generates seq_len+1 tokens per example so the model always sees exactly
+seq_len positions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_example_tpu.models.bert import BertLayer
+from apex_example_tpu.normalization import FusedLayerNorm
+
+
+class GPTForCausalLM(nn.Module):
+    """Decoder-only transformer; returns (B, S, vocab) fp32 logits (plus the
+    MoE aux loss when moe_experts > 0, mirroring BertForMaskedLM's
+    contract)."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 1024
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    ln_dtype: Optional[jnp.dtype] = None
+    softmax_dtype: jnp.dtype = jnp.float32
+    fused_attention: Union[bool, str] = "auto"
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+    context_parallel: bool = False
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_axis_name: str = "expert"
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = True):
+        del train  # no dropout in the pretraining benchmark path
+        if self.moe_experts and (self.tensor_parallel
+                                 or self.sequence_parallel
+                                 or self.context_parallel):
+            raise ValueError("moe_experts does not compose with "
+                             "tensor/sequence/context parallelism yet")
+        if self.sequence_parallel and self.context_parallel:
+            raise ValueError("sequence_parallel shards activations along "
+                             "the sequence dim the context axis already "
+                             "owns; CP composes with plain tensor_parallel")
+        ln_io = self.ln_dtype or self.dtype
+        b, L = input_ids.shape
+        if self.tensor_parallel:
+            from apex_example_tpu.transformer.tensor_parallel.layers import (
+                VocabParallelEmbedding)
+            word_emb = VocabParallelEmbedding(
+                self.vocab_size, self.hidden_size, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="word_embeddings")
+        else:
+            word_emb = nn.Embed(self.vocab_size, self.hidden_size,
+                                dtype=self.dtype,
+                                param_dtype=self.param_dtype,
+                                name="word_embeddings")
+        x = word_emb(input_ids)
+        pos = jnp.arange(L)[None, :]
+        if self.context_parallel:
+            # contiguous sequence chunks: global positions offset by the
+            # context-shard index (the causal ring keys on the same order)
+            from jax import lax as _lax
+            from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+            pos = pos + _lax.axis_index(CONTEXT_AXIS) * L
+        x = x + nn.Embed(self.max_position, self.hidden_size,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="position_embeddings")(pos)
+        x = FusedLayerNorm(dtype=ln_io, name="embeddings_ln")(
+            x.astype(ln_io)).astype(self.dtype)
+
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(self.num_layers):
+            x = BertLayer(self.hidden_size, self.num_heads,
+                          self.intermediate_size, self.dtype,
+                          self.param_dtype, self.ln_dtype,
+                          self.softmax_dtype,
+                          fused_attention=self.fused_attention,
+                          tensor_parallel=self.tensor_parallel,
+                          sequence_parallel=self.sequence_parallel,
+                          context_parallel=self.context_parallel,
+                          moe_experts=self.moe_experts,
+                          moe_capacity_factor=self.moe_capacity_factor,
+                          moe_axis_name=self.moe_axis_name,
+                          causal=True,
+                          name=f"layer_{i}")(x, None)
+            if self.moe_experts:
+                x, aux = x
+                aux_total = aux_total + aux
+
+        x = FusedLayerNorm(dtype=ln_io, name="final_ln")(
+            x.astype(ln_io)).astype(self.dtype)
+        logits = word_emb.attend(x)
+        bias_init = nn.initializers.zeros
+        if self.tensor_parallel:
+            bias_init = nn.with_partitioning(bias_init, ("model",))
+        logits = logits + self.param("lm_bias", bias_init,
+                                     (self.vocab_size,), jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if self.moe_experts:
+            return logits, aux_total / self.num_layers
+        return logits
+
+
+def gpt_base(**kw) -> GPTForCausalLM:
+    return GPTForCausalLM(**kw)
+
+
+def gpt_tiny(**kw) -> GPTForCausalLM:
+    """Test-scale configuration (same code path, CPU-friendly)."""
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position", 128)
+    return GPTForCausalLM(**kw)

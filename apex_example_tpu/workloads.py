@@ -167,6 +167,17 @@ def make_bert_eval_step(model):
     return eval_step
 
 
+def make_gpt_eval_step(model):
+    """(params, (x, y)) -> {loss}: next-token CE on a held-out batch; the
+    harness reports corpus ppl = exp(mean loss) like the TXL eval loop
+    (GPT has no recurrence carry, so the signature is BERT-shaped)."""
+    def eval_step(params, batch) -> Dict:
+        x, y = batch
+        logits = model.apply({"params": params}, x, train=False)
+        return {"loss": lm_loss(logits, y)}
+    return eval_step
+
+
 def make_txl_eval_step(model):
     """(params, mems, (inp, tgt)) -> (new_mems, {loss}): held-out next-token
     loss, threading the recurrence memory exactly like training (the
@@ -306,6 +317,68 @@ def make_bert_cp_eval_step(mesh: Mesh, model):
     return jax.jit(sharded)
 
 
+def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
+                           donate: bool = True, grad_accum: int = 1,
+                           state_shardings=None):
+    """Ring context-parallel GPT step over a ('data', 'context') mesh
+    (train.py --context-parallel with a gpt arch).
+
+    Same shape as :func:`make_bert_cp_train_step` with two causal
+    specifics: attention runs the CAUSAL KV ring (future chunks skipped,
+    diagonal chunk masked blockwise — models/bert.BertSelfAttention
+    causal=True under context_parallel), and the objective is next-token
+    CE averaged over the GLOBAL position count (a psum-ed sum / psum-ed
+    count, so shard means never misweight).  The (x, y) pair arrives
+    pre-shifted from the harness; both shard batch-over-'data' and
+    sequence-over-'context' in the same contiguous chunk order the ring
+    and the position offsets key on.
+    """
+    from apex_example_tpu.engine import make_train_step
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+
+    def cp_lm_loss(logits, y):
+        axes = (DATA_AXIS, CONTEXT_AXIS)
+        ce = softmax_cross_entropy(logits, y)
+        num = jax.lax.psum(ce.sum(), axes)
+        den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), axes)
+        return num / den
+
+    per_shard = make_train_step(model, optimizer, policy, axis_name=None,
+                                loss_fn=cp_lm_loss, compute_accuracy=False,
+                                grad_accum=grad_accum)
+    spec = P(DATA_AXIS, CONTEXT_AXIS)
+    sharded = _shard_map(per_shard, mesh=mesh,
+                         in_specs=(P(), (spec, spec)),
+                         out_specs=(P(), P()),
+                         **_cp_axis_names(mesh, model))
+    jkw = {}
+    if state_shardings is not None:
+        from jax.sharding import NamedSharding
+        jkw["out_shardings"] = (state_shardings, NamedSharding(mesh, P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else (), **jkw)
+
+
+def make_gpt_cp_eval_step(mesh: Mesh, model):
+    """Sequence-sharded held-out eval under the same causal KV ring
+    (train.py --context-parallel --eval, gpt archs): loss at the training
+    context length, psum-normalized globally."""
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+
+    def per_shard(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x, train=False)
+        axes = (DATA_AXIS, CONTEXT_AXIS)
+        ce = softmax_cross_entropy(logits, y)
+        den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), axes)
+        return {"loss": jax.lax.psum(ce.sum(), axes) / den}
+
+    spec = P(DATA_AXIS, CONTEXT_AXIS)
+    sharded = _shard_map(per_shard, mesh=mesh,
+                         in_specs=(P(), (spec, spec)), out_specs=P(),
+                         **_cp_axis_names(mesh, model))
+    return jax.jit(sharded)
+
+
 def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                               state_shardings,
                               max_grad_norm: float = 0.25,
@@ -410,7 +483,8 @@ def _check_moe_model(mesh: Mesh, model, optimizer=None):
 def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                              state_template: TrainState,
                              aux_weight: float = 1e-2,
-                             donate: bool = True, grad_accum: int = 1):
+                             donate: bool = True, grad_accum: int = 1,
+                             objective: str = "mlm"):
     """Expert-parallel BERT MLM step over the 'data' axis (train.py
     --moe-experts).
 
@@ -427,17 +501,25 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     """
     from apex_example_tpu.engine import make_train_step
     _check_moe_model(mesh, model, optimizer)
+    if objective not in ("mlm", "lm"):
+        raise ValueError(f"objective must be 'mlm' or 'lm', "
+                         f"got {objective!r}")
 
-    def moe_mlm_loss(out, target):
+    def moe_loss(out, target):
         logits, aux = out
-        labels, weights = target
-        ce = softmax_cross_entropy(logits, labels)
-        num = jax.lax.psum((ce * weights).sum(), DATA_AXIS)
-        den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
+        if objective == "mlm":
+            labels, weights = target
+            ce = softmax_cross_entropy(logits, labels)
+            num = jax.lax.psum((ce * weights).sum(), DATA_AXIS)
+            den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
+        else:                      # next-token CE (MoE GPT)
+            ce = softmax_cross_entropy(logits, target)
+            num = jax.lax.psum(ce.sum(), DATA_AXIS)
+            den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), DATA_AXIS)
         return num / den + jnp.asarray(aux_weight, jnp.float32) * aux
 
     per_shard = make_train_step(model, optimizer, policy, axis_name=None,
-                                loss_fn=moe_mlm_loss,
+                                loss_fn=moe_loss,
                                 compute_accuracy=False,
                                 grad_accum=grad_accum,
                                 finite_reduce_axes=DATA_AXIS)
@@ -446,31 +528,46 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     # device_put host state works fine.
     spec_state = bert_moe_state_specs(state_template, optimizer)
     b = P(DATA_AXIS)
+    batch_spec = (b, (b, b)) if objective == "mlm" else (b, b)
     sharded = _shard_map(per_shard, mesh=mesh,
-                         in_specs=(spec_state, (b, (b, b))),
+                         in_specs=(spec_state, batch_spec),
                          out_specs=(spec_state, P()))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def make_bert_moe_eval_step(mesh: Mesh, model, params_template):
+def make_bert_moe_eval_step(mesh: Mesh, model, params_template,
+                            objective: str = "mlm"):
     """Expert-parallel held-out eval: same mesh, same all_to_all dispatch,
     metrics psum-normalized globally (mirrors make_bert_cp_eval_step's
-    contract; --moe-experts --eval)."""
+    contract; --moe-experts --eval).  objective='lm' evaluates next-token
+    CE for MoE GPT ({loss} only — the harness reports ppl)."""
     _check_moe_model(mesh, model)
+    if objective not in ("mlm", "lm"):
+        raise ValueError(f"objective must be 'mlm' or 'lm', "
+                         f"got {objective!r}")
 
     def per_shard(params, batch):
-        ids, (labels, weights) = batch
-        logits, _aux = model.apply({"params": params}, ids, train=False)
-        ce = softmax_cross_entropy(logits, labels)
-        den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
-        hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
-        return {"loss": jax.lax.psum((ce * weights).sum(), DATA_AXIS) / den,
-                "masked_acc": jax.lax.psum((hit * weights).sum(), DATA_AXIS)
-                / den * 100.0}
+        if objective == "mlm":
+            ids, (labels, weights) = batch
+            logits, _aux = model.apply({"params": params}, ids, train=False)
+            ce = softmax_cross_entropy(logits, labels)
+            den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
+            hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+            return {"loss":
+                    jax.lax.psum((ce * weights).sum(), DATA_AXIS) / den,
+                    "masked_acc":
+                    jax.lax.psum((hit * weights).sum(), DATA_AXIS)
+                    / den * 100.0}
+        x, y = batch
+        logits, _aux = model.apply({"params": params}, x, train=False)
+        ce = softmax_cross_entropy(logits, y)
+        den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), DATA_AXIS)
+        return {"loss": jax.lax.psum(ce.sum(), DATA_AXIS) / den}
 
     b = P(DATA_AXIS)
+    batch_spec = (b, (b, b)) if objective == "mlm" else (b, b)
     sharded = _shard_map(per_shard, mesh=mesh,
                          in_specs=(_moe_param_spec_tree(params_template),
-                                   (b, (b, b))),
+                                   batch_spec),
                          out_specs=P())
     return jax.jit(sharded)

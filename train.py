@@ -48,10 +48,12 @@ from apex_example_tpu.parallel import (DDPConfig, LARC, is_main_process,
 from apex_example_tpu.utils import AverageMeter, Throughput
 from apex_example_tpu.utils.checkpoint import (CheckpointManager,
                                                restore_under_mesh)
-from apex_example_tpu.workloads import (make_sharded_txl_train_step,
+from apex_example_tpu.workloads import (lm_loss,
+                                        make_sharded_txl_train_step,
                                         make_txl_train_step, mlm_loss)
 
-LM_ARCHS = ["bert_base", "bert_tiny", "transformer_xl", "transformer_xl_tiny"]
+LM_ARCHS = ["bert_base", "bert_tiny", "gpt_base", "gpt_tiny",
+            "transformer_xl", "transformer_xl_tiny"]
 
 
 def parse_args(argv=None):
@@ -298,11 +300,12 @@ def main(argv=None):
     policy, scaler = amp.initialize(
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
-    if args.fused_attention and not args.arch.startswith("bert"):
+    if args.fused_attention and not args.arch.startswith(("bert", "gpt")):
         # Uniform rejection (not a silent no-op): the kernel is wired into
-        # the BERT attention module only — see lm_main for the
+        # the BERT/GPT attention module only — see lm_main for the
         # transformer_xl rationale.
-        raise SystemExit("--fused-attention is wired for BERT archs only")
+        raise SystemExit("--fused-attention is wired for the BERT/GPT "
+                         "archs only")
     if args.fused_attention and args.opt_level == "O3":
         # The kernel's softmax is always fp32; O3's contract is half softmax
         # and the module gate would silently fall back to the naive path.
@@ -510,10 +513,12 @@ def _lm_main_impl(args, policy, scaler):
     pp = args.pipeline_parallel
     cp = args.context_parallel
     is_bert = args.arch.startswith("bert")
+    is_gpt = args.arch.startswith("gpt")
     if args.moe_experts:
-        if not is_bert:
-            raise SystemExit("--moe-experts is wired for the BERT archs "
-                             "(switch-MoE replaces the encoder FFN)")
+        if not (is_bert or is_gpt):
+            raise SystemExit("--moe-experts is wired for the BERT/GPT "
+                             "archs (switch-MoE replaces the "
+                             "transformer FFN)")
         if tp > 1 or pp > 1 or cp > 1 or args.sequence_parallel \
                 or args.zero:
             raise SystemExit("--moe-experts does not compose with "
@@ -527,8 +532,8 @@ def _lm_main_impl(args, policy, scaler):
                              "EP-sharded [E, ...] expert stacks; use adam/"
                              "sgd/adagrad with --moe-experts")
     if cp > 1:
-        if not is_bert:
-            raise SystemExit("--context-parallel is wired for the BERT "
+        if not (is_bert or is_gpt):
+            raise SystemExit("--context-parallel is wired for the BERT/GPT "
                              "archs (transformer_xl's long-context story "
                              "is its segment recurrence)")
         if pp > 1 or args.zero:
@@ -554,7 +559,8 @@ def _lm_main_impl(args, policy, scaler):
         if not is_bert:
             raise SystemExit("--pipeline-parallel is wired for the BERT "
                              "archs (transformer_xl's recurrence carry "
-                             "spans all layers every segment)")
+                             "spans all layers every segment; GPT's "
+                             "pipeline form is not built yet)")
         if args.zero:
             raise SystemExit("--pipeline-parallel does not compose with "
                              "--zero (ZeRO shards optimizer state over "
@@ -590,8 +596,8 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--pipeline-parallel owns microbatching "
                              "(--microbatches); drop --grad-accum")
     if args.zero:
-        if not is_bert:
-            raise SystemExit("--zero is wired for the image and BERT "
+        if not (is_bert or is_gpt):
+            raise SystemExit("--zero is wired for the image and BERT/GPT "
                              "workloads (transformer_xl's step owns its "
                              "own grad-clip path)")
         if tp > 1:
@@ -600,8 +606,8 @@ def _lm_main_impl(args, policy, scaler):
                              "TP shards params over model)")
     if tp > 1:
         # (pure TP and the TP×PP composition alike)
-        if args.sequence_parallel and not is_bert:
-            raise SystemExit("--sequence-parallel is wired for the BERT "
+        if args.sequence_parallel and not (is_bert or is_gpt):
+            raise SystemExit("--sequence-parallel is wired for the BERT/GPT "
                              "archs (transformer_xl's recurrence carry is "
                              "batch-sharded, not sequence-sharded)")
         if args.fused_attention:
@@ -632,15 +638,17 @@ def _lm_main_impl(args, policy, scaler):
     else:
         devices = select_devices(args)
         n_dev = len(devices)
+    from apex_example_tpu.models.gpt import gpt_base, gpt_tiny
     builder = {"bert_base": bert_base, "bert_tiny": bert_tiny,
+               "gpt_base": gpt_base, "gpt_tiny": gpt_tiny,
                "transformer_xl": transformer_xl_base,
                "transformer_xl_tiny": transformer_xl_tiny}[args.arch]
     md = amp.module_dtypes(policy)
     mkw = dict(dtype=md.compute, param_dtype=md.param, ln_dtype=md.ln_io,
                softmax_dtype=md.softmax)
-    if args.arch in ("bert_base", "transformer_xl"):
+    if args.arch in ("bert_base", "gpt_base", "transformer_xl"):
         mkw["vocab_size"] = args.vocab_size
-    if is_bert:
+    if is_bert or is_gpt:
         # (transformer_xl is rejected in main(): its relative-position
         # logits are q·r terms, not an additive bias — blockwise attention
         # for it needs the rel-shift inside the kernel; its long-context
@@ -651,7 +659,8 @@ def _lm_main_impl(args, policy, scaler):
         # Long sequences need a position table that covers them — the
         # nn.Embed gather otherwise silently CLAMPS out-of-range position
         # ids to the last row (no error, garbage embeddings).
-        arch_maxpos = {"bert_base": 512, "bert_tiny": 128}[args.arch]
+        arch_maxpos = {"bert_base": 512, "bert_tiny": 128,
+                       "gpt_base": 1024, "gpt_tiny": 128}[args.arch]
         if args.seq_len > arch_maxpos:
             mkw["max_position"] = args.seq_len
         if tp > 1:
@@ -774,9 +783,11 @@ def _lm_main_impl(args, policy, scaler):
         state, shardings = create_gspmd_train_state(
             jax.random.PRNGKey(args.seed), mesh, model, optimizer,
             sample[:1], policy, scaler)
-        if is_bert:
+        if is_bert or is_gpt:
             step_fn = make_gspmd_train_step(mesh, model, optimizer, policy,
-                                            shardings, loss_fn=mlm_loss,
+                                            shardings,
+                                            loss_fn=mlm_loss if is_bert
+                                            else lm_loss,
                                             compute_accuracy=False,
                                             grad_accum=args.grad_accum)
             mems = None
@@ -798,7 +809,8 @@ def _lm_main_impl(args, policy, scaler):
         # models jointly.
         from apex_example_tpu.ops import _config as ops_config
         from apex_example_tpu.transformer import parallel_state
-        from apex_example_tpu.workloads import make_bert_cp_train_step
+        from apex_example_tpu.workloads import (make_bert_cp_train_step,
+                                                make_gpt_cp_train_step)
         if tp > 1:
             ops_config.set_force_xla(True)
         mesh = parallel_state.initialize_model_parallel(
@@ -813,9 +825,11 @@ def _lm_main_impl(args, policy, scaler):
         else:
             state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                        optimizer, sample[:1], policy, scaler)
-        step_fn = make_bert_cp_train_step(mesh, model_cp, optimizer, policy,
-                                          grad_accum=args.grad_accum,
-                                          state_shardings=cp_shardings)
+        make_cp = make_gpt_cp_train_step if is_gpt \
+            else make_bert_cp_train_step
+        step_fn = make_cp(mesh, model_cp, optimizer, policy,
+                          grad_accum=args.grad_accum,
+                          state_shardings=cp_shardings)
         mems = None
         print(f"CP over {cp} sequence shards (local seq "
               f"{args.seq_len // cp}), TP over {tp}, DP over "
@@ -845,33 +859,37 @@ def _lm_main_impl(args, policy, scaler):
             state, bert_moe_state_shardings(mesh, state, optimizer))
         step_fn = make_bert_moe_train_step(
             mesh, model, optimizer, policy, state_template=state,
-            aux_weight=args.moe_aux_weight, grad_accum=args.grad_accum)
+            aux_weight=args.moe_aux_weight, grad_accum=args.grad_accum,
+            objective="mlm" if is_bert else "lm")
         mems = None
         print(f"MoE over {n_dev} experts (1/device, capacity factor "
               f"{args.moe_capacity_factor}), DP over {n_dev}: {mesh}")
     else:
-        state = create_train_state(jax.random.PRNGKey(args.seed), model,
-                                   optimizer, sample[:1], policy, scaler,
-                                   train_kwargs={} if not is_bert else None)
-        mems = None if is_bert else model.init_mems(args.batch_size)
+        state = create_train_state(
+            jax.random.PRNGKey(args.seed), model, optimizer, sample[:1],
+            policy, scaler,
+            train_kwargs={} if not (is_bert or is_gpt) else None)
+        mems = None if (is_bert or is_gpt) \
+            else model.init_mems(args.batch_size)
 
     if tp > 1 or pp > 1 or cp > 1 or args.moe_experts:
         pass                                   # step_fn built above
-    elif is_bert:
+    elif is_bert or is_gpt:
+        loss_fn = mlm_loss if is_bert else lm_loss
         if args.zero:
             mesh = make_data_mesh(devices=devices)
             step_fn = make_zero_train_step(mesh, model, optimizer, policy,
-                                           loss_fn=mlm_loss,
+                                           loss_fn=loss_fn,
                                            compute_accuracy=False)
             print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
         elif n_dev > 1:
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_train_step(
-                mesh, model, optimizer, policy, loss_fn=mlm_loss,
+                mesh, model, optimizer, policy, loss_fn=loss_fn,
                 compute_accuracy=False, grad_accum=args.grad_accum)
         else:
             step_fn = jax.jit(make_train_step(model, optimizer, policy,
-                                              loss_fn=mlm_loss,
+                                              loss_fn=loss_fn,
                                               compute_accuracy=False,
                                               grad_accum=args.grad_accum),
                               donate_argnums=(0,))
@@ -894,8 +912,19 @@ def _lm_main_impl(args, policy, scaler):
     eval_fn = None
     if args.eval:
         from apex_example_tpu.workloads import (make_bert_eval_step,
+                                                make_gpt_eval_step,
                                                 make_txl_eval_step)
-        if is_bert:
+        if is_gpt:
+            if cp > 1:
+                from apex_example_tpu.workloads import make_gpt_cp_eval_step
+                eval_fn = make_gpt_cp_eval_step(mesh, model_cp)
+            elif args.moe_experts:
+                from apex_example_tpu.workloads import make_bert_moe_eval_step
+                eval_fn = make_bert_moe_eval_step(mesh, model, state.params,
+                                                  objective="lm")
+            else:
+                eval_fn = jax.jit(make_gpt_eval_step(model))
+        elif is_bert:
             if cp > 1:
                 # Sequence-sharded eval under the same KV ring as training
                 # — held-out loss AT the training context length (a dense
@@ -991,7 +1020,7 @@ def _lm_main_impl(args, policy, scaler):
             thr = Throughput(warmup_steps=2)
             for i in range(args.steps_per_epoch):
                 batch = batch_fn(global_step)
-                if is_bert:
+                if is_bert or is_gpt:
                     state, metrics = step_fn(state, batch)
                 else:
                     state, mems, metrics = step_fn(state, mems, batch)
@@ -1020,13 +1049,16 @@ def _lm_main_impl(args, policy, scaler):
                 import math
                 el = AverageMeter("loss")
                 e2 = AverageMeter("masked_acc")
-                emems = None if is_bert else model.init_mems(args.batch_size)
+                emems = None if (is_bert or is_gpt) \
+                    else model.init_mems(args.batch_size)
                 for j in range(args.eval_batches):
                     b = eval_batch_fn(
                         10_000_000 + epoch * args.eval_batches + j)
                     if is_bert:
                         em = eval_fn(state.params, b)
                         e2.update(float(em["masked_acc"]))
+                    elif is_gpt:
+                        em = eval_fn(state.params, b)
                     else:
                         emems, em = eval_fn(state.params, emems, b)
                     el.update(float(em["loss"]))
