@@ -10,7 +10,7 @@ compose with it through the same module flags BERT uses — the model is the
 composition demo, not new machinery.
 
 Architecture: learned token+position embeddings -> N post-LN transformer
-layers (models/bert.BertLayer with causal=True) -> final dense+gelu+LN ->
+layers (models/bert.BertLayer with causal=True) -> final LayerNorm ->
 tied decoder head (vocab logits, fp32).  The objective is next-token CE
 (workloads.lm_loss) on an input/target pair shifted by one token — train.py
 generates seq_len+1 tokens per example so the model always sees exactly

@@ -1,11 +1,14 @@
-"""Pipeline-parallel BERT training step (train.py --pipeline-parallel).
+"""Pipeline-parallel BERT/GPT training step (train.py --pipeline-parallel).
 
 Reference: apex.transformer's pipeline_parallel package drives Megatron-LM
 models through its schedules; the in-tree schedules here
 (pipeline_parallel/schedules.py) were previously exercised on synthetic
-stage functions only.  This module closes the integration gap for a real
-workload: BERT-for-MLM, stages = contiguous blocks of encoder layers,
-driven through the SPMD ring schedule over a ('pipe', 'data') mesh.
+stage functions only.  This module closes the integration gap for real
+workloads: BERT-for-MLM and GPT causal LM (one schedule body serves both —
+the GPT (x, y) batch becomes the MLM shape with all-ones weights, a
+causal layer stack, and its own head cell), stages = contiguous blocks of
+encoder layers, driven through the SPMD ring schedule over a
+('pipe', 'data') mesh.
 
 Design (TPU-native, *uniform-schedule* form):
 
@@ -79,16 +82,21 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-_REST_KEYS = ("word_embeddings", "position_embeddings", "embeddings_ln",
-              "mlm_dense", "mlm_ln", "mlm_bias")
+def _rest_keys(dense_params) -> Tuple[str, ...]:
+    """Everything that is not a stacked encoder layer — embedding + head
+    params.  Derived from the tree itself so one pack/unpack pair serves
+    both BertForMaskedLM (mlm_dense/mlm_ln/mlm_bias) and GPTForCausalLM
+    (final_ln/lm_bias)."""
+    return tuple(k for k in dense_params if not k.startswith("layer_"))
 
 
 def pack_params(dense_params: Dict[str, Any], num_layers: int
                 ) -> Dict[str, Any]:
-    """Dense BertForMaskedLM tree -> {'rest': ..., 'layers': stacked}."""
+    """Dense BertForMaskedLM/GPTForCausalLM tree ->
+    {'rest': ..., 'layers': stacked}."""
     layers = [dense_params[f"layer_{i}"] for i in range(num_layers)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
-    return {"rest": {k: dense_params[k] for k in _REST_KEYS},
+    return {"rest": {k: dense_params[k] for k in _rest_keys(dense_params)},
             "layers": stacked}
 
 
@@ -127,7 +135,7 @@ def pack_params_1f1b(dense_params: Dict[str, Any], num_layers: int,
         lambda *xs: jnp.stack(xs).reshape(num_chunks, per, *xs[0].shape),
         *[dense_params[f"layer_{j}"] for j in row]) for row in order]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *rows)
-    return {"rest": {k: dense_params[k] for k in _REST_KEYS},
+    return {"rest": {k: dense_params[k] for k in _rest_keys(dense_params)},
             "layers": stacked}
 
 
@@ -143,8 +151,9 @@ def unpack_params_1f1b(packed: Dict[str, Any], num_layers: int,
     return out
 
 
-def _embed(rest, ids, model: BertForMaskedLM):
-    """Embedding + post-embedding LN, matching BertForMaskedLM.__call__."""
+def _embed(rest, ids, model):
+    """Embedding + post-embedding LN, matching BertForMaskedLM.__call__
+    (GPTForCausalLM uses the identical names and math)."""
     dtype = model.dtype
     ln_io = model.ln_dtype or dtype
     L = ids.shape[-1]
@@ -176,7 +185,22 @@ def _head_loss_sum(rest, y, labels, weights, model: BertForMaskedLM):
     return (ce * weights).sum()
 
 
-def _tp_layer_specs(model: BertForMaskedLM):
+def _gpt_head_loss_sum(rest, y, labels, weights, model):
+    """GPT head (final LN + tied decoder) + CE *sum*, matching
+    GPTForCausalLM.__call__.  ``weights`` is all-ones from the factory, so
+    the shared global denominator turns the sum into exactly
+    workloads.lm_loss's mean over the full batch."""
+    dtype = model.dtype
+    ln_io = model.ln_dtype or dtype
+    x = layer_norm(y.astype(ln_io), rest["final_ln"]["scale"],
+                   rest["final_ln"]["bias"]).astype(dtype)
+    logits = x @ rest["word_embeddings"]["embedding"].astype(dtype).T
+    logits = logits.astype(jnp.float32) + rest["lm_bias"]
+    ce = softmax_cross_entropy(logits, labels)
+    return (ce * weights).sum()
+
+
+def _tp_layer_specs(model):
     """Per-leaf PartitionSpecs of ONE encoder layer under TP (the flax
     with_partitioning metadata of the column/row-parallel layers), shaped
     like an entry of the packed ``layers`` subtree minus the stacked dim."""
@@ -397,13 +421,27 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                 f"does not match the {schedule!r} schedule's param layout "
                 f"(needs stacked_dims={want})")
     opt = _wrap_optimizer(optimizer)
+    from apex_example_tpu.models.gpt import GPTForCausalLM
+    is_gpt = isinstance(model, GPTForCausalLM)
+    head_sum = _gpt_head_loss_sum if is_gpt else _head_loss_sum
     layer_mod = BertLayer(model.hidden_size, model.num_heads,
                           model.intermediate_size, model.dtype,
                           model.param_dtype, model.ln_dtype,
                           model.softmax_dtype,
                           fused_attention=model.fused_attention,
                           tensor_parallel=model.tensor_parallel,
-                          sequence_parallel=model.sequence_parallel)
+                          sequence_parallel=model.sequence_parallel,
+                          causal=is_gpt)
+
+    def _unpack(batch):
+        """One schedule body serves both objectives: GPT's (x, y) pair
+        becomes the MLM shape with all-ones weights, under which the
+        global weighted-CE normalization IS the next-token mean."""
+        if is_gpt:
+            ids, labels = batch
+            return ids, labels, jnp.ones(labels.shape, jnp.float32)
+        ids, (labels, weights) = batch
+        return ids, labels, weights
 
     def stage_fn(stage_layers, x):
         # stage_layers leaves: [per_stage, ...] — scan applies them in
@@ -453,7 +491,7 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                           opt_state=new_opt_state, scaler=scaler), metrics
 
     def per_shard_ring(state: TrainState, batch):
-        ids, (labels, weights) = batch
+        ids, labels, weights = _unpack(batch)
         M, b, mb = _split(ids)
 
         def scaled_loss_fn(params):
@@ -465,8 +503,8 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
             loss = spmd_pipeline(
                 stage_fn,
-                lambda y, tgt: _head_loss_sum(rest, y, tgt[0], tgt[1],
-                                              model) * M / denom,
+                lambda y, tgt: head_sum(rest, y, tgt[0], tgt[1],
+                                        model) * M / denom,
                 params["layers"], mb(x), (mb(labels), mb(weights)))
             loss = lax.psum(loss, DATA_AXIS)
             return amp_lib.scale_loss(loss, state.scaler), loss
@@ -485,14 +523,14 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         over 'pipe' only, every data shard takes the same branch); the
         pipe axis, over which the predicates DO vary, is kept local and
         reduced with the two explicit psums below."""
-        ids, (labels, weights) = batch
+        ids, labels, weights = _unpack(batch)
         M, b, mb = _split(ids)
         rest = state.params["rest"]
         x, vjp_embed = jax.vjp(lambda r: _embed(r, ids, model), rest)
         denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
 
         def last_fn(hp, y, tgt):
-            raw = _head_loss_sum(hp, y, tgt[0], tgt[1], model) * M / denom
+            raw = head_sum(hp, y, tgt[0], tgt[1], model) * M / denom
             return amp_lib.scale_loss(raw, state.scaler)
 
         layers = jax.tree_util.tree_map(lambda l: l[0],
@@ -547,8 +585,10 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                 "(jax >= 0.7); the jax.experimental fallback cannot "
                 "express a partially-manual mesh")
         kw["axis_names"] = {PIPE_AXIS, DATA_AXIS}
+    bspec = (P(DATA_AXIS), P(DATA_AXIS)) if is_gpt \
+        else (P(DATA_AXIS), (P(DATA_AXIS), P(DATA_AXIS)))
     sharded = _shard_map(
         per_shard, mesh=mesh,
-        in_specs=(state_spec, (P(DATA_AXIS), (P(DATA_AXIS), P(DATA_AXIS)))),
+        in_specs=(state_spec, bspec),
         out_specs=(state_spec, P()), **kw)
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -47,6 +47,17 @@ def lm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return softmax_cross_entropy(logits, labels).mean()
 
 
+def _global_lm_loss(logits, labels, axes):
+    """Next-token CE averaged over the GLOBAL position count: psum-ed sum /
+    psum-ed count, so shards (whose local means would misweight) combine
+    exactly to lm_loss on the full batch.  One definition shared by the CP
+    train/eval and MoE 'lm' train/eval steps."""
+    ce = softmax_cross_entropy(logits, labels)
+    num = jax.lax.psum(ce.sum(), axes)
+    den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), axes)
+    return num / den
+
+
 def make_txl_train_step(model, optimizer, policy: Policy,
                         ddp: Optional[DDPConfig] = None,
                         axis_name: Optional[str] = None,
@@ -337,11 +348,7 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
 
     def cp_lm_loss(logits, y):
-        axes = (DATA_AXIS, CONTEXT_AXIS)
-        ce = softmax_cross_entropy(logits, y)
-        num = jax.lax.psum(ce.sum(), axes)
-        den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), axes)
-        return num / den
+        return _global_lm_loss(logits, y, (DATA_AXIS, CONTEXT_AXIS))
 
     per_shard = make_train_step(model, optimizer, policy, axis_name=None,
                                 loss_fn=cp_lm_loss, compute_accuracy=False,
@@ -367,10 +374,8 @@ def make_gpt_cp_eval_step(mesh: Mesh, model):
     def per_shard(params, batch):
         x, y = batch
         logits = model.apply({"params": params}, x, train=False)
-        axes = (DATA_AXIS, CONTEXT_AXIS)
-        ce = softmax_cross_entropy(logits, y)
-        den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), axes)
-        return {"loss": jax.lax.psum(ce.sum(), axes) / den}
+        return {"loss": _global_lm_loss(logits, y,
+                                        (DATA_AXIS, CONTEXT_AXIS))}
 
     spec = P(DATA_AXIS, CONTEXT_AXIS)
     sharded = _shard_map(per_shard, mesh=mesh,
@@ -512,11 +517,11 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
             ce = softmax_cross_entropy(logits, labels)
             num = jax.lax.psum((ce * weights).sum(), DATA_AXIS)
             den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
-        else:                      # next-token CE (MoE GPT)
-            ce = softmax_cross_entropy(logits, target)
-            num = jax.lax.psum(ce.sum(), DATA_AXIS)
-            den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), DATA_AXIS)
-        return num / den + jnp.asarray(aux_weight, jnp.float32) * aux
+            return (num / den
+                    + jnp.asarray(aux_weight, jnp.float32) * aux)
+        # next-token CE (MoE GPT)
+        return (_global_lm_loss(logits, target, DATA_AXIS)
+                + jnp.asarray(aux_weight, jnp.float32) * aux)
 
     per_shard = make_train_step(model, optimizer, policy, axis_name=None,
                                 loss_fn=moe_loss,
@@ -560,9 +565,7 @@ def make_bert_moe_eval_step(mesh: Mesh, model, params_template,
                     / den * 100.0}
         x, y = batch
         logits, _aux = model.apply({"params": params}, x, train=False)
-        ce = softmax_cross_entropy(logits, y)
-        den = jax.lax.psum(jnp.asarray(ce.size, jnp.float32), DATA_AXIS)
-        return {"loss": jax.lax.psum(ce.sum(), DATA_AXIS) / den}
+        return {"loss": _global_lm_loss(logits, y, DATA_AXIS)}
 
     b = P(DATA_AXIS)
     batch_spec = (b, (b, b)) if objective == "mlm" else (b, b)

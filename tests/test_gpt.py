@@ -142,6 +142,72 @@ def test_gpt_cp_matches_dense(devices8):
                                    rtol=1e-4, atol=1e-5, err_msg=str(ka))
 
 
+@pytest.mark.parametrize("sched", ["ring", "1f1b"])
+def test_gpt_pp_matches_dense(devices8, sched):
+    """3 pipeline-parallel GPT train steps == 3 dense steps — the GPT head
+    cell (final LN + tied decoder) and the all-ones-weights normalization
+    (== next-token mean) inside the schedule are the parts worth pinning."""
+    from apex_example_tpu.engine import TrainState
+    from apex_example_tpu.transformer.bert_pipeline import (
+        bert_pp_state_shardings, make_bert_pp_train_step, pack_params,
+        pack_params_1f1b, unpack_params, unpack_params_1f1b)
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("pipe", "data"))
+    policy, scaler = amp.initialize("O0")
+    model = gpt_tiny()
+    V = model.vocab_size
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+    sample = _batch(0, V)[0][:1]
+    state_d = create_train_state(jax.random.PRNGKey(0), model, opt(),
+                                 sample, policy, scaler)
+    step_d = jax.jit(make_train_step(model, opt(), policy, loss_fn=lm_loss,
+                                     compute_accuracy=False))
+    zopt = opt()
+    if sched == "ring":
+        packed = pack_params(state_d.params, model.num_layers)
+        unp = lambda p: unpack_params(p, model.num_layers)
+    else:
+        packed = pack_params_1f1b(state_d.params, model.num_layers, 2, 1)
+        unp = lambda p: unpack_params_1f1b(p, model.num_layers, 2, 1)
+    state_p = TrainState(step=jnp.zeros((), jnp.int32), params=packed,
+                         batch_stats={}, opt_state=zopt.init(packed),
+                         scaler=state_d.scaler)
+    state_p = jax.device_put(
+        state_p, bert_pp_state_shardings(mesh, state_p, zopt))
+    step_p = make_bert_pp_train_step(mesh, model, zopt, policy,
+                                     microbatches=2, donate=False,
+                                     schedule=sched)
+    for i in range(3):
+        b = _batch(i, V)
+        state_d, m_d = step_d(state_d, b)
+        state_p, m_p = step_p(state_p, b)
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_p["loss"]),
+                                   rtol=3e-5)
+    key = lambda kv: str(kv[0])
+    for (ka, a), (_, b2) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(state_d.params),
+                   key=key),
+            sorted(jax.tree_util.tree_leaves_with_path(unp(state_p.params)),
+                   key=key)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=1e-4, atol=1e-5, err_msg=str(ka))
+
+
+def test_train_py_cli_gpt_pp(devices8, capsys):
+    """GPT rides the pipeline from the CLI (ring + eval via unpack)."""
+    import train as train_mod
+    from apex_example_tpu.transformer import parallel_state
+    argv = ["--arch", "gpt_tiny", "--pipeline-parallel", "2",
+            "--microbatches", "2", "--batch-size", str(BATCH),
+            "--seq-len", str(SEQ), "--epochs", "1", "--steps-per-epoch",
+            "2", "--opt", "adam", "--lr", "1e-3", "--opt-level", "O0",
+            "--print-freq", "1", "--eval", "--eval-batches", "2"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        parallel_state.set_mesh(None)
+    assert "ppl" in capsys.readouterr().out
+
+
 def test_train_py_cli_gpt(devices8, capsys):
     """DDP + eval ppl from the CLI."""
     import train as train_mod
@@ -169,5 +235,11 @@ def test_train_py_gpt_rejections():
     import train as train_mod
     base = ["--arch", "gpt_tiny", "--batch-size", "16", "--seq-len", "16",
             "--epochs", "1", "--steps-per-epoch", "1"]
-    with pytest.raises(SystemExit):   # no GPT pipeline form yet
-        train_mod.main(base + ["--pipeline-parallel", "2"])
+    with pytest.raises(SystemExit):   # MoE does not ride the pipeline
+        train_mod.main(base + ["--moe-experts", "4",
+                               "--pipeline-parallel", "2"])
+    with pytest.raises(SystemExit):   # TXL's recurrence spans all layers
+        train_mod.main(["--arch", "transformer_xl_tiny",
+                        "--pipeline-parallel", "2", "--batch-size", "16",
+                        "--seq-len", "16", "--epochs", "1",
+                        "--steps-per-epoch", "1"])

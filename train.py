@@ -120,7 +120,8 @@ def parse_args(argv=None):
                    help="with --tensor-parallel: keep activations outside "
                         "the TP blocks sequence-sharded (Megatron-SP)")
     p.add_argument("--pipeline-parallel", type=int, default=1, metavar="PP",
-                   help="split BERT's encoder layers into this many stages "
+                   help="split BERT/GPT's encoder layers into this many "
+                        "stages "
                         "driven by the SPMD ring schedule "
                         "(transformer/bert_pipeline.py); remaining devices "
                         "form the data axis")
@@ -556,11 +557,10 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                              f"--context-parallel {cp}")
     if pp > 1:
-        if not is_bert:
-            raise SystemExit("--pipeline-parallel is wired for the BERT "
-                             "archs (transformer_xl's recurrence carry "
-                             "spans all layers every segment; GPT's "
-                             "pipeline form is not built yet)")
+        if not (is_bert or is_gpt):
+            raise SystemExit("--pipeline-parallel is wired for the "
+                             "BERT/GPT archs (transformer_xl's recurrence "
+                             "carry spans all layers every segment)")
         if args.zero:
             raise SystemExit("--pipeline-parallel does not compose with "
                              "--zero (ZeRO shards optimizer state over "
@@ -914,28 +914,21 @@ def _lm_main_impl(args, policy, scaler):
         from apex_example_tpu.workloads import (make_bert_eval_step,
                                                 make_gpt_eval_step,
                                                 make_txl_eval_step)
-        if is_gpt:
-            if cp > 1:
-                from apex_example_tpu.workloads import make_gpt_cp_eval_step
-                eval_fn = make_gpt_cp_eval_step(mesh, model_cp)
-            elif args.moe_experts:
-                from apex_example_tpu.workloads import make_bert_moe_eval_step
-                eval_fn = make_bert_moe_eval_step(mesh, model, state.params,
-                                                  objective="lm")
-            else:
-                eval_fn = jax.jit(make_gpt_eval_step(model))
-        elif is_bert:
+        if is_bert or is_gpt:
             if cp > 1:
                 # Sequence-sharded eval under the same KV ring as training
                 # — held-out loss AT the training context length (a dense
                 # eval forward would materialize the (L, L) scores CP
                 # exists to shard).
-                from apex_example_tpu.workloads import make_bert_cp_eval_step
-                eval_fn = make_bert_cp_eval_step(mesh, model_cp)
+                from apex_example_tpu.workloads import (
+                    make_bert_cp_eval_step, make_gpt_cp_eval_step)
+                eval_fn = (make_gpt_cp_eval_step if is_gpt
+                           else make_bert_cp_eval_step)(mesh, model_cp)
             elif pp > 1:
                 from apex_example_tpu.transformer.bert_pipeline import (
                     unpack_params, unpack_params_1f1b)
-                core = make_bert_eval_step(model)
+                core = make_gpt_eval_step(model) if is_gpt \
+                    else make_bert_eval_step(model)
                 if pp_sched == "ring":
                     unp = lambda p: unpack_params(p, model.num_layers)
                 else:
@@ -948,9 +941,12 @@ def _lm_main_impl(args, policy, scaler):
                 # device and would route with a different (global)
                 # capacity.
                 from apex_example_tpu.workloads import make_bert_moe_eval_step
-                eval_fn = make_bert_moe_eval_step(mesh, model, state.params)
+                eval_fn = make_bert_moe_eval_step(
+                    mesh, model, state.params,
+                    objective="mlm" if is_bert else "lm")
             else:
-                eval_fn = jax.jit(make_bert_eval_step(model))
+                eval_fn = jax.jit((make_gpt_eval_step if is_gpt
+                                   else make_bert_eval_step)(model))
         else:
             eval_fn = jax.jit(make_txl_eval_step(model))
 
