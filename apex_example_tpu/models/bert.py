@@ -81,6 +81,12 @@ class BertSelfAttention(nn.Module):
     # take it natively (their blockwise/chunkwise skip logic).  Consumed
     # by models/gpt.py.
     causal: bool = False
+    # Load-balanced causal ring (context_parallel + causal only): local
+    # shards hold zigzag chunk pairs (i, 2n-1-i) and attention runs
+    # ring_attention_zigzag, so every device does identical live work per
+    # ring step.  The caller (workloads.make_gpt_cp_train_step
+    # zigzag=True) reorders the batch with zigzag_shard.
+    cp_zigzag: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -137,12 +143,23 @@ class BertSelfAttention(nn.Module):
                                  "attention mask (the benchmark MLM path "
                                  "uses none); masking would need per-chunk "
                                  "key-bias rotation in the ring")
-            # causal=True: contiguous sequence chunks; blocks entirely in
-            # the future are skipped, the diagonal chunk masks blockwise
-            # (GPT's CP path; ring_attention_zigzag is the load-balanced
-            # variant for when throughput matters).
-            ctx = ring_attention(q, k, v, causal=self.causal,
-                                 scale=1.0 / float(hd) ** 0.5)
+            if self.cp_zigzag:
+                if not self.causal:
+                    raise ValueError(
+                        "cp_zigzag is the load-BALANCED CAUSAL layout; "
+                        "non-causal CP has uniform work already — use the "
+                        "plain ring")
+                from apex_example_tpu.parallel.context_parallel import (
+                    ring_attention_zigzag)
+                ctx = ring_attention_zigzag(q, k, v,
+                                            scale=1.0 / float(hd) ** 0.5)
+            else:
+                # causal=True: contiguous sequence chunks; blocks entirely
+                # in the future are skipped, the diagonal chunk masks
+                # blockwise (GPT's CP path; cp_zigzag is the load-balanced
+                # variant).
+                ctx = ring_attention(q, k, v, causal=self.causal,
+                                     scale=1.0 / float(hd) ** 0.5)
             return dense_out(ctx.reshape(*x.shape[:-1], d))
         if use_kernel and not self.tensor_parallel:
             # (TP runs the einsum path: pallas_call is opaque to the SPMD
@@ -192,6 +209,7 @@ class BertLayer(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
     causal: bool = False
+    cp_zigzag: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -208,6 +226,7 @@ class BertLayer(nn.Module):
                                  sequence_parallel=self.sequence_parallel,
                                  context_parallel=self.context_parallel,
                                  causal=self.causal,
+                                 cp_zigzag=self.cp_zigzag,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))

@@ -50,6 +50,11 @@ class GPTForCausalLM(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
+    # Load-balanced causal ring (with context_parallel): local shards hold
+    # zigzag chunk pairs (i, 2n-1-i); position ids follow the same order.
+    # The step factory (workloads.make_gpt_cp_train_step(zigzag=True))
+    # reorders the batch with parallel.context_parallel.zigzag_shard.
+    cp_zigzag: bool = False
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -79,11 +84,21 @@ class GPTForCausalLM(nn.Module):
         x = word_emb(input_ids)
         pos = jnp.arange(L)[None, :]
         if self.context_parallel:
-            # contiguous sequence chunks: global positions offset by the
-            # context-shard index (the causal ring keys on the same order)
             from jax import lax as _lax
             from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
-            pos = pos + _lax.axis_index(CONTEXT_AXIS) * L
+            i = _lax.axis_index(CONTEXT_AXIS)
+            if self.cp_zigzag:
+                # zigzag layout: this shard's halves are global chunks i
+                # and 2n-1-i (each of length L/2)
+                n = _lax.axis_size(CONTEXT_AXIS)
+                c = L // 2
+                pos = jnp.concatenate(
+                    [jnp.arange(c) + i * c,
+                     jnp.arange(c) + (2 * n - 1 - i) * c])[None, :]
+            else:
+                # contiguous chunks: global positions offset by the shard
+                # index (the causal ring keys on the same order)
+                pos = pos + i * L
         x = x + nn.Embed(self.max_position, self.hidden_size,
                          dtype=self.dtype, param_dtype=self.param_dtype,
                          name="position_embeddings")(pos)
@@ -103,7 +118,7 @@ class GPTForCausalLM(nn.Module):
                           moe_experts=self.moe_experts,
                           moe_capacity_factor=self.moe_capacity_factor,
                           moe_axis_name=self.moe_axis_name,
-                          causal=True,
+                          causal=True, cp_zigzag=self.cp_zigzag,
                           name=f"layer_{i}")(x, None)
             if self.moe_experts:
                 x, aux = x

@@ -328,9 +328,32 @@ def make_bert_cp_eval_step(mesh: Mesh, model):
     return jax.jit(sharded)
 
 
+def _zigzag_wrap(fn, mesh, model, zigzag: bool):
+    """Shared zigzag plumbing for the GPT CP train/eval factories: enforce
+    that the batch layout and the model's position ids/ring agree (a
+    mismatch trains/evals on inconsistently ordered data with no error),
+    and wrap ``fn`` with the zigzag_shard pre-pass when on."""
+    if zigzag != bool(getattr(model, "cp_zigzag", False)):
+        raise ValueError(
+            f"zigzag={zigzag} but model.cp_zigzag="
+            f"{getattr(model, 'cp_zigzag', False)} — the batch layout and "
+            "the model's position ids/ring must agree or the computation "
+            "is silently wrong")
+    if not zigzag:
+        return fn
+    from apex_example_tpu.parallel.context_parallel import zigzag_shard
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+    n = mesh.shape[CONTEXT_AXIS]
+
+    def wrapped(carry, batch):
+        x, y = batch
+        return fn(carry, (zigzag_shard(x, n), zigzag_shard(y, n)))
+    return wrapped
+
+
 def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                            donate: bool = True, grad_accum: int = 1,
-                           state_shardings=None):
+                           state_shardings=None, zigzag: bool = False):
     """Ring context-parallel GPT step over a ('data', 'context') mesh
     (train.py --context-parallel with a gpt arch).
 
@@ -343,6 +366,15 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     pre-shifted from the harness; both shard batch-over-'data' and
     sequence-over-'context' in the same contiguous chunk order the ring
     and the position offsets key on.
+
+    ``zigzag=True`` switches to the load-BALANCED causal ring
+    (parallel.context_parallel.ring_attention_zigzag): the factory
+    reorders both sequences with ``zigzag_shard`` before the shard_map,
+    so P('context') hands device i its (i, 2n-1-i) chunk pair and every
+    ring step does identical live work on every device.  The model must
+    be built with ``cp_zigzag=True`` (zigzag position ids + zigzag ring).
+    Losses/grads are order-invariant sums, so the trajectory equals the
+    contiguous form exactly.
     """
     from apex_example_tpu.engine import make_train_step
     from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
@@ -358,6 +390,7 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                          in_specs=(P(), (spec, spec)),
                          out_specs=(P(), P()),
                          **_cp_axis_names(mesh, model))
+    sharded = _zigzag_wrap(sharded, mesh, model, zigzag)
     jkw = {}
     if state_shardings is not None:
         from jax.sharding import NamedSharding
@@ -365,7 +398,7 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     return jax.jit(sharded, donate_argnums=(0,) if donate else (), **jkw)
 
 
-def make_gpt_cp_eval_step(mesh: Mesh, model):
+def make_gpt_cp_eval_step(mesh: Mesh, model, zigzag: bool = False):
     """Sequence-sharded held-out eval under the same causal KV ring
     (train.py --context-parallel --eval, gpt archs): loss at the training
     context length, psum-normalized globally."""
@@ -381,7 +414,7 @@ def make_gpt_cp_eval_step(mesh: Mesh, model):
     sharded = _shard_map(per_shard, mesh=mesh,
                          in_specs=(P(), (spec, spec)), out_specs=P(),
                          **_cp_axis_names(mesh, model))
-    return jax.jit(sharded)
+    return jax.jit(_zigzag_wrap(sharded, mesh, model, zigzag))
 
 
 def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,

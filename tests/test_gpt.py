@@ -109,15 +109,19 @@ def test_gpt_tp_matches_dense(devices8):
         parallel_state.set_mesh(None)
 
 
-def test_gpt_cp_matches_dense(devices8):
+@pytest.mark.parametrize("zigzag", [False, True])
+def test_gpt_cp_matches_dense(devices8, zigzag):
     """3 causal-KV-ring CP train steps on a (data=2, context=4) mesh == 3
     dense steps — the causal chunk skipping and the global position-count
-    loss normalization are the parts worth pinning."""
+    loss normalization are the parts worth pinning.  zigzag=True runs the
+    load-balanced layout: the factory's zigzag_shard pre-pass, the model's
+    zigzag position ids, and ring_attention_zigzag's four-pair chunk
+    algebra must compose back to the exact dense trajectory."""
     from apex_example_tpu.workloads import make_gpt_cp_train_step
     mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("data", "context"))
     policy, scaler = amp.initialize("O0")
     dense = gpt_tiny()
-    cp_model = gpt_tiny(context_parallel=True)
+    cp_model = gpt_tiny(context_parallel=True, cp_zigzag=zigzag)
     V = dense.vocab_size
     opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
     sample = _batch(0, V)[0][:1]
@@ -128,7 +132,7 @@ def test_gpt_cp_matches_dense(devices8):
     state_c = create_train_state(jax.random.PRNGKey(0), dense, opt(),
                                  sample, policy, scaler)
     step_c = make_gpt_cp_train_step(mesh, cp_model, opt(), policy,
-                                    donate=False)
+                                    donate=False, zigzag=zigzag)
     for i in range(3):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
@@ -231,10 +235,29 @@ def test_train_py_cli_gpt_moe(devices8, capsys):
     assert "ppl" in capsys.readouterr().out
 
 
+def test_train_py_cli_gpt_cp_zigzag(devices8, capsys):
+    """Load-balanced causal ring from the CLI."""
+    import train as train_mod
+    argv = ["--arch", "gpt_tiny", "--context-parallel", "4", "--cp-zigzag",
+            "--batch-size", "16", "--seq-len", "16", "--epochs", "1",
+            "--steps-per-epoch", "2", "--opt", "adam", "--lr", "1e-3",
+            "--opt-level", "O0", "--print-freq", "1",
+            "--eval", "--eval-batches", "2"]
+    assert train_mod.main(argv) == 0
+    assert "ppl" in capsys.readouterr().out
+
+
 def test_train_py_gpt_rejections():
     import train as train_mod
     base = ["--arch", "gpt_tiny", "--batch-size", "16", "--seq-len", "16",
             "--epochs", "1", "--steps-per-epoch", "1"]
+    with pytest.raises(SystemExit):   # zigzag needs CP
+        train_mod.main(base + ["--cp-zigzag"])
+    with pytest.raises(SystemExit):   # zigzag balances the CAUSAL mask
+        train_mod.main(["--arch", "bert_tiny", "--context-parallel", "4",
+                        "--cp-zigzag", "--batch-size", "16",
+                        "--seq-len", "16", "--epochs", "1",
+                        "--steps-per-epoch", "1"])
     with pytest.raises(SystemExit):   # MoE does not ride the pipeline
         train_mod.main(base + ["--moe-experts", "4",
                                "--pipeline-parallel", "2"])

@@ -143,6 +143,11 @@ def parse_args(argv=None):
                         "of this size (ppermute KV-ring attention — the "
                         "long-context training path); remaining devices "
                         "form the data axis")
+    p.add_argument("--cp-zigzag", action="store_true",
+                   help="with --context-parallel on a gpt arch: the "
+                        "load-balanced causal ring (zigzag chunk layout — "
+                        "each device holds chunks (i, 2n-1-i), so every "
+                        "ring step does identical live work everywhere)")
     p.add_argument("--moe-experts", type=int, default=0, metavar="E",
                    help="switch-MoE BERT encoder FFNs with E experts, one "
                         "per device over the 'data' axis (expert "
@@ -556,6 +561,19 @@ def _lm_main_impl(args, policy, scaler):
         if args.seq_len % cp:
             raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                              f"--context-parallel {cp}")
+        if args.cp_zigzag:
+            if not is_gpt:
+                raise SystemExit("--cp-zigzag balances the CAUSAL mask's "
+                                 "ring work (gpt archs); BERT attention is "
+                                 "bidirectional — every device already "
+                                 "does uniform work on the plain ring")
+            if args.seq_len % (2 * cp):
+                raise SystemExit(f"--cp-zigzag needs --seq-len "
+                                 f"({args.seq_len}) divisible by 2x"
+                                 f"--context-parallel ({2 * cp})")
+    elif args.cp_zigzag:
+        raise SystemExit("--cp-zigzag only applies with "
+                         "--context-parallel > 1")
     if pp > 1:
         if not (is_bert or is_gpt):
             raise SystemExit("--pipeline-parallel is wired for the "
@@ -815,7 +833,9 @@ def _lm_main_impl(args, policy, scaler):
             ops_config.set_force_xla(True)
         mesh = parallel_state.initialize_model_parallel(
             tensor_parallel=tp, context_parallel=cp, devices=devices)
-        model_cp = builder(**mkw, context_parallel=True)
+        model_cp = builder(**mkw, context_parallel=True,
+                           **(dict(cp_zigzag=True) if args.cp_zigzag
+                              else {}))
         cp_shardings = None
         if tp > 1:
             from apex_example_tpu.engine import create_gspmd_train_state
@@ -825,11 +845,17 @@ def _lm_main_impl(args, policy, scaler):
         else:
             state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                        optimizer, sample[:1], policy, scaler)
-        make_cp = make_gpt_cp_train_step if is_gpt \
-            else make_bert_cp_train_step
-        step_fn = make_cp(mesh, model_cp, optimizer, policy,
-                          grad_accum=args.grad_accum,
-                          state_shardings=cp_shardings)
+        if is_gpt:
+            step_fn = make_gpt_cp_train_step(mesh, model_cp, optimizer,
+                                             policy,
+                                             grad_accum=args.grad_accum,
+                                             state_shardings=cp_shardings,
+                                             zigzag=args.cp_zigzag)
+        else:
+            step_fn = make_bert_cp_train_step(mesh, model_cp, optimizer,
+                                              policy,
+                                              grad_accum=args.grad_accum,
+                                              state_shardings=cp_shardings)
         mems = None
         print(f"CP over {cp} sequence shards (local seq "
               f"{args.seq_len // cp}), TP over {tp}, DP over "
@@ -922,8 +948,9 @@ def _lm_main_impl(args, policy, scaler):
                 # exists to shard).
                 from apex_example_tpu.workloads import (
                     make_bert_cp_eval_step, make_gpt_cp_eval_step)
-                eval_fn = (make_gpt_cp_eval_step if is_gpt
-                           else make_bert_cp_eval_step)(mesh, model_cp)
+                eval_fn = make_gpt_cp_eval_step(
+                    mesh, model_cp, zigzag=args.cp_zigzag) if is_gpt \
+                    else make_bert_cp_eval_step(mesh, model_cp)
             elif pp > 1:
                 from apex_example_tpu.transformer.bert_pipeline import (
                     unpack_params, unpack_params_1f1b)
