@@ -186,6 +186,47 @@ def bench_c4(args):
           rate, "tokens/sec/chip", None)
 
 
+def bench_gpt(args):
+    """GPT-base causal LM + FusedAdam under amp-O2 (beyond-reference model
+    family, models/gpt.py; same measurement contract as c4 — tokens/sec/
+    chip, the "auto" flash crossover engages at --seq-len >= 2048)."""
+    from apex_example_tpu import amp
+    from apex_example_tpu.data import lm_batch
+    from apex_example_tpu.engine import create_train_state, make_train_step
+    from apex_example_tpu.models.gpt import gpt_base
+    from apex_example_tpu.optim import FusedAdam
+    from apex_example_tpu.workloads import lm_loss
+
+    policy, scaler = amp.initialize("O2")
+    md = amp.module_dtypes(policy)
+    kw = {}
+    if args.seq_len > 1024:
+        kw["max_position"] = args.seq_len
+    model = gpt_base(dtype=md.compute, param_dtype=md.param,
+                     ln_dtype=md.ln_io, softmax_dtype=md.softmax,
+                     fused_attention=args.fused_attention or "auto", **kw)
+    opt = FusedAdam(lr=1e-4, weight_decay=0.01)
+    bs, seq = args.batch_size, args.seq_len
+    toks = lm_batch(jnp.asarray(0), batch_size=bs, seq_len=seq,
+                    vocab_size=model.vocab_size, seed=0)
+    batch = (toks[:, :-1], toks[:, 1:])
+    state = create_train_state(jax.random.PRNGKey(0), model, opt,
+                               batch[0][:1], policy, scaler,
+                               train_kwargs={})
+    step = jax.jit(make_train_step(model, opt, policy, loss_fn=lm_loss,
+                                   compute_accuracy=False),
+                   donate_argnums=(0,))
+
+    for _ in range(max(args.warmup, 1)):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    rate = chain_rate(step, state, batch, args.steps, bs * seq,
+                      lambda m: float(m["loss"]))
+    _emit("gpt_base_causal_lm_fusedadam_ampO2_tokens_per_sec_per_chip",
+          rate, "tokens/sec/chip", None)
+
+
 def bench_c5(args):
     """Transformer-XL + FusedLayerNorm + grad clip (BASELINE.md row 5)."""
     from apex_example_tpu import amp
@@ -322,7 +363,8 @@ def _tunnel_watchdog(timeout_s: float = 600.0):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="c2",
-                    choices=["c1", "c2", "c3", "c4", "c5", "hostpipe"])
+                    choices=["c1", "c2", "c3", "c4", "c5", "gpt",
+                             "hostpipe"])
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--image-size", type=int, default=None)
     ap.add_argument("--seq-len", type=int, default=None)
@@ -343,7 +385,8 @@ def main():
     defaults = {          # (batch_size, image_size, seq_len)
         "c1": (256, 32, None), "c2": (256, 224, None),
         "c3": (256, 224, None), "c4": (64, None, 128),
-        "c5": (32, None, 192), "hostpipe": (256, 224, None),
+        "c5": (32, None, 192), "gpt": (64, None, 128),
+        "hostpipe": (256, 224, None),
     }
     db, di, ds = defaults[args.config]
     if args.batch_size is None:
@@ -371,6 +414,8 @@ def main():
         bench_c4(args)
     elif args.config == "c5":
         bench_c5(args)
+    elif args.config == "gpt":
+        bench_gpt(args)
     elif args.config == "hostpipe":
         bench_hostpipe(args)
 

@@ -58,6 +58,7 @@ ITEMS = [
     ("c1",            ["--config", "c1"], 900),
     ("c4",            ["--config", "c4"], 900),
     ("c5",            ["--config", "c5"], 900),
+    ("gpt",           ["--config", "gpt"], 900),
     ("hostpipe",      ["--config", "hostpipe"], 900),
     # ---- long-compile experiments: nothing queues behind these ----
     ("c2_remat_conv", ["--config", "c2", "--remat", "conv"], 2700),

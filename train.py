@@ -333,6 +333,9 @@ def main(argv=None):
     if args.moe_experts:
         raise SystemExit("--moe-experts is wired for the BERT archs "
                          "(switch-MoE replaces the transformer FFN)")
+    if args.cp_zigzag:
+        raise SystemExit("--cp-zigzag only applies with "
+                         "--context-parallel on a gpt arch")
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
     devices = select_devices(args)
