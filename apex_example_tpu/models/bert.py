@@ -48,6 +48,27 @@ def _resolve_fused_attention(setting: Union[bool, str], seq_len: int,
                      f"got {setting!r}")
 
 
+def _softmax_attention(q, k, v, softmax_dtype, out_dtype,
+                       bool_mask=None, add_bias=None):
+    """The einsum attention core shared by the standard and KV-cache-decode
+    paths: scaled QK^T (+boolean mask as a where, +additive bias), softmax
+    in ``softmax_dtype``, context product.  ``bool_mask`` broadcasts
+    against [B, H, Sq, Sk]; the -1e9/-1e4 "minus infinity enough" constant
+    follows the half-dtype clamp rationale (fp16 overflows -1e9 to -inf
+    and a fully-masked row would softmax to NaN)."""
+    hd = q.shape[-1]
+    sd = softmax_dtype
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(sd)
+    logits = logits / jnp.sqrt(hd).astype(sd)
+    neg = -1e9 if sd == jnp.float32 else -1e4
+    if bool_mask is not None:
+        logits = jnp.where(bool_mask, logits, jnp.asarray(neg, sd))
+    if add_bias is not None:
+        logits = logits + jnp.maximum(add_bias, neg).astype(sd)
+    probs = nn.softmax(logits, axis=-1).astype(out_dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 class BertSelfAttention(nn.Module):
     hidden_size: int
     num_heads: int
@@ -87,13 +108,24 @@ class BertSelfAttention(nn.Module):
     # ring step.  The caller (workloads.make_gpt_cp_train_step
     # zigzag=True) reorders the batch with zigzag_shard.
     cp_zigzag: bool = False
+    # Autoregressive KV-cache decoding (flax 'cache' collection, the
+    # canonical single-token pattern): init with a [B, max_len] dummy
+    # allocates cached_key/cached_value/cache_index; each subsequent call
+    # takes ONE token, writes its k/v at the running index, and attends
+    # against the filled prefix.  models/gpt.generate drives it.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
         d = self.hidden_size
         h = self.num_heads
         hd = d // h
-        use_kernel = _resolve_fused_attention(
+        if self.decode and (self.tensor_parallel or self.context_parallel
+                            or mask_bias is not None or not self.causal):
+            raise ValueError(
+                "decode (KV-cache) is the single-device causal inference "
+                "path: no TP/CP/mask composition")
+        use_kernel = (not self.decode) and _resolve_fused_attention(
             self.fused_attention, x.shape[1], self.softmax_dtype)
         if self.tensor_parallel:
             from apex_example_tpu.transformer.tensor_parallel.layers import (
@@ -123,6 +155,36 @@ class BertSelfAttention(nn.Module):
         q = head_spec(dense_in("query")(x).reshape(*x.shape[:-1], h, hd))
         k = head_spec(dense_in("key")(x).reshape(*x.shape[:-1], h, hd))
         v = head_spec(dense_in("value")(x).reshape(*x.shape[:-1], h, hd))
+        if self.decode:
+            from jax import lax as _lax
+            is_init = self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape,
+                               k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape,
+                               v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            if is_init:
+                if x.shape[1] != 1:
+                    raise ValueError("decode takes ONE token per call "
+                                     f"(got seq {x.shape[1]}); the "
+                                     "[B, max_len] shape is for cache "
+                                     "allocation at init only")
+                idx = ci.value
+                ck.value = _lax.dynamic_update_slice(ck.value, k,
+                                                     (0, idx, 0, 0))
+                cv.value = _lax.dynamic_update_slice(cv.value, v,
+                                                     (0, idx, 0, 0))
+                ci.value = idx + 1
+                # keys beyond the running index are unwritten cache slots
+                live = jnp.arange(ck.value.shape[1]) <= idx
+                ctx = _softmax_attention(q, ck.value, cv.value,
+                                         self.softmax_dtype, self.dtype,
+                                         bool_mask=live[None, None, None])
+                return dense_out(ctx.reshape(*x.shape[:-1], d))
+            # init trace on the [B, max_len] dummy: cache allocated above;
+            # fall through to the standard causal path so params/shapes
+            # initialize.
         if self.context_parallel:
             # Same projections as the dense path (identical param tree);
             # only the attention computation changes: a ppermute KV ring
@@ -170,22 +232,12 @@ class BertSelfAttention(nn.Module):
             ctx = flash_attention(q, k, v, key_bias, causal=self.causal,
                                   scale=1.0 / float(hd) ** 0.5)
             return dense_out(ctx.reshape(*x.shape[:-1], d))
-        sd = self.softmax_dtype
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(sd)
-        logits = logits / jnp.sqrt(hd).astype(sd)
-        neg = -1e9 if sd == jnp.float32 else -1e4
+        tri = None
         if self.causal:
             S = x.shape[1]
-            tri = jnp.tril(jnp.ones((S, S), jnp.bool_))
-            logits = jnp.where(tri[None, None], logits,
-                               jnp.asarray(neg, sd))
-        if mask_bias is not None:
-            # Clamp before the cast: -1e9 overflows to -inf in fp16 and a
-            # fully-masked row would softmax to NaN (cf. transformer_xl's
-            # mask fill).  -1e4 is "minus infinity enough" for half dtypes.
-            logits = logits + jnp.maximum(mask_bias, neg).astype(sd)
-        probs = nn.softmax(logits, axis=-1).astype(self.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            tri = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+        ctx = _softmax_attention(q, k, v, self.softmax_dtype, self.dtype,
+                                 bool_mask=tri, add_bias=mask_bias)
         ctx = ctx.reshape(*x.shape[:-1], d)
         return dense_out(ctx)
 
@@ -210,6 +262,7 @@ class BertLayer(nn.Module):
     moe_axis_name: str = "expert"
     causal: bool = False
     cp_zigzag: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -227,6 +280,7 @@ class BertLayer(nn.Module):
                                  context_parallel=self.context_parallel,
                                  causal=self.causal,
                                  cp_zigzag=self.cp_zigzag,
+                                 decode=self.decode,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))

@@ -19,10 +19,13 @@ seq_len positions.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Union
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from apex_example_tpu.models.bert import BertLayer
 from apex_example_tpu.normalization import FusedLayerNorm
@@ -55,6 +58,10 @@ class GPTForCausalLM(nn.Module):
     # The step factory (workloads.make_gpt_cp_train_step(zigzag=True))
     # reorders the batch with parallel.context_parallel.zigzag_shard.
     cp_zigzag: bool = False
+    # Autoregressive KV-cache inference (see :func:`generate`): init with
+    # a [B, max_len] dummy to allocate per-layer caches, then apply one
+    # token at a time with mutable=["cache"].
+    decode: bool = False
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -81,8 +88,21 @@ class GPTForCausalLM(nn.Module):
                                 dtype=self.dtype,
                                 param_dtype=self.param_dtype,
                                 name="word_embeddings")
+        if self.decode and (self.moe_experts or self.tensor_parallel
+                            or self.context_parallel):
+            raise ValueError("decode (KV-cache) is the single-device "
+                             "inference path: no TP/CP/MoE composition")
         x = word_emb(input_ids)
         pos = jnp.arange(L)[None, :]
+        if self.decode:
+            # position = running cache index (checked BEFORE .variable
+            # creates it: at allocation time the dummy covers 0..L-1)
+            is_init = self.has_variable("cache", "cache_position")
+            pi = self.variable("cache", "cache_position",
+                               lambda: jnp.zeros((), jnp.int32))
+            if is_init:
+                pos = pos + pi.value
+                pi.value = pi.value + L
         if self.context_parallel:
             from jax import lax as _lax
             from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
@@ -119,6 +139,7 @@ class GPTForCausalLM(nn.Module):
                           moe_capacity_factor=self.moe_capacity_factor,
                           moe_axis_name=self.moe_axis_name,
                           causal=True, cp_zigzag=self.cp_zigzag,
+                          decode=self.decode,
                           name=f"layer_{i}")(x, None)
             if self.moe_experts:
                 x, aux = x
@@ -151,3 +172,88 @@ def gpt_tiny(**kw) -> GPTForCausalLM:
     kw.setdefault("intermediate_size", 128)
     kw.setdefault("max_position", 128)
     return GPTForCausalLM(**kw)
+
+
+def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
+             max_len: int, temperature: float = 0.0, rng=None
+             ) -> jnp.ndarray:
+    """Autoregressive generation with a KV cache (greedy at temperature 0,
+    categorical sampling otherwise).
+
+    ``prompt`` is [B, P] int32; returns [B, max_len] — the prompt followed
+    by max_len - P generated tokens.  TPU-idiomatic decode: ONE jitted
+    ``lax.scan`` over single-token steps with static shapes throughout —
+    per-layer K/V caches ([B, max_len, H, D], allocated by a one-time init
+    trace) are scan carries, each step costs O(max_len·D) attention
+    against the filled prefix instead of re-running the O(S²) forward on
+    a growing sequence.  Prompt positions are fed through the same loop
+    (their logits are discarded), so prefill and decode share one
+    compiled program.
+
+    Beyond-reference: the reference family is training-only; this makes
+    the GPT family usable end-to-end (models/gpt.py docstring).
+    """
+    B, P = prompt.shape
+    if not 0 < P < max_len:
+        raise ValueError(f"need 0 < prompt len {P} < max_len {max_len}")
+    if model.max_position < max_len:
+        raise ValueError(f"max_len {max_len} exceeds the model's position "
+                         f"table ({model.max_position})")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 samples; pass rng=PRNGKey")
+    dec = model.clone(decode=True, fused_attention=False)
+    # cache ALLOCATION without compute: eval_shape traces the init only
+    # abstractly (no training-scale dummy forward actually runs), then the
+    # zeroed pytree is built from the shapes.
+    shapes = jax.eval_shape(
+        dec.init, jax.random.PRNGKey(0),
+        jnp.zeros((B, max_len), jnp.int32))["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+    tokens = jnp.zeros((B, max_len), jnp.int32).at[:, :P].set(prompt)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)          # carried but unused (greedy)
+    run = _decode_loop(dec, P, max_len, float(temperature))
+    return run(params, tokens, cache, rng)
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_loop(dec: GPTForCausalLM, P: int, max_len: int,
+                 temperature: float):
+    """Jitted scan for :func:`generate`, cached on the static
+    configuration (the module is a frozen dataclass, so it keys the
+    cache): repeated generate() calls reuse one compiled program, and
+    params enter as an ARGUMENT — baked-as-constants weights would bloat
+    the executable and defeat the cache."""
+
+    def step(params, carry, t):
+        tokens, cache, rng = carry
+        B = tokens.shape[0]
+        tok = lax.dynamic_slice(tokens, (0, t), (B, 1))
+        logits, mut = dec.apply({"params": params, "cache": cache}, tok,
+                                train=False, mutable=["cache"])
+        cache = mut["cache"]
+        last = logits[:, -1]
+        if temperature == 0.0:
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        else:
+            rng, key = jax.random.split(rng)
+            nxt = jax.random.categorical(
+                key, last / temperature).astype(jnp.int32)
+        # inside the prompt, keep the given token (prefill); past it,
+        # write the model's choice
+        cur = lax.dynamic_slice(tokens, (0, t + 1), (B, 1))[:, 0]
+        nxt = jnp.where(t + 1 < P, cur, nxt)
+        tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, t + 1))
+        return (tokens, cache, rng), None
+
+    @jax.jit
+    def run(params, tokens, cache, rng):
+        (tokens, _, _), _ = lax.scan(functools.partial(step, params),
+                                     (tokens, cache, rng),
+                                     jnp.arange(max_len - 1))
+        return tokens
+
+    return run
