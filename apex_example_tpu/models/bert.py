@@ -157,14 +157,14 @@ class BertSelfAttention(nn.Module):
         v = head_spec(dense_in("value")(x).reshape(*x.shape[:-1], h, hd))
         if self.decode:
             from jax import lax as _lax
-            is_init = self.has_variable("cache", "cached_key")
+            cache_ready = self.has_variable("cache", "cached_key")
             ck = self.variable("cache", "cached_key", jnp.zeros, k.shape,
                                k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros, v.shape,
                                v.dtype)
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
-            if is_init:
+            if cache_ready:      # per-token decode step (cache exists)
                 if x.shape[1] != 1:
                     raise ValueError("decode takes ONE token per call "
                                      f"(got seq {x.shape[1]}); the "
@@ -260,6 +260,7 @@ class BertLayer(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
+    moe_top_k: int = 1
     causal: bool = False
     cp_zigzag: bool = False
     decode: bool = False
@@ -306,7 +307,8 @@ class BertLayer(nn.Module):
                             self.moe_experts,
                             capacity_factor=self.moe_capacity_factor,
                             dtype=self.dtype, param_dtype=self.param_dtype,
-                            axis_name=self.moe_axis_name, name="moe")(x)
+                            axis_name=self.moe_axis_name,
+                            top_k=self.moe_top_k, name="moe")(x)
         else:
             y = nn.Dense(self.intermediate_size, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="intermediate")(x)
@@ -351,6 +353,7 @@ class BertForMaskedLM(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
@@ -418,6 +421,7 @@ class BertForMaskedLM(nn.Module):
                           moe_experts=self.moe_experts,
                           moe_capacity_factor=self.moe_capacity_factor,
                           moe_axis_name=self.moe_axis_name,
+                          moe_top_k=self.moe_top_k,
                           name=f"layer_{i}")(x, mask_bias)
             if self.moe_experts:
                 x, aux = x

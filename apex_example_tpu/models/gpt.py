@@ -53,6 +53,7 @@ class GPTForCausalLM(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
+    moe_top_k: int = 1
     # Load-balanced causal ring (with context_parallel): local shards hold
     # zigzag chunk pairs (i, 2n-1-i); position ids follow the same order.
     # The step factory (workloads.make_gpt_cp_train_step(zigzag=True))
@@ -97,10 +98,10 @@ class GPTForCausalLM(nn.Module):
         if self.decode:
             # position = running cache index (checked BEFORE .variable
             # creates it: at allocation time the dummy covers 0..L-1)
-            is_init = self.has_variable("cache", "cache_position")
+            cache_ready = self.has_variable("cache", "cache_position")
             pi = self.variable("cache", "cache_position",
                                lambda: jnp.zeros((), jnp.int32))
-            if is_init:
+            if cache_ready:      # per-token decode step
                 pos = pos + pi.value
                 pi.value = pi.value + L
         if self.context_parallel:
@@ -138,6 +139,7 @@ class GPTForCausalLM(nn.Module):
                           moe_experts=self.moe_experts,
                           moe_capacity_factor=self.moe_capacity_factor,
                           moe_axis_name=self.moe_axis_name,
+                          moe_top_k=self.moe_top_k,
                           causal=True, cp_zigzag=self.cp_zigzag,
                           decode=self.decode,
                           name=f"layer_{i}")(x, None)
@@ -215,12 +217,12 @@ def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
     tokens = jnp.zeros((B, max_len), jnp.int32).at[:, :P].set(prompt)
     if rng is None:
         rng = jax.random.PRNGKey(0)          # carried but unused (greedy)
-    run = _decode_loop(dec, P, max_len, float(temperature))
-    return run(params, tokens, cache, rng)
+    run = _decode_loop(dec, max_len, float(temperature))
+    return run(params, tokens, cache, rng, jnp.asarray(P, jnp.int32))
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_loop(dec: GPTForCausalLM, P: int, max_len: int,
+def _decode_loop(dec: GPTForCausalLM, max_len: int,
                  temperature: float):
     """Jitted scan for :func:`generate`, cached on the static
     configuration (the module is a frozen dataclass, so it keys the
@@ -228,7 +230,7 @@ def _decode_loop(dec: GPTForCausalLM, P: int, max_len: int,
     params enter as an ARGUMENT — baked-as-constants weights would bloat
     the executable and defeat the cache."""
 
-    def step(params, carry, t):
+    def step(params, P, carry, t):
         tokens, cache, rng = carry
         B = tokens.shape[0]
         tok = lax.dynamic_slice(tokens, (0, t), (B, 1))
@@ -250,8 +252,10 @@ def _decode_loop(dec: GPTForCausalLM, P: int, max_len: int,
         return (tokens, cache, rng), None
 
     @jax.jit
-    def run(params, tokens, cache, rng):
-        (tokens, _, _), _ = lax.scan(functools.partial(step, params),
+    def run(params, tokens, cache, rng, P):
+        # P rides as a TRACED scalar (only `t + 1 < P` consumes it), so
+        # one compiled program serves every prompt length at this shape.
+        (tokens, _, _), _ = lax.scan(functools.partial(step, params, P),
                                      (tokens, cache, rng),
                                      jnp.arange(max_len - 1))
         return tokens

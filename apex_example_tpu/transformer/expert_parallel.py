@@ -1,4 +1,5 @@
-"""Expert parallelism (MoE): switch-style top-1 routing over an expert axis.
+"""Expert parallelism (MoE): top-1 (Switch) / top-2 (GShard) routing over an
+expert axis.
 
 Reference status: EP is ABSENT from the reference family (SURVEY.md §3.2
 marks it "documented as absent"); like context parallelism
@@ -9,7 +10,8 @@ a "complete" modern parallelism surface includes it.
 TPU-native design (the Switch-Transformer dispatch, expressed as static-shape
 XLA collectives — no dynamic shapes, jit-stable):
 
-  1. router: logits = x @ w_r → top-1 expert per token, softmax gate.
+  1. router: logits = x @ w_r → top-1 expert per token, softmax gate
+     (top_k=2: GShard-style second choice with renormalized gates).
   2. capacity: each expert accepts at most C tokens per device
      (C = ceil(tokens/E · capacity_factor)); overflow tokens are dropped
      (their combine weight is 0 — the standard switch trade that keeps every
@@ -65,38 +67,63 @@ def init_moe_params(rng, d: int, hidden: int, n_experts: int,
                ).astype(dtype))
 
 
-def _dispatch_masks(logits: jnp.ndarray, capacity: int
+def _dispatch_masks(logits: jnp.ndarray, capacity: int, top_k: int = 1
                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Top-1 switch dispatch for [T, E] router logits.
+    """Top-1 (Switch) or top-2 (GShard-style) dispatch for [T, E] router
+    logits.
 
     Returns (dispatch [T, E, C] one-hot, combine [T, E, C] gate-weighted,
-    aux_loss scalar).  All shapes static; overflow tokens get all-zero rows.
+    aux_loss scalar).  All shapes static; overflow tokens get all-zero
+    rows.  Top-2 follows the GShard conventions: the two gates are
+    renormalized to sum to 1, second choices queue BEHIND every kept
+    first choice in each expert's capacity buffer (so under pressure the
+    second opinions are the ones dropped), and the load-balancing loss
+    keys on the FIRST-choice assignment fractions.
     """
     T, E = logits.shape
+    if top_k not in (1, 2):
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                  # [T]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    e1 = jnp.argmax(probs, axis=-1)                      # [T]
+    g1 = jnp.take_along_axis(probs, e1[:, None], axis=-1)[:, 0]
+    oh1 = jax.nn.one_hot(e1, E, dtype=jnp.float32)       # [T, E]
 
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # [T, E]
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0           # [T, E]
-    keep = (pos < capacity) & (onehot > 0)
-    pos_c = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                           dtype=jnp.float32)                  # [T, E, C]
-    dispatch = pos_c * keep[..., None]
-    combine = dispatch * gate[:, None, None]
+    # position of each first-choice token within its expert's queue
+    pos1 = jnp.cumsum(oh1, axis=0) * oh1 - 1.0           # [T, E]
+    keep1 = (pos1 < capacity) & (oh1 > 0)
+    pc1 = jax.nn.one_hot(pos1.astype(jnp.int32), capacity,
+                         dtype=jnp.float32)              # [T, E, C]
+    d1 = pc1 * keep1[..., None]
 
-    # Switch load-balancing loss: E · Σ_e fraction_e · mean-prob_e.
-    fraction = onehot.mean(axis=0)
-    mean_prob = probs.mean(axis=0)
-    aux = E * jnp.sum(fraction * mean_prob)
-    return dispatch, combine, aux
+    # Switch load-balancing loss: E · Σ_e fraction_e · mean-prob_e
+    # (first-choice fractions in both modes).
+    aux = E * jnp.sum(oh1.mean(axis=0) * probs.mean(axis=0))
+
+    if top_k == 1:
+        return d1, d1 * g1[:, None, None], aux
+
+    e2 = jnp.argmax(probs - oh1 * 2.0, axis=-1)          # runner-up
+    g2 = jnp.take_along_axis(probs, e2[:, None], axis=-1)[:, 0]
+    oh2 = jax.nn.one_hot(e2, E, dtype=jnp.float32)
+    # second choices start after each expert's KEPT first-choice count
+    used1 = jnp.minimum(oh1.sum(axis=0), float(capacity))    # [E]
+    pos2 = jnp.cumsum(oh2, axis=0) * oh2 - 1.0 + used1[None] * oh2
+    keep2 = (pos2 < capacity) & (oh2 > 0)
+    pc2 = jax.nn.one_hot(pos2.astype(jnp.int32), capacity,
+                         dtype=jnp.float32)
+    d2 = pc2 * keep2[..., None]
+
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    combine = (d1 * (g1 / denom)[:, None, None]
+               + d2 * (g2 / denom)[:, None, None])
+    return d1 + d2, combine, aux
 
 
 def moe_forward(params: MoEParams, x: jnp.ndarray,
                 capacity_factor: float = 1.25,
                 axis_name: str = EXPERT_AXIS,
-                activation=jax.nn.relu) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                activation=jax.nn.relu,
+                top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Switch-MoE block over the expert axis.  Inside shard_map:
 
     x: [T, d] this device's tokens; params.w_in/w_out: [1, d, h]/[1, h, d]
@@ -117,12 +144,15 @@ def moe_forward(params: MoEParams, x: jnp.ndarray,
             f"{params.w_router.shape[1]}, axis size {E}, local shard "
             f"{params.w_in.shape[0]} (shard stacked [E, ...] weights with "
             f"P('{axis_name}'))")
-    capacity = int(-(-T * capacity_factor // E))
+    # GShard capacity sizing: the dispatch demand is top_k slots per
+    # token, so C scales with top_k or most second choices would be
+    # silently dropped at the default factor.
+    capacity = int(-(-T * top_k * capacity_factor // E))
     # lane-friendly capacity (C is a matmul/all_to_all dim)
     capacity = capacity + (-capacity) % 8
 
     logits = x @ params.w_router.astype(x.dtype)         # [T, E]
-    dispatch, combine, aux = _dispatch_masks(logits, capacity)
+    dispatch, combine, aux = _dispatch_masks(logits, capacity, top_k)
 
     # [E, C, d] expert-major send buffer; tiled all_to_all over the axis
     # swaps "which expert" for "which sender": recv[j] = device j's tokens
@@ -144,18 +174,19 @@ def moe_forward(params: MoEParams, x: jnp.ndarray,
 
 def moe_forward_dense_reference(params: MoEParams, x: jnp.ndarray,
                                 capacity_factor: float = 1.25,
-                                activation=jax.nn.relu
+                                activation=jax.nn.relu,
+                                top_k: int = 1
                                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """No-mesh golden: every expert computed densely on every token, the
     same dispatch/combine masks select the result.  Matches moe_forward
     exactly on a single shard (tests) and defines the semantics."""
     T, d = x.shape
     E = params.w_in.shape[0]
-    capacity = int(-(-T * capacity_factor // E))
+    capacity = int(-(-T * top_k * capacity_factor // E))
     capacity = capacity + (-capacity) % 8
 
     logits = x @ params.w_router.astype(x.dtype)
-    dispatch, combine, aux = _dispatch_masks(logits, capacity)
+    dispatch, combine, aux = _dispatch_masks(logits, capacity, top_k)
 
     send = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
                       dispatch).astype(x.dtype)           # [E, C, d]
@@ -204,6 +235,7 @@ class MoEMLP(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     axis_name: str = EXPERT_AXIS
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -225,8 +257,10 @@ class MoEMLP(nn.Module):
         flat = x.reshape(-1, d).astype(self.dtype)
         if dist:
             y, aux = moe_forward(params, flat, self.capacity_factor,
-                                 self.axis_name, activation=nn.gelu)
+                                 self.axis_name, activation=nn.gelu,
+                                 top_k=self.top_k)
         else:
             y, aux = moe_forward_dense_reference(
-                params, flat, self.capacity_factor, activation=nn.gelu)
+                params, flat, self.capacity_factor, activation=nn.gelu,
+                top_k=self.top_k)
         return y.reshape(x.shape).astype(self.dtype), aux

@@ -156,6 +156,11 @@ def parse_args(argv=None):
     p.add_argument("--moe-aux-weight", type=float, default=1e-2,
                    help="weight of the Switch load-balancing aux loss in "
                         "the --moe-experts objective")
+    p.add_argument("--moe-top-k", type=int, default=1, choices=[1, 2],
+                   help="router fan-out under --moe-experts: 1 = Switch "
+                        "top-1, 2 = GShard-style top-2 (renormalized "
+                        "gates; second choices dropped first under "
+                        "capacity pressure)")
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
                    help="per-expert token capacity multiplier under "
                         "--moe-experts (overflow tokens ride the residual "
@@ -691,6 +696,7 @@ def _lm_main_impl(args, policy, scaler):
             from apex_example_tpu.parallel.mesh import DATA_AXIS
             mkw["moe_experts"] = args.moe_experts
             mkw["moe_capacity_factor"] = args.moe_capacity_factor
+            mkw["moe_top_k"] = args.moe_top_k
             # bind the MoE collectives to the axis the EP step maps over
             mkw["moe_axis_name"] = DATA_AXIS
     elif tp > 1:
