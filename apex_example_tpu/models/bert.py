@@ -286,10 +286,20 @@ class BertLayer(nn.Module):
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))
         x = x.astype(self.dtype)
-        if self.tensor_parallel:
+        if self.moe_experts:
+            from apex_example_tpu.transformer.expert_parallel import MoEMLP
+            y, aux = MoEMLP(self.hidden_size, self.intermediate_size,
+                            self.moe_experts,
+                            capacity_factor=self.moe_capacity_factor,
+                            dtype=self.dtype, param_dtype=self.param_dtype,
+                            axis_name=self.moe_axis_name,
+                            top_k=self.moe_top_k, name="moe")(x)
+        elif self.tensor_parallel:
             # Megatron MLP: column (sharded GELU features) -> row (the
             # all-reduce — or, under sequence_parallel, the reduce-scatter
             # onto sequence shards — lands at the row output constraint).
+            # (checked after moe_experts: under the MoE x TP composition
+            # the FFN is the expert block and TP applies to attention/head)
             from apex_example_tpu.transformer.tensor_parallel.layers import (
                 ColumnParallelLinear, RowParallelLinear)
             y = ColumnParallelLinear(
@@ -301,14 +311,6 @@ class BertLayer(nn.Module):
                 self.hidden_size, input_is_parallel=True,
                 sequence_parallel=self.sequence_parallel, dtype=self.dtype,
                 param_dtype=self.param_dtype, name="output")(y)
-        elif self.moe_experts:
-            from apex_example_tpu.transformer.expert_parallel import MoEMLP
-            y, aux = MoEMLP(self.hidden_size, self.intermediate_size,
-                            self.moe_experts,
-                            capacity_factor=self.moe_capacity_factor,
-                            dtype=self.dtype, param_dtype=self.param_dtype,
-                            axis_name=self.moe_axis_name,
-                            top_k=self.moe_top_k, name="moe")(x)
         else:
             y = nn.Dense(self.intermediate_size, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="intermediate")(x)
@@ -359,15 +361,15 @@ class BertForMaskedLM(nn.Module):
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
                  train: bool = True):
         del train  # no dropout in the pretraining benchmark path
-        if self.moe_experts and (self.tensor_parallel
-                                 or self.sequence_parallel
+        if self.moe_experts and (self.sequence_parallel
                                  or self.context_parallel):
             # The MoE all_to_all dispatch assumes every local token routes
-            # over the full expert set; TP/SP/CP re-shard the very dims the
-            # dispatch indexes (features / sequence).  Composition needs a
-            # designed layout, not a silent overlap — reject.
+            # over the full expert set; SP/CP re-shard the sequence dim the
+            # dispatch indexes.  (TP composes: the FFN is the expert block
+            # and the Megatron sharding applies to attention/embeddings/
+            # head on the automatic model axis.)
             raise ValueError("moe_experts does not compose with "
-                             "tensor/sequence/context parallelism yet")
+                             "sequence/context parallelism yet")
         if self.sequence_parallel and self.context_parallel:
             raise ValueError("sequence_parallel shards activations along "
                              "the sequence dim the context axis already "

@@ -67,11 +67,12 @@ class GPTForCausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
         del train  # no dropout in the pretraining benchmark path
-        if self.moe_experts and (self.tensor_parallel
-                                 or self.sequence_parallel
+        if self.moe_experts and (self.sequence_parallel
                                  or self.context_parallel):
+            # (TP composes: the expert block replaces the FFN; Megatron
+            # sharding applies to attention/embeddings/head)
             raise ValueError("moe_experts does not compose with "
-                             "tensor/sequence/context parallelism yet")
+                             "sequence/context parallelism yet")
         if self.sequence_parallel and self.context_parallel:
             raise ValueError("sequence_parallel shards activations along "
                              "the sequence dim the context axis already "

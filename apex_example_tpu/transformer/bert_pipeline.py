@@ -572,19 +572,14 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     opt_spec = _opt_state_specs(optimizer, probe, params_spec)
     state_spec = TrainState(step=P(), params=params_spec, batch_stats=P(),
                             opt_state=opt_spec, scaler=P())
-    kw = {}
-    if tp > 1:
-        # TP×PP: manual over (pipe, data) only — 'model' (and 'context')
-        # stay automatic, so the TP layers' GSPMD constraints inside the
-        # body bind to them.  The specs name manual axes; the layer leaves'
-        # model-axis sharding rides along from the arrays' placement
-        # (bert_pp_state_shardings).
-        if not hasattr(jax, "shard_map"):  # pragma: no cover
-            raise RuntimeError(
-                "the TP×PP composition needs jax.shard_map's axis_names "
-                "(jax >= 0.7); the jax.experimental fallback cannot "
-                "express a partially-manual mesh")
-        kw["axis_names"] = {PIPE_AXIS, DATA_AXIS}
+    # TP×PP: manual over (pipe, data) only — 'model' (and 'context') stay
+    # automatic, so the TP layers' GSPMD constraints inside the body bind
+    # to them.  The specs name manual axes; the layer leaves' model-axis
+    # sharding rides along from the arrays' placement
+    # (bert_pp_state_shardings).
+    from apex_example_tpu.workloads import partial_manual_axis_names
+    kw = partial_manual_axis_names(
+        mesh, model, frozenset({PIPE_AXIS, DATA_AXIS}), "TP x PP")
     bspec = (P(DATA_AXIS), P(DATA_AXIS)) if is_gpt \
         else (P(DATA_AXIS), (P(DATA_AXIS), P(DATA_AXIS)))
     sharded = _shard_map(

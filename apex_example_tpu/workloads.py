@@ -279,22 +279,29 @@ def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     return jax.jit(sharded, donate_argnums=(0,) if donate else (), **jkw)
 
 
-def _cp_axis_names(mesh: Mesh, model) -> dict:
-    """shard_map kwargs for the CP step: with a nontrivial 'model' axis the
-    map goes manual over (data, context) ONLY, leaving 'model' automatic so
-    the GSPMD TP layers (tensor_parallel=True) run inside the ring — the
-    same partially-manual composition the TP×PP path uses
-    (transformer/bert_pipeline.py).  Param model-axis shardings ride along
-    from the arrays' placement (engine.gspmd_state_shardings)."""
-    from apex_example_tpu.parallel.mesh import (CONTEXT_AXIS,
-                                                require_model_axis_match)
-    tp = require_model_axis_match(mesh, model.tensor_parallel)
+def partial_manual_axis_names(mesh: Mesh, model, manual_axes: frozenset,
+                              label: str) -> dict:
+    """shard_map kwargs for a TP-composed step: with a nontrivial 'model'
+    axis the map goes manual over ``manual_axes`` ONLY, leaving 'model'
+    automatic so the GSPMD TP layers (tensor_parallel=True) run inside
+    the manual program — the partially-manual composition shared by the
+    CP x TP, MoE x TP and TP x PP paths.  Param model-axis shardings ride
+    along from the arrays' placement (engine.gspmd_state_shardings)."""
+    from apex_example_tpu.parallel.mesh import require_model_axis_match
+    tp = require_model_axis_match(mesh, getattr(model, "tensor_parallel",
+                                                False))
     if tp > 1 and not hasattr(jax, "shard_map"):  # pragma: no cover
         raise RuntimeError(
-            "the CP×TP composition needs jax.shard_map's axis_names "
+            f"the {label} composition needs jax.shard_map's axis_names "
             "(jax >= 0.7); the jax.experimental fallback cannot express "
             "a partially-manual mesh")
-    return {"axis_names": {DATA_AXIS, CONTEXT_AXIS}} if tp > 1 else {}
+    return {"axis_names": set(manual_axes)} if tp > 1 else {}
+
+
+def _cp_axis_names(mesh: Mesh, model) -> dict:
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+    return partial_manual_axis_names(
+        mesh, model, frozenset({DATA_AXIS, CONTEXT_AXIS}), "CP x TP")
 
 
 def make_bert_cp_eval_step(mesh: Mesh, model):
@@ -452,17 +459,23 @@ def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
 # there); this is the same "library feature -> harness-reachable" move the
 # CP path made in round 3.
 
+def _is_expert_leaf(path) -> bool:
+    """The ONE definition of which param leaves are EP-sharded expert
+    stacks (under a 'moe' module, named w_in/w_out): used by both the
+    shard_map spec tree and the device-placement overlay — they must
+    never disagree or placement and specs silently diverge."""
+    keys = {getattr(p, "key", None) for p in path}
+    return "moe" in keys and ("w_in" in keys or "w_out" in keys)
+
+
 def _moe_param_spec_tree(params):
-    """P(DATA_AXIS) for the stacked [E, ...] expert weights (leaves under a
-    'moe' module named w_in/w_out — one expert per data-axis device), P()
-    for everything else (router, attention, embeddings, head: replicated,
-    their grads arrive implicitly psum-ed)."""
-    def spec(path, _leaf):
-        keys = {getattr(p, "key", None) for p in path}
-        if "moe" in keys and ("w_in" in keys or "w_out" in keys):
-            return P(DATA_AXIS)
-        return P()
-    return jax.tree_util.tree_map_with_path(spec, params)
+    """P(DATA_AXIS) for the stacked [E, ...] expert weights (one expert
+    per data-axis device), P() for everything else (router, attention,
+    embeddings, head: replicated, their grads arrive implicitly
+    psum-ed)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _leaf: P(DATA_AXIS) if _is_expert_leaf(path) else P(),
+        params)
 
 
 def bert_moe_state_specs(state: TrainState, optimizer) -> TrainState:
@@ -481,14 +494,33 @@ def bert_moe_state_specs(state: TrainState, optimizer) -> TrainState:
         scaler=tmap(lambda _: P(), state.scaler))
 
 
-def bert_moe_state_shardings(mesh: Mesh, state: TrainState, optimizer
-                             ) -> TrainState:
-    """NamedSharding tree for device_put / the orbax restore template."""
+def bert_moe_state_shardings(mesh: Mesh, state: TrainState, optimizer,
+                             base_shardings=None) -> TrainState:
+    """NamedSharding tree for device_put / the orbax restore template.
+
+    ``base_shardings`` (MoE x TP): the GSPMD NamedSharding tree from
+    create_gspmd_train_state — non-expert leaves keep their model-axis
+    placement, the expert stacks are overridden to P('data') (they are
+    model-replicated; each data-axis device owns one expert)."""
     from jax.sharding import NamedSharding
-    return jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s),
-        bert_moe_state_specs(state, optimizer),
-        is_leaf=lambda v: isinstance(v, P))
+    if base_shardings is None:
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            bert_moe_state_specs(state, optimizer),
+            is_leaf=lambda v: isinstance(v, P))
+
+    # Overlay on the BASE tree by path (its structure may collapse
+    # sharding-uniform subtrees like the scaler into one leaf): exactly
+    # the expert-stack leaves (_is_expert_leaf, the same predicate the
+    # spec tree uses) switch to P('data').
+    return jax.tree_util.tree_map_with_path(
+        lambda path, base_leaf: NamedSharding(mesh, P(DATA_AXIS))
+        if _is_expert_leaf(path) else base_leaf, base_shardings)
+
+
+def _moe_axis_names(mesh: Mesh, model) -> dict:
+    return partial_manual_axis_names(mesh, model, frozenset({DATA_AXIS}),
+                                     "MoE x TP")
 
 
 def _check_moe_model(mesh: Mesh, model, optimizer=None):
@@ -522,7 +554,8 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                              state_template: TrainState,
                              aux_weight: float = 1e-2,
                              donate: bool = True, grad_accum: int = 1,
-                             objective: str = "mlm"):
+                             objective: str = "mlm",
+                             state_shardings=None):
     """Expert-parallel BERT MLM step over the 'data' axis (train.py
     --moe-experts).
 
@@ -569,8 +602,17 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     batch_spec = (b, (b, b)) if objective == "mlm" else (b, b)
     sharded = _shard_map(per_shard, mesh=mesh,
                          in_specs=(spec_state, batch_spec),
-                         out_specs=(spec_state, P()))
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+                         out_specs=(spec_state, P()),
+                         **_moe_axis_names(mesh, model))
+    jkw = {}
+    if state_shardings is not None:
+        # MoE x TP: pin the returned state to its combined placement
+        # (expert stacks over 'data', TP leaves over 'model') — with
+        # 'model' automatic the compiler would otherwise be free to hand
+        # the updated params back replicated on that axis.
+        from jax.sharding import NamedSharding
+        jkw["out_shardings"] = (state_shardings, NamedSharding(mesh, P()))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else (), **jkw)
 
 
 def make_bert_moe_eval_step(mesh: Mesh, model, params_template,
@@ -605,5 +647,5 @@ def make_bert_moe_eval_step(mesh: Mesh, model, params_template,
     sharded = _shard_map(per_shard, mesh=mesh,
                          in_specs=(_moe_param_spec_tree(params_template),
                                    batch_spec),
-                         out_specs=P())
+                         out_specs=P(), **_moe_axis_names(mesh, model))
     return jax.jit(sharded)

@@ -533,13 +533,13 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--moe-experts is wired for the BERT/GPT "
                              "archs (switch-MoE replaces the "
                              "transformer FFN)")
-        if tp > 1 or pp > 1 or cp > 1 or args.sequence_parallel \
-                or args.zero:
+        if pp > 1 or cp > 1 or args.sequence_parallel or args.zero:
             raise SystemExit("--moe-experts does not compose with "
-                             "--tensor/sequence/pipeline/context-parallel "
-                             "or --zero yet (the all_to_all dispatch "
-                             "assumes every local token routes over the "
-                             "full expert set on the data axis)")
+                             "--sequence/pipeline/context-parallel or "
+                             "--zero yet (the all_to_all dispatch assumes "
+                             "every local token routes over the full "
+                             "expert set on the data axis); "
+                             "--tensor-parallel composes")
         if args.opt in ("lamb", "novograd") or args.larc:
             raise SystemExit("--opt lamb/novograd and --larc compute "
                              "per-tensor statistics that collapse on the "
@@ -790,7 +790,7 @@ def _lm_main_impl(args, policy, scaler):
               + (f", V={pp_chunks}" if pp_chunks > 1 else "")
               + f"), TP over {tp}, DP over {n_dev // (pp * tp)}, "
               f"{args.microbatches} microbatches/shard: {mesh}")
-    elif tp > 1 and cp == 1:
+    elif tp > 1 and cp == 1 and not args.moe_experts:
         # GSPMD tensor parallelism: one (pipe, data, context, model) mesh,
         # params carrying the TP layers' partitioning metadata, the plain
         # single-device step jitted with those shardings — collectives are
@@ -874,31 +874,54 @@ def _lm_main_impl(args, policy, scaler):
         # axis (workloads.make_bert_moe_train_step).  Init runs the dense-
         # reference MoE path (no mesh axis bound), yielding the full
         # [E, ...] stacks; device_put shards them one-expert-per-device.
+        # With --tensor-parallel the shard_map goes manual over 'data'
+        # only: the GSPMD TP attention/embeddings/head run on the
+        # automatic 'model' axis around the expert block (the same
+        # partially-manual composition as CP x TP).
         from apex_example_tpu.workloads import (bert_moe_state_shardings,
                                                 make_bert_moe_train_step)
-        if args.moe_experts != n_dev:
+        ep = n_dev // tp
+        if args.moe_experts != ep:
             raise SystemExit(f"--moe-experts {args.moe_experts} must equal "
-                             f"the device count {n_dev} (one expert per "
-                             f"device over the data axis)")
-        if args.batch_size % n_dev:
+                             f"the data-axis size {ep} (one expert per "
+                             f"device)")
+        if args.batch_size % ep:
             raise SystemExit(f"--batch-size {args.batch_size} not "
-                             f"divisible by {n_dev} devices")
-        if (args.batch_size // n_dev) % args.grad_accum:
-            raise SystemExit(f"per-shard batch {args.batch_size // n_dev} "
+                             f"divisible by the data-axis size {ep}")
+        if (args.batch_size // ep) % args.grad_accum:
+            raise SystemExit(f"per-shard batch {args.batch_size // ep} "
                              f"not divisible by --grad-accum "
                              f"{args.grad_accum}")
-        mesh = make_data_mesh(devices=devices)
-        state = create_train_state(jax.random.PRNGKey(args.seed), model,
-                                   optimizer, sample[:1], policy, scaler)
-        state = jax.device_put(
-            state, bert_moe_state_shardings(mesh, state, optimizer))
+        if tp > 1:
+            from apex_example_tpu.engine import create_gspmd_train_state
+            from apex_example_tpu.ops import _config as ops_config
+            from apex_example_tpu.transformer import parallel_state
+            ops_config.set_force_xla(True)
+            mesh = parallel_state.initialize_model_parallel(
+                tensor_parallel=tp, devices=devices)
+            state, gsh = create_gspmd_train_state(
+                jax.random.PRNGKey(args.seed), mesh, model, optimizer,
+                sample[:1], policy, scaler)
+            shardings = bert_moe_state_shardings(mesh, state, optimizer,
+                                                 base_shardings=gsh)
+            state = jax.device_put(state, shardings)
+        else:
+            mesh = make_data_mesh(devices=devices)
+            shardings = None
+            state = create_train_state(jax.random.PRNGKey(args.seed),
+                                       model, optimizer, sample[:1],
+                                       policy, scaler)
+            state = jax.device_put(
+                state, bert_moe_state_shardings(mesh, state, optimizer))
         step_fn = make_bert_moe_train_step(
             mesh, model, optimizer, policy, state_template=state,
             aux_weight=args.moe_aux_weight, grad_accum=args.grad_accum,
-            objective="mlm" if is_bert else "lm")
+            objective="mlm" if is_bert else "lm",
+            state_shardings=shardings)
         mems = None
-        print(f"MoE over {n_dev} experts (1/device, capacity factor "
-              f"{args.moe_capacity_factor}), DP over {n_dev}: {mesh}")
+        print(f"MoE over {ep} experts (1/device, capacity factor "
+              f"{args.moe_capacity_factor}), TP over {tp}, DP over {ep}: "
+              f"{mesh}")
     else:
         state = create_train_state(
             jax.random.PRNGKey(args.seed), model, optimizer, sample[:1],
