@@ -102,12 +102,15 @@ class BertSelfAttention(nn.Module):
     # take it natively (their blockwise/chunkwise skip logic).  Consumed
     # by models/gpt.py.
     causal: bool = False
-    # Load-balanced causal ring (context_parallel + causal only): local
-    # shards hold zigzag chunk pairs (i, 2n-1-i) and attention runs
-    # ring_attention_zigzag, so every device does identical live work per
-    # ring step.  The caller (workloads.make_gpt_cp_train_step
-    # zigzag=True) reorders the batch with zigzag_shard.
-    cp_zigzag: bool = False
+    # Context-parallel attention program (with context_parallel):
+    #   "ring"    — ppermute KV ring, contiguous chunks (flash-composed);
+    #   "zigzag"  — load-balanced CAUSAL ring: local shards hold zigzag
+    #               chunk pairs (i, 2n-1-i), identical live work per ring
+    #               step (the caller reorders the batch with zigzag_shard);
+    #   "ulysses" — all-to-all head sharding: full sequence per device,
+    #               H/N heads per device, exact attention (DeepSpeed-
+    #               Ulysses form; needs heads % axis size == 0).
+    cp_mode: str = "ring"
     # Autoregressive KV-cache decoding (flax 'cache' collection, the
     # canonical single-token pattern): init with a [B, max_len] dummy
     # allocates cached_key/cached_value/cache_index; each subsequent call
@@ -205,23 +208,30 @@ class BertSelfAttention(nn.Module):
                                  "attention mask (the benchmark MLM path "
                                  "uses none); masking would need per-chunk "
                                  "key-bias rotation in the ring")
-            if self.cp_zigzag:
+            if self.cp_mode == "zigzag":
                 if not self.causal:
                     raise ValueError(
-                        "cp_zigzag is the load-BALANCED CAUSAL layout; "
-                        "non-causal CP has uniform work already — use the "
-                        "plain ring")
+                        "cp_mode='zigzag' is the load-BALANCED CAUSAL "
+                        "layout; non-causal CP has uniform work already — "
+                        "use the plain ring")
                 from apex_example_tpu.parallel.context_parallel import (
                     ring_attention_zigzag)
                 ctx = ring_attention_zigzag(q, k, v,
                                             scale=1.0 / float(hd) ** 0.5)
-            else:
+            elif self.cp_mode == "ulysses":
+                from apex_example_tpu.parallel.context_parallel import (
+                    ulysses_attention)
+                ctx = ulysses_attention(q, k, v, causal=self.causal,
+                                        scale=1.0 / float(hd) ** 0.5)
+            elif self.cp_mode == "ring":
                 # causal=True: contiguous sequence chunks; blocks entirely
                 # in the future are skipped, the diagonal chunk masks
-                # blockwise (GPT's CP path; cp_zigzag is the load-balanced
-                # variant).
+                # blockwise (zigzag is the load-balanced causal variant).
                 ctx = ring_attention(q, k, v, causal=self.causal,
                                      scale=1.0 / float(hd) ** 0.5)
+            else:
+                raise ValueError(f"unknown cp_mode {self.cp_mode!r} "
+                                 "(ring | zigzag | ulysses)")
             return dense_out(ctx.reshape(*x.shape[:-1], d))
         if use_kernel and not self.tensor_parallel:
             # (TP runs the einsum path: pallas_call is opaque to the SPMD
@@ -262,7 +272,7 @@ class BertLayer(nn.Module):
     moe_axis_name: str = "expert"
     moe_top_k: int = 1
     causal: bool = False
-    cp_zigzag: bool = False
+    cp_mode: str = "ring"
     decode: bool = False
 
     @nn.compact
@@ -280,7 +290,7 @@ class BertLayer(nn.Module):
                                  sequence_parallel=self.sequence_parallel,
                                  context_parallel=self.context_parallel,
                                  causal=self.causal,
-                                 cp_zigzag=self.cp_zigzag,
+                                 cp_mode=self.cp_mode,
                                  decode=self.decode,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
@@ -356,6 +366,9 @@ class BertForMaskedLM(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
     moe_top_k: int = 1
+    # context-parallel attention program: "ring" (default) or "ulysses"
+    # (all-to-all head sharding; "zigzag" is causal-only -> GPT)
+    cp_mode: str = "ring"
 
     @nn.compact
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
@@ -424,6 +437,7 @@ class BertForMaskedLM(nn.Module):
                           moe_capacity_factor=self.moe_capacity_factor,
                           moe_axis_name=self.moe_axis_name,
                           moe_top_k=self.moe_top_k,
+                          cp_mode=self.cp_mode,
                           name=f"layer_{i}")(x, mask_bias)
             if self.moe_experts:
                 x, aux = x

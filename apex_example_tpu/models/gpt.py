@@ -54,11 +54,11 @@ class GPTForCausalLM(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_axis_name: str = "expert"
     moe_top_k: int = 1
-    # Load-balanced causal ring (with context_parallel): local shards hold
-    # zigzag chunk pairs (i, 2n-1-i); position ids follow the same order.
-    # The step factory (workloads.make_gpt_cp_train_step(zigzag=True))
-    # reorders the batch with parallel.context_parallel.zigzag_shard.
-    cp_zigzag: bool = False
+    # Context-parallel attention program: "ring" (contiguous causal KV
+    # ring), "zigzag" (load-balanced causal ring — the step factory
+    # reorders the batch with zigzag_shard and position ids follow), or
+    # "ulysses" (all-to-all head sharding, full sequence per device).
+    cp_mode: str = "ring"
     # Autoregressive KV-cache inference (see :func:`generate`): init with
     # a [B, max_len] dummy to allocate per-layer caches, then apply one
     # token at a time with mutable=["cache"].
@@ -109,7 +109,7 @@ class GPTForCausalLM(nn.Module):
             from jax import lax as _lax
             from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
             i = _lax.axis_index(CONTEXT_AXIS)
-            if self.cp_zigzag:
+            if self.cp_mode == "zigzag":
                 # zigzag layout: this shard's halves are global chunks i
                 # and 2n-1-i (each of length L/2)
                 n = _lax.axis_size(CONTEXT_AXIS)
@@ -141,7 +141,7 @@ class GPTForCausalLM(nn.Module):
                           moe_capacity_factor=self.moe_capacity_factor,
                           moe_axis_name=self.moe_axis_name,
                           moe_top_k=self.moe_top_k,
-                          causal=True, cp_zigzag=self.cp_zigzag,
+                          causal=True, cp_mode=self.cp_mode,
                           decode=self.decode,
                           name=f"layer_{i}")(x, None)
             if self.moe_experts:

@@ -335,18 +335,20 @@ def make_bert_cp_eval_step(mesh: Mesh, model):
     return jax.jit(sharded)
 
 
-def _zigzag_wrap(fn, mesh, model, zigzag: bool):
-    """Shared zigzag plumbing for the GPT CP train/eval factories: enforce
-    that the batch layout and the model's position ids/ring agree (a
-    mismatch trains/evals on inconsistently ordered data with no error),
-    and wrap ``fn`` with the zigzag_shard pre-pass when on."""
-    if zigzag != bool(getattr(model, "cp_zigzag", False)):
+def _cp_layout_wrap(fn, mesh, model, mode: str):
+    """Shared CP-layout plumbing for the GPT CP train/eval factories:
+    enforce that the factory's mode and the model's cp_mode agree (a
+    mismatch trains/evals on inconsistently ordered data or the wrong
+    attention program with no error), and wrap ``fn`` with the
+    zigzag_shard pre-pass when the layout calls for it (ring and ulysses
+    both use contiguous chunks — no reorder)."""
+    model_mode = getattr(model, "cp_mode", "ring")
+    if mode != model_mode:
         raise ValueError(
-            f"zigzag={zigzag} but model.cp_zigzag="
-            f"{getattr(model, 'cp_zigzag', False)} — the batch layout and "
-            "the model's position ids/ring must agree or the computation "
-            "is silently wrong")
-    if not zigzag:
+            f"mode={mode!r} but model.cp_mode={model_mode!r} — the batch "
+            "layout and the model's position ids/attention program must "
+            "agree or the computation is silently wrong")
+    if mode != "zigzag":
         return fn
     from apex_example_tpu.parallel.context_parallel import zigzag_shard
     from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
@@ -360,7 +362,7 @@ def _zigzag_wrap(fn, mesh, model, zigzag: bool):
 
 def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                            donate: bool = True, grad_accum: int = 1,
-                           state_shardings=None, zigzag: bool = False):
+                           state_shardings=None, mode: str = "ring"):
     """Ring context-parallel GPT step over a ('data', 'context') mesh
     (train.py --context-parallel with a gpt arch).
 
@@ -374,14 +376,15 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     sequence-over-'context' in the same contiguous chunk order the ring
     and the position offsets key on.
 
-    ``zigzag=True`` switches to the load-BALANCED causal ring
-    (parallel.context_parallel.ring_attention_zigzag): the factory
-    reorders both sequences with ``zigzag_shard`` before the shard_map,
-    so P('context') hands device i its (i, 2n-1-i) chunk pair and every
-    ring step does identical live work on every device.  The model must
-    be built with ``cp_zigzag=True`` (zigzag position ids + zigzag ring).
-    Losses/grads are order-invariant sums, so the trajectory equals the
-    contiguous form exactly.
+    ``mode`` selects the CP attention program and must match the model's
+    ``cp_mode``: "ring" (contiguous causal KV ring), "zigzag" (the
+    load-BALANCED causal ring — the factory reorders both sequences with
+    ``zigzag_shard`` before the shard_map, so P('context') hands device i
+    its (i, 2n-1-i) chunk pair and every ring step does identical live
+    work), or "ulysses" (all-to-all head sharding: full sequence per
+    device, H/N heads per device, exact attention).  Losses/grads are
+    order-invariant sums, so every mode's trajectory equals the dense
+    model exactly.
     """
     from apex_example_tpu.engine import make_train_step
     from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
@@ -397,7 +400,7 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                          in_specs=(P(), (spec, spec)),
                          out_specs=(P(), P()),
                          **_cp_axis_names(mesh, model))
-    sharded = _zigzag_wrap(sharded, mesh, model, zigzag)
+    sharded = _cp_layout_wrap(sharded, mesh, model, mode)
     jkw = {}
     if state_shardings is not None:
         from jax.sharding import NamedSharding
@@ -405,7 +408,7 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     return jax.jit(sharded, donate_argnums=(0,) if donate else (), **jkw)
 
 
-def make_gpt_cp_eval_step(mesh: Mesh, model, zigzag: bool = False):
+def make_gpt_cp_eval_step(mesh: Mesh, model, mode: str = "ring"):
     """Sequence-sharded held-out eval under the same causal KV ring
     (train.py --context-parallel --eval, gpt archs): loss at the training
     context length, psum-normalized globally."""
@@ -421,7 +424,7 @@ def make_gpt_cp_eval_step(mesh: Mesh, model, zigzag: bool = False):
     sharded = _shard_map(per_shard, mesh=mesh,
                          in_specs=(P(), (spec, spec)), out_specs=P(),
                          **_cp_axis_names(mesh, model))
-    return jax.jit(_zigzag_wrap(sharded, mesh, model, zigzag))
+    return jax.jit(_cp_layout_wrap(sharded, mesh, model, mode))
 
 
 def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,

@@ -69,6 +69,38 @@ def test_cp_train_matches_dense(devices8):
                                    rtol=1e-3, atol=3e-5)
 
 
+def test_cp_ulysses_train_matches_dense(devices8):
+    """BERT CP with the all-to-all (Ulysses) attention program == dense:
+    full sequence per device on H/N head shards, exact attention — the
+    bidirectional counterpart of the GPT ulysses test."""
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("data", "context"))
+    policy, scaler = amp.initialize("O0")
+    dense = bert_tiny()
+    cp_model = bert_tiny(context_parallel=True, cp_mode="ulysses")
+    V = dense.vocab_size
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+    sample = _batch(0, V)[0][:1]
+    state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                 sample, policy, scaler)
+    step_d = jax.jit(make_train_step(dense, opt(), policy,
+                                     loss_fn=mlm_loss,
+                                     compute_accuracy=False))
+    state_c = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                 sample, policy, scaler)
+    step_c = make_bert_cp_train_step(mesh, cp_model, opt(), policy,
+                                     donate=False)
+    for i in range(3):
+        b = _batch(i, V)
+        state_d, m_d = step_d(state_d, b)
+        state_c, m_c = step_c(state_c, b)
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_c["loss"]),
+                                   rtol=3e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
+                    jax.tree_util.tree_leaves(state_c.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_cp_eval_matches_dense(devices8):
     """Sequence-sharded eval (workloads.make_bert_cp_eval_step) returns the
     dense eval's loss AND masked accuracy on the same params — the ring

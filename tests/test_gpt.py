@@ -109,19 +109,19 @@ def test_gpt_tp_matches_dense(devices8):
         parallel_state.set_mesh(None)
 
 
-@pytest.mark.parametrize("zigzag", [False, True])
-def test_gpt_cp_matches_dense(devices8, zigzag):
-    """3 causal-KV-ring CP train steps on a (data=2, context=4) mesh == 3
-    dense steps — the causal chunk skipping and the global position-count
-    loss normalization are the parts worth pinning.  zigzag=True runs the
-    load-balanced layout: the factory's zigzag_shard pre-pass, the model's
-    zigzag position ids, and ring_attention_zigzag's four-pair chunk
-    algebra must compose back to the exact dense trajectory."""
+@pytest.mark.parametrize("mode", ["ring", "zigzag", "ulysses"])
+def test_gpt_cp_matches_dense(devices8, mode):
+    """3 CP train steps on a (data=2, context=4) mesh == 3 dense steps for
+    EVERY attention program: "ring" pins the causal chunk skipping and
+    global position-count normalization; "zigzag" additionally composes
+    the factory's zigzag_shard pre-pass, the model's zigzag position ids,
+    and ring_attention_zigzag's four-pair chunk algebra; "ulysses" pins
+    the all-to-all head-sharding exchange (full sequence per device)."""
     from apex_example_tpu.workloads import make_gpt_cp_train_step
     mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("data", "context"))
     policy, scaler = amp.initialize("O0")
     dense = gpt_tiny()
-    cp_model = gpt_tiny(context_parallel=True, cp_zigzag=zigzag)
+    cp_model = gpt_tiny(context_parallel=True, cp_mode=mode)
     V = dense.vocab_size
     opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
     sample = _batch(0, V)[0][:1]
@@ -132,7 +132,7 @@ def test_gpt_cp_matches_dense(devices8, zigzag):
     state_c = create_train_state(jax.random.PRNGKey(0), dense, opt(),
                                  sample, policy, scaler)
     step_c = make_gpt_cp_train_step(mesh, cp_model, opt(), policy,
-                                    donate=False, zigzag=zigzag)
+                                    donate=False, mode=mode)
     for i in range(3):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
@@ -277,10 +277,65 @@ def test_generate_sampling():
         generate(model, params, prompt, max_len=8, temperature=0.8)
 
 
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_gpt_cp_tp_train_matches_dense(devices8, mode):
+    """GPT CP x TP: the causal CP attention program over 'context' with
+    GSPMD TP attention on the still-automatic 'model' axis — trajectory
+    matches dense and the params keep their model-axis sharding (mirror
+    of the BERT CP x TP test; the ops-config XLA pin follows the
+    train.py path).  "ulysses" additionally pins the manual context-axis
+    head all_to_all composing with the auto model-axis head sharding."""
+    from apex_example_tpu.engine import gspmd_state_shardings
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    from apex_example_tpu.workloads import make_gpt_cp_train_step
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_parallel=2, context_parallel=2, devices=devices8)
+    ops_config.set_force_xla(True)
+    try:
+        policy, scaler = amp.initialize("O0")
+        dense = gpt_tiny()
+        tp_model = gpt_tiny(tensor_parallel=True)
+        cp_tp_model = gpt_tiny(tensor_parallel=True, context_parallel=True,
+                               cp_mode=mode)
+        V = dense.vocab_size
+        opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+        sample = _batch(0, V)[0][:1]
+        state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                     sample, policy, scaler)
+        step_d = jax.jit(make_train_step(dense, opt(), policy,
+                                         loss_fn=lm_loss,
+                                         compute_accuracy=False))
+        state_c = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                     sample, policy, scaler)
+        sh = gspmd_state_shardings(mesh, tp_model, opt(), sample, policy)
+        state_c = jax.device_put(state_c, sh)
+        step_c = make_gpt_cp_train_step(mesh, cp_tp_model, opt(), policy,
+                                        donate=False, state_shardings=sh,
+                                        mode=mode)
+        for i in range(3):
+            b = _batch(i, V)
+            state_d, m_d = step_d(state_d, b)
+            state_c, m_c = step_c(state_c, b)
+            np.testing.assert_allclose(float(m_d["loss"]),
+                                       float(m_c["loss"]), rtol=3e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
+                        jax.tree_util.tree_leaves(state_c.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        qk = state_c.params["layer_0"]["attention"]["query"]["kernel"]
+        assert qk.addressable_shards[0].data.shape == (64, 32), \
+            "query kernel lost its model-axis sharding"
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
 def test_train_py_cli_gpt_cp_zigzag(devices8, capsys):
     """Load-balanced causal ring from the CLI."""
     import train as train_mod
-    argv = ["--arch", "gpt_tiny", "--context-parallel", "4", "--cp-zigzag",
+    argv = ["--arch", "gpt_tiny", "--context-parallel", "4",
+            "--cp-mode", "zigzag",
             "--batch-size", "16", "--seq-len", "16", "--epochs", "1",
             "--steps-per-epoch", "2", "--opt", "adam", "--lr", "1e-3",
             "--opt-level", "O0", "--print-freq", "1",
@@ -293,11 +348,11 @@ def test_train_py_gpt_rejections():
     import train as train_mod
     base = ["--arch", "gpt_tiny", "--batch-size", "16", "--seq-len", "16",
             "--epochs", "1", "--steps-per-epoch", "1"]
-    with pytest.raises(SystemExit):   # zigzag needs CP
-        train_mod.main(base + ["--cp-zigzag"])
+    with pytest.raises(SystemExit):   # non-ring modes need CP
+        train_mod.main(base + ["--cp-mode", "zigzag"])
     with pytest.raises(SystemExit):   # zigzag balances the CAUSAL mask
         train_mod.main(["--arch", "bert_tiny", "--context-parallel", "4",
-                        "--cp-zigzag", "--batch-size", "16",
+                        "--cp-mode", "zigzag", "--batch-size", "16",
                         "--seq-len", "16", "--epochs", "1",
                         "--steps-per-epoch", "1"])
     with pytest.raises(SystemExit):   # MoE does not ride the pipeline

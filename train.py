@@ -143,11 +143,15 @@ def parse_args(argv=None):
                         "of this size (ppermute KV-ring attention — the "
                         "long-context training path); remaining devices "
                         "form the data axis")
-    p.add_argument("--cp-zigzag", action="store_true",
-                   help="with --context-parallel on a gpt arch: the "
-                        "load-balanced causal ring (zigzag chunk layout — "
-                        "each device holds chunks (i, 2n-1-i), so every "
-                        "ring step does identical live work everywhere)")
+    p.add_argument("--cp-mode", default="ring",
+                   choices=["ring", "zigzag", "ulysses"],
+                   help="attention program under --context-parallel: "
+                        "'ring' (ppermute KV ring), 'zigzag' (load-"
+                        "balanced CAUSAL ring, gpt archs — each device "
+                        "holds chunks (i, 2n-1-i) so every ring step does "
+                        "identical live work), 'ulysses' (all-to-all head "
+                        "sharding: full sequence per device, H/N heads "
+                        "per device; needs heads divisible by CP)")
     p.add_argument("--moe-experts", type=int, default=0, metavar="E",
                    help="switch-MoE BERT encoder FFNs with E experts, one "
                         "per device over the 'data' axis (expert "
@@ -338,9 +342,9 @@ def main(argv=None):
     if args.moe_experts:
         raise SystemExit("--moe-experts is wired for the BERT archs "
                          "(switch-MoE replaces the transformer FFN)")
-    if args.cp_zigzag:
-        raise SystemExit("--cp-zigzag only applies with "
-                         "--context-parallel on a gpt arch")
+    if args.cp_mode != "ring":
+        raise SystemExit(f"--cp-mode {args.cp_mode} only applies with "
+                         "--context-parallel on the LM archs")
 
     spec = CIFAR10 if args.dataset == "cifar10" else IMAGENET
     devices = select_devices(args)
@@ -569,18 +573,28 @@ def _lm_main_impl(args, policy, scaler):
         if args.seq_len % cp:
             raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                              f"--context-parallel {cp}")
-        if args.cp_zigzag:
+        if args.cp_mode == "zigzag":
             if not is_gpt:
-                raise SystemExit("--cp-zigzag balances the CAUSAL mask's "
-                                 "ring work (gpt archs); BERT attention is "
-                                 "bidirectional — every device already "
-                                 "does uniform work on the plain ring")
+                raise SystemExit("--cp-mode zigzag balances the CAUSAL "
+                                 "mask's ring work (gpt archs); BERT "
+                                 "attention is bidirectional — every "
+                                 "device already does uniform work on the "
+                                 "plain ring")
             if args.seq_len % (2 * cp):
-                raise SystemExit(f"--cp-zigzag needs --seq-len "
+                raise SystemExit(f"--cp-mode zigzag needs --seq-len "
                                  f"({args.seq_len}) divisible by 2x"
                                  f"--context-parallel ({2 * cp})")
-    elif args.cp_zigzag:
-        raise SystemExit("--cp-zigzag only applies with "
+        if args.cp_mode == "ulysses":
+            arch_heads = {"bert_base": 12, "bert_tiny": 4,
+                          "gpt_base": 12, "gpt_tiny": 4}[args.arch]
+            if arch_heads % (cp * tp):
+                raise SystemExit(
+                    f"--cp-mode ulysses splits the {arch_heads} attention "
+                    f"heads over --context-parallel {cp}"
+                    + (f" x --tensor-parallel {tp}" if tp > 1 else "")
+                    + " — not divisible")
+    elif args.cp_mode != "ring":
+        raise SystemExit(f"--cp-mode {args.cp_mode} only applies with "
                          "--context-parallel > 1")
     if pp > 1:
         if not (is_bert or is_gpt):
@@ -843,8 +857,7 @@ def _lm_main_impl(args, policy, scaler):
         mesh = parallel_state.initialize_model_parallel(
             tensor_parallel=tp, context_parallel=cp, devices=devices)
         model_cp = builder(**mkw, context_parallel=True,
-                           **(dict(cp_zigzag=True) if args.cp_zigzag
-                              else {}))
+                           cp_mode=args.cp_mode)
         cp_shardings = None
         if tp > 1:
             from apex_example_tpu.engine import create_gspmd_train_state
@@ -859,7 +872,7 @@ def _lm_main_impl(args, policy, scaler):
                                              policy,
                                              grad_accum=args.grad_accum,
                                              state_shardings=cp_shardings,
-                                             zigzag=args.cp_zigzag)
+                                             mode=args.cp_mode)
         else:
             step_fn = make_bert_cp_train_step(mesh, model_cp, optimizer,
                                               policy,
@@ -981,7 +994,7 @@ def _lm_main_impl(args, policy, scaler):
                 from apex_example_tpu.workloads import (
                     make_bert_cp_eval_step, make_gpt_cp_eval_step)
                 eval_fn = make_gpt_cp_eval_step(
-                    mesh, model_cp, zigzag=args.cp_zigzag) if is_gpt \
+                    mesh, model_cp, mode=args.cp_mode) if is_gpt \
                     else make_bert_cp_eval_step(mesh, model_cp)
             elif pp > 1:
                 from apex_example_tpu.transformer.bert_pipeline import (
