@@ -89,7 +89,7 @@ def test_cp_ulysses_train_matches_dense(devices8):
                                  sample, policy, scaler)
     step_c = make_bert_cp_train_step(mesh, cp_model, opt(), policy,
                                      donate=False)
-    for i in range(3):
+    for i in range(10):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
         state_c, m_c = step_c(state_c, b)
@@ -154,7 +154,7 @@ def test_cp_grad_accum_matches_dense(devices8):
                                  sample, policy, scaler)
     step_c = make_bert_cp_train_step(mesh, cp_model, opt(), policy,
                                      donate=False, grad_accum=K)
-    for i in range(3):
+    for i in range(10):
         ids, (lab, w) = _batch(i, V)
         state_d, m_d = step_d(state_d, (ids[perm], (lab[perm], w[perm])))
         state_c, m_c = step_c(state_c, (ids, (lab, w)))
@@ -223,7 +223,7 @@ def test_cp_tp_train_matches_dense(devices8):
         state_c = jax.device_put(state_c, sh)
         step_c = make_bert_cp_train_step(mesh, cp_tp_model, opt(), policy,
                                          donate=False, state_shardings=sh)
-        for i in range(3):
+        for i in range(10):
             b = _batch(i, V)
             state_d, m_d = step_d(state_d, b)
             state_c, m_c = step_c(state_c, b)

@@ -100,7 +100,7 @@ def test_moe_train_matches_blocked_dense_golden(devices8):
                                       state_template=state_e,
                                       aux_weight=AUX_W, donate=False)
 
-    for i in range(3):
+    for i in range(10):
         batch = _batch(i, V)
         state_g, loss_g = golden(state_g, batch)
         state_e, m_e = step_e(state_e, batch)
@@ -145,7 +145,7 @@ def test_moe_tp_train_matches_blocked_dense_golden(devices8):
                                           state_template=state_e,
                                           aux_weight=AUX_W, donate=False,
                                           state_shardings=sh)
-        for i in range(3):
+        for i in range(10):
             batch = _batch(i, V)
             state_g, loss_g = golden(state_g, batch)
             state_e, m_e = step_e(state_e, batch)

@@ -65,7 +65,7 @@ def test_flash_matches_einsum():
 
 
 def test_gpt_tp_matches_dense(devices8):
-    """3 TP train steps on a (data=2, model=4) mesh == 3 dense steps."""
+    """10 lockstep TP train steps on a (data=2, model=4) mesh == dense."""
     from apex_example_tpu.engine import (create_gspmd_train_state,
                                          make_gspmd_train_step)
     from apex_example_tpu.ops import _config as ops_config
@@ -92,7 +92,7 @@ def test_gpt_tp_matches_dense(devices8):
         step_t = make_gspmd_train_step(mesh, tp_model, opt(), policy,
                                        shardings, loss_fn=lm_loss,
                                        compute_accuracy=False, donate=False)
-        for i in range(3):
+        for i in range(10):
             b = _batch(i, V)
             state_d, m_d = step_d(state_d, b)
             state_t, m_t = step_t(state_t, b)
@@ -111,7 +111,7 @@ def test_gpt_tp_matches_dense(devices8):
 
 @pytest.mark.parametrize("mode", ["ring", "zigzag", "ulysses"])
 def test_gpt_cp_matches_dense(devices8, mode):
-    """3 CP train steps on a (data=2, context=4) mesh == 3 dense steps for
+    """10 lockstep CP train steps on a (data=2, context=4) mesh == dense for
     EVERY attention program: "ring" pins the causal chunk skipping and
     global position-count normalization; "zigzag" additionally composes
     the factory's zigzag_shard pre-pass, the model's zigzag position ids,
@@ -133,7 +133,7 @@ def test_gpt_cp_matches_dense(devices8, mode):
                                  sample, policy, scaler)
     step_c = make_gpt_cp_train_step(mesh, cp_model, opt(), policy,
                                     donate=False, mode=mode)
-    for i in range(3):
+    for i in range(10):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
         state_c, m_c = step_c(state_c, b)
@@ -148,7 +148,7 @@ def test_gpt_cp_matches_dense(devices8, mode):
 
 @pytest.mark.parametrize("sched", ["ring", "1f1b"])
 def test_gpt_pp_matches_dense(devices8, sched):
-    """3 pipeline-parallel GPT train steps == 3 dense steps — the GPT head
+    """10 lockstep pipeline-parallel GPT train steps == dense — the GPT head
     cell (final LN + tied decoder) and the all-ones-weights normalization
     (== next-token mean) inside the schedule are the parts worth pinning."""
     from apex_example_tpu.engine import TrainState
@@ -180,7 +180,7 @@ def test_gpt_pp_matches_dense(devices8, sched):
     step_p = make_bert_pp_train_step(mesh, model, zopt, policy,
                                      microbatches=2, donate=False,
                                      schedule=sched)
-    for i in range(3):
+    for i in range(10):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
         state_p, m_p = step_p(state_p, b)
@@ -313,7 +313,7 @@ def test_gpt_cp_tp_train_matches_dense(devices8, mode):
         step_c = make_gpt_cp_train_step(mesh, cp_tp_model, opt(), policy,
                                         donate=False, state_shardings=sh,
                                         mode=mode)
-        for i in range(3):
+        for i in range(10):
             b = _batch(i, V)
             state_d, m_d = step_d(state_d, b)
             state_c, m_c = step_c(state_c, b)
