@@ -18,8 +18,9 @@ XLA collectives — no dynamic shapes, jit-stable):
      shape static).
   3. dispatch: one-hot position-in-expert (cumsum over the token dim) builds
      a [E, C, d] buffer per device; ``lax.all_to_all`` over the expert axis
-     turns it into this device's expert's [world·C, d] token block.
-  4. expert FFN (dense→act→dense; one expert per device shard).
+     turns it into this device's experts' per-sender token blocks.
+  4. expert FFN (dense→act→dense; k = E/n experts per device shard,
+     batched over the local expert dim).
   5. inverse all_to_all + gate-weighted combine back to [tokens, d].
 
 Gradients flow through dispatch/combine as through any other collectives
@@ -53,9 +54,9 @@ class MoEParams(NamedTuple):
 
 def init_moe_params(rng, d: int, hidden: int, n_experts: int,
                     dtype=jnp.float32) -> MoEParams:
-    """Per-device params: router replicated, expert weights sharded (one
-    expert per device over the expert axis → pass P(expert) specs for
-    w_in/w_out stacked as [E, ...] at the shard_map boundary)."""
+    """Logical params: router replicated, expert weights stacked [E, ...]
+    and sharded over the expert axis (P(expert) on dim 0 → E/n experts
+    per device at the shard_map boundary)."""
     k1, k2, k3 = jax.random.split(rng, 3)
     scale = 1.0 / jnp.sqrt(d)
     return MoEParams(
@@ -126,47 +127,56 @@ def moe_forward(params: MoEParams, x: jnp.ndarray,
                 top_k: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Switch-MoE block over the expert axis.  Inside shard_map:
 
-    x: [T, d] this device's tokens; params.w_in/w_out: [1, d, h]/[1, h, d]
-    (this device's expert shard of the stacked [E, ...] arrays).
+    x: [T, d] this device's tokens; params.w_in/w_out:
+    [k, d, h]/[k, h, d] — this device's k = E/n experts of the stacked
+    [E, ...] arrays (P(axis) on dim 0).
 
     Returns (y [T, d], aux_loss).
     """
     T, d = x.shape
-    E = lax.axis_size(axis_name)
-    # One expert per expert-axis device: the [E, C, d] send buffer is split
-    # E-ways by the tiled all_to_all, so router width, axis size, and the
-    # local weight shard must agree or every device silently applies the
-    # wrong expert to other experts' tokens.
-    if params.w_router.shape[1] != E or params.w_in.shape[0] != 1:
+    n = lax.axis_size(axis_name)
+    k = params.w_in.shape[0]            # experts on THIS device
+    E = params.w_router.shape[1]        # total experts
+    # k experts per expert-axis device (E = k·n): the [E, C, d] send
+    # buffer is split n-ways by the tiled all_to_all, so router width,
+    # axis size, and the local weight shard must agree or every device
+    # silently applies the wrong experts to other experts' tokens.
+    if E != k * n:
         raise ValueError(
-            f"moe_forward needs n_experts == expert-axis size with one "
-            f"expert per device; got router width "
-            f"{params.w_router.shape[1]}, axis size {E}, local shard "
-            f"{params.w_in.shape[0]} (shard stacked [E, ...] weights with "
-            f"P('{axis_name}'))")
+            f"moe_forward needs n_experts == local shard x axis size; got "
+            f"router width {E}, axis '{axis_name}' size {n}, local shard "
+            f"{k} (shard stacked [E, ...] weights with P('{axis_name}'))")
     # GShard capacity sizing: the dispatch demand is top_k slots per
     # token, so C scales with top_k or most second choices would be
     # silently dropped at the default factor.
     capacity = int(-(-T * top_k * capacity_factor // E))
     # lane-friendly capacity (C is a matmul/all_to_all dim)
     capacity = capacity + (-capacity) % 8
+    C = capacity
 
     logits = x @ params.w_router.astype(x.dtype)         # [T, E]
     dispatch, combine, aux = _dispatch_masks(logits, capacity, top_k)
 
-    # [E, C, d] expert-major send buffer; tiled all_to_all over the axis
-    # swaps "which expert" for "which sender": recv[j] = device j's tokens
-    # for THIS device's expert.
+    # [E, C, d] expert-major send buffer; the tiled all_to_all splits it
+    # into n k-expert blocks and swaps "which expert block" for "which
+    # sender": recv row j·k+e = device j's tokens for THIS device's local
+    # expert e.
     send = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
                       dispatch).astype(x.dtype)
     recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True)                    # [E, C, d]
-    w_in = params.w_in[0].astype(x.dtype)
-    w_out = params.w_out[0].astype(x.dtype)
-    h = activation(recv @ w_in)                          # [E, C, hidden]
-    out = h @ w_out                                      # [E, C, d]
+                          tiled=True)                    # [n·k, C, d]
+    # group by local expert: [n, k, C, d] -> [k, n·C, d]
+    recv = recv.reshape(n, k, C, d).transpose(1, 0, 2, 3) \
+               .reshape(k, n * C, d)
+    w_in = params.w_in.astype(x.dtype)                   # [k, d, h]
+    w_out = params.w_out.astype(x.dtype)                 # [k, h, d]
+    h = activation(jnp.einsum("kcd,kdh->kch", recv, w_in))
+    out = jnp.einsum("kch,khd->kcd", h, w_out)           # [k, n·C, d]
+    # back to sender-major [n·k, C, d] for the inverse all_to_all
+    out = out.reshape(k, n, C, d).transpose(1, 0, 2, 3) \
+             .reshape(n * k, C, d)
     back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True)                    # [E, C, d]: back[e]
+                          tiled=True)                    # [E, C, d]
     y = jnp.einsum("ecd,tec->td", back.astype(jnp.float32),
                    combine).astype(x.dtype)
     return y, lax.pmean(aux, axis_name)
@@ -220,7 +230,7 @@ class MoEMLP(nn.Module):
     w_out [E, h, d].  Outside any mesh the dense reference runs on the full
     stack (init, golden tests, single-device eval).  Inside a shard_map
     with ``axis_name`` bound, the caller shards the stacked weights over
-    that axis (P(axis) on dim 0 — one expert per device; see
+    that axis (P(axis) on dim 0 — E/n experts per device; see
     ``workloads.bert_moe_state_specs``) and the all_to_all dispatch runs.
 
     Returns ``(y, aux)`` — the load-balancing aux loss is part of the
@@ -244,10 +254,10 @@ class MoEMLP(nn.Module):
         dist = _axis_is_bound(self.axis_name)
         # flax verifies declared param shapes against the provided values
         # at apply time; inside the EP shard_map the stacked [E, ...]
-        # arrays arrive SLICED to this device's expert, so the declared
-        # leading dim is the local one.  Init always runs outside the mesh
-        # (dist=False) and stores the full stack.
-        e_local = 1 if dist else E
+        # arrays arrive SLICED to this device's experts (E/n of them), so
+        # the declared leading dim is the local one.  Init always runs
+        # outside the mesh (dist=False) and stores the full stack.
+        e_local = E // lax.axis_size(self.axis_name) if dist else E
         params = MoEParams(
             w_router=self.param("router", init, (d, E), self.param_dtype),
             w_in=self.param("w_in", init, (e_local, d, h),

@@ -455,7 +455,7 @@ def make_gspmd_txl_train_step(mesh: Mesh, model, optimizer, policy: Policy,
 # ------------------- Expert-parallel (MoE) BERT --------------------------
 #
 # The harness face of transformer/expert_parallel.py (train.py
-# --moe-experts): switch-MoE encoder FFNs with one expert per device over
+# --moe-experts): switch-MoE encoder FFNs with E/n experts per device over
 # the 'data' axis — EP rides the DP devices the way DeepSpeed-MoE does, so
 # no new mesh axis is needed and every token still trains on its home
 # shard.  No reference analog (SURVEY.md §3.2: EP documented as absent
@@ -504,7 +504,7 @@ def bert_moe_state_shardings(mesh: Mesh, state: TrainState, optimizer,
     ``base_shardings`` (MoE x TP): the GSPMD NamedSharding tree from
     create_gspmd_train_state — non-expert leaves keep their model-axis
     placement, the expert stacks are overridden to P('data') (they are
-    model-replicated; each data-axis device owns one expert)."""
+    model-replicated; each data-axis device owns E/n experts)."""
     from jax.sharding import NamedSharding
     if base_shardings is None:
         return jax.tree_util.tree_map(
@@ -531,11 +531,12 @@ def _check_moe_model(mesh: Mesh, model, optimizer=None):
     if not model.moe_experts:
         raise ValueError("model has moe_experts=0; build it with "
                          "moe_experts=<data-axis size>")
-    if model.moe_experts != E:
+    if model.moe_experts % E:
         raise ValueError(
-            f"moe_experts={model.moe_experts} must equal the data-axis "
-            f"size {E} (one expert per device — the all_to_all splits the "
-            f"[E, C, d] dispatch buffer E-ways over the axis)")
+            f"moe_experts={model.moe_experts} must be a multiple of the "
+            f"data-axis size {E} (the all_to_all splits the [E, C, d] "
+            f"dispatch buffer {E}-ways; each device owns "
+            f"moe_experts/{E} experts)")
     if model.moe_axis_name != DATA_AXIS:
         raise ValueError(
             f"model.moe_axis_name={model.moe_axis_name!r} but the EP step "
@@ -567,7 +568,7 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     load-balancing loss (already pmean-ed over the axis inside
     moe_forward).  Replicated-param grads arrive implicitly psum-ed
     through the psum-ed loss (the CP-step mechanism); the expert stacks'
-    grads stay shard-local — each device owns its expert.  The dynamic-
+    grads stay shard-local — each device owns its experts.  The dynamic-
     scaling finite flag is pmean-ed over 'data'
     (engine.make_train_step(finite_reduce_axes=...)): a local overflow in
     one expert's grads must skip the step and halve the scale on EVERY

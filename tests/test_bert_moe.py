@@ -76,10 +76,15 @@ def _golden_step(model, optimizer, state, n_blocks=E):
     return step
 
 
-def test_moe_train_matches_blocked_dense_golden(devices8):
+@pytest.mark.parametrize("n_experts", [E, 2 * E])
+def test_moe_train_matches_blocked_dense_golden(devices8, n_experts):
+    """n_experts = 2*E runs TWO experts per device: the grouped
+    all_to_all's backward (reshape/transpose pairs), the shard-local
+    [k, ...] expert grads, and the optimizer on the k-stacked shards are
+    the parts only this variant exercises."""
     mesh = Mesh(np.asarray(devices8), ("data",))
     policy, scaler = amp.initialize("O0")
-    model = _moe_model()
+    model = _moe_model(moe_experts=n_experts)
     V = model.vocab_size
     # SGD+momentum, not adam: attention's key bias takes a mathematically
     # ~zero gradient, and adam's m/sqrt(v) normalization would amplify the
@@ -89,7 +94,7 @@ def test_moe_train_matches_blocked_dense_golden(devices8):
     opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
     state_g = create_train_state(jax.random.PRNGKey(0), model, opt(),
                                  _batch(0, V)[0][:1], policy, scaler)
-    golden = _golden_step(model, opt(), state_g)
+    golden = _golden_step(model, opt(), state_g, n_blocks=E)
 
     zopt = opt()
     state_e = create_train_state(jax.random.PRNGKey(0), model, zopt,

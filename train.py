@@ -894,10 +894,10 @@ def _lm_main_impl(args, policy, scaler):
         from apex_example_tpu.workloads import (bert_moe_state_shardings,
                                                 make_bert_moe_train_step)
         ep = n_dev // tp
-        if args.moe_experts != ep:
-            raise SystemExit(f"--moe-experts {args.moe_experts} must equal "
-                             f"the data-axis size {ep} (one expert per "
-                             f"device)")
+        if args.moe_experts % ep:
+            raise SystemExit(f"--moe-experts {args.moe_experts} must be a "
+                             f"multiple of the data-axis size {ep} "
+                             f"(each device owns moe_experts/{ep} experts)")
         if args.batch_size % ep:
             raise SystemExit(f"--batch-size {args.batch_size} not "
                              f"divisible by the data-axis size {ep}")
@@ -932,7 +932,8 @@ def _lm_main_impl(args, policy, scaler):
             objective="mlm" if is_bert else "lm",
             state_shardings=shardings)
         mems = None
-        print(f"MoE over {ep} experts (1/device, capacity factor "
+        print(f"MoE over {args.moe_experts} experts "
+              f"({args.moe_experts // ep}/device, capacity factor "
               f"{args.moe_capacity_factor}), TP over {tp}, DP over {ep}: "
               f"{mesh}")
     else:
