@@ -47,9 +47,9 @@ EXPERT_AXIS = "expert"
 
 
 class MoEParams(NamedTuple):
-    w_router: jnp.ndarray   # [d, E]
-    w_in: jnp.ndarray       # [d, hidden]  (this device's expert)
-    w_out: jnp.ndarray      # [hidden, d]
+    w_router: jnp.ndarray   # [d, E] (replicated)
+    w_in: jnp.ndarray       # stacked [E, d, h]; [k, d, h] local shard
+    w_out: jnp.ndarray      # stacked [E, h, d]; [k, h, d] local shard
 
 
 def init_moe_params(rng, d: int, hidden: int, n_experts: int,

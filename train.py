@@ -153,10 +153,10 @@ def parse_args(argv=None):
                         "sharding: full sequence per device, H/N heads "
                         "per device; needs heads divisible by CP)")
     p.add_argument("--moe-experts", type=int, default=0, metavar="E",
-                   help="switch-MoE BERT encoder FFNs with E experts, one "
-                        "per device over the 'data' axis (expert "
+                   help="switch-MoE BERT/GPT FFNs with E experts, E/n per "
+                        "device over the 'data' axis of size n (expert "
                         "parallelism via all_to_all dispatch; requires "
-                        "E == device count)")
+                        "E to be a multiple of the data-axis size)")
     p.add_argument("--moe-aux-weight", type=float, default=1e-2,
                    help="weight of the Switch load-balancing aux loss in "
                         "the --moe-experts objective")
