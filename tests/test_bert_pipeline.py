@@ -337,23 +337,26 @@ def test_train_py_cli_tp_pp(devices8, capsys):
     assert "masked_acc" in capsys.readouterr().out
 
 
-@pytest.mark.parametrize("sched", ["ring", "1f1b"])
-def test_pp_fp16_dynamic_scaling_skips_globally(devices8, sched):
+@pytest.mark.parametrize("sched,chunks,layers",
+                         [("ring", 1, 2), ("1f1b", 1, 2),
+                          ("interleaved", 2, 4)])
+def test_pp_fp16_dynamic_scaling_skips_globally(devices8, sched, chunks,
+                                                layers):
     """fp16 dynamic scaling under PP: an overflow anywhere in the schedule
     poisons the accumulated grads, the pipe-pmean'd finite flag is mesh-
     invariant, and every stage takes the same all-or-none skip — scale
     halves, the sharded state rolls back bit-exactly, and the next clean
     step trains (mirror of test_tp_fp16_dynamic_scaling_skips_globally).
     Parametrized over the autodiff ring schedule AND the value-program
-    1F1B schedule: the latter assembles its backward externally (head
-    grads + input cotangents), so its overflow/unscale path is distinct
-    code."""
+    1F1B/interleaved schedules: the latter assemble their backward
+    externally (head grads + input cotangents), so their overflow/
+    unscale path is distinct code."""
     from apex_example_tpu.transformer.bert_pipeline import pack_params_1f1b
     mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("pipe", "data"))
     policy, scaler = amp.initialize("O2", loss_scale="dynamic",
                                     half_dtype=jnp.float16,
                                     init_scale=2.0 ** 4)
-    model = bert_tiny(dtype=jnp.float16)
+    model = bert_tiny(dtype=jnp.float16, num_layers=layers)
     V = model.vocab_size
     opt = FusedAdam(lr=1e-3)
     state_d = create_train_state(jax.random.PRNGKey(0), model, opt,
@@ -361,14 +364,15 @@ def test_pp_fp16_dynamic_scaling_skips_globally(devices8, sched):
     if sched == "ring":
         state = _pp_state(state_d, model, opt)
     else:
-        packed = pack_params_1f1b(state_d.params, model.num_layers, 2, 1)
+        packed = pack_params_1f1b(state_d.params, model.num_layers, 2,
+                                  chunks)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=packed,
                            batch_stats={}, opt_state=opt.init(packed),
                            scaler=state_d.scaler)
     state = jax.device_put(state, bert_pp_state_shardings(mesh, state, opt))
     step = make_bert_pp_train_step(mesh, model, opt, policy,
                                    microbatches=2, donate=False,
-                                   schedule=sched)
+                                   schedule=sched, num_chunks=chunks)
 
     ids, (labels, w) = _batch(0, V)
     w_bad = w.at[0, 0].set(jnp.inf)
