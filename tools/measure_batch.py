@@ -17,11 +17,13 @@ Design for a flaky single-tenant tunnel (PERF.md methodology):
 Items (priority order — the headline first so even a short window lands
 the contract number, then every other cheap-compile config, and ONLY
 then the long-compile experiments): c2 headline, c1, c4 (BERT+LAMB),
-c5 (TXL), hostpipe; then remat conv/block and c4 @ seq 8192 (the flash
-kernel's must-win point) last — see the ITEMS comment for why that
-order is load-bearing.  CP throughput is NOT here: context parallelism
-needs >1 real chip and this rig has exactly one (the 8-device mesh
-evidence is the driver's CPU dryrun).
+c5 (TXL), gpt, hostpipe, the steploop dispatch-bubble probe, the
+per-seed on-chip accuracy reruns (~15-20 min each); then remat
+conv/block and c4 @ seq 8192 (the flash kernel's must-win point) last —
+see the ITEMS comment for why that order is load-bearing.  CP
+throughput is NOT here: context parallelism needs >1 real chip and this
+rig has exactly one (the 8-device mesh evidence is the driver's CPU
+dryrun).
 """
 
 from __future__ import annotations
@@ -41,7 +43,10 @@ PROBE = ("import jax, jax.numpy as jnp, time\n"
          "y = (x @ x).block_until_ready()\n"
          "print('PROBE OK %.1fs' % (time.time() - t0), float(y[0, 0]))\n")
 
-# (key, argv-after-"bench.py", subprocess timeout seconds)
+# (key, script + argv, subprocess timeout seconds) — scripts other than
+# bench.py join the same resumable queue: tools/steploop_probe.py (the
+# dispatch-bubble arbitration, PERF.md) and the on-chip accuracy rerun
+# (VERDICT r3 item 9) drain in the same window.
 #
 # ORDER MATTERS (learned 2026-07-31 03:55–04:12): all known-cheap-compile
 # items run FIRST, every long-compile experiment LAST.  The first campaign
@@ -54,22 +59,42 @@ PROBE = ("import jax, jax.numpy as jnp, time\n"
 # which precedes the workload compile.  So the defense is ordering + a
 # timeout that outlasts the worst plausible compile.
 ITEMS = [
-    ("c2",            ["--config", "c2"], 900),
-    ("c1",            ["--config", "c1"], 900),
-    ("c4",            ["--config", "c4"], 900),
-    ("c5",            ["--config", "c5"], 900),
-    ("gpt",           ["--config", "gpt"], 900),
-    ("hostpipe",      ["--config", "hostpipe"], 900),
+    ("c2",            ["bench.py", "--config", "c2"], 900),
+    ("c1",            ["bench.py", "--config", "c1"], 900),
+    ("c4",            ["bench.py", "--config", "c4"], 900),
+    ("c5",            ["bench.py", "--config", "c5"], 900),
+    ("gpt",           ["bench.py", "--config", "gpt"], 900),
+    ("hostpipe",      ["bench.py", "--config", "hostpipe"], 900),
+    ("steploop",      ["tools/steploop_probe.py"], 1200),
+    # on-chip accuracy reruns (non-saturated label-noise design at full
+    # ResNet-50 scale; replaces the CPU artifact's platform caveat).
+    # One item PER SEED so a mid-campaign wedge preserves completed
+    # seeds — each writes its own artifact; the cross-seed gap summary
+    # is the mean over the three gap fields.
+    ("accuracy_full_s0", ["accuracy.py", "--preset", "full",
+                          "--label-noise", "0.3", "--seeds", "0",
+                          "--eval-batches", "32",
+                          "--out", "ACCURACY_FULL_seed0.json"], 1800),
+    ("accuracy_full_s1", ["accuracy.py", "--preset", "full",
+                          "--label-noise", "0.3", "--seeds", "1",
+                          "--eval-batches", "32",
+                          "--out", "ACCURACY_FULL_seed1.json"], 1800),
+    ("accuracy_full_s2", ["accuracy.py", "--preset", "full",
+                          "--label-noise", "0.3", "--seeds", "2",
+                          "--eval-batches", "32",
+                          "--out", "ACCURACY_FULL_seed2.json"], 1800),
     # ---- long-compile experiments: nothing queues behind these ----
-    ("c2_remat_conv", ["--config", "c2", "--remat", "conv"], 2700),
-    ("c2_remat_block", ["--config", "c2", "--remat", "block"], 2700),
+    ("c2_remat_conv", ["bench.py", "--config", "c2", "--remat", "conv"],
+     2700),
+    ("c2_remat_block", ["bench.py", "--config", "c2", "--remat", "block"],
+     2700),
     # seq-8192 compiles a big Pallas grid through the remote-compile path:
     # this is the item whose mid-compile kill wedged the tunnel for a day
     # (PERF.md outage record) — the ITEM timeout must outlast the worst
     # compile.  bench.py's own watchdog stays at its default: it only
     # guards the pre-compile first-op round-trip (wedged-at-entry), not
     # the workload compile, so widening it would just slow that detection.
-    ("c4_seq8192",    ["--config", "c4", "--seq-len", "8192",
+    ("c4_seq8192",    ["bench.py", "--config", "c4", "--seq-len", "8192",
                        "--batch-size", "2"], 2700),
 ]
 
@@ -106,13 +131,22 @@ def probe(timeout: float = 150.0) -> bool:
     return ok
 
 
+# Items with no JSON stdout line — rc 0 alone marks them done on resume.
+# accuracy writes its artifact file; steploop's numbers live ONLY in the
+# stdout_tail logged below (it writes no file), so that field is the
+# record of the dispatch-bubble arbitration.
+NO_JSON_ITEMS = {"steploop", "accuracy_full_s0", "accuracy_full_s1",
+                 "accuracy_full_s2"}
+
+
 def main() -> int:
     done = have()
     for key, argv, timeout in ITEMS:
         # A number from a crashed run (rc != 0) is not a measurement —
-        # only a clean parse counts as done.
-        if key in done and done[key].get("parsed") \
-                and done[key].get("rc") == 0:
+        # only a clean parse (or, for the no-JSON scripts, a clean exit)
+        # counts as done.
+        if key in done and done[key].get("rc") == 0 \
+                and (done[key].get("parsed") or key in NO_JSON_ITEMS):
             print(f"[{key}] already measured — skip")
             continue
         if not probe():
@@ -120,16 +154,20 @@ def main() -> int:
                  "reason": "probe failed (tunnel wedged)",
                  "utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())})
             return 3
-        print(f"[{key}] python bench.py {' '.join(argv)}  (timeout "
-              f"{timeout}s)")
+        print(f"[{key}] python {' '.join(argv)}  (timeout {timeout}s)")
         t0 = time.time()
         try:
-            p = subprocess.run([sys.executable, "bench.py"] + argv,
+            p = subprocess.run([sys.executable] + argv,
                                timeout=timeout, capture_output=True,
                                text=True, cwd=REPO)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            # the captured tails show WHERE the kill landed (mid-compile
+            # = the tunnel-wedging case) without having to rerun
+            tail = lambda b: (b.decode() if isinstance(b, bytes) else
+                              (b or ""))[-400:]
             log({"key": key, "parsed": None, "rc": "timeout",
                  "seconds": timeout,
+                 "stdout_tail": tail(e.stdout), "stderr_tail": tail(e.stderr),
                  "utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())})
             print(f"[{key}] TIMEOUT after {timeout}s — stopping the batch "
                   "(the tunnel is likely wedged behind the killed compile)")
@@ -143,6 +181,7 @@ def main() -> int:
                 continue
         log({"key": key, "parsed": parsed, "rc": p.returncode,
              "seconds": round(time.time() - t0, 1),
+             "stdout_tail": p.stdout[-600:],
              "stderr_tail": p.stderr[-300:],
              "utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())})
         print(f"[{key}] rc={p.returncode} {json.dumps(parsed)}")
