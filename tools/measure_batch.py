@@ -189,5 +189,63 @@ def main() -> int:
     return 0
 
 
+def _accuracy_artifacts():
+    """The per-seed --out names, derived from ITEMS (single source of
+    truth — adding/renaming a seed item keeps the merge in sync)."""
+    outs = []
+    for key, argv, _t in ITEMS:
+        if key.startswith("accuracy_full_"):
+            outs.append(argv[argv.index("--out") + 1])
+    return outs
+
+
+def _merge_accuracy() -> None:
+    """When every per-seed on-chip artifact exists, synthesize the
+    canonical ACCURACY_FULL.json under the name the acceptance contract
+    keys on — every cross-seed field is RECOMPUTED from the per-seed
+    data (a wholesale copy of seed 0 would present one seed's top-1
+    means as the aggregate)."""
+    outs = _accuracy_artifacts()
+    arts = []
+    for name in outs:
+        f = os.path.join(REPO, name)
+        if not os.path.exists(f):
+            return
+        with open(f) as fh:
+            arts.append(json.load(fh))
+    gaps = [a["gap"] for a in arts]
+    per_seed = {}
+    for a in arts:
+        per_seed.update(a["per_seed"])
+    seeds = sorted(int(s) for s in per_seed)
+    mean = lambda xs: sum(xs) / len(xs)
+    merged = {k: arts[0][k] for k in
+              ("preset", "arch", "steps", "batch_size", "eval_batches",
+               "top1_quantum_pct", "label_noise") if k in arts[0]}
+    if "top1_ceiling" in arts[0]:
+        merged["top1_ceiling"] = arts[0]["top1_ceiling"]
+    merged.update({
+        "seeds": seeds,
+        "top1_fp32": mean([per_seed[str(s)]["O0"]["top1"] for s in seeds]),
+        "top1_o2": mean([per_seed[str(s)]["O2"]["top1"] for s in seeds]),
+        "per_seed": per_seed,
+        "gap": mean(gaps),
+        "gap_per_seed": gaps,
+        "gap_spread": max(gaps) - min(gaps),
+        "merged_from": outs,
+    })
+    out = os.path.join(REPO, "ACCURACY_FULL.json")
+    with open(out, "w") as fh:
+        json.dump(merged, fh, indent=1)
+    print(f"merged per-seed accuracy artifacts -> {out}")
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    try:
+        rc = main()
+    finally:
+        # the merge runs on EVERY exit path: the likeliest real-world run
+        # lands all accuracy seeds and then times out on a long-compile
+        # experiment — the canonical artifact must still appear.
+        _merge_accuracy()
+    raise SystemExit(rc)
