@@ -1,10 +1,12 @@
 """Model zoo (the reference imports these from torchvision/external repos;
 SURVEY.md §3.5 — here they are implemented natively in Flax)."""
 
-from apex_example_tpu.models.gpt import GPTForCausalLM, gpt_base, gpt_tiny
+from apex_example_tpu.models.gpt import (GPTForCausalLM, generate,
+                                         gpt_base, gpt_tiny)
 from apex_example_tpu.models.resnet import (ARCHS, ResNet, resnet18,
                                             resnet34, resnet50, resnet101,
                                             resnet152)
 
-__all__ = ["ARCHS", "GPTForCausalLM", "ResNet", "gpt_base", "gpt_tiny",
+__all__ = ["ARCHS", "GPTForCausalLM", "ResNet", "generate", "gpt_base",
+           "gpt_tiny",
            "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
