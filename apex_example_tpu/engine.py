@@ -323,7 +323,27 @@ def make_sharded_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def _opt_state_specs(optimizer, abs_params, param_specs):
+def _zero_leaf_spec(spec: P, shape, axis_name: str, axis_size: int) -> P:
+    """ZeRO-1 spec upgrade for one optimizer-state leaf: add ``axis_name``
+    (the data axis) on the largest dim that is currently unsharded and
+    divisible by the axis size, keeping whatever model-parallel sharding the
+    param already carries on its other dims.  Leaves with no eligible dim
+    (odd-sized biases) stay on the param's spec — they are the tail of the
+    byte count, and correctness never depends on which leaves shard.
+    """
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    best = -1
+    for d, (e, n) in enumerate(zip(entries, shape)):
+        if e is None and n > 0 and n % axis_size == 0 \
+                and (best < 0 or n > shape[best]):
+            best = d
+    if best < 0:
+        return spec
+    entries[best] = axis_name
+    return P(*entries)
+
+
+def _opt_state_specs(optimizer, abs_params, param_specs, zero_spec_fn=None):
     """PartitionSpec tree for an optimizer state.
 
     The fused-optimizer states (AdamState etc.) are NamedTuples whose fields
@@ -334,6 +354,10 @@ def _opt_state_specs(optimizer, abs_params, param_specs):
     params TREE but holds per-tensor scalars — structure alone would hand
     its scalars the params' (possibly sharded) specs.  Recursion covers
     optax-style nested tuples of such states.
+
+    ``zero_spec_fn(spec, shape) -> spec``, when given, rewrites each
+    params-shaped leaf's spec — the ZeRO-1 hook that shards mu/nu over the
+    data axis while the params themselves stay on their TP specs.
     """
     params_def = jax.tree_util.tree_structure(abs_params)
     param_leaves = jax.tree_util.tree_leaves(abs_params)
@@ -348,7 +372,12 @@ def _opt_state_specs(optimizer, abs_params, param_specs):
 
     def walk(node):
         if params_shaped(node):
-            return param_specs
+            if zero_spec_fn is None:
+                return param_specs
+            return jax.tree_util.tree_map(
+                lambda sp, p: zero_spec_fn(sp, p.shape),
+                param_specs, abs_params,
+                is_leaf=lambda v: isinstance(v, P))
         if isinstance(node, tuple):
             sub = [walk(c) for c in node]
             # NamedTuple ctors take fields positionally; plain tuples take
@@ -365,7 +394,8 @@ def _opt_state_specs(optimizer, abs_params, param_specs):
 
 def gspmd_state_shardings(mesh: Mesh, model, optimizer, sample_batch,
                           policy: Policy, scaler=None,
-                          train_kwargs: Optional[dict] = None) -> TrainState:
+                          train_kwargs: Optional[dict] = None,
+                          zero_axis: Optional[str] = None) -> TrainState:
     """NamedSharding pytree for this model's TrainState under GSPMD.
 
     Param specs come from the flax partitioning metadata the TP layers
@@ -373,6 +403,17 @@ def gspmd_state_shardings(mesh: Mesh, model, optimizer, sample_batch,
     them; step/scaler/batch_stats replicate.  Feed the result to
     jit ``in_shardings``/``out_shardings`` (prefix semantics: a bare P()
     stands for a replicated subtree).
+
+    ``zero_axis``: ZeRO-1 under GSPMD — the *annotate, don't orchestrate*
+    form of the reference's distributed_fused_adam (SURVEY.md §3.4 contrib
+    row, §3.3 weight-update sharding).  Optimizer-state leaves additionally
+    shard over this (data) axis on a free dim while params keep their TP
+    specs: the partitioner then stores mu/nu distributed (1/N bytes per
+    device), slices the Adam update over ``data``, and all-gathers the new
+    params back to their param sharding — reduce-scatter(grads) + sharded
+    update + all-gather(params), derived from the sharding lattice instead
+    of hand-written collectives, and composing with tensor parallelism
+    because ``data`` and ``model`` are independent mesh axes.
     """
     import flax.linen as nn
     from flax.core import meta
@@ -383,9 +424,15 @@ def gspmd_state_shardings(mesh: Mesh, model, optimizer, sample_batch,
     specs = nn.get_partition_spec(abs_vars)
     param_specs = specs["params"]
     abs_params = meta.unbox(abs_vars)["params"]
+    zfn = None
+    if zero_axis is not None:
+        axis_size = mesh.shape[zero_axis]
+        zfn = lambda sp, shape: _zero_leaf_spec(sp, shape, zero_axis,
+                                                axis_size)
     spec_state = TrainState(
         step=P(), params=param_specs, batch_stats=P(),
-        opt_state=_opt_state_specs(optimizer, abs_params, param_specs),
+        opt_state=_opt_state_specs(optimizer, abs_params, param_specs,
+                                   zero_spec_fn=zfn),
         scaler=P())
     to_sharding = lambda s: NamedSharding(mesh, s)
     return jax.tree_util.tree_map(to_sharding, spec_state,
@@ -394,12 +441,15 @@ def gspmd_state_shardings(mesh: Mesh, model, optimizer, sample_batch,
 
 def create_gspmd_train_state(rng, mesh: Mesh, model, optimizer, sample_batch,
                              policy: Policy, scaler=None,
-                             train_kwargs: Optional[dict] = None):
+                             train_kwargs: Optional[dict] = None,
+                             zero_axis: Optional[str] = None):
     """(state, state_shardings): TrainState initialized directly into its
     GSPMD placement — params/optimizer state land sharded (no host-side
-    full materialization beyond tracing)."""
+    full materialization beyond tracing).  ``zero_axis``: see
+    :func:`gspmd_state_shardings` (ZeRO-1 optimizer-state sharding)."""
     shardings = gspmd_state_shardings(mesh, model, optimizer, sample_batch,
-                                      policy, scaler, train_kwargs)
+                                      policy, scaler, train_kwargs,
+                                      zero_axis=zero_axis)
     init = jax.jit(
         lambda r: create_train_state(r, model, optimizer, sample_batch,
                                      policy, scaler, train_kwargs),

@@ -201,4 +201,202 @@ def test_train_py_zero_rejections():
         train_mod.main(["--arch", "bert_tiny", "--zero", "--opt", "lamb"])
     with pytest.raises(SystemExit):
         train_mod.main(["--arch", "bert_tiny", "--zero", "--opt", "adam",
-                        "--tensor-parallel", "2"])
+                        "--grad-accum", "2", "--batch-size", "16"])
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 x tensor parallelism (VERDICT r4 item 2): under GSPMD the ZeRO
+# contract is pure annotation — params keep their 'model'-axis TP specs,
+# optimizer state (mu/nu) additionally shards over 'data'
+# (engine.gspmd_state_shardings zero_axis) — and the partitioner derives
+# reduce-scatter(grads) + data-sliced Adam + all-gather(params) from the
+# sharding lattice, composed with the TP collectives in one jit program.
+# ---------------------------------------------------------------------------
+
+TP, SEQ, BATCH = 4, 16, 8
+
+
+def _mlm(i, vocab):
+    from apex_example_tpu.data import mlm_batch
+    ids, labels, w = mlm_batch(jnp.asarray(i, jnp.int32), batch_size=BATCH,
+                               seq_len=SEQ, vocab_size=vocab,
+                               mask_token_id=vocab - 1, seed=0)
+    return ids, (labels, w)
+
+
+@pytest.fixture()
+def tp_mesh(devices8):
+    from apex_example_tpu.transformer import parallel_state
+    mesh = parallel_state.initialize_model_parallel(tensor_parallel=TP,
+                                                    devices=devices8)
+    yield mesh
+    parallel_state.set_mesh(None)
+
+
+def test_zero_tp_matches_dense_trajectory(tp_mesh):
+    """10 Adam steps of ZeRO-1 x TP BERT on the (data=2, model=4) mesh ==
+    10 single-device dense steps from the same init and batches.  Same
+    tolerance design as test_zero_matches_replicated_adam: Adam near zero
+    grads behaves like sign(g)*lr, so partitioning-order noise can flip
+    individual elements by ~lr/step without the trajectories diverging."""
+    from apex_example_tpu.engine import (create_gspmd_train_state,
+                                         create_train_state as mk_state,
+                                         make_gspmd_train_step,
+                                         make_train_step)
+    from apex_example_tpu.models.bert import bert_tiny
+    from apex_example_tpu.parallel.mesh import DATA_AXIS
+    from apex_example_tpu.workloads import mlm_loss
+
+    steps, lr = 10, 1e-3
+    policy, scaler = amp.initialize("O0")
+    dense = bert_tiny()
+    tp_model = bert_tiny(tensor_parallel=True)
+    V = dense.vocab_size
+    opt = lambda: FusedAdam(lr=lr, weight_decay=1e-2)
+
+    sample = _mlm(0, V)[0][:1]
+    state_d = mk_state(jax.random.PRNGKey(0), dense, opt(), sample, policy,
+                       scaler)
+    step_d = jax.jit(make_train_step(dense, opt(), policy, loss_fn=mlm_loss,
+                                     compute_accuracy=False))
+
+    state_z, shardings = create_gspmd_train_state(
+        jax.random.PRNGKey(0), tp_mesh, tp_model, opt(), sample, policy,
+        scaler, zero_axis=DATA_AXIS)
+    state_z = state_z.replace(
+        params=jax.device_put(state_d.params, shardings.params))
+    step_z = make_gspmd_train_step(tp_mesh, tp_model, opt(), policy,
+                                   shardings, loss_fn=mlm_loss,
+                                   compute_accuracy=False, donate=False)
+
+    for i in range(steps):
+        b = _mlm(i, V)
+        state_d, m_d = step_d(state_d, b)
+        state_z, m_z = step_z(state_z, b)
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_z["loss"]),
+                                   rtol=1e-4)
+
+    diffs = np.concatenate([
+        np.abs(np.asarray(a) - np.asarray(b)).ravel()
+        for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
+                        jax.tree_util.tree_leaves(state_z.params))])
+    assert float((diffs < 5e-3).mean()) > 0.999
+    assert float(diffs.max()) < steps * lr * 3
+
+
+def test_zero_tp_state_shards_both_axes(tp_mesh):
+    """Params provably shard over 'model' AND opt state over 'data': the
+    live buffers carry 1/TP param bytes and 1/(DP*TP) mu/nu bytes per
+    device — the ZeRO-1 memory contract on top of TP's."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_example_tpu.engine import create_gspmd_train_state
+    from apex_example_tpu.models.bert import bert_tiny
+    from apex_example_tpu.parallel.mesh import DATA_AXIS
+
+    dp = 8 // TP
+    policy, scaler = amp.initialize("O0")
+    model = bert_tiny(tensor_parallel=True)
+    sample = _mlm(0, model.vocab_size)[0][:1]
+    state, shardings = create_gspmd_train_state(
+        jax.random.PRNGKey(0), tp_mesh, model, FusedAdam(lr=1e-3), sample,
+        policy, scaler, zero_axis=DATA_AXIS)
+
+    k = state.params["layer_0"]["intermediate"]["kernel"]
+    mu = state.opt_state.mu["layer_0"]["intermediate"]["kernel"]
+    nu = state.opt_state.nu["layer_0"]["intermediate"]["kernel"]
+    # param: TP only (replicated over data — ZeRO-1, not ZeRO-3)
+    assert k.addressable_shards[0].data.shape[1] == k.shape[1] // TP
+    assert k.addressable_shards[0].data.nbytes == k.nbytes // TP
+    # mu/nu: data x model
+    for s in (mu, nu):
+        assert s.addressable_shards[0].data.nbytes == s.nbytes // (dp * TP)
+        assert DATA_AXIS in s.sharding.spec
+    # the sharding spec tree says the same thing statically
+    mu_spec = shardings.opt_state.mu["layer_0"]["intermediate"]["kernel"].spec
+    assert DATA_AXIS in mu_spec and "model" in mu_spec
+    # scalar step stays replicated
+    assert state.opt_state.step.sharding.spec == P()
+
+
+def test_zero_tp_fp16_dynamic_scaling_skips_globally(tp_mesh):
+    """fp16 dynamic scaling under ZeRO-1 x TP: one jit program, so the
+    finite flag is global by construction — a poisoned batch rolls back
+    params AND the data-sharded (mu, nu) everywhere and halves the scale;
+    a clean step then trains."""
+    from apex_example_tpu.engine import (create_gspmd_train_state,
+                                         make_gspmd_train_step)
+    from apex_example_tpu.models.bert import bert_tiny
+    from apex_example_tpu.parallel.mesh import DATA_AXIS
+    from apex_example_tpu.workloads import mlm_loss
+
+    policy, scaler = amp.initialize("O2", loss_scale="dynamic",
+                                    half_dtype=jnp.float16,
+                                    init_scale=2.0 ** 4)
+    model = bert_tiny(tensor_parallel=True, dtype=jnp.float16)
+    V = model.vocab_size
+    opt = FusedAdam(lr=1e-3)
+    sample = _mlm(0, V)[0][:1]
+    state, shardings = create_gspmd_train_state(
+        jax.random.PRNGKey(0), tp_mesh, model, opt, sample, policy, scaler,
+        zero_axis=DATA_AXIS)
+    step = make_gspmd_train_step(tp_mesh, model, opt, policy, shardings,
+                                 loss_fn=mlm_loss, compute_accuracy=False,
+                                 donate=False)
+
+    ids, (labels, w) = _mlm(0, V)
+    w_bad = w.at[0, 0].set(jnp.inf)
+    p_before = jax.tree_util.tree_map(lambda p: np.asarray(p), state.params)
+    o_before = jax.tree_util.tree_map(lambda p: np.asarray(p),
+                                      state.opt_state)
+    state, m = step(state, (ids, (labels, w_bad)))
+    assert float(m["grads_finite"]) == 0.0
+    assert float(state.scaler.scale) == 2.0 ** 3
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(o_before),
+                    jax.tree_util.tree_leaves(state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    state, m = step(state, (ids, (labels, w)))
+    assert float(m["grads_finite"]) == 1.0
+    assert int(state.opt_state.step) == 1
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                        jax.tree_util.tree_leaves(state.params)))
+    assert moved
+
+
+def test_train_py_cli_bert_zero_tensor_parallel(devices8):
+    """The VERDICT contract: --zero --tensor-parallel 2 accepted and trains
+    through the CLI on the (data=4, model=2) CPU mesh."""
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    argv = ["--arch", "bert_tiny", "--zero", "--tensor-parallel", "2",
+            "--batch-size", "16", "--seq-len", "16", "--epochs", "1",
+            "--steps-per-epoch", "3", "--opt", "adam", "--opt-level", "O0",
+            "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_train_py_cli_gpt_zero_tensor_parallel(devices8):
+    """Same cell for the GPT causal-LM family (shared GSPMD path)."""
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    argv = ["--arch", "gpt_tiny", "--zero", "--tensor-parallel", "2",
+            "--batch-size", "16", "--seq-len", "16", "--epochs", "1",
+            "--steps-per-epoch", "3", "--opt", "adam", "--opt-level", "O0",
+            "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)

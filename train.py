@@ -268,14 +268,20 @@ def pick_devices(args):
         else jax.devices()
 
 
-def build_zero_optimizer(args, n_dev):
-    """DistributedFusedAdam for the --zero paths (image and BERT alike)."""
+def build_zero_optimizer(args, n_dev, gspmd=False):
+    """Optimizer for the --zero paths.
+
+    shard_map path (tp == 1): DistributedFusedAdam, the explicit flat-buffer
+    reduce-scatter/all-gather program.  GSPMD path (--tensor-parallel): plain
+    FusedAdam — there the ZeRO-1 contract lives entirely in the opt-state
+    shardings (engine.gspmd_state_shardings zero_axis), not in the optimizer.
+    """
     if args.larc:
         raise SystemExit("--larc does not compose with --zero (the sharded "
                          "optimizer owns its update)")
     if n_dev < 2:
-        raise SystemExit("--zero needs >1 device (state shards over "
-                         "the data axis)")
+        raise SystemExit("--zero needs >1 device on the data axis (state "
+                         "shards over it)")
     if args.opt != "adam":
         raise SystemExit("--zero is wired for --opt adam "
                          "(DistributedFusedAdam)")
@@ -285,6 +291,8 @@ def build_zero_optimizer(args, n_dev):
         raise SystemExit("--zero does not support "
                          "--gradient-predivide-factor (the reduction "
                          "lives inside the sharded optimizer)")
+    if gspmd:
+        return FusedAdam(lr=build_lr(args), weight_decay=args.weight_decay)
     return DistributedFusedAdam(lr=build_lr(args),
                                 weight_decay=args.weight_decay,
                                 world=n_dev)
@@ -640,10 +648,9 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--zero is wired for the image and BERT/GPT "
                              "workloads (transformer_xl's step owns its "
                              "own grad-clip path)")
-        if tp > 1:
-            raise SystemExit("--zero does not compose with "
-                             "--tensor-parallel (state shards over data; "
-                             "TP shards params over model)")
+        # tp > 1 composes: ZeRO-1 under GSPMD shards optimizer state over
+        # 'data' while params keep their 'model'-axis TP specs (both are
+        # partitioner-visible mesh axes — engine.gspmd_state_shardings).
     if tp > 1:
         # (pure TP and the TP×PP composition alike)
         if args.sequence_parallel and not (is_bert or is_gpt):
@@ -716,8 +723,10 @@ def _lm_main_impl(args, policy, scaler):
     elif tp > 1:
         mkw["tensor_parallel"] = True
     model = builder(**mkw)
-    optimizer = build_zero_optimizer(args, n_dev) if args.zero \
-        else build_optimizer(args)
+    # Under TP the data axis only gets n_dev/tp devices — that is the axis
+    # ZeRO shards over, so it is the size the >=2 check applies to.
+    optimizer = build_zero_optimizer(args, n_dev // tp, gspmd=tp > 1) \
+        if args.zero else build_optimizer(args)
 
     V = model.vocab_size
     if is_bert:
@@ -821,9 +830,11 @@ def _lm_main_impl(args, policy, scaler):
         ops_config.set_force_xla(True)
         mesh = parallel_state.initialize_model_parallel(
             tensor_parallel=tp, devices=devices)
+        from apex_example_tpu.parallel.mesh import DATA_AXIS as _DATA
         state, shardings = create_gspmd_train_state(
             jax.random.PRNGKey(args.seed), mesh, model, optimizer,
-            sample[:1], policy, scaler)
+            sample[:1], policy, scaler,
+            zero_axis=_DATA if args.zero else None)
         if is_bert or is_gpt:
             step_fn = make_gspmd_train_step(mesh, model, optimizer, policy,
                                             shardings,
@@ -838,7 +849,9 @@ def _lm_main_impl(args, policy, scaler):
                 max_grad_norm=args.max_grad_norm,
                 grad_accum=args.grad_accum)
             mems = model.init_mems(args.batch_size)
-        print(f"TP over {tp} devices, DP over {n_dev // tp}: {mesh}")
+        print(f"TP over {tp} devices, DP over {n_dev // tp}"
+              + (", ZeRO-1 opt-state over data" if args.zero else "")
+              + f": {mesh}")
     elif cp > 1:
         # Ring context parallelism: init via the twin WITHOUT
         # context_parallel (identical param tree; the CP module's
