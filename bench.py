@@ -37,17 +37,29 @@ import time
 import jax
 import jax.numpy as jnp
 
+from apex_example_tpu.utils.flops import (model_train_flops_per_token,
+                                          mfu_pct,
+                                          resnet_train_flops_per_image)
+
 BASELINE_IMG_PER_SEC_PER_CHIP = 4000.0
 
 
-def _emit(metric: str, value: float, unit: str, vs_baseline):
-    print(json.dumps({
+def _emit(metric: str, value: float, unit: str, vs_baseline,
+          flops_per_item: float = None):
+    """One JSON line.  ``flops_per_item`` (analytic model FLOPs per image/
+    token, utils/flops.py) adds ``mfu_pct`` — the fraction of the v5e bf16
+    peak this throughput represents.  MFU counts MODEL FLOPs by convention:
+    rematerialization recompute does not inflate it."""
+    rec = {
         "metric": metric,
         "value": round(value, 1),
         "unit": unit,
         "vs_baseline": (round(vs_baseline, 4)
                         if vs_baseline is not None else None),
-    }))
+    }
+    if flops_per_item is not None:
+        rec["mfu_pct"] = round(mfu_pct(value, flops_per_item), 2)
+    print(json.dumps(rec))
 
 
 def chain_rate(step, state, batch, steps: int, items_per_step: int,
@@ -118,7 +130,9 @@ def bench_image_single(args, *, arch: str, opt_level: str, image_size: int,
     rate = chain_rate(step, state, batch, args.steps, args.batch_size,
                       lambda m: float(m["loss"]))
     _emit(metric, rate, "images/sec/chip",
-          rate / BASELINE_IMG_PER_SEC_PER_CHIP if vs_target else None)
+          rate / BASELINE_IMG_PER_SEC_PER_CHIP if vs_target else None,
+          flops_per_item=resnet_train_flops_per_image(
+              arch, image_size, num_classes))
 
 
 def bench_c3(args):
@@ -145,7 +159,9 @@ def bench_c3(args):
                       lambda m: float(m["loss"]))
     _emit(f"resnet50_ddp_syncbn_{n}dev_ampO2_images_per_sec_per_chip",
           rate / n, "images/sec/chip",
-          rate / n / BASELINE_IMG_PER_SEC_PER_CHIP)
+          rate / n / BASELINE_IMG_PER_SEC_PER_CHIP,
+          flops_per_item=resnet_train_flops_per_image(
+              "resnet50", args.image_size, 1000))
 
 
 def bench_c4(args):
@@ -183,7 +199,8 @@ def bench_c4(args):
     rate = chain_rate(step, state, batch, args.steps, bs * seq,
                       lambda m: float(m["loss"]))
     _emit("bert_base_mlm_fusedlamb_ampO2_tokens_per_sec_per_chip",
-          rate, "tokens/sec/chip", None)
+          rate, "tokens/sec/chip", None,
+          flops_per_item=model_train_flops_per_token(model, seq))
 
 
 def bench_gpt(args):
@@ -224,7 +241,8 @@ def bench_gpt(args):
     rate = chain_rate(step, state, batch, args.steps, bs * seq,
                       lambda m: float(m["loss"]))
     _emit("gpt_base_causal_lm_fusedadam_ampO2_tokens_per_sec_per_chip",
-          rate, "tokens/sec/chip", None)
+          rate, "tokens/sec/chip", None,
+          flops_per_item=model_train_flops_per_token(model, seq))
 
 
 def bench_c5(args):
@@ -266,7 +284,8 @@ def bench_c5(args):
     rate = chain_rate(step, carry, batch, args.steps, bs * seq,
                       lambda m: float(m["loss"]))
     _emit("transformer_xl_fusedln_clip_tokens_per_sec_per_chip",
-          rate, "tokens/sec/chip", None)
+          rate, "tokens/sec/chip", None,
+          flops_per_item=model_train_flops_per_token(model, seq))
 
 
 def bench_hostpipe(args):
@@ -323,7 +342,9 @@ def bench_hostpipe(args):
           f"host-fed {host_rate:.1f} img/s "
           f"({host_rate / on_device:.2%})", file=sys.stderr)
     _emit("resnet50_ampO2_hostpipe_images_per_sec_per_chip", host_rate,
-          "images/sec/chip", host_rate / BASELINE_IMG_PER_SEC_PER_CHIP)
+          "images/sec/chip", host_rate / BASELINE_IMG_PER_SEC_PER_CHIP,
+          flops_per_item=resnet_train_flops_per_image(
+              "resnet50", args.image_size, 1000))
 
 
 def _tunnel_watchdog(timeout_s: float = 600.0):
