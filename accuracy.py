@@ -103,11 +103,14 @@ def main(argv=None):
                     help='comma seed list, e.g. "0,1,2" — runs every opt '
                          "level per seed and reports the gap mean ± spread "
                          "(overrides --seed)")
-    ap.add_argument("--label-noise", type=float, default=0.0,
+    ap.add_argument("--label-noise", type=float, default=None,
                     help="flip labels to a uniform class with this "
                          "probability: caps best top-1 at (1-p)+p/C so the "
                          "task cannot saturate and the fp32-vs-amp gap is "
-                         "measured mid-range")
+                         "measured mid-range.  Default 0.3 (the noiseless "
+                         "round-1/2 design saturated at 100/100 and "
+                         "resolved nothing — see superseded/); pass 0 "
+                         "explicitly for the saturating variant")
     ap.add_argument("--opt-levels", default="O0,O2")
     ap.add_argument("--platform", default="",
                     help="force a jax platform (e.g. 'cpu') before first "
@@ -125,9 +128,14 @@ def main(argv=None):
         defaults = dict(steps=300, batch_size=128, eval_batches=8, lr=0.1,
                         warmup=20)
     else:
+        # eval 32×256 = 8192 examples => top-1 quantum 0.0122% — far under
+        # the 0.1% acceptance bar (VERDICT r3: a quantum EQUAL to the bar
+        # proves nothing).
         arch, spec = "resnet50", IMAGENET
-        defaults = dict(steps=1500, batch_size=256, eval_batches=16, lr=0.2,
+        defaults = dict(steps=1500, batch_size=256, eval_batches=32, lr=0.2,
                         warmup=100)
+    if args.label_noise is None:
+        args.label_noise = 0.3
     steps = args.steps if args.steps is not None else defaults["steps"]
     bs = args.batch_size if args.batch_size is not None \
         else defaults["batch_size"]

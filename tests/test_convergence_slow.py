@@ -41,8 +41,8 @@ def test_full_convergence_matrix(devices8):
 
     # O0 vs O2 top-1 band, per device count: short runs are noisier than
     # the converged <0.1% contract — the band here is the integration-tier
-    # check (full-convergence evidence lives in ACCURACY_CI_NOISE.json /
-    # ACCURACY_FULL_r2.json).
+    # check (full-convergence evidence lives in ACCURACY_CI_NOISE.json,
+    # and on-chip in ACCURACY_FULL.json when the tunnel allows it).
     for n in (1, 8):
         gap = cells[("O0", n)]["top1"] - cells[("O2", n)]["top1"]
         assert abs(gap) < 5.0, (n, gap, cells)
