@@ -123,11 +123,14 @@ class BertSelfAttention(nn.Module):
         d = self.hidden_size
         h = self.num_heads
         hd = d // h
-        if self.decode and (self.tensor_parallel or self.context_parallel
+        if self.decode and (self.context_parallel or self.sequence_parallel
                             or mask_bias is not None or not self.causal):
             raise ValueError(
-                "decode (KV-cache) is the single-device causal inference "
-                "path: no TP/CP/mask composition")
+                "decode (KV-cache) is the causal inference path: no "
+                "CP/SP/mask composition (tensor_parallel composes: the "
+                "cache shards over heads like training attention; SP's "
+                "sequence-dim constraints cannot partition a length-1 "
+                "decode step)")
         use_kernel = (not self.decode) and _resolve_fused_attention(
             self.fused_attention, x.shape[1], self.softmax_dtype)
         if self.tensor_parallel:
@@ -181,7 +184,11 @@ class BertSelfAttention(nn.Module):
                 ci.value = idx + 1
                 # keys beyond the running index are unwritten cache slots
                 live = jnp.arange(ck.value.shape[1]) <= idx
-                ctx = _softmax_attention(q, ck.value, cv.value,
+                # head_spec: under TP the cache shards over heads ('model')
+                # exactly like training attention — the constraint keeps
+                # GSPMD from gathering the [B, max_len, h, hd] cache.
+                ctx = _softmax_attention(q, head_spec(ck.value),
+                                         head_spec(cv.value),
                                          self.softmax_dtype, self.dtype,
                                          bool_mask=live[None, None, None])
                 return dense_out(ctx.reshape(*x.shape[:-1], d))

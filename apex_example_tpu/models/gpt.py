@@ -90,10 +90,15 @@ class GPTForCausalLM(nn.Module):
                                 dtype=self.dtype,
                                 param_dtype=self.param_dtype,
                                 name="word_embeddings")
-        if self.decode and (self.moe_experts or self.tensor_parallel
-                            or self.context_parallel):
-            raise ValueError("decode (KV-cache) is the single-device "
-                             "inference path: no TP/CP/MoE composition")
+        if self.decode and (self.moe_experts or self.context_parallel
+                            or self.sequence_parallel):
+            # SP shards activations along the sequence dim, which is 1 in
+            # per-token decode — its scatter/gather constraints cannot
+            # partition it; rejecting here beats an opaque GSPMD
+            # divisibility error deep in the trace.
+            raise ValueError("decode (KV-cache) is the dense/TP inference "
+                             "path: no CP/MoE/sequence-parallel "
+                             "composition")
         x = word_emb(input_ids)
         pos = jnp.arange(L)[None, :]
         if self.decode:
@@ -195,6 +200,13 @@ def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
 
     Beyond-reference: the reference family is training-only; this makes
     the GPT family usable end-to-end (models/gpt.py docstring).
+
+    Composes with tensor parallelism: for a ``tensor_parallel=True`` model
+    under a registered ``parallel_state`` mesh, the per-layer KV caches
+    shard over heads on the ``model`` axis exactly like training attention
+    (pass TP-sharded ``params``; the constraint points in the layers do the
+    rest).  The XLA reference ops are pinned for the trace — pallas custom
+    calls are opaque to the SPMD partitioner (same as train.py's TP path).
     """
     B, P = prompt.shape
     if not 0 < P < max_len:
@@ -219,6 +231,10 @@ def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
     if rng is None:
         rng = jax.random.PRNGKey(0)          # carried but unused (greedy)
     run = _decode_loop(dec, max_len, float(temperature))
+    if model.tensor_parallel:
+        from apex_example_tpu.ops import _config as ops_config
+        with ops_config.force_xla():
+            return run(params, tokens, cache, rng, jnp.asarray(P, jnp.int32))
     return run(params, tokens, cache, rng, jnp.asarray(P, jnp.int32))
 
 

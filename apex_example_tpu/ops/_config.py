@@ -1,5 +1,7 @@
 """Kernel-dispatch configuration shared by all ops."""
 
+import contextlib
+
 import jax
 
 INTERPRET = False  # run Pallas kernels in interpreter mode (CPU tests)
@@ -17,6 +19,27 @@ FORCE_XLA = False
 def set_force_xla(value: bool) -> None:
     global FORCE_XLA
     FORCE_XLA = bool(value)
+
+
+def get_force_xla() -> bool:
+    return FORCE_XLA
+
+
+@contextlib.contextmanager
+def force_xla(value: bool = True):
+    """Scoped FORCE_XLA pin, restoring the prior value on exit.
+
+    The flag is process-global and read at TRACE time: anything else that
+    first-traces inside the pinned window (another thread, an interleaved
+    jit) compiles with this dispatch and caches it — the same caveat as
+    train.py's run-long set_force_xla(True), scoped smaller here."""
+    global FORCE_XLA
+    prev = FORCE_XLA
+    FORCE_XLA = bool(value)
+    try:
+        yield
+    finally:
+        FORCE_XLA = prev
 
 
 def interpret() -> bool:

@@ -363,3 +363,85 @@ def test_train_py_gpt_rejections():
                         "--pipeline-parallel", "2", "--batch-size", "16",
                         "--seq-len", "16", "--epochs", "1",
                         "--steps-per-epoch", "1"])
+
+
+def test_generate_tp_matches_dense(devices8):
+    """TP-composed generation (VERDICT r4 item 7): greedy decode of the
+    tensor_parallel model on a (data=2, model=4) mesh — KV caches sharded
+    over heads on the 'model' axis via the layers' constraint points —
+    must produce exactly the dense single-device generate's tokens (greedy
+    argmax is invariant to the TP reduction order at these magnitudes; any
+    mismatch is a sharding/cache bug)."""
+    from apex_example_tpu.models.gpt import generate
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    from apex_example_tpu.transformer.tensor_parallel.layers import (
+        param_partition_specs)
+    from flax.core import meta
+    from jax.sharding import NamedSharding
+
+    mesh = parallel_state.initialize_model_parallel(tensor_parallel=4,
+                                                    devices=devices8)
+    try:
+        dense = gpt_tiny()
+        tp_model = gpt_tiny(tensor_parallel=True)
+        V = dense.vocab_size
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(0, V, (2, 3)), jnp.int32)
+        params = dense.init(jax.random.PRNGKey(1), prompt)["params"]
+        ref = generate(dense, params, prompt, max_len=10)
+
+        # Same param tree; placed per the TP layers' partition metadata.
+        abs_vars = jax.eval_shape(
+            lambda r: tp_model.init(r, prompt), jax.random.PRNGKey(1))
+        specs = param_partition_specs(abs_vars)["params"]
+        tp_params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params, specs, is_leaf=lambda v: not isinstance(v, dict))
+        out = generate(tp_model, tp_params, prompt, max_len=10)
+        np.testing.assert_array_equal(np.array(out), np.array(ref))
+        # a head-sharded param really is distributed under the mesh
+        q = tp_params["layer_0"]["attention"]["query"]["kernel"]
+        assert q.addressable_shards[0].data.shape[1] == q.shape[1] // 4
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_generate_tp_sampling_runs(devices8):
+    """Sampled TP decode: same rng => same tokens, prompt preserved."""
+    from apex_example_tpu.models.gpt import generate
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+
+    mesh = parallel_state.initialize_model_parallel(tensor_parallel=4,
+                                                    devices=devices8)
+    try:
+        model = gpt_tiny(tensor_parallel=True)
+        V = model.vocab_size
+        prompt = jnp.asarray(
+            np.random.RandomState(5).randint(0, V, (2, 3)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        s1 = generate(model, params, prompt, max_len=8, temperature=0.7,
+                      rng=jax.random.PRNGKey(11))
+        s2 = generate(model, params, prompt, max_len=8, temperature=0.7,
+                      rng=jax.random.PRNGKey(11))
+        np.testing.assert_array_equal(np.array(s1), np.array(s2))
+        assert (np.array(s1)[:, :3] == np.array(prompt)).all()
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_generate_rejects_sp_moe_cp():
+    """decode guards: SP (length-1 sequence cannot partition), MoE, CP all
+    rejected with a clean ValueError, not a deep GSPMD trace error."""
+    from apex_example_tpu.models.gpt import generate
+    V = 256
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    for kw in ({"tensor_parallel": True, "sequence_parallel": True},
+               {"moe_experts": 4},
+               {"context_parallel": True}):
+        model = gpt_tiny(**kw)
+        with pytest.raises(ValueError, match="decode"):
+            generate(model, {}, prompt, max_len=6)
