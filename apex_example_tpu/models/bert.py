@@ -381,15 +381,16 @@ class BertForMaskedLM(nn.Module):
     def __call__(self, input_ids, attention_mask: Optional[jnp.ndarray] = None,
                  train: bool = True):
         del train  # no dropout in the pretraining benchmark path
-        if self.moe_experts and (self.sequence_parallel
-                                 or self.context_parallel):
-            # The MoE all_to_all dispatch assumes every local token routes
-            # over the full expert set; SP/CP re-shard the sequence dim the
-            # dispatch indexes.  (TP composes: the FFN is the expert block
-            # and the Megatron sharding applies to attention/embeddings/
-            # head on the automatic model axis.)
+        if self.moe_experts and self.sequence_parallel:
+            # SP re-shards the sequence dim the dispatch indexes.  (TP
+            # composes: the FFN is the expert block and the Megatron
+            # sharding applies to attention/embeddings/head on the
+            # automatic model axis.  CP composes: every local token still
+            # routes over the full expert set via the all_to_all on
+            # 'data', independent of the KV ring on 'context' — per-shard
+            # routing/capacity, the pure-EP per-device contract.)
             raise ValueError("moe_experts does not compose with "
-                             "sequence/context parallelism yet")
+                             "sequence parallelism yet")
         if self.sequence_parallel and self.context_parallel:
             raise ValueError("sequence_parallel shards activations along "
                              "the sequence dim the context axis already "

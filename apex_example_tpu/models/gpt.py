@@ -67,12 +67,14 @@ class GPTForCausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
         del train  # no dropout in the pretraining benchmark path
-        if self.moe_experts and (self.sequence_parallel
-                                 or self.context_parallel):
+        if self.moe_experts and self.sequence_parallel:
             # (TP composes: the expert block replaces the FFN; Megatron
-            # sharding applies to attention/embeddings/head)
+            # sharding applies to attention/embeddings/head.  CP composes
+            # too: the expert all_to_all over 'data' and the KV ring over
+            # 'context' are independent collectives — routing/capacity are
+            # per-(data, context) shard, the pure-EP per-device contract.)
             raise ValueError("moe_experts does not compose with "
-                             "sequence/context parallelism yet")
+                             "sequence parallelism yet")
         if self.sequence_parallel and self.context_parallel:
             raise ValueError("sequence_parallel shards activations along "
                              "the sequence dim the context axis already "

@@ -526,6 +526,36 @@ def _moe_axis_names(mesh: Mesh, model) -> dict:
                                      "MoE x TP")
 
 
+def _moe_cp_axis_names(mesh: Mesh, model) -> dict:
+    """EP x CP: manual over 'data' (expert all_to_all) AND 'context' (KV
+    ring) jointly; 'model' would stay automatic but the TP triple
+    composition is not wired (train.py rejects it)."""
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+    return partial_manual_axis_names(
+        mesh, model, frozenset({DATA_AXIS, CONTEXT_AXIS}), "MoE x CP x TP")
+
+
+def _moe_batch_plumbing(mesh: Mesh, model, objective: str,
+                        context_parallel: bool, mode: str):
+    """The EP / EP x CP spec-and-layout epilogue the MoE train AND eval
+    factories share: (per-item batch spec, shard_map manual-axes kwargs,
+    layout wrapper).  One home so the mode validation and the zigzag
+    pre-pass can never drift between the two paths."""
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+    if not context_parallel:
+        return P(DATA_AXIS), _moe_axis_names(mesh, model), lambda fn: fn
+    if objective == "mlm" and mode == "zigzag":
+        # (the model layer rejects zigzag for non-causal attention anyway;
+        # this keeps the error at the factory boundary)
+        raise ValueError("zigzag is the load-balanced CAUSAL layout; "
+                         "MLM BERT uses ring or ulysses")
+    # ring/ulysses need no reorder, so for MLM the wrap is just the
+    # mode<->model.cp_mode agreement check; the zigzag pre-pass only
+    # ever fires on the (x, y) LM pair shape.
+    return (P(DATA_AXIS, CONTEXT_AXIS), _moe_cp_axis_names(mesh, model),
+            lambda fn: _cp_layout_wrap(fn, mesh, model, mode))
+
+
 def _check_moe_model(mesh: Mesh, model, optimizer=None):
     E = mesh.shape[DATA_AXIS]
     if not model.moe_experts:
@@ -559,7 +589,9 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                              aux_weight: float = 1e-2,
                              donate: bool = True, grad_accum: int = 1,
                              objective: str = "mlm",
-                             state_shardings=None):
+                             state_shardings=None,
+                             context_parallel: bool = False,
+                             mode: str = "ring"):
     """Expert-parallel BERT MLM step over the 'data' axis (train.py
     --moe-experts).
 
@@ -573,24 +605,41 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     (engine.make_train_step(finite_reduce_axes=...)): a local overflow in
     one expert's grads must skip the step and halve the scale on EVERY
     shard or the replicated scaler state diverges.
+
+    ``context_parallel``: the EP x CP composition (train.py --moe-experts
+    --context-parallel, the modern long-context-MoE stack): the batch
+    additionally shards sequence-over-'context', attention rides the
+    causal/ring KV programs on that axis, and the MoE all_to_all over
+    'data' runs independently per context column — two manual axes, two
+    independent collectives in one body.  Routing/capacity stay
+    per-(data, context)-shard (the same per-device contract the pure EP
+    path pins); the aux loss is additionally pmean-ed over 'context' so
+    the objective (and the metrics' mesh-invariance) see the mean expert
+    balance across sequence shards.  ``mode`` selects the CP attention
+    program (ring/zigzag/ulysses; must match the model's cp_mode).
     """
     from apex_example_tpu.engine import make_train_step
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
     _check_moe_model(mesh, model, optimizer)
     if objective not in ("mlm", "lm"):
         raise ValueError(f"objective must be 'mlm' or 'lm', "
                          f"got {objective!r}")
+    loss_axes = (DATA_AXIS, CONTEXT_AXIS) if context_parallel else DATA_AXIS
 
     def moe_loss(out, target):
         logits, aux = out
+        if context_parallel:
+            # per-context-column aux (moe_forward pmean-ed 'data' only)
+            aux = jax.lax.pmean(aux, CONTEXT_AXIS)
         if objective == "mlm":
             labels, weights = target
             ce = softmax_cross_entropy(logits, labels)
-            num = jax.lax.psum((ce * weights).sum(), DATA_AXIS)
-            den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
+            num = jax.lax.psum((ce * weights).sum(), loss_axes)
+            den = jnp.maximum(jax.lax.psum(weights.sum(), loss_axes), 1.0)
             return (num / den
                     + jnp.asarray(aux_weight, jnp.float32) * aux)
         # next-token CE (MoE GPT)
-        return (_global_lm_loss(logits, target, DATA_AXIS)
+        return (_global_lm_loss(logits, target, loss_axes)
                 + jnp.asarray(aux_weight, jnp.float32) * aux)
 
     per_shard = make_train_step(model, optimizer, policy, axis_name=None,
@@ -602,12 +651,12 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     # replicated split); shapes/values are irrelevant, so the pre-
     # device_put host state works fine.
     spec_state = bert_moe_state_specs(state_template, optimizer)
-    b = P(DATA_AXIS)
+    b, manual, wrap = _moe_batch_plumbing(mesh, model, objective,
+                                          context_parallel, mode)
     batch_spec = (b, (b, b)) if objective == "mlm" else (b, b)
-    sharded = _shard_map(per_shard, mesh=mesh,
-                         in_specs=(spec_state, batch_spec),
-                         out_specs=(spec_state, P()),
-                         **_moe_axis_names(mesh, model))
+    sharded = wrap(_shard_map(per_shard, mesh=mesh,
+                              in_specs=(spec_state, batch_spec),
+                              out_specs=(spec_state, P()), **manual))
     jkw = {}
     if state_shardings is not None:
         # MoE x TP: pin the returned state to its combined placement
@@ -620,36 +669,43 @@ def make_bert_moe_train_step(mesh: Mesh, model, optimizer, policy: Policy,
 
 
 def make_bert_moe_eval_step(mesh: Mesh, model, params_template,
-                            objective: str = "mlm"):
+                            objective: str = "mlm",
+                            context_parallel: bool = False,
+                            mode: str = "ring"):
     """Expert-parallel held-out eval: same mesh, same all_to_all dispatch,
     metrics psum-normalized globally (mirrors make_bert_cp_eval_step's
     contract; --moe-experts --eval).  objective='lm' evaluates next-token
-    CE for MoE GPT ({loss} only — the harness reports ppl)."""
+    CE for MoE GPT ({loss} only — the harness reports ppl).
+    ``context_parallel``: sequence-sharded EP x CP eval under the same KV
+    ring + per-column expert dispatch as training."""
+    from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
     _check_moe_model(mesh, model)
     if objective not in ("mlm", "lm"):
         raise ValueError(f"objective must be 'mlm' or 'lm', "
                          f"got {objective!r}")
+    axes = (DATA_AXIS, CONTEXT_AXIS) if context_parallel else DATA_AXIS
 
     def per_shard(params, batch):
         if objective == "mlm":
             ids, (labels, weights) = batch
             logits, _aux = model.apply({"params": params}, ids, train=False)
             ce = softmax_cross_entropy(logits, labels)
-            den = jnp.maximum(jax.lax.psum(weights.sum(), DATA_AXIS), 1.0)
+            den = jnp.maximum(jax.lax.psum(weights.sum(), axes), 1.0)
             hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
             return {"loss":
-                    jax.lax.psum((ce * weights).sum(), DATA_AXIS) / den,
+                    jax.lax.psum((ce * weights).sum(), axes) / den,
                     "masked_acc":
-                    jax.lax.psum((hit * weights).sum(), DATA_AXIS)
+                    jax.lax.psum((hit * weights).sum(), axes)
                     / den * 100.0}
         x, y = batch
         logits, _aux = model.apply({"params": params}, x, train=False)
-        return {"loss": _global_lm_loss(logits, y, DATA_AXIS)}
+        return {"loss": _global_lm_loss(logits, y, axes)}
 
-    b = P(DATA_AXIS)
+    b, manual, wrap = _moe_batch_plumbing(mesh, model, objective,
+                                          context_parallel, mode)
     batch_spec = (b, (b, b)) if objective == "mlm" else (b, b)
-    sharded = _shard_map(per_shard, mesh=mesh,
-                         in_specs=(_moe_param_spec_tree(params_template),
-                                   batch_spec),
-                         out_specs=P(), **_moe_axis_names(mesh, model))
+    sharded = wrap(_shard_map(per_shard, mesh=mesh,
+                              in_specs=(_moe_param_spec_tree(
+                                  params_template), batch_spec),
+                              out_specs=P(), **manual))
     return jax.jit(sharded)

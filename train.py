@@ -545,13 +545,19 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--moe-experts is wired for the BERT/GPT "
                              "archs (switch-MoE replaces the "
                              "transformer FFN)")
-        if pp > 1 or cp > 1 or args.sequence_parallel or args.zero:
+        if pp > 1 or args.sequence_parallel or args.zero:
             raise SystemExit("--moe-experts does not compose with "
-                             "--sequence/pipeline/context-parallel or "
+                             "--sequence/pipeline-parallel or "
                              "--zero yet (the all_to_all dispatch assumes "
                              "every local token routes over the full "
                              "expert set on the data axis); "
-                             "--tensor-parallel composes")
+                             "--tensor-parallel and --context-parallel "
+                             "compose")
+        if cp > 1 and tp > 1:
+            raise SystemExit("--moe-experts --context-parallel "
+                             "--tensor-parallel (the EP x CP x TP triple) "
+                             "is not wired yet; drop one of the three "
+                             "(EP x CP and EP x TP both compose pairwise)")
         if args.opt in ("lamb", "novograd") or args.larc:
             raise SystemExit("--opt lamb/novograd and --larc compute "
                              "per-tensor statistics that collapse on the "
@@ -872,7 +878,32 @@ def _lm_main_impl(args, policy, scaler):
         model_cp = builder(**mkw, context_parallel=True,
                            cp_mode=args.cp_mode)
         cp_shardings = None
-        if tp > 1:
+        if args.moe_experts:
+            # EP x CP (the long-context MoE stack): experts over 'data',
+            # KV ring over 'context' — two manual axes, two independent
+            # collectives in one step (workloads.make_bert_moe_train_step
+            # context_parallel=True).  Init runs the dense twin (full
+            # [E, ...] stacks); device_put shards experts one-per-
+            # data-device, everything else replicated over both axes.
+            from apex_example_tpu.workloads import (
+                bert_moe_state_shardings, make_bert_moe_train_step)
+            ep = n_dev // cp
+            if args.moe_experts % ep:
+                raise SystemExit(f"--moe-experts {args.moe_experts} must "
+                                 f"be a multiple of the data-axis size "
+                                 f"{ep} (= devices / --context-parallel)")
+            state = create_train_state(jax.random.PRNGKey(args.seed),
+                                       model, optimizer, sample[:1],
+                                       policy, scaler)
+            state = jax.device_put(
+                state, bert_moe_state_shardings(mesh, state, optimizer))
+            step_fn = make_bert_moe_train_step(
+                mesh, model_cp, optimizer, policy, state_template=state,
+                aux_weight=args.moe_aux_weight,
+                grad_accum=args.grad_accum,
+                objective="mlm" if is_bert else "lm",
+                context_parallel=True, mode=args.cp_mode)
+        elif tp > 1:
             from apex_example_tpu.engine import create_gspmd_train_state
             state, cp_shardings = create_gspmd_train_state(
                 jax.random.PRNGKey(args.seed), mesh, model, optimizer,
@@ -880,7 +911,9 @@ def _lm_main_impl(args, policy, scaler):
         else:
             state = create_train_state(jax.random.PRNGKey(args.seed), model,
                                        optimizer, sample[:1], policy, scaler)
-        if is_gpt:
+        if args.moe_experts:
+            pass                                   # step_fn built above
+        elif is_gpt:
             step_fn = make_gpt_cp_train_step(mesh, model_cp, optimizer,
                                              policy,
                                              grad_accum=args.grad_accum,
@@ -894,7 +927,10 @@ def _lm_main_impl(args, policy, scaler):
         mems = None
         print(f"CP over {cp} sequence shards (local seq "
               f"{args.seq_len // cp}), TP over {tp}, DP over "
-              f"{n_dev // (cp * tp)}: {mesh}")
+              f"{n_dev // (cp * tp)}"
+              + (f", MoE over {args.moe_experts} experts"
+                 if args.moe_experts else "")
+              + f": {mesh}")
     elif args.moe_experts:
         # Expert parallelism: one switch expert per device over the 'data'
         # axis (workloads.make_bert_moe_train_step).  Init runs the dense-
@@ -1000,7 +1036,16 @@ def _lm_main_impl(args, policy, scaler):
                                                 make_gpt_eval_step,
                                                 make_txl_eval_step)
         if is_bert or is_gpt:
-            if cp > 1:
+            if cp > 1 and args.moe_experts:
+                # EP x CP eval: same KV ring + per-column expert dispatch
+                # as training.
+                from apex_example_tpu.workloads import (
+                    make_bert_moe_eval_step)
+                eval_fn = make_bert_moe_eval_step(
+                    mesh, model_cp, state.params,
+                    objective="mlm" if is_bert else "lm",
+                    context_parallel=True, mode=args.cp_mode)
+            elif cp > 1:
                 # Sequence-sharded eval under the same KV ring as training
                 # — held-out loss AT the training context length (a dense
                 # eval forward would materialize the (L, L) scores CP
