@@ -89,16 +89,16 @@ def test_cp_ulysses_train_matches_dense(devices8):
                                  sample, policy, scaler)
     step_c = make_bert_cp_train_step(mesh, cp_model, opt(), policy,
                                      donate=False)
-    for i in range(10):
+    for i in range(30):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
         state_c, m_c = step_c(state_c, b)
         np.testing.assert_allclose(float(m_d["loss"]), float(m_c["loss"]),
-                                   rtol=3e-5)
+                                   rtol=3e-5 * (1 + i / 3))
     for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
                     jax.tree_util.tree_leaves(state_c.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-4, atol=1e-5)
+                                   rtol=1e-3, atol=3e-5)
 
 
 def test_cp_eval_matches_dense(devices8):
@@ -223,16 +223,16 @@ def test_cp_tp_train_matches_dense(devices8):
         state_c = jax.device_put(state_c, sh)
         step_c = make_bert_cp_train_step(mesh, cp_tp_model, opt(), policy,
                                          donate=False, state_shardings=sh)
-        for i in range(10):
+        for i in range(30):
             b = _batch(i, V)
             state_d, m_d = step_d(state_d, b)
             state_c, m_c = step_c(state_c, b)
             np.testing.assert_allclose(float(m_d["loss"]),
-                                       float(m_c["loss"]), rtol=3e-5)
+                                       float(m_c["loss"]), rtol=3e-5 * (1 + i / 3))
         for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
                         jax.tree_util.tree_leaves(state_c.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=1e-4, atol=1e-5)
+                                       rtol=1e-3, atol=3e-5)
         qk = state_c.params["layer_0"]["attention"]["query"]["kernel"]
         assert qk.addressable_shards[0].data.shape == (64, 32), \
             "query kernel lost its model-axis sharding"

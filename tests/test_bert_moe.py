@@ -105,17 +105,17 @@ def test_moe_train_matches_blocked_dense_golden(devices8, n_experts):
                                       state_template=state_e,
                                       aux_weight=AUX_W, donate=False)
 
-    for i in range(10):
+    for i in range(30):
         batch = _batch(i, V)
         state_g, loss_g = golden(state_g, batch)
         state_e, m_e = step_e(state_e, batch)
         np.testing.assert_allclose(float(loss_g), float(m_e["loss"]),
-                                   rtol=2e-5)
+                                   rtol=2e-5 * (1 + i / 3))
     for (ka, a), (kb, b2) in zip(
             jax.tree_util.tree_leaves_with_path(state_g.params),
             jax.tree_util.tree_leaves_with_path(state_e.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
-                                   rtol=2e-4, atol=1e-6, err_msg=str(ka))
+                                   rtol=1e-3, atol=1e-5, err_msg=str(ka))
 
 
 def test_moe_tp_train_matches_blocked_dense_golden(devices8):
@@ -150,12 +150,12 @@ def test_moe_tp_train_matches_blocked_dense_golden(devices8):
                                           state_template=state_e,
                                           aux_weight=AUX_W, donate=False,
                                           state_shardings=sh)
-        for i in range(10):
+        for i in range(30):
             batch = _batch(i, V)
             state_g, loss_g = golden(state_g, batch)
             state_e, m_e = step_e(state_e, batch)
             np.testing.assert_allclose(float(loss_g), float(m_e["loss"]),
-                                       rtol=3e-5)
+                                       rtol=3e-5 * (1 + i / 3))
         p0 = state_e.params["layer_0"]
         assert p0["moe"]["w_in"].sharding.spec == P("data")
         q_spec = p0["attention"]["query"]["kernel"].sharding.spec

@@ -65,7 +65,7 @@ def test_flash_matches_einsum():
 
 
 def test_gpt_tp_matches_dense(devices8):
-    """10 lockstep TP train steps on a (data=2, model=4) mesh == dense."""
+    """30 lockstep TP train steps on a (data=2, model=4) mesh == dense."""
     from apex_example_tpu.engine import (create_gspmd_train_state,
                                          make_gspmd_train_step)
     from apex_example_tpu.ops import _config as ops_config
@@ -92,17 +92,17 @@ def test_gpt_tp_matches_dense(devices8):
         step_t = make_gspmd_train_step(mesh, tp_model, opt(), policy,
                                        shardings, loss_fn=lm_loss,
                                        compute_accuracy=False, donate=False)
-        for i in range(10):
+        for i in range(30):
             b = _batch(i, V)
             state_d, m_d = step_d(state_d, b)
             state_t, m_t = step_t(state_t, b)
             np.testing.assert_allclose(float(m_d["loss"]),
-                                       float(m_t["loss"]), rtol=3e-5)
+                                       float(m_t["loss"]), rtol=3e-5 * (1 + i / 3))
         for (ka, a), (_, b2) in zip(
                 jax.tree_util.tree_leaves_with_path(state_d.params),
                 jax.tree_util.tree_leaves_with_path(state_t.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
-                                       rtol=1e-4, atol=1e-5,
+                                       rtol=1e-3, atol=3e-5,
                                        err_msg=str(ka))
     finally:
         ops_config.set_force_xla(False)
@@ -111,7 +111,7 @@ def test_gpt_tp_matches_dense(devices8):
 
 @pytest.mark.parametrize("mode", ["ring", "zigzag", "ulysses"])
 def test_gpt_cp_matches_dense(devices8, mode):
-    """10 lockstep CP train steps on a (data=2, context=4) mesh == dense for
+    """30 lockstep CP train steps on a (data=2, context=4) mesh == dense for
     EVERY attention program: "ring" pins the causal chunk skipping and
     global position-count normalization; "zigzag" additionally composes
     the factory's zigzag_shard pre-pass, the model's zigzag position ids,
@@ -133,22 +133,22 @@ def test_gpt_cp_matches_dense(devices8, mode):
                                  sample, policy, scaler)
     step_c = make_gpt_cp_train_step(mesh, cp_model, opt(), policy,
                                     donate=False, mode=mode)
-    for i in range(10):
+    for i in range(30):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
         state_c, m_c = step_c(state_c, b)
         np.testing.assert_allclose(float(m_d["loss"]), float(m_c["loss"]),
-                                   rtol=3e-5)
+                                   rtol=3e-5 * (1 + i / 3))
     for (ka, a), (_, b2) in zip(
             jax.tree_util.tree_leaves_with_path(state_d.params),
             jax.tree_util.tree_leaves_with_path(state_c.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
-                                   rtol=1e-4, atol=1e-5, err_msg=str(ka))
+                                   rtol=1e-3, atol=3e-5, err_msg=str(ka))
 
 
 @pytest.mark.parametrize("sched", ["ring", "1f1b"])
 def test_gpt_pp_matches_dense(devices8, sched):
-    """10 lockstep pipeline-parallel GPT train steps == dense — the GPT head
+    """30 lockstep pipeline-parallel GPT train steps == dense — the GPT head
     cell (final LN + tied decoder) and the all-ones-weights normalization
     (== next-token mean) inside the schedule are the parts worth pinning."""
     from apex_example_tpu.engine import TrainState
@@ -180,12 +180,12 @@ def test_gpt_pp_matches_dense(devices8, sched):
     step_p = make_bert_pp_train_step(mesh, model, zopt, policy,
                                      microbatches=2, donate=False,
                                      schedule=sched)
-    for i in range(10):
+    for i in range(30):
         b = _batch(i, V)
         state_d, m_d = step_d(state_d, b)
         state_p, m_p = step_p(state_p, b)
         np.testing.assert_allclose(float(m_d["loss"]), float(m_p["loss"]),
-                                   rtol=3e-5)
+                                   rtol=3e-5 * (1 + i / 3))
     key = lambda kv: str(kv[0])
     for (ka, a), (_, b2) in zip(
             sorted(jax.tree_util.tree_leaves_with_path(state_d.params),
@@ -193,7 +193,7 @@ def test_gpt_pp_matches_dense(devices8, sched):
             sorted(jax.tree_util.tree_leaves_with_path(unp(state_p.params)),
                    key=key)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
-                                   rtol=1e-4, atol=1e-5, err_msg=str(ka))
+                                   rtol=1e-3, atol=3e-5, err_msg=str(ka))
 
 
 def test_train_py_cli_gpt_pp(devices8, capsys):
@@ -313,16 +313,16 @@ def test_gpt_cp_tp_train_matches_dense(devices8, mode):
         step_c = make_gpt_cp_train_step(mesh, cp_tp_model, opt(), policy,
                                         donate=False, state_shardings=sh,
                                         mode=mode)
-        for i in range(10):
+        for i in range(30):
             b = _batch(i, V)
             state_d, m_d = step_d(state_d, b)
             state_c, m_c = step_c(state_c, b)
             np.testing.assert_allclose(float(m_d["loss"]),
-                                       float(m_c["loss"]), rtol=3e-5)
+                                       float(m_c["loss"]), rtol=3e-5 * (1 + i / 3))
         for a, b in zip(jax.tree_util.tree_leaves(state_d.params),
                         jax.tree_util.tree_leaves(state_c.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=1e-4, atol=1e-5)
+                                       rtol=1e-3, atol=3e-5)
         qk = state_c.params["layer_0"]["attention"]["query"]["kernel"]
         assert qk.addressable_shards[0].data.shape == (64, 32), \
             "query kernel lost its model-axis sharding"
