@@ -235,9 +235,16 @@ def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer,
     from apex_example_tpu.engine import _opt_state_specs
     tmap = jax.tree_util.tree_map
     if model is not None and model.tensor_parallel:
-        layer_specs = tmap(lambda s: P(PIPE_AXIS, *tuple(s)),
-                           _tp_layer_specs(model),
-                           is_leaf=lambda v: isinstance(v, P))
+        # Pad between the 'pipe'-sharded stacked dim and the layer's own
+        # TP spec: the ring pack has ONE leading index dim ([L, ...]), the
+        # 1F1B arranged pack has THREE ([S, V, per, ...]) — the TP axes
+        # always name the trailing (per-layer) dims.
+        layer_specs = tmap(
+            lambda s, leaf: P(PIPE_AXIS,
+                              *([None] * (leaf.ndim - 1 - len(tuple(s)))),
+                              *tuple(s)),
+            _tp_layer_specs(model), state.params["layers"],
+            is_leaf=lambda v: isinstance(v, P))
     else:
         layer_specs = tmap(lambda _: P(PIPE_AXIS), state.params["layers"])
     params_specs = {
@@ -390,12 +397,13 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                          f"pipeline size {S} x chunks {V}")
     from apex_example_tpu.parallel.mesh import require_model_axis_match
     tp = require_model_axis_match(mesh, model.tensor_parallel)
-    if tp > 1 and schedule != "ring":
-        raise ValueError(
-            "tensor parallelism composes with the ring schedule only: the "
-            "1F1B schedules run stage cells inside lax.cond with per-stage "
-            "predicates, where the TP layers' auto-axis collectives cannot "
-            "live")
+    # TP composes with ALL THREE schedules (round 5; r4 allowed ring
+    # only).  NOT via the plain cond dispatch: TP collectives inside the
+    # per-stage lax.cond COMPILE fine but DEADLOCK at runtime — devices
+    # disagree on the global cross-program collective order (PERF.md
+    # round-5 note).  The 1F1B/interleaved cells therefore require the
+    # branch-free uniform_collectives form, passed below; any new TP call
+    # site of pipeline_1f1b must pass it too.
     from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
     if isinstance(optimizer, FusedLAMB):
         raise ValueError(
@@ -539,7 +547,12 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             layers = jax.tree_util.tree_map(lambda l: l[0], layers)
         sloss, glayers, ghead, dxa = pipeline_1f1b(
             stage_fn, last_fn, layers, mb(x),
-            (mb(labels), mb(weights)), num_chunks=V, head_params=rest)
+            (mb(labels), mb(weights)), num_chunks=V, head_params=rest,
+            # TP: the stage/head cells contain GSPMD model-axis collectives
+            # — the cond dispatch would give devices divergent collective
+            # orders and deadlock; the branch-free masked form keeps one
+            # uniform order (see pipeline_1f1b docstring).
+            uniform_collectives=tp > 1)
         if V == 1:
             glayers = jax.tree_util.tree_map(lambda g: g[None], glayers)
         glayers = jax.tree_util.tree_map(lambda g: g[None], glayers)

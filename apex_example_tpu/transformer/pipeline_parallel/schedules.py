@@ -300,7 +300,8 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                   targets: Any,
                   axis_name: str = PIPE_AXIS,
                   num_chunks: int = 1,
-                  head_params: Any = None):
+                  head_params: Any = None,
+                  uniform_collectives: bool = False):
     """True 1F1B pipeline: explicit warmup/steady/drain microbatch ordering
     with bounded in-flight activations.  Must run inside shard_map.
 
@@ -328,10 +329,23 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     The per-tick schedule is a static table computed by
     :func:`_simulate_1f1b` (M, S, V are trace-time constants), so the
     traced program is a single ``lax.scan`` whose body does masked
-    compute (``lax.cond``) + two ring ``ppermute`` hops; ``stage_fn``
-    must therefore be collective-free (put TP collectives inside
-    :func:`spmd_pipeline` instead, or keep TP on a separate mesh axis
-    outside the cond).
+    compute (``lax.cond``) + two ring ``ppermute`` hops; a plain-cond
+    ``stage_fn`` must therefore be collective-free.
+
+    ``uniform_collectives=True`` (the TP composition): the cond dispatch
+    becomes BRANCH-FREE masked compute — every tick on every device runs
+    the identical op (and collective) sequence (stage forward, stage vjp,
+    loss cell) with the results where-selected by the schedule masks.
+    Required when ``stage_fn``/``last_stage_fn`` contain auto-axis (GSPMD
+    model) collectives: with per-stage cond predicates only SOME devices
+    execute those collectives per tick, the cross-device collective order
+    diverges, and the program deadlocks at runtime (observed on the CPU
+    backend: 7 devices at the ring ppermute, 1 stuck in a model-pair
+    all-reduce).  Cost: bubble ticks compute garbage that is masked out —
+    on an SPMD pipeline the tick latency is set by the busiest device
+    anyway, so this costs ~no wall-clock; the loss cell does run every
+    tick on every device (the cond form runs it once per S·V), which is
+    the price of the uniform order.
 
     Returns ``(mean loss, grads)`` with grads shaped like
     ``stage_params``.
@@ -365,14 +379,24 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             lambda p: lax.dynamic_index_in_dim(p, c, keepdims=False), params)
 
     p0 = jax.tree_util.tree_map(lambda p: p[0], params)
-    y_sd = jax.eval_shape(stage_fn, p0, jax.eval_shape(
-        lambda a: a[0], inputs))
-    if y_sd.shape != inputs.shape[1:] or y_sd.dtype != inputs.dtype:
+    try:
+        y_sd = jax.eval_shape(stage_fn, p0, jax.eval_shape(
+            lambda a: a[0], inputs))
+    except Exception:
+        # Best-effort early check only: a TP stage_fn's sharding
+        # constraints do not trace under a NESTED eval_shape inside the
+        # partially-manual shard_map (the manual-mesh context is lost).
+        # The invariant still holds — lax.cond's branch-shape agreement
+        # enforces it at trace time, just with a less pointed error.
+        y_sd = None
+    if y_sd is not None and (y_sd.shape != inputs.shape[1:]
+                             or y_sd.dtype != inputs.dtype):
         raise ValueError(
             "stage output must match the per-microbatch input (the ring "
             f"carries one activation shape); got {y_sd.shape}/{y_sd.dtype} "
             f"vs {inputs.shape[1:]}/{inputs.dtype}")
-    act_shape, act_dtype = y_sd.shape, y_sd.dtype
+    act_shape, act_dtype = (y_sd.shape, y_sd.dtype) if y_sd is not None \
+        else (inputs.shape[1:], inputs.dtype)
 
     fwd_tbl, bwd_tbl, fdepth, bdepth, xdepth = _simulate_1f1b(M, S, V)
     fwd_tbl = jnp.asarray(fwd_tbl, jnp.int32)
@@ -447,14 +471,42 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         is_inject = (idx == 0) & (cf == 0)
         x_in = jnp.where(is_inject, _idx(inputs, kf),
                          _idx2(fwd_reg, cf, kf % fdepth))
-        y = lax.cond(do_f,
-                     lambda x: stage_fn(params_for(cf), x).astype(act_dtype),
-                     lambda x: _vzeros(act_shape, act_dtype), x_in)
+        if uniform_collectives:
+            # branch-free: every device runs the stage (and its model-axis
+            # collectives) every tick; the mask selects the result.
+            y_real = stage_fn(params_for(cf), x_in).astype(act_dtype)
+            if y_real.shape != act_shape:
+                # the cond form's branch-shape agreement enforces this;
+                # a bare jnp.where would silently BROADCAST a wrong-but-
+                # compatible stage output instead of erroring.
+                raise ValueError(
+                    "stage output must match the per-microbatch input "
+                    f"(got {y_real.shape}, need {act_shape})")
+            y = jnp.where(do_f, y_real, _vzeros(act_shape, act_dtype))
+        else:
+            y = lax.cond(do_f,
+                         lambda x: stage_fn(params_for(cf),
+                                            x).astype(act_dtype),
+                         lambda x: _vzeros(act_shape, act_dtype), x_in)
         xbuf = jnp.where(do_f, _upd2(xbuf, x_in, cf, kf % xdepth), xbuf)
 
         # ---- backward: recompute from stash, pull cotangent, vjp.
         is_last = (idx == S - 1) & (cb == V - 1)
         tgt = jax.tree_util.tree_map(lambda s: _idx(s, kb), targets)
+
+        def _loss_cell_core(yb2, tgt2):
+            """ONE definition of the loss-cell math (value_and_grad over
+            last_stage_fn + dtype casts), shared by the cond form's
+            loss_cell and the branch-free run_bwd_uniform so the two
+            dispatch forms can never diverge."""
+            if head_params is None:
+                lv, dyl = jax.value_and_grad(
+                    lambda yy: last_stage_fn(yy, tgt2))(yb2)
+                return lv.astype(jnp.float32), dyl.astype(act_dtype), ()
+            lv, (dh2, dyl) = jax.value_and_grad(
+                lambda hp, yy: last_stage_fn(hp, yy, tgt2),
+                argnums=(0, 1))(head_params, yb2)
+            return lv.astype(jnp.float32), dyl.astype(act_dtype), dh2
 
         def run_bwd(opr):
             xb, cot_in, tgt = opr
@@ -469,16 +521,7 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             # predicate varies over the pipe axis only, and the implicit
             # data-axis grad psums inside agree on the branch everywhere.
             def loss_cell(opr2):
-                yb2, tgt2 = opr2
-                if head_params is None:
-                    lv, dyl = jax.value_and_grad(
-                        lambda yy: last_stage_fn(yy, tgt2))(yb2)
-                    return (lv.astype(jnp.float32),
-                            dyl.astype(act_dtype), ())
-                lv, (dh2, dyl) = jax.value_and_grad(
-                    lambda hp, yy: last_stage_fn(hp, yy, tgt2),
-                    argnums=(0, 1))(head_params, yb2)
-                return lv.astype(jnp.float32), dyl.astype(act_dtype), dh2
+                return _loss_cell_core(*opr2)
 
             def loss_skip(opr2):
                 dh0 = () if head_params is None else jax.tree_util.tree_map(
@@ -500,9 +543,30 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                     _vzeros(act_shape, act_dtype),
                     _vzeros((), jnp.float32), dh)
 
-        dp, dx, lval, dh = lax.cond(
-            do_b, run_bwd, skip_bwd,
-            (xb, _idx2(bwd_reg, cb, kb % bdepth), tgt))
+        def run_bwd_uniform(opr):
+            """Branch-free form of run_bwd: stage vjp AND loss cell run on
+            every device every tick (identical collective order — the TP
+            requirement), results where-selected.  Garbage compute in
+            masked-off ticks never lands: dp is gated by do_b at the gacc
+            update, dh by do_b & is_last, dx by the receiver's ab_in >= 0
+            mask, and lval is masked here."""
+            xb, cot_in, tgt = opr
+            pb = params_for(cb)
+            yb, vjp = jax.vjp(stage_fn, pb, xb)
+            lv, dyl, dh = _loss_cell_core(yb, tgt)
+            lval = jnp.where(do_b & is_last, lv,
+                             _vzeros((), jnp.float32))
+            dy = jnp.where(is_last, dyl, cot_in)
+            dp, dx = vjp(dy.astype(yb.dtype))
+            return dp, dx.astype(act_dtype), lval, dh
+
+        if uniform_collectives:
+            dp, dx, lval, dh = run_bwd_uniform(
+                (xb, _idx2(bwd_reg, cb, kb % bdepth), tgt))
+        else:
+            dp, dx, lval, dh = lax.cond(
+                do_b, run_bwd, skip_bwd,
+                (xb, _idx2(bwd_reg, cb, kb % bdepth), tgt))
         gacc = jax.tree_util.tree_map(
             lambda a, d: jnp.where(
                 do_b, _upd(a, _idx(a, cb) + d.astype(jnp.float32), cb), a),

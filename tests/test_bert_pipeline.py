@@ -424,3 +424,90 @@ def test_train_py_pp_rejections():
     with pytest.raises(SystemExit):
         train_mod.main(["--arch", "bert_tiny", "--pipeline-parallel", "2",
                         "--zero", "--opt", "adam"])
+
+
+@pytest.mark.parametrize("sched,chunks,layers", [("1f1b", 1, 2),
+                                                 ("interleaved", 2, 4)])
+def test_tp_pp_1f1b_interleaved_matches_dense(devices8, sched, chunks,
+                                              layers):
+    """TP under the 1F1B AND interleaved schedules (VERDICT r4 item 8 —
+    previously rejected): the branch-free uniform-collectives cell form
+    keeps one collective order on every device, so the GSPMD model-axis
+    collectives ride inside the schedule without the cond deadlock.  3
+    lockstep steps on a (pipe=2, data=2, model=2) mesh == dense, params
+    jointly sharded over pipe AND model."""
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    from apex_example_tpu.transformer.bert_pipeline import (
+        pack_params_1f1b, unpack_params_1f1b)
+    mesh = Mesh(np.asarray(devices8).reshape(2, 2, 2),
+                ("pipe", "data", "model"))
+    parallel_state.set_mesh(mesh)
+    ops_config.set_force_xla(True)
+    try:
+        policy, scaler = amp.initialize("O0")
+        dense = bert_tiny(num_layers=layers)
+        model_tp = bert_tiny(tensor_parallel=True, num_layers=layers)
+        V = dense.vocab_size
+        opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+        state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                     _batch(0, V)[0][:1], policy, scaler)
+        step_d = jax.jit(make_train_step(dense, opt(), policy,
+                                         loss_fn=mlm_loss,
+                                         compute_accuracy=False))
+        zopt = opt()
+        packed = pack_params_1f1b(state_d.params, layers, 2, chunks)
+        state_p = TrainState(step=jnp.zeros((), jnp.int32), params=packed,
+                             batch_stats={}, opt_state=zopt.init(packed),
+                             scaler=state_d.scaler)
+        state_p = jax.device_put(
+            state_p, bert_pp_state_shardings(mesh, state_p, zopt,
+                                             model=model_tp))
+        step_p = make_bert_pp_train_step(mesh, model_tp, zopt, policy,
+                                         microbatches=2, donate=False,
+                                         schedule=sched, num_chunks=chunks)
+        for i in range(3):
+            b = _batch(i, V)
+            state_d, m_d = step_d(state_d, b)
+            state_p, m_p = step_p(state_p, b)
+            np.testing.assert_allclose(float(m_d["loss"]),
+                                       float(m_p["loss"]), rtol=3e-5)
+        un = unpack_params_1f1b(state_p.params, layers, 2, chunks)
+        key = lambda kv: str(kv[0])
+        for (ka, a), (kb, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(state_d.params),
+                       key=key),
+                sorted(jax.tree_util.tree_leaves_with_path(un), key=key)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=str(ka))
+        # jointly sharded: stacked [S, V, per] dims over pipe, column dim
+        # over model — and still so after the steps.
+        qk = state_p.params["layers"]["attention"]["query"]["kernel"]
+        assert qk.addressable_shards[0].data.shape[-1] == \
+            qk.shape[-1] // 2
+        assert qk.addressable_shards[0].data.shape[0] == qk.shape[0] // 2
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_train_py_cli_tp_pp_1f1b(devices8):
+    """--tensor-parallel now rides the 1F1B schedule from the CLI (the
+    interleaved×TP cell is pinned at the library level in
+    test_tp_pp_1f1b_interleaved_matches_dense — bert_tiny's 2 layers
+    cannot divide stages × virtual chunks for a CLI interleaved smoke)."""
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    argv = ["--arch", "bert_tiny", "--pipeline-parallel", "2",
+            "--tensor-parallel", "2", "--microbatches", "2",
+            "--pipeline-schedule", "1f1b",
+            "--batch-size", str(BATCH), "--seq-len", str(SEQ),
+            "--epochs", "1", "--steps-per-epoch", "2", "--opt", "adam",
+            "--opt-level", "O0", "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)

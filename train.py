@@ -629,11 +629,10 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--opt novograd does not compose with "
                              "--pipeline-parallel (its per-tensor second "
                              "moment collapses on stacked per-layer params)")
-        if tp > 1 and args.pipeline_schedule != "ring":
-            raise SystemExit("--tensor-parallel composes with "
-                             "--pipeline-schedule ring only (the 1F1B "
-                             "schedules run stage cells inside lax.cond, "
-                             "where the TP collectives cannot live)")
+        # --tensor-parallel composes with ALL THREE schedules (round 5):
+        # the 1F1B/interleaved cells run branch-free under TP
+        # (schedules.pipeline_1f1b uniform_collectives — one collective
+        # order on every device; the cond form deadlocks).
         if args.virtual_stages is not None \
                 and args.pipeline_schedule != "interleaved":
             raise SystemExit("--virtual-stages only applies to "
