@@ -153,13 +153,24 @@ def unpack_params_1f1b(packed: Dict[str, Any], num_layers: int,
 
 def _embed(rest, ids, model):
     """Embedding + post-embedding LN, matching BertForMaskedLM.__call__
-    (GPTForCausalLM uses the identical names and math)."""
+    (GPTForCausalLM uses the identical names and math).
+
+    Under CP x PP (``model.context_parallel``) ``ids`` is this shard's
+    contiguous sequence chunk: positions offset by the context-shard
+    index, exactly like the models' own CP branch (contiguous/ring
+    layout; the zigzag layout is rejected at the factory)."""
     dtype = model.dtype
     ln_io = model.ln_dtype or dtype
     L = ids.shape[-1]
     x = jnp.take(rest["word_embeddings"]["embedding"], ids,
                  axis=0).astype(dtype)
-    x = x + rest["position_embeddings"]["embedding"][:L][None].astype(dtype)
+    pos_tbl = rest["position_embeddings"]["embedding"]
+    if getattr(model, "context_parallel", False):
+        from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
+        pos = jnp.arange(L) + lax.axis_index(CONTEXT_AXIS) * L
+        x = x + jnp.take(pos_tbl, pos, axis=0)[None].astype(dtype)
+    else:
+        x = x + pos_tbl[:L][None].astype(dtype)
     x = layer_norm(x.astype(ln_io), rest["embeddings_ln"]["scale"],
                    rest["embeddings_ln"]["bias"])
     return x.astype(dtype)
@@ -395,7 +406,8 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     if model.num_layers % (S * V):
         raise ValueError(f"num_layers {model.num_layers} not divisible by "
                          f"pipeline size {S} x chunks {V}")
-    from apex_example_tpu.parallel.mesh import require_model_axis_match
+    from apex_example_tpu.parallel.mesh import (CONTEXT_AXIS,
+                                                require_model_axis_match)
     tp = require_model_axis_match(mesh, model.tensor_parallel)
     # TP composes with ALL THREE schedules (round 5; r4 allowed ring
     # only).  NOT via the plain cond dispatch: TP collectives inside the
@@ -404,6 +416,25 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     # round-5 note).  The 1F1B/interleaved cells therefore require the
     # branch-free uniform_collectives form, passed below; any new TP call
     # site of pipeline_1f1b must pass it too.
+    # CP x PP (round 5): the sequence additionally shards over 'context'
+    # as another manual axis — the KV ring runs INSIDE each stage cell,
+    # positions offset in _embed, losses psum over (data, context).  The
+    # same uniform-collectives requirement applies on 1F1B/interleaved
+    # (the KV ring's manual ppermutes inside a cond would diverge the
+    # collective order exactly like the TP case).
+    cp = mesh.shape.get(CONTEXT_AXIS, 1)
+    model_is_cp = bool(getattr(model, "context_parallel", False))
+    if cp > 1 and not model_is_cp:
+        raise ValueError(f"mesh has '{CONTEXT_AXIS}' size {cp} but the "
+                         "model was built without context_parallel=True")
+    if model_is_cp and cp <= 1:
+        raise ValueError("context_parallel model needs a mesh with a "
+                         f"nontrivial '{CONTEXT_AXIS}' axis")
+    if cp > 1 and getattr(model, "cp_mode", "ring") == "zigzag":
+        raise ValueError(
+            "CP x PP runs the contiguous sequence layouts (ring/ulysses); "
+            "the zigzag reorder would need zigzag position ids inside the "
+            "schedule's embed")
     from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
     if isinstance(optimizer, FusedLAMB):
         raise ValueError(
@@ -439,7 +470,10 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                           fused_attention=model.fused_attention,
                           tensor_parallel=model.tensor_parallel,
                           sequence_parallel=model.sequence_parallel,
+                          context_parallel=model_is_cp,
+                          cp_mode=getattr(model, "cp_mode", "ring"),
                           causal=is_gpt)
+    red_axes = (DATA_AXIS, CONTEXT_AXIS) if cp > 1 else DATA_AXIS
 
     def _unpack(batch):
         """One schedule body serves both objectives: GPT's (x, y) pair
@@ -508,13 +542,13 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             # Global masked-position denominator: per-microbatch SUMS ride
             # the schedule (scaled by M to cancel its mean), the psum stitches
             # the shards — the result equals mlm_loss on the full batch.
-            denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
+            denom = jnp.maximum(lax.psum(weights.sum(), red_axes), 1.0)
             loss = spmd_pipeline(
                 stage_fn,
                 lambda y, tgt: head_sum(rest, y, tgt[0], tgt[1],
                                         model) * M / denom,
                 params["layers"], mb(x), (mb(labels), mb(weights)))
-            loss = lax.psum(loss, DATA_AXIS)
+            loss = lax.psum(loss, red_axes)
             return amp_lib.scale_loss(loss, state.scaler), loss
 
         grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
@@ -535,7 +569,7 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         M, b, mb = _split(ids)
         rest = state.params["rest"]
         x, vjp_embed = jax.vjp(lambda r: _embed(r, ids, model), rest)
-        denom = jnp.maximum(lax.psum(weights.sum(), DATA_AXIS), 1.0)
+        denom = jnp.maximum(lax.psum(weights.sum(), red_axes), 1.0)
 
         def last_fn(hp, y, tgt):
             raw = head_sum(hp, y, tgt[0], tgt[1], model) * M / denom
@@ -551,8 +585,9 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             # TP: the stage/head cells contain GSPMD model-axis collectives
             # — the cond dispatch would give devices divergent collective
             # orders and deadlock; the branch-free masked form keeps one
-            # uniform order (see pipeline_1f1b docstring).
-            uniform_collectives=tp > 1)
+            # uniform order (see pipeline_1f1b docstring).  The CP KV
+            # ring's manual ppermutes have the same requirement.
+            uniform_collectives=tp > 1 or cp > 1)
         if V == 1:
             glayers = jax.tree_util.tree_map(lambda g: g[None], glayers)
         glayers = jax.tree_util.tree_map(lambda g: g[None], glayers)
@@ -566,7 +601,7 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         grads = {"rest": jax.tree_util.tree_map(
                     lambda a, c: a + c.astype(a.dtype), ghead, g_embed),
                  "layers": glayers}
-        sloss = lax.psum(sloss, DATA_AXIS)
+        sloss = lax.psum(sloss, red_axes)
         loss = sloss if state.scaler.identity \
             else sloss / state.scaler.scale
         return finish(state, grads, loss)
@@ -585,16 +620,18 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     opt_spec = _opt_state_specs(optimizer, probe, params_spec)
     state_spec = TrainState(step=P(), params=params_spec, batch_stats=P(),
                             opt_state=opt_spec, scaler=P())
-    # TP×PP: manual over (pipe, data) only — 'model' (and 'context') stay
-    # automatic, so the TP layers' GSPMD constraints inside the body bind
-    # to them.  The specs name manual axes; the layer leaves' model-axis
-    # sharding rides along from the arrays' placement
+    # TP×PP: manual over (pipe, data) — 'model' stays automatic so the TP
+    # layers' GSPMD constraints inside the body bind to it.  CP×PP adds
+    # 'context' to the MANUAL set (the KV ring's ppermutes are manual-axis
+    # collectives).  The specs name manual axes; the layer leaves'
+    # model-axis sharding rides along from the arrays' placement
     # (bert_pp_state_shardings).
     from apex_example_tpu.workloads import partial_manual_axis_names
-    kw = partial_manual_axis_names(
-        mesh, model, frozenset({PIPE_AXIS, DATA_AXIS}), "TP x PP")
-    bspec = (P(DATA_AXIS), P(DATA_AXIS)) if is_gpt \
-        else (P(DATA_AXIS), (P(DATA_AXIS), P(DATA_AXIS)))
+    manual = frozenset({PIPE_AXIS, DATA_AXIS}
+                       | ({CONTEXT_AXIS} if cp > 1 else set()))
+    kw = partial_manual_axis_names(mesh, model, manual, "TP x PP")
+    b = P(DATA_AXIS, CONTEXT_AXIS) if cp > 1 else P(DATA_AXIS)
+    bspec = (b, b) if is_gpt else (b, (b, b))
     sharded = _shard_map(
         per_shard, mesh=mesh,
         in_specs=(state_spec, bspec),

@@ -511,3 +511,114 @@ def test_train_py_cli_tp_pp_1f1b(devices8):
     finally:
         ops_config.set_force_xla(False)
         parallel_state.set_mesh(None)
+
+
+@pytest.mark.parametrize("arch,sched,mode", [("gpt", "ring", "ring"),
+                                             ("gpt", "1f1b", "ring"),
+                                             ("gpt", "ring", "ulysses"),
+                                             ("bert", "ring", "ring")])
+def test_cp_pp_matches_dense(devices8, arch, sched, mode):
+    """CP x PP (round 5; previously rejected): the KV ring rides the
+    'context' axis INSIDE the schedule's stage cells — long context and
+    deep pipelines jointly.  3 lockstep steps on a (pipe=2, data=2,
+    context=2) mesh == dense; position embeddings offset per context
+    shard in the schedule's embed; losses psum over (data, context).
+    1F1B requires the branch-free uniform-collectives cells (the manual
+    KV-ring ppermutes inside a cond diverge the collective order exactly
+    like the TP case)."""
+    from apex_example_tpu.models.gpt import gpt_tiny
+    from apex_example_tpu.transformer.bert_pipeline import (
+        pack_params_1f1b, unpack_params_1f1b)
+    from apex_example_tpu.workloads import lm_loss
+    is_gpt = arch == "gpt"
+    mk = gpt_tiny if is_gpt else bert_tiny
+    mesh = Mesh(np.asarray(devices8).reshape(2, 2, 2),
+                ("pipe", "data", "context"))
+    policy, scaler = amp.initialize("O0")
+    dense = mk()
+    cp_model = mk(context_parallel=True, cp_mode=mode)
+    V = dense.vocab_size
+
+    def batch(i):
+        if is_gpt:
+            from apex_example_tpu.data import lm_batch
+            toks = lm_batch(jnp.asarray(i, jnp.int32), batch_size=BATCH,
+                            seq_len=SEQ, vocab_size=V, seed=0)
+            return toks[:, :-1], toks[:, 1:]
+        return _batch(i, V)
+
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+    state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                 batch(0)[0][:1], policy, scaler)
+    step_d = jax.jit(make_train_step(dense, opt(), policy,
+                                     loss_fn=lm_loss if is_gpt
+                                     else mlm_loss,
+                                     compute_accuracy=False))
+    zopt = opt()
+    if sched == "ring":
+        packed = pack_params(state_d.params, dense.num_layers)
+        unp = lambda p: unpack_params(p, dense.num_layers)
+    else:
+        packed = pack_params_1f1b(state_d.params, dense.num_layers, 2, 1)
+        unp = lambda p: unpack_params_1f1b(p, dense.num_layers, 2, 1)
+    state_p = TrainState(step=jnp.zeros((), jnp.int32), params=packed,
+                         batch_stats={}, opt_state=zopt.init(packed),
+                         scaler=state_d.scaler)
+    state_p = jax.device_put(
+        state_p, bert_pp_state_shardings(mesh, state_p, zopt))
+    step_p = make_bert_pp_train_step(mesh, cp_model, zopt, policy,
+                                     microbatches=2, donate=False,
+                                     schedule=sched)
+    for i in range(3):
+        b = batch(i)
+        state_d, m_d = step_d(state_d, b)
+        state_p, m_p = step_p(state_p, b)
+        np.testing.assert_allclose(float(m_d["loss"]), float(m_p["loss"]),
+                                   rtol=3e-5)
+    key = lambda kv: str(kv[0])
+    for (ka, a), (kb, b2) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(state_d.params),
+                   key=key),
+            sorted(jax.tree_util.tree_leaves_with_path(
+                unp(state_p.params)), key=key)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=1e-4, atol=1e-5, err_msg=str(ka))
+
+
+def test_cp_pp_zigzag_rejected():
+    """zigzag's reorder needs zigzag position ids inside the schedule's
+    embed — rejected at the factory AND the CLI."""
+    import train as train_mod
+    from apex_example_tpu.models.gpt import gpt_tiny
+    mesh_args = ["--arch", "gpt_tiny", "--pipeline-parallel", "2",
+                 "--context-parallel", "2", "--cp-mode", "zigzag",
+                 "--microbatches", "2", "--batch-size", "8",
+                 "--seq-len", "16", "--opt", "adam"]
+    with pytest.raises(SystemExit):
+        train_mod.main(mesh_args)
+    with pytest.raises(SystemExit):      # the CP x PP x TP triple
+        train_mod.main(["--arch", "gpt_tiny", "--pipeline-parallel", "2",
+                        "--context-parallel", "2", "--tensor-parallel",
+                        "2", "--microbatches", "2", "--batch-size", "8",
+                        "--seq-len", "16", "--opt", "adam"])
+
+
+def test_train_py_cli_cp_pp(devices8):
+    """--context-parallel composes with --pipeline-parallel from the CLI
+    (GPT ring schedule + BERT 1f1b)."""
+    import train as train_mod
+    from apex_example_tpu.transformer import parallel_state
+    base = ["--microbatches", "2", "--batch-size", "8", "--seq-len", "16",
+            "--epochs", "1", "--steps-per-epoch", "2", "--opt", "adam",
+            "--opt-level", "O0", "--print-freq", "1"]
+    try:
+        assert train_mod.main(
+            ["--arch", "gpt_tiny", "--pipeline-parallel", "2",
+             "--context-parallel", "2", "--eval", "--eval-batches", "2"]
+            + base) == 0
+        assert train_mod.main(
+            ["--arch", "bert_tiny", "--pipeline-parallel", "2",
+             "--context-parallel", "2", "--pipeline-schedule", "1f1b"]
+            + base) == 0
+    finally:
+        parallel_state.set_mesh(None)

@@ -568,9 +568,22 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--context-parallel is wired for the BERT/GPT "
                              "archs (transformer_xl's long-context story "
                              "is its segment recurrence)")
-        if pp > 1 or args.zero:
+        if args.zero:
             raise SystemExit("--context-parallel does not compose with "
-                             "--pipeline-parallel/--zero yet")
+                             "--zero yet")
+        if pp > 1:
+            # CP x PP composes (round 5): the KV ring rides inside the
+            # schedule's stage cells on a third manual axis.  Bounds:
+            if tp > 1:
+                raise SystemExit("--context-parallel --pipeline-parallel "
+                                 "--tensor-parallel (the CP x PP x TP "
+                                 "triple) is not wired yet; drop one")
+            if args.cp_mode == "zigzag":
+                raise SystemExit("--cp-mode zigzag does not compose with "
+                                 "--pipeline-parallel (the zigzag reorder "
+                                 "would need zigzag position ids inside "
+                                 "the schedule's embed); use ring or "
+                                 "ulysses")
         if args.sequence_parallel:
             raise SystemExit("--sequence-parallel shards activations along "
                              "the sequence dim --context-parallel already "
@@ -785,7 +798,13 @@ def _lm_main_impl(args, policy, scaler):
             # the XLA reference ops (restored by lm_main's outer finally).
             ops_config.set_force_xla(True)
         mesh = parallel_state.initialize_model_parallel(
-            tensor_parallel=tp, pipeline_parallel=pp, devices=devices)
+            tensor_parallel=tp, pipeline_parallel=pp, context_parallel=cp,
+            devices=devices)
+        # CP x PP: the schedule's stage cells run the KV ring on the
+        # 'context' axis; the step's model twin carries the CP flags
+        # (init uses the dense twin — identical param tree).
+        model_pp = builder(**mkw, context_parallel=True,
+                           cp_mode=args.cp_mode) if cp > 1 else model
         if model.num_layers % (pp * pp_chunks):
             raise SystemExit(f"--pipeline-parallel {pp} x --virtual-stages "
                              f"{pp_chunks} does not divide "
@@ -809,14 +828,15 @@ def _lm_main_impl(args, policy, scaler):
         state = jax.device_put(
             state, bert_pp_state_shardings(mesh, state, optimizer,
                                            model=model))
-        step_fn = make_bert_pp_train_step(mesh, model, optimizer, policy,
+        step_fn = make_bert_pp_train_step(mesh, model_pp, optimizer, policy,
                                           microbatches=args.microbatches,
                                           schedule=pp_sched,
                                           num_chunks=pp_chunks)
         mems = None
         print(f"PP over {pp} stages ({pp_sched}"
               + (f", V={pp_chunks}" if pp_chunks > 1 else "")
-              + f"), TP over {tp}, DP over {n_dev // (pp * tp)}, "
+              + f"), TP over {tp}, CP over {cp}, DP over "
+              f"{n_dev // (pp * tp * cp)}, "
               f"{args.microbatches} microbatches/shard: {mesh}")
     elif tp > 1 and cp == 1 and not args.moe_experts:
         # GSPMD tensor parallelism: one (pipe, data, context, model) mesh,
@@ -1035,7 +1055,23 @@ def _lm_main_impl(args, policy, scaler):
                                                 make_gpt_eval_step,
                                                 make_txl_eval_step)
         if is_bert or is_gpt:
-            if cp > 1 and args.moe_experts:
+            if pp > 1:
+                # PP (and CP x PP) eval: unpack the packed/stacked params
+                # into the dense layout and run the dense eval step — the
+                # trees are content-identical by construction.  (Under
+                # CP x PP this evaluates the full sequence densely; the
+                # schedule's own KV ring is a training program.)
+                from apex_example_tpu.transformer.bert_pipeline import (
+                    unpack_params, unpack_params_1f1b)
+                core = make_gpt_eval_step(model) if is_gpt \
+                    else make_bert_eval_step(model)
+                if pp_sched == "ring":
+                    unp = lambda p: unpack_params(p, model.num_layers)
+                else:
+                    unp = lambda p: unpack_params_1f1b(
+                        p, model.num_layers, pp, pp_chunks)
+                eval_fn = jax.jit(lambda p, b: core(unp(p), b))
+            elif cp > 1 and args.moe_experts:
                 # EP x CP eval: same KV ring + per-column expert dispatch
                 # as training.
                 from apex_example_tpu.workloads import (
@@ -1054,17 +1090,6 @@ def _lm_main_impl(args, policy, scaler):
                 eval_fn = make_gpt_cp_eval_step(
                     mesh, model_cp, mode=args.cp_mode) if is_gpt \
                     else make_bert_cp_eval_step(mesh, model_cp)
-            elif pp > 1:
-                from apex_example_tpu.transformer.bert_pipeline import (
-                    unpack_params, unpack_params_1f1b)
-                core = make_gpt_eval_step(model) if is_gpt \
-                    else make_bert_eval_step(model)
-                if pp_sched == "ring":
-                    unp = lambda p: unpack_params(p, model.num_layers)
-                else:
-                    unp = lambda p: unpack_params_1f1b(
-                        p, model.num_layers, pp, pp_chunks)
-                eval_fn = jax.jit(lambda p, b: core(unp(p), b))
             elif args.moe_experts:
                 # Same mesh + all_to_all dispatch as training: a dense
                 # eval would need the expert stacks gathered onto one
