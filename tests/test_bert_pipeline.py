@@ -622,3 +622,99 @@ def test_train_py_cli_cp_pp(devices8):
             + base) == 0
     finally:
         parallel_state.set_mesh(None)
+
+
+@pytest.mark.parametrize("sched", ["ring", "1f1b"])
+def test_cp_pp_tp_triple_matches_dense(devices8, sched):
+    """The CP x PP x TP TRIPLE (round 5): manual (pipe, data, context) +
+    automatic 'model' in one schedule body — KV ring inside the stage
+    cells, GSPMD TP inside the attention/FFN, layer params jointly
+    sharded over pipe AND model, sequence over context.  3 lockstep
+    steps == dense on a (2, 1, 2, 2) mesh."""
+    from apex_example_tpu.models.gpt import gpt_tiny
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    from apex_example_tpu.transformer.bert_pipeline import (
+        pack_params_1f1b, unpack_params_1f1b)
+    from apex_example_tpu.data import lm_batch
+    from apex_example_tpu.workloads import lm_loss
+
+    mesh = Mesh(np.asarray(devices8).reshape(2, 1, 2, 2),
+                ("pipe", "data", "context", "model"))
+    parallel_state.set_mesh(mesh)
+    ops_config.set_force_xla(True)
+    try:
+        policy, scaler = amp.initialize("O0")
+        dense = gpt_tiny()
+        triple = gpt_tiny(tensor_parallel=True, context_parallel=True,
+                          cp_mode="ring")
+        V = dense.vocab_size
+
+        def batch(i):
+            toks = lm_batch(jnp.asarray(i, jnp.int32), batch_size=BATCH,
+                            seq_len=SEQ, vocab_size=V, seed=0)
+            return toks[:, :-1], toks[:, 1:]
+
+        opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+        state_d = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                     batch(0)[0][:1], policy, scaler)
+        step_d = jax.jit(make_train_step(dense, opt(), policy,
+                                         loss_fn=lm_loss,
+                                         compute_accuracy=False))
+        zopt = opt()
+        if sched == "ring":
+            packed = pack_params(state_d.params, dense.num_layers)
+            unp = lambda p: unpack_params(p, dense.num_layers)
+        else:
+            packed = pack_params_1f1b(state_d.params, dense.num_layers,
+                                      2, 1)
+            unp = lambda p: unpack_params_1f1b(p, dense.num_layers, 2, 1)
+        state_p = TrainState(step=jnp.zeros((), jnp.int32), params=packed,
+                             batch_stats={}, opt_state=zopt.init(packed),
+                             scaler=state_d.scaler)
+        state_p = jax.device_put(
+            state_p, bert_pp_state_shardings(mesh, state_p, zopt,
+                                             model=triple))
+        step_p = make_bert_pp_train_step(mesh, triple, zopt, policy,
+                                         microbatches=2, donate=False,
+                                         schedule=sched)
+        for i in range(3):
+            b = batch(i)
+            state_d, m_d = step_d(state_d, b)
+            state_p, m_p = step_p(state_p, b)
+            np.testing.assert_allclose(float(m_d["loss"]),
+                                       float(m_p["loss"]), rtol=3e-5)
+        key = lambda kv: str(kv[0])
+        for (ka, a), (kb, b2) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(state_d.params),
+                       key=key),
+                sorted(jax.tree_util.tree_leaves_with_path(
+                    unp(state_p.params)), key=key)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=str(ka))
+        # params jointly pipe x model sharded
+        qk = state_p.params["layers"]["attention"]["query"]["kernel"]
+        assert qk.addressable_shards[0].data.shape[0] == qk.shape[0] // 2
+        assert qk.addressable_shards[0].data.shape[-1] == qk.shape[-1] // 2
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_train_py_cli_cp_pp_tp(devices8):
+    """The triple from the CLI: --pipeline-parallel 2 --context-parallel 2
+    --tensor-parallel 2 on 8 devices."""
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    argv = ["--arch", "gpt_tiny", "--pipeline-parallel", "2",
+            "--context-parallel", "2", "--tensor-parallel", "2",
+            "--microbatches", "2", "--batch-size", "8", "--seq-len", "16",
+            "--epochs", "1", "--steps-per-epoch", "2", "--opt", "adam",
+            "--opt-level", "O0", "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)

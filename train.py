@@ -573,11 +573,9 @@ def _lm_main_impl(args, policy, scaler):
                              "--zero yet")
         if pp > 1:
             # CP x PP composes (round 5): the KV ring rides inside the
-            # schedule's stage cells on a third manual axis.  Bounds:
-            if tp > 1:
-                raise SystemExit("--context-parallel --pipeline-parallel "
-                                 "--tensor-parallel (the CP x PP x TP "
-                                 "triple) is not wired yet; drop one")
+            # schedule's stage cells on a third manual axis — and the
+            # CP x PP x TP TRIPLE composes too (manual pipe/data/context,
+            # automatic 'model', branch-free cells; parity-tested).
             if args.cp_mode == "zigzag":
                 raise SystemExit("--cp-mode zigzag does not compose with "
                                  "--pipeline-parallel (the zigzag reorder "
