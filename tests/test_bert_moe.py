@@ -381,10 +381,10 @@ def test_moe_cp_expert_state_sharded(devices8):
 def test_train_py_moe_cp_rejections():
     import train as train_mod
     base = ["--batch-size", "16", "--seq-len", "16", "--opt", "adam"]
-    with pytest.raises(SystemExit):   # the EP x CP x TP triple is unwired
+    with pytest.raises(SystemExit):   # PP still rejected with MoE
         train_mod.main(["--arch", "gpt_tiny", "--moe-experts", "4",
-                        "--context-parallel", "2", "--tensor-parallel", "2"]
-                       + base)
+                        "--context-parallel", "2", "--pipeline-parallel",
+                        "2", "--microbatches", "2"] + base)
     with pytest.raises(SystemExit):   # SP still rejected with MoE
         train_mod.main(["--arch", "bert_tiny", "--moe-experts", "8",
                         "--sequence-parallel"] + base)
@@ -403,3 +403,96 @@ def test_train_py_cli_moe_context_parallel(devices8):
         ["--arch", "bert_tiny", "--moe-experts", "4",
          "--context-parallel", "2", "--eval", "--eval-batches", "2"]
         + base) == 0
+
+
+def test_moe_cp_tp_triple_matches_dense_ref_golden(devices8):
+    """EP x CP x TP (round 5): expert all_to_all over manual 'data', KV
+    ring over manual 'context', GSPMD TP over automatic 'model' — 10
+    lockstep steps against the same EXACT dense-reference golden the
+    EP x CP test uses (on its own (data=2, context=2) 4-device mesh,
+    identical init and batches), expert stacks AND attention provably
+    sharded."""
+    from apex_example_tpu.engine import create_gspmd_train_state
+    from apex_example_tpu.models.gpt import gpt_tiny
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    from apex_example_tpu.workloads import make_bert_moe_train_step
+
+    gold_mesh = Mesh(np.asarray(devices8[:4]).reshape(2, 2),
+                     ("data", "context"))
+    mesh = Mesh(np.asarray(devices8).reshape(2, 2, 2),
+                ("data", "context", "model"))
+    policy, scaler = amp.initialize("O0")
+    kw = dict(moe_experts=2, moe_axis_name="data")
+    dense_init = gpt_tiny(**kw)
+    gold_model = gpt_tiny(moe_experts=2, moe_axis_name="expert",
+                          context_parallel=True, cp_mode="ring")
+    triple = gpt_tiny(**kw, tensor_parallel=True, context_parallel=True,
+                      cp_mode="ring")
+    V = dense_init.vocab_size
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+
+    sample = _lm_batch(0, V)[0][:1]
+    state_g = create_train_state(jax.random.PRNGKey(0), dense_init, opt(),
+                                 sample, policy, scaler)
+    golden = _golden_moe_cp_step(gold_mesh, gold_model, opt(), policy,
+                                 "ring")
+
+    parallel_state.set_mesh(mesh)
+    ops_config.set_force_xla(True)
+    try:
+        zopt = opt()
+        state_e, gsh = create_gspmd_train_state(
+            jax.random.PRNGKey(0), mesh,
+            gpt_tiny(**kw, tensor_parallel=True), zopt, sample, policy,
+            scaler)
+        sh = bert_moe_state_shardings(mesh, state_e, zopt,
+                                      base_shardings=gsh)
+        # same starting point as the golden (identical param tree)
+        state_e = jax.device_put(
+            state_g.replace(opt_state=state_e.opt_state), sh)
+        step_e = make_bert_moe_train_step(mesh, triple, zopt, policy,
+                                          state_template=state_e,
+                                          aux_weight=AUX_W, donate=False,
+                                          objective="lm",
+                                          context_parallel=True,
+                                          mode="ring", state_shardings=sh)
+        for i in range(10):
+            batch = _lm_batch(i, V)
+            state_g, m_g = golden(state_g, batch)
+            state_e, m_e = step_e(state_e, batch)
+            np.testing.assert_allclose(float(m_g["loss"]),
+                                       float(m_e["loss"]),
+                                       rtol=3e-5 * (1 + i / 3))
+        for (ka, a), (kb, b2) in zip(
+                jax.tree_util.tree_leaves_with_path(state_g.params),
+                jax.tree_util.tree_leaves_with_path(state_e.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       rtol=1e-3, atol=1e-5,
+                                       err_msg=str(ka))
+        w_in = state_e.params["layer_0"]["moe"]["w_in"]
+        qk = state_e.params["layer_0"]["attention"]["query"]["kernel"]
+        assert w_in.addressable_shards[0].data.shape[0] == \
+            w_in.shape[0] // 2                       # experts over data
+        assert qk.addressable_shards[0].data.shape[-1] == \
+            qk.shape[-1] // 2                        # heads over model
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_train_py_cli_moe_cp_tp(devices8):
+    """The EP x CP x TP triple from the CLI."""
+    import train as train_mod
+    from apex_example_tpu.ops import _config as ops_config
+    from apex_example_tpu.transformer import parallel_state
+    argv = ["--arch", "gpt_tiny", "--moe-experts", "2",
+            "--context-parallel", "2", "--tensor-parallel", "2",
+            "--batch-size", "8", "--seq-len", "16", "--epochs", "1",
+            "--steps-per-epoch", "2", "--opt", "adam", "--opt-level", "O0",
+            "--print-freq", "1"]
+    try:
+        assert train_mod.main(argv) == 0
+    finally:
+        ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)

@@ -553,11 +553,10 @@ def _lm_main_impl(args, policy, scaler):
                              "expert set on the data axis); "
                              "--tensor-parallel and --context-parallel "
                              "compose")
-        if cp > 1 and tp > 1:
-            raise SystemExit("--moe-experts --context-parallel "
-                             "--tensor-parallel (the EP x CP x TP triple) "
-                             "is not wired yet; drop one of the three "
-                             "(EP x CP and EP x TP both compose pairwise)")
+        # EP x CP, EP x TP and the EP x CP x TP triple all compose: the
+        # expert all_to_all (manual 'data'), the KV ring (manual
+        # 'context') and the GSPMD TP collectives (automatic 'model') are
+        # independent; see workloads._moe_cp_axis_names.
         if args.opt in ("lamb", "novograd") or args.larc:
             raise SystemExit("--opt lamb/novograd and --larc compute "
                              "per-tensor statistics that collapse on the "
@@ -904,22 +903,36 @@ def _lm_main_impl(args, policy, scaler):
             # data-device, everything else replicated over both axes.
             from apex_example_tpu.workloads import (
                 bert_moe_state_shardings, make_bert_moe_train_step)
-            ep = n_dev // cp
+            ep = n_dev // (cp * tp)
             if args.moe_experts % ep:
                 raise SystemExit(f"--moe-experts {args.moe_experts} must "
                                  f"be a multiple of the data-axis size "
-                                 f"{ep} (= devices / --context-parallel)")
-            state = create_train_state(jax.random.PRNGKey(args.seed),
-                                       model, optimizer, sample[:1],
-                                       policy, scaler)
-            state = jax.device_put(
-                state, bert_moe_state_shardings(mesh, state, optimizer))
+                                 f"{ep} (= devices / cp / tp)")
+            moe_shardings = None
+            if tp > 1:
+                # EP x CP x TP: GSPMD placement for the TP leaves, expert
+                # stacks overridden to P('data') (the same overlay the
+                # MoE x TP path uses).
+                from apex_example_tpu.engine import create_gspmd_train_state
+                state, gsh = create_gspmd_train_state(
+                    jax.random.PRNGKey(args.seed), mesh, model, optimizer,
+                    sample[:1], policy, scaler)
+                moe_shardings = bert_moe_state_shardings(
+                    mesh, state, optimizer, base_shardings=gsh)
+                state = jax.device_put(state, moe_shardings)
+            else:
+                state = create_train_state(jax.random.PRNGKey(args.seed),
+                                           model, optimizer, sample[:1],
+                                           policy, scaler)
+                state = jax.device_put(
+                    state, bert_moe_state_shardings(mesh, state, optimizer))
             step_fn = make_bert_moe_train_step(
                 mesh, model_cp, optimizer, policy, state_template=state,
                 aux_weight=args.moe_aux_weight,
                 grad_accum=args.grad_accum,
                 objective="mlm" if is_bert else "lm",
-                context_parallel=True, mode=args.cp_mode)
+                context_parallel=True, mode=args.cp_mode,
+                state_shardings=moe_shardings)
         elif tp > 1:
             from apex_example_tpu.engine import create_gspmd_train_state
             state, cp_shardings = create_gspmd_train_state(
