@@ -230,6 +230,22 @@ def _tp_layer_specs(model):
     return nn.get_partition_spec(abs_vars)["params"]
 
 
+def _moe_pp_layers_spec(layers_tree):
+    """Per-leaf specs for an EP x PP packed ``layers`` subtree: expert
+    stacks (workloads._is_expert_leaf) shard [stacked->pipe,
+    experts->data], everything else P('pipe') on the stacked dim only.
+    ONE definition shared by the in_specs and the placement shardings."""
+    from apex_example_tpu.workloads import _is_expert_leaf
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _leaf: P(PIPE_AXIS, DATA_AXIS)
+        if _is_expert_leaf(path) else P(PIPE_AXIS), layers_tree)
+
+
+def _is_moe_ep(model) -> bool:
+    return bool(getattr(model, "moe_experts", 0)) and \
+        getattr(model, "moe_axis_name", "") == DATA_AXIS
+
+
 def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer,
                             model: Optional[BertForMaskedLM] = None
                             ) -> TrainState:
@@ -256,6 +272,8 @@ def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer,
                               *tuple(s)),
             _tp_layer_specs(model), state.params["layers"],
             is_leaf=lambda v: isinstance(v, P))
+    elif model is not None and _is_moe_ep(model):
+        layer_specs = _moe_pp_layers_spec(state.params["layers"])
     else:
         layer_specs = tmap(lambda _: P(PIPE_AXIS), state.params["layers"])
     params_specs = {
@@ -366,7 +384,8 @@ class PipelineFusedLAMB:
 def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                             policy: Policy, microbatches: int,
                             donate: bool = True, schedule: str = "ring",
-                            num_chunks: int = 1):
+                            num_chunks: int = 1,
+                            moe_aux_weight: float = 1e-2):
     """Jitted (state, (ids, (labels, weights))) -> (state, metrics) over a
     ('pipe', 'data') mesh.  ``state.params`` is the packed tree with
     ``layers`` leaves carrying a leading stacked-stage dim (shard
@@ -435,6 +454,36 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             "CP x PP runs the contiguous sequence layouts (ring/ulysses); "
             "the zigzag reorder would need zigzag position ids inside the "
             "schedule's embed")
+    # EP x PP (round 5): switch-MoE FFNs inside the ring schedule's
+    # stages — the expert all_to_all rides the manual 'data' axis inside
+    # each tick, the per-(stage, microbatch) Switch aux loss rides the
+    # schedule's carry (spmd_pipeline with_aux).  Expert stacks shard
+    # [layers->pipe, experts->data] jointly.  moe_axis_name='data' is the
+    # EP form; any UNBOUND axis name (e.g. 'expert') runs the dense-
+    # reference experts on replicated stacks — the exact golden the tests
+    # compare against, through this same factory.
+    moe = int(getattr(model, "moe_experts", 0) or 0)
+    moe_ep = moe > 0 and getattr(model, "moe_axis_name", "") == DATA_AXIS
+    if moe:
+        if schedule != "ring":
+            raise ValueError(
+                "MoE composes with the ring schedule only: the 1F1B value "
+                "program would need the aux loss threaded through its "
+                "masked cells and the expert all_to_all runs per tick "
+                "either way (no memory win to buy)")
+        if cp > 1 or tp > 1:
+            raise ValueError("MoE x PP composes pairwise only (no "
+                             "MoE x PP x TP/CP triple yet)")
+        if moe_ep and moe % mesh.shape[DATA_AXIS]:
+            raise ValueError(
+                f"moe_experts={moe} must be a multiple of the data-axis "
+                f"size {mesh.shape[DATA_AXIS]}")
+        if moe_ep and isinstance(optimizer, PipelineFusedLAMB):
+            raise ValueError(
+                "PipelineFusedLAMB does not compose with EP x PP: its "
+                "clip norm psums over 'pipe' only, but EP expert-stack "
+                "grads vary over 'data' too — every replicated leaf would "
+                "silently receive a different update per data shard")
     from apex_example_tpu.optim.fused import FusedLAMB, FusedNovoGrad
     if isinstance(optimizer, FusedLAMB):
         raise ValueError(
@@ -472,6 +521,12 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                           sequence_parallel=model.sequence_parallel,
                           context_parallel=model_is_cp,
                           cp_mode=getattr(model, "cp_mode", "ring"),
+                          moe_experts=moe,
+                          moe_capacity_factor=getattr(
+                              model, "moe_capacity_factor", 1.25),
+                          moe_axis_name=getattr(model, "moe_axis_name",
+                                                "expert"),
+                          moe_top_k=getattr(model, "moe_top_k", 1),
                           causal=is_gpt)
     red_axes = (DATA_AXIS, CONTEXT_AXIS) if cp > 1 else DATA_AXIS
 
@@ -493,6 +548,23 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         if PIPE_AXIS not in getattr(jax.typeof(x), "vma", frozenset()):
             x = lax.pcast(x, PIPE_AXIS, to="varying")
 
+        if moe:
+            # MoE layers return (h, aux); the stage emits the SUM of its
+            # layers' Switch balance losses alongside the activation
+            # (spmd_pipeline with_aux accumulates it across the ring).
+            def body_aux(carry, p):
+                h, a = carry
+                h, aux = layer_mod.apply({"params": p}, h, None)
+                return (h, a + aux.astype(jnp.float32)), None
+            # the aux carry must enter with the activation's shard-
+            # variance type (pipe + data) or the scan carry typing trips
+            a0 = lax.pcast(
+                jnp.zeros((), jnp.float32),
+                tuple(sorted(getattr(jax.typeof(x), "vma", frozenset()))),
+                to="varying")
+            (y, aux_sum), _ = lax.scan(body_aux, (x, a0), stage_layers)
+            return y, aux_sum
+
         def body(h, p):
             return layer_mod.apply({"params": p}, h, None), None
         y, _ = lax.scan(body, x, stage_layers)
@@ -511,10 +583,12 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         every schedule's per-shard step."""
         grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
         # layers grads vary over 'pipe' (each stage owns its block), so the
-        # all-leaves finite flag does too; make it mesh-invariant for the
-        # replicated metrics/scaler.
+        # all-leaves finite flag does too; under EP the expert-stack grads
+        # additionally vary over 'data' (each shard owns its experts).
+        # Make the flag mesh-invariant for the replicated metrics/scaler.
+        finite_axes = (PIPE_AXIS, DATA_AXIS) if moe_ep else PIPE_AXIS
         grads_finite = lax.pmean(
-            grads_finite.astype(jnp.float32), PIPE_AXIS) == 1.0
+            grads_finite.astype(jnp.float32), finite_axes) == 1.0
         new_params, new_opt_state = opt.apply(grads, state.opt_state,
                                               state.params)
         if policy.uses_dynamic_scaling:
@@ -543,12 +617,23 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
             # the schedule (scaled by M to cancel its mean), the psum stitches
             # the shards — the result equals mlm_loss on the full batch.
             denom = jnp.maximum(lax.psum(weights.sum(), red_axes), 1.0)
-            loss = spmd_pipeline(
+            out = spmd_pipeline(
                 stage_fn,
                 lambda y, tgt: head_sum(rest, y, tgt[0], tgt[1],
                                         model) * M / denom,
-                params["layers"], mb(x), (mb(labels), mb(weights)))
-            loss = lax.psum(loss, red_axes)
+                params["layers"], mb(x), (mb(labels), mb(weights)),
+                with_aux=bool(moe))
+            if moe:
+                loss, aux = out
+                # aux: psum-over-pipe of per-(stage, microbatch) Switch
+                # sums / M (spmd_pipeline) -> per-layer mean, then the
+                # data-shard mean — the dense model's aux_total/L averaged
+                # over routing blocks (the blocked-dense golden contract).
+                aux = lax.pmean(aux / model.num_layers, DATA_AXIS)
+                loss = lax.psum(loss, red_axes) \
+                    + jnp.asarray(moe_aux_weight, jnp.float32) * aux
+            else:
+                loss = lax.psum(out, red_axes)
             return amp_lib.scale_loss(loss, state.scaler), loss
 
         grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(state.params)
@@ -614,10 +699,26 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     # (engine._opt_state_specs), so the same {'rest': P(), 'layers':
     # P('pipe')} prefix applies inside each of its (mu, nu, ...) fields.
     from apex_example_tpu.engine import _opt_state_specs
-    params_spec = {"rest": P(), "layers": P(PIPE_AXIS)}
-    probe = {"rest": jax.ShapeDtypeStruct((), jnp.float32),
-             "layers": jax.ShapeDtypeStruct((), jnp.float32)}
-    opt_spec = _opt_state_specs(optimizer, probe, params_spec)
+    if moe_ep:
+        # Per-leaf specs (the prefix trick cannot single out the expert
+        # stacks): abstract-init the model, pack, and mark expert leaves
+        # [stacked->pipe, experts->data].
+        abs_params = jax.eval_shape(
+            lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32)),
+            jax.random.PRNGKey(0))["params"]
+        abs_packed = jax.tree_util.tree_map(
+            lambda sd: jax.ShapeDtypeStruct(sd.shape, sd.dtype),
+            jax.eval_shape(lambda p: pack_params(p, model.num_layers),
+                           abs_params))
+        params_spec = {"rest": jax.tree_util.tree_map(
+                           lambda _: P(), abs_packed["rest"]),
+                       "layers": _moe_pp_layers_spec(abs_packed["layers"])}
+        opt_spec = _opt_state_specs(optimizer, abs_packed, params_spec)
+    else:
+        params_spec = {"rest": P(), "layers": P(PIPE_AXIS)}
+        probe = {"rest": jax.ShapeDtypeStruct((), jnp.float32),
+                 "layers": jax.ShapeDtypeStruct((), jnp.float32)}
+        opt_spec = _opt_state_specs(optimizer, probe, params_spec)
     state_spec = TrainState(step=P(), params=params_spec, batch_stats=P(),
                             opt_state=opt_spec, scaler=P())
     # TP×PP: manual over (pipe, data) — 'model' stays automatic so the TP

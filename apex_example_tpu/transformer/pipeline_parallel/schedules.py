@@ -85,7 +85,8 @@ def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                   inputs: jnp.ndarray,
                   targets: Any,
                   axis_name: str = PIPE_AXIS,
-                  remat: bool = True) -> jnp.ndarray:
+                  remat: bool = True,
+                  with_aux: bool = False):
     """Mean loss of the ring pipeline; differentiate for the full schedule.
 
     Must run inside shard_map with ``axis_name`` bound.  Arguments:
@@ -107,6 +108,15 @@ def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     drain the pipe.  Bubble ticks compute on don't-care data and are masked
     out of the loss — the standard SPMD-pipeline trade (S−1 wasted
     stage-steps) that keeps the whole schedule one fused collective program.
+
+    ``with_aux=True`` (the EP x PP composition): ``stage_fn`` returns
+    ``(y, aux_scalar)`` — a per-(stage, microbatch) auxiliary scalar (the
+    Switch load-balancing loss summed over this stage's MoE layers) — and
+    the schedule returns ``(mean loss, aux_sum)``: the psum over stages
+    of every VALID tick's aux (bubble ticks masked exactly like the
+    loss), divided by M.  The caller normalizes by its layer count and
+    weights it into the objective; gradients flow through the aux path
+    because the accumulation lives inside the differentiated program.
     """
     if not isinstance(inputs, jnp.ndarray):
         raise TypeError("spmd_pipeline inputs must be a single [M, ...] "
@@ -125,36 +135,52 @@ def spmd_pipeline(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             lambda s: lax.dynamic_index_in_dim(
                 s, jnp.clip(t, 0, M - 1), keepdims=False), stack)
 
-    def compute(recv, loss_acc, t):
+    def compute(recv, loss_acc, aux_acc, t):
         """One tick given the activation received from upstream."""
         # First stage injects a fresh microbatch; others consume the ring.
         x = jnp.where(idx == 0, pick(inputs, t), recv)
-        y = body(stage_params, x)
+        if with_aux:
+            y, aux_t = body(stage_params, x)
+            # Stage s at tick t holds microbatch t - s; bubble ticks
+            # (outside [0, M)) computed don't-care routing — mask them.
+            mine = t - idx
+            aux_acc = aux_acc + jnp.where((mine >= 0) & (mine < M),
+                                          aux_t.astype(jnp.float32), 0.0)
+        else:
+            y = body(stage_params, x)
         # Last stage scores microbatch t-(S-1) when it is real.
         mb = t - (S - 1)
         loss_t = last_stage_fn(y, pick(targets, mb))
         use = (idx == S - 1) & (mb >= 0)
-        return y, loss_acc + jnp.where(use, loss_t, 0.0)
+        return y, loss_acc + jnp.where(use, loss_t, 0.0), aux_acc
 
     # Tick 0 needs no upstream receive (the pipe is empty); the remaining
     # ticks rotate at entry via p2p send_forward, so no final rotation is
     # computed only to be discarded.
     x0 = pick(inputs, jnp.asarray(0))
     out_sd = jax.eval_shape(stage_fn, stage_params, x0)
+    if with_aux:
+        out_sd = out_sd[0]
     empty = lax.pcast(jnp.zeros(out_sd.shape, out_sd.dtype), axis_name,
                       to="varying")
     loss0 = lax.pcast(jnp.zeros((), jnp.float32), axis_name, to="varying")
-    y, loss_acc = compute(empty, loss0, jnp.asarray(0))
+    aux0 = lax.pcast(jnp.zeros((), jnp.float32), axis_name, to="varying")
+    y, loss_acc, aux_acc = compute(empty, loss0, aux0, jnp.asarray(0))
 
     def tick(carry, t):
-        y, loss_acc = carry
-        y, loss_acc = compute(send_forward(y, axis_name), loss_acc, t)
-        return (y, loss_acc), None
+        y, loss_acc, aux_acc = carry
+        y, loss_acc, aux_acc = compute(send_forward(y, axis_name),
+                                       loss_acc, aux_acc, t)
+        return (y, loss_acc, aux_acc), None
 
-    (_, loss_sum), _ = lax.scan(tick, (y, loss_acc), jnp.arange(1, T))
+    (_, loss_sum, aux_sum), _ = lax.scan(tick, (y, loss_acc, aux_acc),
+                                         jnp.arange(1, T))
     # Only the last stage accumulated anything; psum makes the mean loss a
     # cross-stage invariant (and its transpose routes the cotangent there).
-    return lax.psum(loss_sum, axis_name) / M
+    loss = lax.psum(loss_sum, axis_name) / M
+    if with_aux:
+        return loss, lax.psum(aux_sum, axis_name) / M
+    return loss
 
 
 # ---------------------------------------------------------------------------

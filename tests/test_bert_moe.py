@@ -496,3 +496,121 @@ def test_train_py_cli_moe_cp_tp(devices8):
     finally:
         ops_config.set_force_xla(False)
         parallel_state.set_mesh(None)
+
+
+# ---------------------------------------------------------------------------
+# EP x PP (round 5): switch-MoE experts INSIDE the ring pipeline schedule —
+# expert stacks shard [layers->pipe, experts->data], the per-(stage,
+# microbatch) aux loss rides the schedule carry (spmd_pipeline with_aux).
+# ---------------------------------------------------------------------------
+
+def test_moe_pp_matches_blocked_dense_golden(devices8):
+    """10 lockstep EP x PP steps on a (pipe=2, data=4) mesh == an
+    INDEPENDENT blocked-dense golden (no schedule code shared): the dense
+    MoE model applied per (data-shard, microbatch) row block — the
+    per-device routing contract — with CE globally normalized and the aux
+    term the mean over blocks of aux_total/L.  Independence matters: a
+    bug in the schedule's aux normalization would cancel in a golden
+    built from the same factory."""
+    from apex_example_tpu.engine import TrainState, _wrap_optimizer
+    from apex_example_tpu.models.gpt import gpt_tiny
+    from apex_example_tpu.transformer.bert_pipeline import (
+        bert_pp_state_shardings, make_bert_pp_train_step, pack_params,
+        unpack_params)
+
+    B, L, M, DP = 8, 16, 2, 4
+    mesh = Mesh(np.asarray(devices8).reshape(2, DP), ("pipe", "data"))
+    policy, scaler = amp.initialize("O0")
+    ep_model = gpt_tiny(moe_experts=4, moe_axis_name="data")
+    dense = gpt_tiny(moe_experts=4, moe_axis_name="expert")  # dense ref
+    V = dense.vocab_size
+    opt = lambda: FusedSGD(lr=0.05, momentum=0.9)
+
+    def batch(i):
+        return _lm_batch(i, V, batch=B, seq=L)
+
+    state0 = create_train_state(jax.random.PRNGKey(0), dense, opt(),
+                                batch(0)[0][:1], policy, scaler)
+
+    # ---- independent golden: dense model per row block (B blocks of 1
+    # row: data shard d owns rows [2d, 2d+1], microbatch m takes row m of
+    # the shard => block index 2d+m runs row 2d+m).
+    gopt = _wrap_optimizer(opt())
+
+    def gold_loss(params, b):
+        x, y = b
+        num = jnp.zeros((), jnp.float32)
+        aux_sum = jnp.zeros((), jnp.float32)
+        for r in range(B):
+            logits, aux = dense.apply({"params": params}, x[r:r + 1],
+                                      train=True)
+            ce = softmax_cross_entropy(logits, y[r:r + 1])
+            num = num + ce.sum()
+            aux_sum = aux_sum + aux           # model returns aux_total/L
+        return num / (B * L) + AUX_W * aux_sum / B
+
+    @jax.jit
+    def gold_step(state, b):
+        loss, grads = jax.value_and_grad(gold_loss)(state.params, b)
+        new_p, new_o = gopt.apply(grads, state.opt_state, state.params)
+        return TrainState(step=state.step + 1, params=new_p,
+                          batch_stats=state.batch_stats, opt_state=new_o,
+                          scaler=state.scaler), {"loss": loss}
+
+    state_g = state0
+
+    # ---- the EP x PP step under test
+    eopt = opt()
+    packed = pack_params(state0.params, dense.num_layers)
+    state_e = TrainState(step=jnp.zeros((), jnp.int32), params=packed,
+                         batch_stats={}, opt_state=eopt.init(packed),
+                         scaler=state0.scaler)
+    state_e = jax.device_put(
+        state_e, bert_pp_state_shardings(mesh, state_e, eopt,
+                                         model=ep_model))
+    step_e = make_bert_pp_train_step(mesh, ep_model, eopt, policy,
+                                     microbatches=M, donate=False,
+                                     moe_aux_weight=AUX_W)
+
+    for i in range(10):
+        b = batch(i)
+        state_g, m_g = gold_step(state_g, b)
+        state_e, m_e = step_e(state_e, b)
+        np.testing.assert_allclose(float(m_g["loss"]), float(m_e["loss"]),
+                                   rtol=3e-5 * (1 + i / 3))
+    un = unpack_params(state_e.params, dense.num_layers)
+    key = lambda kv: str(kv[0])
+    for (ka, a), (kb, b2) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(state_g.params),
+                   key=key),
+            sorted(jax.tree_util.tree_leaves_with_path(un), key=key)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=1e-3, atol=1e-5, err_msg=str(ka))
+    # expert stacks jointly sharded [layers->pipe, experts->data]
+    w_in = state_e.params["layers"]["moe"]["w_in"]
+    assert w_in.addressable_shards[0].data.shape[0] == w_in.shape[0] // 2
+    assert w_in.addressable_shards[0].data.shape[1] == w_in.shape[1] // DP
+
+
+def test_train_py_cli_moe_pp(devices8):
+    """EP x PP from the CLI (+ the rejection bounds)."""
+    import train as train_mod
+    from apex_example_tpu.transformer import parallel_state
+    base = ["--batch-size", "8", "--seq-len", "16", "--epochs", "1",
+            "--steps-per-epoch", "2", "--opt", "adam", "--opt-level", "O0",
+            "--print-freq", "1"]
+    try:
+        assert train_mod.main(
+            ["--arch", "gpt_tiny", "--moe-experts", "4",
+             "--pipeline-parallel", "2", "--microbatches", "2"]
+            + base) == 0
+    finally:
+        parallel_state.set_mesh(None)
+    with pytest.raises(SystemExit):      # 1f1b has no aux channel
+        train_mod.main(["--arch", "gpt_tiny", "--moe-experts", "4",
+                        "--pipeline-parallel", "2", "--microbatches", "2",
+                        "--pipeline-schedule", "1f1b"] + base)
+    with pytest.raises(SystemExit):      # no MoE x PP x TP triple
+        train_mod.main(["--arch", "gpt_tiny", "--moe-experts", "4",
+                        "--pipeline-parallel", "2", "--microbatches", "2",
+                        "--tensor-parallel", "2"] + base)

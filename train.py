@@ -545,14 +545,36 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--moe-experts is wired for the BERT/GPT "
                              "archs (switch-MoE replaces the "
                              "transformer FFN)")
-        if pp > 1 or args.sequence_parallel or args.zero:
+        if args.sequence_parallel or args.zero:
             raise SystemExit("--moe-experts does not compose with "
-                             "--sequence/pipeline-parallel or "
-                             "--zero yet (the all_to_all dispatch assumes "
-                             "every local token routes over the full "
-                             "expert set on the data axis); "
-                             "--tensor-parallel and --context-parallel "
-                             "compose")
+                             "--sequence-parallel or --zero yet; "
+                             "--tensor-parallel, --context-parallel and "
+                             "--pipeline-parallel compose")
+        if pp > 1:
+            # EP x PP (round 5): experts inside the ring schedule's stage
+            # cells, aux loss riding the schedule carry.  Bounds:
+            if args.pipeline_schedule != "ring":
+                raise SystemExit("--moe-experts composes with "
+                                 "--pipeline-schedule ring only (the 1F1B "
+                                 "value program has no aux-loss channel)")
+            if tp > 1 or cp > 1:
+                raise SystemExit("--moe-experts --pipeline-parallel "
+                                 "composes pairwise only (no MoE x PP x "
+                                 "TP/CP triple yet)")
+            if args.eval:
+                raise SystemExit("--eval under --moe-experts "
+                                 "--pipeline-parallel is not wired (the "
+                                 "dense unpacked eval would route with a "
+                                 "different global capacity)")
+            ep_pp = len(pick_devices(args)) // pp
+            if ep_pp < 1:
+                raise SystemExit(f"--pipeline-parallel {pp} exceeds the "
+                                 f"{len(pick_devices(args))} devices")
+            if args.moe_experts % ep_pp:
+                raise SystemExit(f"--moe-experts {args.moe_experts} must "
+                                 f"be a multiple of the data-axis size "
+                                 f"{ep_pp} (= devices / "
+                                 f"--pipeline-parallel)")
         # EP x CP, EP x TP and the EP x CP x TP triple all compose: the
         # expert all_to_all (manual 'data'), the KV ring (manual
         # 'context') and the GSPMD TP collectives (automatic 'model') are
@@ -828,7 +850,8 @@ def _lm_main_impl(args, policy, scaler):
         step_fn = make_bert_pp_train_step(mesh, model_pp, optimizer, policy,
                                           microbatches=args.microbatches,
                                           schedule=pp_sched,
-                                          num_chunks=pp_chunks)
+                                          num_chunks=pp_chunks,
+                                          moe_aux_weight=args.moe_aux_weight)
         mems = None
         print(f"PP over {pp} stages ({pp_sched}"
               + (f", V={pp_chunks}" if pp_chunks > 1 else "")
