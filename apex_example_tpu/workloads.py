@@ -261,12 +261,13 @@ def make_bert_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     per_shard = make_train_step(model, optimizer, policy, axis_name=None,
                                 loss_fn=cp_mlm_loss, compute_accuracy=False,
                                 grad_accum=grad_accum)
+    st_spec = _cp_state_spec(optimizer)
     sharded = _shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(), (P(DATA_AXIS, CONTEXT_AXIS),
-                        (P(DATA_AXIS, CONTEXT_AXIS),
-                         P(DATA_AXIS, CONTEXT_AXIS)))),
-        out_specs=(P(), P()), **_cp_axis_names(mesh, model))
+        in_specs=(st_spec, (P(DATA_AXIS, CONTEXT_AXIS),
+                            (P(DATA_AXIS, CONTEXT_AXIS),
+                             P(DATA_AXIS, CONTEXT_AXIS)))),
+        out_specs=(st_spec, P()), **_cp_axis_names(mesh, model))
     jkw = {}
     if state_shardings is not None:
         # CP×TP: pin the returned state to its model-axis placement
@@ -302,6 +303,22 @@ def _cp_axis_names(mesh: Mesh, model) -> dict:
     from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
     return partial_manual_axis_names(
         mesh, model, frozenset({DATA_AXIS, CONTEXT_AXIS}), "CP x TP")
+
+
+def _cp_state_spec(optimizer):
+    """shard_map TrainState spec for the CP steps: everything replicated
+    EXCEPT a ZeRO optimizer's state (ZeRO x CP, round 5) — the flat
+    (mu, nu) buffers shard over 'data' while params stay replicated over
+    both axes.  The optimizer's reduce/slice/all-gather collectives run
+    over 'data' inside the same shard_map; grads arrive implicitly
+    psum-ed over BOTH axes (replicated params), so the update is
+    context-invariant by construction."""
+    from apex_example_tpu.engine import TrainState
+    from apex_example_tpu.optim.distributed import DistributedFusedAdam
+    if isinstance(optimizer, DistributedFusedAdam):
+        return TrainState(step=P(), params=P(), batch_stats=P(),
+                          opt_state=optimizer.state_spec(), scaler=P())
+    return P()
 
 
 def make_bert_cp_eval_step(mesh: Mesh, model):
@@ -396,9 +413,10 @@ def make_gpt_cp_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                                 loss_fn=cp_lm_loss, compute_accuracy=False,
                                 grad_accum=grad_accum)
     spec = P(DATA_AXIS, CONTEXT_AXIS)
+    st_spec = _cp_state_spec(optimizer)
     sharded = _shard_map(per_shard, mesh=mesh,
-                         in_specs=(P(), (spec, spec)),
-                         out_specs=(P(), P()),
+                         in_specs=(st_spec, (spec, spec)),
+                         out_specs=(st_spec, P()),
                          **_cp_axis_names(mesh, model))
     sharded = _cp_layout_wrap(sharded, mesh, model, mode)
     jkw = {}

@@ -589,9 +589,17 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--context-parallel is wired for the BERT/GPT "
                              "archs (transformer_xl's long-context story "
                              "is its segment recurrence)")
-        if args.zero:
-            raise SystemExit("--context-parallel does not compose with "
-                             "--zero yet")
+        if args.zero and tp > 1:
+            raise SystemExit("--zero --context-parallel --tensor-parallel "
+                             "(the ZeRO x CP x TP triple) is not wired "
+                             "yet; drop one")
+        if args.zero and pp > 1:
+            raise SystemExit("--zero does not compose with "
+                             "--pipeline-parallel")
+        # --zero + --context-parallel composes (round 5): the flat
+        # (mu, nu) buffers shard over 'data' inside the CP shard_map
+        # (workloads._cp_state_spec); params stay replicated over both
+        # axes, so the sharded update is context-invariant.
         if pp > 1:
             # CP x PP composes (round 5): the KV ring rides inside the
             # schedule's stage cells on a third manual axis — and the
@@ -760,9 +768,11 @@ def _lm_main_impl(args, policy, scaler):
     elif tp > 1:
         mkw["tensor_parallel"] = True
     model = builder(**mkw)
-    # Under TP the data axis only gets n_dev/tp devices — that is the axis
-    # ZeRO shards over, so it is the size the >=2 check applies to.
-    optimizer = build_zero_optimizer(args, n_dev // tp, gspmd=tp > 1) \
+    # Under TP/CP the data axis only gets n_dev/(tp*cp) devices — that is
+    # the axis ZeRO shards over, so it is the size the >=2 check applies
+    # to (and DistributedFusedAdam's static world).
+    optimizer = build_zero_optimizer(args, n_dev // (tp * cp),
+                                     gspmd=tp > 1) \
         if args.zero else build_optimizer(args)
 
     V = model.vocab_size
