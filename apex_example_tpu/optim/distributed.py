@@ -93,10 +93,18 @@ class DistributedFusedAdam:
     def __init__(self, lr: Schedule = 1e-3, betas=(0.9, 0.999),
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  adam_w_mode: bool = True, *, world: int,
-                 axis_name: str = "data"):
+                 axis_name: str = "data",
+                 grads_global_mean: bool = False):
         self.lr, self.betas, self.eps = lr, betas, eps
         self.weight_decay, self.adam_w_mode = weight_decay, adam_w_mode
         self.world, self.axis_name = world, axis_name
+        # Reduction contract: False (the DDP engine path) = incoming
+        # grads are per-shard LOCAL means whose implicit psum sums to
+        # world x the global mean — apply() divides by world.  True (the
+        # CP path, whose losses are psum-normalized GLOBALLY) = grads
+        # arrive as the true global mean already — dividing again would
+        # hand Adam g/world and silently inflate the effective epsilon.
+        self.grads_global_mean = grads_global_mean
 
     def init(self, params) -> ZeroAdamState:
         padded = _padded_size(_flat_size(params), self.world)
@@ -129,7 +137,9 @@ class DistributedFusedAdam:
         shard = padded // world
         idx = lax.axis_index(self.axis_name)
 
-        flat_g = _flatten(grads, padded) / world     # mean-reduction contract
+        flat_g = _flatten(grads, padded)
+        if not self.grads_global_mean:
+            flat_g = flat_g / world                  # mean-reduction contract
         vma = getattr(jax.typeof(flat_g), "vma", None)
         if vma is None:
             # Without vma typing (pre-vma JAX / check_vma=False) we cannot
@@ -141,6 +151,12 @@ class DistributedFusedAdam:
                 "gradient-reduction state is visible; got an aval without "
                 "vma typing")
         if self.axis_name in vma:
+            if self.grads_global_mean:
+                raise RuntimeError(
+                    "grads_global_mean=True expects implicitly psum-ed "
+                    "(shard-invariant) grads — the CP-loss contract; got "
+                    "shard-varying grads, whose reduce-scatter would need "
+                    "the /world mean the flag disables")
             # Raw per-replica grads: the reduction IS the reduce-scatter.
             g_shard = lax.psum_scatter(flat_g, self.axis_name,
                                        scatter_dimension=0, tiled=True)

@@ -437,7 +437,13 @@ def test_zero_cp_matches_cp_adam(devices8):
     step_a = make_gpt_cp_train_step(mesh, cp_model, FusedAdam(**hp),
                                     policy, donate=False)
 
-    zopt = DistributedFusedAdam(**hp, world=2, axis_name="data")
+    # grads_global_mean: the CP losses psum-normalize GLOBALLY, so the
+    # implicitly psum-ed grads arrive as the true global mean — without
+    # the flag the optimizer would divide by world again (Adam's scale
+    # invariance would hide it from the loss/param comparison; the mu
+    # norm check below would not).
+    zopt = DistributedFusedAdam(**hp, world=2, axis_name="data",
+                                grads_global_mean=True)
     state_z = create_train_state(jax.random.PRNGKey(0), dense, zopt,
                                  sample, policy, scaler)
     state_z = state_z.replace(params=state_a.params)
@@ -456,6 +462,14 @@ def test_zero_cp_matches_cp_adam(devices8):
                         jax.tree_util.tree_leaves(state_z.params))])
     assert float((diffs < 5e-3).mean()) > 0.999
     assert float(diffs.max()) < 5 * 1e-3 * 3
+    # The first-moment buffers must agree in NORM with the reference
+    # adam's tree (Adam's update is scale-invariant, so a silently
+    # rescaled gradient would pass the param comparison but not this).
+    mu_ref = np.sqrt(sum(
+        float(jnp.sum(m.astype(jnp.float32) ** 2))
+        for m in jax.tree_util.tree_leaves(state_a.opt_state.mu)))
+    mu_z = np.sqrt(float(jnp.sum(state_z.opt_state.mu ** 2)))
+    np.testing.assert_allclose(mu_ref, mu_z, rtol=1e-3)
     # 1/N state: mu sharded over 'data', replicated over 'context'
     mu = state_z.opt_state.mu
     assert mu.addressable_shards[0].data.size * 2 == mu.size

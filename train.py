@@ -268,7 +268,8 @@ def pick_devices(args):
         else jax.devices()
 
 
-def build_zero_optimizer(args, n_dev, gspmd=False):
+def build_zero_optimizer(args, n_dev, gspmd=False,
+                         global_mean_grads=False):
     """Optimizer for the --zero paths.
 
     shard_map path (tp == 1): DistributedFusedAdam, the explicit flat-buffer
@@ -295,7 +296,12 @@ def build_zero_optimizer(args, n_dev, gspmd=False):
         return FusedAdam(lr=build_lr(args), weight_decay=args.weight_decay)
     return DistributedFusedAdam(lr=build_lr(args),
                                 weight_decay=args.weight_decay,
-                                world=n_dev)
+                                world=n_dev,
+                                # the CP losses are psum-normalized
+                                # GLOBALLY, so their implicitly psum-ed
+                                # grads are already the true global mean
+                                # (optim/distributed.py ctor docstring)
+                                grads_global_mean=global_mean_grads)
 
 
 def main(argv=None):
@@ -593,9 +599,7 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--zero --context-parallel --tensor-parallel "
                              "(the ZeRO x CP x TP triple) is not wired "
                              "yet; drop one")
-        if args.zero and pp > 1:
-            raise SystemExit("--zero does not compose with "
-                             "--pipeline-parallel")
+        # (--zero + --pipeline-parallel is rejected by the pp block below)
         # --zero + --context-parallel composes (round 5): the flat
         # (mu, nu) buffers shard over 'data' inside the CP shard_map
         # (workloads._cp_state_spec); params stay replicated over both
@@ -772,7 +776,8 @@ def _lm_main_impl(args, policy, scaler):
     # the axis ZeRO shards over, so it is the size the >=2 check applies
     # to (and DistributedFusedAdam's static world).
     optimizer = build_zero_optimizer(args, n_dev // (tp * cp),
-                                     gspmd=tp > 1) \
+                                     gspmd=tp > 1,
+                                     global_mean_grads=cp > 1) \
         if args.zero else build_optimizer(args)
 
     V = model.vocab_size
