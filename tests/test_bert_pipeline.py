@@ -596,11 +596,10 @@ def test_cp_pp_zigzag_rejected():
                  "--seq-len", "16", "--opt", "adam"]
     with pytest.raises(SystemExit):
         train_mod.main(mesh_args)
-    with pytest.raises(SystemExit):      # the CP x PP x TP triple
+    with pytest.raises(SystemExit):      # ZeRO does not ride PP
         train_mod.main(["--arch", "gpt_tiny", "--pipeline-parallel", "2",
-                        "--context-parallel", "2", "--tensor-parallel",
-                        "2", "--microbatches", "2", "--batch-size", "8",
-                        "--seq-len", "16", "--opt", "adam"])
+                        "--zero", "--microbatches", "2", "--batch-size",
+                        "8", "--seq-len", "16", "--opt", "adam"])
 
 
 def test_train_py_cli_cp_pp(devices8):
