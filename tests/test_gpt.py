@@ -355,9 +355,11 @@ def test_train_py_gpt_rejections():
                         "--cp-mode", "zigzag", "--batch-size", "16",
                         "--seq-len", "16", "--epochs", "1",
                         "--steps-per-epoch", "1"])
-    with pytest.raises(SystemExit):   # MoE does not ride the pipeline
-        train_mod.main(base + ["--moe-experts", "4",
-                               "--pipeline-parallel", "2"])
+    with pytest.raises(SystemExit):   # MoE x PP is ring-schedule only and
+        train_mod.main(base + ["--moe-experts", "4",    # pairwise (no TP)
+                               "--pipeline-parallel", "2",
+                               "--tensor-parallel", "2",
+                               "--microbatches", "2"])
     with pytest.raises(SystemExit):   # TXL's recurrence spans all layers
         train_mod.main(["--arch", "transformer_xl_tiny",
                         "--pipeline-parallel", "2", "--batch-size", "16",
