@@ -60,7 +60,7 @@ AMP's dynamic scaler (Megatron uses its own grad scaler).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -282,14 +282,129 @@ def bert_pp_state_shardings(mesh: Mesh, state: TrainState, optimizer,
     }
     abs_params = tmap(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                       state.params)
+    # PipelineZeroAdam's flat [S, padded] buffers do not mirror the params
+    # tree — they carry their own spec (P(pipe, data)).
+    opt_specs = optimizer.state_spec() \
+        if isinstance(optimizer, PipelineZeroAdam) \
+        else _opt_state_specs(optimizer, abs_params, params_specs)
     spec_state = TrainState(
         step=P(), params=params_specs,
         batch_stats=tmap(lambda _: P(), state.batch_stats),
-        opt_state=_opt_state_specs(optimizer, abs_params, params_specs),
+        opt_state=opt_specs,
         scaler=tmap(lambda _: P(), state.scaler))
     from jax.sharding import NamedSharding
     return tmap(lambda s: NamedSharding(mesh, s), spec_state,
                 is_leaf=lambda v: isinstance(v, P))
+
+
+class PipelineZeroState(NamedTuple):
+    """ZeRO x PP optimizer state: one flat fp32 buffer pair for the
+    (pipe-invariant, replicated-compute) embedding/head params, sharded
+    P('data'), and one per-stage pair for the layer blocks, sharded
+    P('pipe', 'data')."""
+    step: jnp.ndarray
+    rest_mu: jnp.ndarray
+    rest_nu: jnp.ndarray
+    layer_mu: jnp.ndarray
+    layer_nu: jnp.ndarray
+
+
+class PipelineZeroAdam:
+    """ZeRO-1 Adam for the packed ``{'rest', 'layers'}`` pipeline tree —
+    the ZeRO x PP pairing (the reference's distributed_fused_adam is run
+    with Megatron PP in practice; DeepSpeed's "3D" stacks the same way).
+
+    Each pipe stage flattens ITS local packed slice (rest + its layer
+    block) into one fp32 buffer whose (m, v) shard over 'data' via the
+    inner :class:`DistributedFusedAdam` — per-device optimizer state is
+    1/data-axis of the STAGE-local params.  (The 'rest' state is
+    per-stage duplicated, mirroring the schedule's replicated-compute
+    embedding/head: still 1/dp of the non-ZeRO form per device.)
+
+    ``init`` runs OUTSIDE the mesh on the global packed tree and returns
+    ``[S, padded_local]`` buffers; ``state_spec`` shards them
+    P('pipe', 'data'); ``apply`` runs INSIDE the shard_map on the local
+    slice (the inner optimizer sees exactly its per-stage tree, whose
+    flat size matches init's arithmetic because every ``layers`` leaf
+    splits its stacked dim 0 S-ways).  The inner optimizer must carry
+    ``grads_global_mean=True``: the PP losses are psum-normalized
+    globally, so grads arrive as the true global mean (see
+    optim/distributed.py).
+    """
+
+    def __init__(self, zadam, stages: int):
+        from apex_example_tpu.optim.distributed import DistributedFusedAdam
+        if not isinstance(zadam, DistributedFusedAdam):
+            raise TypeError(f"PipelineZeroAdam wraps DistributedFusedAdam, "
+                            f"got {type(zadam).__name__}")
+        if not zadam.grads_global_mean:
+            raise ValueError(
+                "PipelineZeroAdam needs DistributedFusedAdam("
+                "grads_global_mean=True): the PP losses are globally "
+                "psum-normalized, so dividing by world again would hand "
+                "Adam g/world")
+        self.z = zadam
+        self.stages = stages
+
+    def _padded_sizes(self, packed):
+        from apex_example_tpu.optim.distributed import (_flat_size,
+                                                        _padded_size)
+        S = self.stages
+        rest = _padded_size(_flat_size(packed["rest"]), self.z.world)
+        layers = _padded_size(
+            sum(int(l.size) // S
+                for l in jax.tree_util.tree_leaves(packed["layers"])),
+            self.z.world)
+        return rest, layers
+
+    def init(self, packed):
+        if not (isinstance(packed, dict) and "rest" in packed):
+            # The harness bootstraps a dense state first (its opt state is
+            # discarded and rebuilt from the packed tree) — mirror
+            # PipelineFusedLAMB's any-tree tolerance with a throwaway
+            # inner-form state.
+            return self.z.init(packed)
+        pr, pl = self._padded_sizes(packed)
+        return PipelineZeroState(
+            step=jnp.zeros((), jnp.int32),
+            rest_mu=jnp.zeros((pr,), jnp.float32),
+            rest_nu=jnp.zeros((pr,), jnp.float32),
+            layer_mu=jnp.zeros((self.stages, pl), jnp.float32),
+            layer_nu=jnp.zeros((self.stages, pl), jnp.float32))
+
+    def state_spec(self):
+        d = self.z.axis_name
+        return PipelineZeroState(step=P(), rest_mu=P(d), rest_nu=P(d),
+                                 layer_mu=P(PIPE_AXIS, d),
+                                 layer_nu=P(PIPE_AXIS, d))
+
+    def apply(self, grads, state, params):
+        from apex_example_tpu.optim.distributed import ZeroAdamState
+        # Two independent flat buffers so the vma typing stays exact:
+        # 'rest' (pipe-INVARIANT inputs -> invariant outputs, no extra
+        # collective) and this stage's layer block (pipe-varying, the
+        # [S, padded] buffers arrive as this (stage, data) cell's
+        # [1, padded/dp] slice; the inner contract is the bare local
+        # shard of a P(data) buffer).
+        new_rest, st_r = self.z.apply(
+            grads["rest"],
+            ZeroAdamState(step=state.step, mu=state.rest_mu,
+                          nu=state.rest_nu),
+            params["rest"])
+        new_layers, st_l = self.z.apply(
+            grads["layers"],
+            ZeroAdamState(step=state.step, mu=state.layer_mu[0],
+                          nu=state.layer_nu[0]),
+            params["layers"])
+        # One step counter: both inner applies take the same skip decision
+        # whenever the engine's global finite flag lets the update stand
+        # (a partially-finite step is rolled back wholesale by the
+        # engine's select_tree), so st_r.step is THE step.
+        return ({"rest": new_rest, "layers": new_layers},
+                PipelineZeroState(step=st_r.step, rest_mu=st_r.mu,
+                                  rest_nu=st_r.nu,
+                                  layer_mu=st_l.mu[None],
+                                  layer_nu=st_l.nu[None]))
 
 
 class PipelineFusedLAMB:
@@ -699,6 +814,15 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
     # (engine._opt_state_specs), so the same {'rest': P(), 'layers':
     # P('pipe')} prefix applies inside each of its (mu, nu, ...) fields.
     from apex_example_tpu.engine import _opt_state_specs
+    if isinstance(optimizer, PipelineZeroAdam):
+        # ZeRO x PP bounds: the flat-buffer slice assumes replicated-over-
+        # data, non-model-sharded stage params.
+        if tp > 1 or cp > 1 or moe:
+            raise ValueError("PipelineZeroAdam (ZeRO x PP) composes "
+                             "pairwise only — no TP/CP/MoE triple yet")
+        if optimizer.stages != S:
+            raise ValueError(f"PipelineZeroAdam(stages={optimizer.stages}) "
+                             f"does not match the mesh's pipe size {S}")
     if moe_ep:
         # Per-leaf specs (the prefix trick cannot single out the expert
         # stacks): abstract-init the model, pack, and mark expert leaves
@@ -714,6 +838,9 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
                            lambda _: P(), abs_packed["rest"]),
                        "layers": _moe_pp_layers_spec(abs_packed["layers"])}
         opt_spec = _opt_state_specs(optimizer, abs_packed, params_spec)
+    elif isinstance(optimizer, PipelineZeroAdam):
+        params_spec = {"rest": P(), "layers": P(PIPE_AXIS)}
+        opt_spec = optimizer.state_spec()     # flat [S, padded] buffers
     else:
         params_spec = {"rest": P(), "layers": P(PIPE_AXIS)}
         probe = {"rest": jax.ShapeDtypeStruct((), jnp.float32),

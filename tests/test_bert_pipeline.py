@@ -422,8 +422,9 @@ def test_train_py_pp_rejections():
         train_mod.main(["--arch", "transformer_xl_tiny",
                         "--pipeline-parallel", "2"])
     with pytest.raises(SystemExit):
+        # (ZeRO x PP composes since round 5; ZeRO stays adam-only)
         train_mod.main(["--arch", "bert_tiny", "--pipeline-parallel", "2",
-                        "--zero", "--opt", "adam"])
+                        "--zero", "--opt", "lamb"])
 
 
 @pytest.mark.parametrize("sched,chunks,layers", [("1f1b", 1, 2),
@@ -596,9 +597,10 @@ def test_cp_pp_zigzag_rejected():
                  "--seq-len", "16", "--opt", "adam"]
     with pytest.raises(SystemExit):
         train_mod.main(mesh_args)
-    with pytest.raises(SystemExit):      # ZeRO does not ride PP
+    with pytest.raises(SystemExit):      # no ZeRO x PP x TP triple
         train_mod.main(["--arch", "gpt_tiny", "--pipeline-parallel", "2",
-                        "--zero", "--microbatches", "2", "--batch-size",
+                        "--zero", "--tensor-parallel", "2",
+                        "--microbatches", "2", "--batch-size",
                         "8", "--seq-len", "16", "--opt", "adam"])
 
 
@@ -716,4 +718,92 @@ def test_train_py_cli_cp_pp_tp(devices8):
         assert train_mod.main(argv) == 0
     finally:
         ops_config.set_force_xla(False)
+        parallel_state.set_mesh(None)
+
+
+def test_zero_pp_matches_pp_adam(devices8):
+    """ZeRO x PP (round 5): PipelineZeroAdam — stage-local flat (m, v)
+    buffers sharded over 'data' within the pipe sharding — follows the
+    plain-FusedAdam PP trajectory (Adam tolerances), and the buffers'
+    LAYOUT and SCALE match the adam tree exactly (rest buffer ==
+    flatten(rest mu); stage-s layer buffer == flatten(stage-s layer mu)
+    — the check Adam's scale invariance cannot fool)."""
+    from apex_example_tpu.optim.distributed import (DistributedFusedAdam,
+                                                    _flatten)
+    from apex_example_tpu.transformer.bert_pipeline import PipelineZeroAdam
+
+    mesh = Mesh(np.asarray(devices8).reshape(2, 4), ("pipe", "data"))
+    policy, scaler = amp.initialize("O0")
+    model = bert_tiny()
+    V = model.vocab_size
+    hp = dict(lr=1e-3, weight_decay=1e-2)
+
+    state0 = create_train_state(jax.random.PRNGKey(0), model,
+                                FusedAdam(**hp), _batch(0, V)[0][:1],
+                                policy, scaler)
+    packed = pack_params(state0.params, model.num_layers)
+
+    def mk(opt):
+        st = TrainState(step=jnp.zeros((), jnp.int32), params=packed,
+                        batch_stats={}, opt_state=opt.init(packed),
+                        scaler=state0.scaler)
+        return jax.device_put(st,
+                              bert_pp_state_shardings(mesh, st, opt))
+
+    aopt = FusedAdam(**hp)
+    state_a = mk(aopt)
+    step_a = make_bert_pp_train_step(mesh, model, aopt, policy,
+                                     microbatches=2, donate=False)
+    zopt = PipelineZeroAdam(
+        DistributedFusedAdam(**hp, world=4, grads_global_mean=True),
+        stages=2)
+    state_z = mk(zopt)
+    step_z = make_bert_pp_train_step(mesh, model, zopt, policy,
+                                     microbatches=2, donate=False)
+
+    for i in range(5):
+        b = _batch(i, V)
+        state_a, m_a = step_a(state_a, b)
+        state_z, m_z = step_z(state_z, b)
+        np.testing.assert_allclose(float(m_a["loss"]), float(m_z["loss"]),
+                                   rtol=1e-4)
+    diffs = np.concatenate([
+        np.abs(np.asarray(a) - np.asarray(b)).ravel()
+        for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                        jax.tree_util.tree_leaves(state_z.params))])
+    assert float((diffs < 5e-3).mean()) > 0.999
+
+    mu_a = state_a.opt_state.mu
+    rest_mu = np.asarray(state_z.opt_state.rest_mu)
+    np.testing.assert_allclose(
+        np.asarray(_flatten(mu_a["rest"], rest_mu.shape[0])), rest_mu,
+        rtol=2e-2, atol=2e-4)
+    lay_mu = np.asarray(state_z.opt_state.layer_mu)
+    L = model.num_layers
+    for s in range(2):
+        local = jax.tree_util.tree_map(
+            lambda x: x[s * (L // 2):(s + 1) * (L // 2)], mu_a["layers"])
+        np.testing.assert_allclose(
+            np.asarray(_flatten(local, lay_mu.shape[1])), lay_mu[s],
+            rtol=2e-2, atol=2e-4)
+    # 1/(S*dp) optimizer state per device
+    mu = state_z.opt_state.layer_mu
+    assert mu.addressable_shards[0].data.size * 8 == mu.size
+
+
+def test_train_py_cli_zero_pp(devices8):
+    """--zero --pipeline-parallel from the CLI (ring + 1f1b)."""
+    import train as train_mod
+    from apex_example_tpu.transformer import parallel_state
+    base = ["--microbatches", "2", "--batch-size", "8", "--seq-len", "16",
+            "--epochs", "1", "--steps-per-epoch", "2", "--opt", "adam",
+            "--opt-level", "O0", "--print-freq", "1"]
+    try:
+        assert train_mod.main(
+            ["--arch", "bert_tiny", "--zero", "--pipeline-parallel", "2"]
+            + base) == 0
+        assert train_mod.main(
+            ["--arch", "gpt_tiny", "--zero", "--pipeline-parallel", "2",
+             "--pipeline-schedule", "1f1b"] + base) == 0
+    finally:
         parallel_state.set_mesh(None)

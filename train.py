@@ -659,10 +659,13 @@ def _lm_main_impl(args, policy, scaler):
             raise SystemExit("--pipeline-parallel is wired for the "
                              "BERT/GPT archs (transformer_xl's recurrence "
                              "carry spans all layers every segment)")
-        if args.zero:
-            raise SystemExit("--pipeline-parallel does not compose with "
-                             "--zero (ZeRO shards optimizer state over "
-                             "data; PP already shards it over pipe)")
+        # --zero composes with --pipeline-parallel (round 5): the stage-
+        # local flat optimizer buffers shard over 'data' WITHIN the pipe
+        # sharding — PipelineZeroAdam, wired in the pp branch below.
+        if args.zero and (tp > 1 or cp > 1 or args.moe_experts):
+            raise SystemExit("--zero --pipeline-parallel composes "
+                             "pairwise only (no ZeRO x PP x TP/CP/MoE "
+                             "triple yet)")
         if args.larc:
             raise SystemExit("--larc does not compose with "
                              "--pipeline-parallel (the LARC wrapper computes "
@@ -772,12 +775,12 @@ def _lm_main_impl(args, policy, scaler):
     elif tp > 1:
         mkw["tensor_parallel"] = True
     model = builder(**mkw)
-    # Under TP/CP the data axis only gets n_dev/(tp*cp) devices — that is
-    # the axis ZeRO shards over, so it is the size the >=2 check applies
-    # to (and DistributedFusedAdam's static world).
-    optimizer = build_zero_optimizer(args, n_dev // (tp * cp),
+    # Under TP/CP/PP the data axis only gets n_dev/(tp*cp*pp) devices —
+    # that is the axis ZeRO shards over, so it is the size the >=2 check
+    # applies to (and DistributedFusedAdam's static world).
+    optimizer = build_zero_optimizer(args, n_dev // (tp * cp * pp),
                                      gspmd=tp > 1,
-                                     global_mean_grads=cp > 1) \
+                                     global_mean_grads=cp > 1 or pp > 1) \
         if args.zero else build_optimizer(args)
 
     V = model.vocab_size
@@ -826,6 +829,12 @@ def _lm_main_impl(args, policy, scaler):
             # pack carries 3 leading per-layer index dims ([S, V, per]).
             optimizer = PipelineFusedLAMB(
                 optimizer, stacked_dims=1 if pp_sched == "ring" else 3)
+        if args.zero:
+            # ZeRO x PP: stage-local flat (m, v) buffers sharded over
+            # 'data' within the pipe sharding.
+            from apex_example_tpu.transformer.bert_pipeline import (
+                PipelineZeroAdam)
+            optimizer = PipelineZeroAdam(optimizer, stages=pp)
         if tp > 1:
             # Pallas custom calls are opaque to the SPMD partitioner; the
             # model axis stays automatic inside the PP shard_map, so pin
