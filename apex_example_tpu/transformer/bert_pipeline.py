@@ -167,7 +167,17 @@ def _embed(rest, ids, model):
     pos_tbl = rest["position_embeddings"]["embedding"]
     if getattr(model, "context_parallel", False):
         from apex_example_tpu.parallel.mesh import CONTEXT_AXIS
-        pos = jnp.arange(L) + lax.axis_index(CONTEXT_AXIS) * L
+        i = lax.axis_index(CONTEXT_AXIS)
+        if getattr(model, "cp_mode", "ring") == "zigzag":
+            # zigzag layout: this shard's halves are global chunks i and
+            # 2n-1-i (the models' own CP branch algebra; the factory's
+            # zigzag_shard pre-pass reordered the tokens to match)
+            n = lax.axis_size(CONTEXT_AXIS)
+            c = L // 2
+            pos = jnp.concatenate([jnp.arange(c) + i * c,
+                                   jnp.arange(c) + (2 * n - 1 - i) * c])
+        else:
+            pos = jnp.arange(L) + i * L
         x = x + jnp.take(pos_tbl, pos, axis=0)[None].astype(dtype)
     else:
         x = x + pos_tbl[:L][None].astype(dtype)
@@ -565,10 +575,12 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         raise ValueError("context_parallel model needs a mesh with a "
                          f"nontrivial '{CONTEXT_AXIS}' axis")
     if cp > 1 and getattr(model, "cp_mode", "ring") == "zigzag":
-        raise ValueError(
-            "CP x PP runs the contiguous sequence layouts (ring/ulysses); "
-            "the zigzag reorder would need zigzag position ids inside the "
-            "schedule's embed")
+        from apex_example_tpu.models.gpt import GPTForCausalLM as _GPT
+        if not isinstance(model, _GPT):
+            raise ValueError(
+                "CP x PP zigzag is the load-balanced CAUSAL layout (gpt "
+                "archs); bidirectional BERT does uniform ring work "
+                "already")
     # EP x PP (round 5): switch-MoE FFNs inside the ring schedule's
     # stages — the expert all_to_all rides the manual 'data' axis inside
     # each tick, the per-(stage, microbatch) Switch aux loss rides the
@@ -864,4 +876,15 @@ def make_bert_pp_train_step(mesh: Mesh, model: BertForMaskedLM, optimizer,
         per_shard, mesh=mesh,
         in_specs=(state_spec, bspec),
         out_specs=(state_spec, P()), **kw)
+    if cp > 1 and getattr(model, "cp_mode", "ring") == "zigzag":
+        # zigzag x PP: reorder the (x, y) LM pair into the zigzag layout
+        # before the shard_map, so P('context') hands device i its
+        # (i, 2n-1-i) chunk pair — the same pre-pass the pure-CP GPT
+        # factory applies; _embed's zigzag position ids follow.
+        from apex_example_tpu.parallel.context_parallel import zigzag_shard
+        inner = sharded
+
+        def sharded(state, batch):
+            x, y = batch
+            return inner(state, (zigzag_shard(x, cp), zigzag_shard(y, cp)))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

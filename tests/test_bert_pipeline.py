@@ -517,6 +517,8 @@ def test_train_py_cli_tp_pp_1f1b(devices8):
 @pytest.mark.parametrize("arch,sched,mode", [("gpt", "ring", "ring"),
                                              ("gpt", "1f1b", "ring"),
                                              ("gpt", "ring", "ulysses"),
+                                             ("gpt", "ring", "zigzag"),
+                                             ("gpt", "1f1b", "zigzag"),
                                              ("bert", "ring", "ring")])
 def test_cp_pp_matches_dense(devices8, arch, sched, mode):
     """CP x PP (round 5; previously rejected): the KV ring rides the
@@ -587,11 +589,10 @@ def test_cp_pp_matches_dense(devices8, arch, sched, mode):
 
 
 def test_cp_pp_zigzag_rejected():
-    """zigzag's reorder needs zigzag position ids inside the schedule's
-    embed — rejected at the factory AND the CLI."""
+    """zigzag under PP is causal-only (BERT rejected); the general cp
+    block fires first at the CLI."""
     import train as train_mod
-    from apex_example_tpu.models.gpt import gpt_tiny
-    mesh_args = ["--arch", "gpt_tiny", "--pipeline-parallel", "2",
+    mesh_args = ["--arch", "bert_tiny", "--pipeline-parallel", "2",
                  "--context-parallel", "2", "--cp-mode", "zigzag",
                  "--microbatches", "2", "--batch-size", "8",
                  "--seq-len", "16", "--opt", "adam"]

@@ -604,17 +604,13 @@ def _lm_main_impl(args, policy, scaler):
         # (mu, nu) buffers shard over 'data' inside the CP shard_map
         # (workloads._cp_state_spec); params stay replicated over both
         # axes, so the sharded update is context-invariant.
-        if pp > 1:
-            # CP x PP composes (round 5): the KV ring rides inside the
-            # schedule's stage cells on a third manual axis — and the
-            # CP x PP x TP TRIPLE composes too (manual pipe/data/context,
-            # automatic 'model', branch-free cells; parity-tested).
-            if args.cp_mode == "zigzag":
-                raise SystemExit("--cp-mode zigzag does not compose with "
-                                 "--pipeline-parallel (the zigzag reorder "
-                                 "would need zigzag position ids inside "
-                                 "the schedule's embed); use ring or "
-                                 "ulysses")
+        # CP x PP composes (round 5): the KV ring rides inside the
+        # schedule's stage cells on a third manual axis — and the
+        # CP x PP x TP TRIPLE composes too (manual pipe/data/context,
+        # automatic 'model', branch-free cells; parity-tested).  All
+        # three --cp-mode layouts ride the schedules (zigzag is gpt-only
+        # per the check below; the factory's zigzag_shard pre-pass +
+        # schedule-embed position ids handle the reorder).
         if args.sequence_parallel:
             raise SystemExit("--sequence-parallel shards activations along "
                              "the sequence dim --context-parallel already "
