@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +30,8 @@ from apex_example_tpu.data import CIFAR10, IMAGENET, image_batch
 from apex_example_tpu.engine import (create_train_state, make_eval_step,
                                      make_train_step)
 from apex_example_tpu.models import ARCHS
+from apex_example_tpu.obs import JsonlSink, rank_print, span
+from apex_example_tpu.obs import metrics as obs_metrics
 from apex_example_tpu.optim import FusedSGD, build_schedule
 
 EVAL_OFFSET = 1_000_000     # held-out split: indices disjoint from training
@@ -70,11 +71,11 @@ def run_one(opt_level: str, arch: str, spec: dict, steps: int,
                                channels=spec["channels"],
                                num_classes=spec["num_classes"], seed=seed,
                                label_noise=label_noise)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        state, metrics = step_fn(state, mk(i))
-    final_loss = float(metrics["loss"])
-    train_s = time.perf_counter() - t0
+    with span("accuracy_train") as sp:
+        for i in range(steps):
+            state, metrics = step_fn(state, mk(i))
+        final_loss = float(metrics["loss"])
+    train_s = sp.dur_s
 
     # Full eval loop over the held-out split (top-1 averaged across batches;
     # every batch has the same size so the plain mean is exact).
@@ -119,7 +120,12 @@ def main(argv=None):
     ap.add_argument("--num-devices", type=int, default=1,
                     help=">1: DDP cells over a data mesh of this size")
     ap.add_argument("--out", default="ACCURACY.json")
+    ap.add_argument("--metrics-jsonl", default="", metavar="PATH",
+                    help="also emit one schema-valid 'accuracy' JSONL "
+                         "record per (seed, opt level) cell as it lands "
+                         "(obs/schema.py; tools/metrics_lint.py validates)")
     args = ap.parse_args(argv)
+    sink = JsonlSink(args.metrics_jsonl) if args.metrics_jsonl else None
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
@@ -156,8 +162,12 @@ def main(argv=None):
                         label_noise=args.label_noise,
                         num_devices=args.num_devices)
             results[lvl] = r
-            print(f"seed {seed} {lvl}: top1 {r['top1']:.2f}%  eval_loss "
-                  f"{r['eval_loss']:.4f}  ({r['train_seconds']}s)")
+            rank_print(f"seed {seed} {lvl}: top1 {r['top1']:.2f}%  "
+                       f"eval_loss {r['eval_loss']:.4f}  "
+                       f"({r['train_seconds']}s)")
+            if sink is not None:
+                sink.write({"record": "accuracy",
+                            "time": obs_metrics.now(), "seed": seed, **r})
         per_seed[seed] = results
 
     l0, l1 = (levels + levels)[:2]
@@ -186,13 +196,15 @@ def main(argv=None):
         artifact["gap"] = mean(gaps)
         artifact["gap_per_seed"] = gaps
         artifact["gap_spread"] = max(gaps) - min(gaps)
-        print(f"top-1 gap ({l0} − {l1}): {artifact['gap']:+.3f}% "
-              f"(per-seed {['%+.3f' % g for g in gaps]}, spread "
-              f"{artifact['gap_spread']:.3f}; acceptance: |gap| < 0.1% at "
-              f"convergence)")
+        rank_print(f"top-1 gap ({l0} − {l1}): {artifact['gap']:+.3f}% "
+                   f"(per-seed {['%+.3f' % g for g in gaps]}, spread "
+                   f"{artifact['gap_spread']:.3f}; acceptance: |gap| < 0.1% "
+                   f"at convergence)")
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
-    print(f"wrote {args.out}")
+    if sink is not None:
+        sink.close()
+    rank_print(f"wrote {args.out}")
     return 0
 
 

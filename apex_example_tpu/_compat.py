@@ -1,0 +1,57 @@
+"""Version bridges for pre-vma jax (< 0.7).
+
+The codebase targets the vma-typed shard_map era (``jax.typeof``,
+``lax.axis_size``, ``lax.pcast``).  On older jax those APIs are absent but
+the semantics have classic spellings; routing the handful of call sites
+through this module keeps every path importable — and most of them
+runnable — on both.  On vma-era jax each shim is exactly the new API.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_TYPEOF = getattr(jax, "typeof", None)
+
+# True on vma-era jax (>= 0.7): shard-variance is typed and the pallas
+# kernels' interpret-mode path can run (ops/_config.py keys off this).
+HAS_VMA = _TYPEOF is not None
+
+if not hasattr(lax, "pcast"):
+    # Pre-vma jax: the old check_rep shard_map needs an explicit
+    # replication rule per primitive, and `name` (ad_checkpoint's
+    # checkpoint_name, used by the remat-annotated models) never got one
+    # upstream.  It is rep-transparent — the standard rule is exact.
+    try:  # pragma: no cover - version-dependent
+        from jax._src.ad_checkpoint import name_p
+        from jax.experimental import shard_map as _sm
+        _sm.register_standard_check(name_p)
+        _sm.register_standard_rewrite(name_p)
+    except Exception:
+        pass
+
+
+def axis_size(axis_name):
+    """``lax.axis_size``, or the classic ``psum(1)`` spelling before it
+    existed (a compile-time constant either way)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, to="varying"):
+    """``lax.pcast`` where it exists; a no-op before variance typing (there
+    is no vma to cast — old shard_map tracks replication per-eqn
+    instead)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    return x
+
+
+def vma_of(x) -> frozenset:
+    """The value's shard-variance set; empty on pre-vma jax (variance is
+    untracked there, and every query degrades to 'invariant')."""
+    if _TYPEOF is None:
+        return frozenset()
+    return getattr(_TYPEOF(x), "vma", frozenset())

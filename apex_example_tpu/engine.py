@@ -30,8 +30,10 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_example_tpu import amp as amp_lib
+from apex_example_tpu._compat import axis_size, pcast, vma_of
 from apex_example_tpu.amp.policy import Policy
 from apex_example_tpu.amp.scaler import ScalerState
+from apex_example_tpu.obs.spans import device_span
 from apex_example_tpu.parallel.distributed import DDPConfig, allreduce_grads
 from apex_example_tpu.parallel.mesh import DATA_AXIS
 
@@ -150,7 +152,7 @@ def make_train_step(model, optimizer, policy: Policy,
         diff_params = state.params
         if explicit_reduce:
             diff_params = jax.tree_util.tree_map(
-                lambda p: jax.lax.pcast(p, axis_name, to="varying"),
+                lambda p: pcast(p, axis_name, to="varying"),
                 diff_params)
 
         def scaled_loss_for(stats, x_mb, y_mb):
@@ -163,10 +165,12 @@ def make_train_step(model, optimizer, policy: Policy,
                     loss, logits, new_stats)
             return scaled_loss_fn
 
-        # named_scope: phase labels in xprof/tensorboard traces (SURVEY.md §6
-        # tracing row — the reference's nvtx range annotations).
+        # device_span (jax.named_scope): phase labels in xprof/tensorboard
+        # traces (SURVEY.md §6 tracing row — the reference's nvtx range
+        # annotations).  The labels come from obs.spans.PHASES so host-side
+        # spans and the device timeline share one vocabulary.
         if grad_accum == 1:
-            with jax.named_scope("fwd_bwd"):
+            with device_span("fwd_bwd"):
                 grads, (loss, logits, new_stats) = jax.grad(
                     scaled_loss_for(state.batch_stats, x, y),
                     has_aux=True)(diff_params)
@@ -216,10 +220,10 @@ def make_train_step(model, optimizer, policy: Policy,
         # DDP: reduce *scaled* grads, like the reference's backward-hook
         # allreduce; then unscale + finite-check (scale_loss __exit__).
         if axis_name is not None:
-            with jax.named_scope("grad_allreduce"):
+            with device_span("grad_allreduce"):
                 grads = allreduce_grads(grads, ddp, axis_name)
                 loss = jax.lax.pmean(loss, axis_name)
-        with jax.named_scope("unscale_check"):
+        with device_span("unscale_check"):
             grads, grads_finite = amp_lib.unscale_grads(grads, state.scaler)
             if finite_reduce_axes is not None:
                 # all-or-none across shards: pmean == 1.0 is an AND, and
@@ -229,7 +233,7 @@ def make_train_step(model, optimizer, policy: Policy,
                     grads_finite.astype(jnp.float32),
                     finite_reduce_axes) == 1.0
 
-        with jax.named_scope("optimizer"):
+        with device_span("optimizer"):
             new_params, new_opt_state = opt.apply(grads, state.opt_state,
                                                   state.params)
         if policy.uses_dynamic_scaling:
@@ -244,6 +248,17 @@ def make_train_step(model, optimizer, policy: Policy,
 
         metrics = {"loss": loss, "scale": scaler.scale,
                    "grads_finite": grads_finite.astype(jnp.float32)}
+        if finite_reduce_axes is None:
+            # Post-unscale global grad norm, for the telemetry record (the
+            # TXL step computes its own for clipping; this covers the image
+            # and BERT/GPT steps).  Computed unconditionally, like the TXL
+            # step's: the finite check above already reads every grad
+            # element, so XLA fuses the square-sum into that same pass — no
+            # extra HBM traffic.  Skipped under finite_reduce_axes: there
+            # some grads are legitimately shard-varying (per-expert MoE
+            # weights) and a naive global norm would be mesh-variant,
+            # violating the replicated metrics out_spec.
+            metrics["grad_norm"] = optax.global_norm(grads)
         # top1 only makes sense for integer-class labels; structured label
         # pytrees (e.g. BERT's (labels, weights)) must not silently broadcast
         # into a garbage metric.
@@ -499,12 +514,11 @@ def _replicate_mean(tree, axis_name: str):
     """pmean that accepts both replicated and shard-varying leaves."""
     if not jax.tree_util.tree_leaves(tree):
         return tree
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
 
     def f(x):
-        vma = getattr(jax.typeof(x), "vma", frozenset())
-        if axis_name not in vma:        # replicated leaf (SyncBN stats)
-            x = jax.lax.pcast(x, axis_name, to="varying")
+        if axis_name not in vma_of(x):  # replicated leaf (SyncBN stats)
+            x = pcast(x, axis_name, to="varying")
         return jax.lax.psum(x, axis_name) / world
 
     return jax.tree_util.tree_map(f, tree)

@@ -1,0 +1,44 @@
+"""apex_example_tpu.obs — the unified observability subsystem.
+
+One layer, four concerns (README "Observability" documents the schema):
+
+- :mod:`~apex_example_tpu.obs.logging`   rank-aware logging
+  (``rank_print``: rank 0 is byte-identical to ``print``; workers log at
+  DEBUG instead of being silenced).
+- :mod:`~apex_example_tpu.obs.metrics`   metrics registry (counters /
+  gauges / histograms), the rank-aware JSONL sink, and the TensorBoard
+  adapter feeding train.py's existing writer path.
+- :mod:`~apex_example_tpu.obs.spans`     host-side ``perf_counter``
+  spans mirroring the device-side ``jax.named_scope`` phase labels the
+  engine emits, so host and xprof timelines share names.
+- :mod:`~apex_example_tpu.obs.telemetry` the per-step telemetry emitter
+  (loss, scale, grad norm, overflow count, step time, items/sec, compile
+  delta, memory) and :mod:`~apex_example_tpu.obs.profiler` windows
+  (``--profile-window N:M``).
+
+The JSONL schema itself lives in :mod:`~apex_example_tpu.obs.schema`
+(pure stdlib — tools can validate without importing jax).
+"""
+
+from apex_example_tpu.obs.logging import get_logger, rank_print
+from apex_example_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                          JsonlSink, MetricsRegistry,
+                                          TensorBoardAdapter, read_jsonl)
+from apex_example_tpu.obs.profiler import (DEFAULT_TRACE_DIR, ProfilerWindow,
+                                           make_profiler_window,
+                                           parse_window)
+from apex_example_tpu.obs.schema import (SCHEMA_VERSION, validate_record,
+                                         validate_stream)
+from apex_example_tpu.obs.spans import (PHASES, current_span, device_span,
+                                        set_default_registry, span)
+from apex_example_tpu.obs.telemetry import TelemetryEmitter, \
+    device_memory_stats
+
+__all__ = [
+    "Counter", "DEFAULT_TRACE_DIR", "Gauge", "Histogram", "JsonlSink",
+    "MetricsRegistry", "PHASES", "ProfilerWindow", "SCHEMA_VERSION",
+    "TelemetryEmitter", "TensorBoardAdapter", "current_span",
+    "device_memory_stats", "device_span", "get_logger",
+    "make_profiler_window", "parse_window", "rank_print", "read_jsonl",
+    "set_default_registry", "span", "validate_record", "validate_stream",
+]

@@ -1,0 +1,232 @@
+"""Metrics registry + sinks: counters, gauges, histograms, a rank-aware
+JSONL sink, and the TensorBoard adapter.
+
+The registry is host-side and deliberately dumb — plain python numbers,
+no device sync.  Callers fetch device scalars (``float(...)``) before
+updating it; the telemetry emitter (obs/telemetry.py) owns that cadence.
+
+Sink contract (the JSONL schema obs/schema.py defines): one JSON object
+per line, one file per run, flushed per record so a killed run keeps
+every step it completed.  Rank-awareness mirrors the reference harness's
+"rank 0 logs" rule: by default only the main process writes; with
+``all_ranks=True`` every process writes its own per-host file
+(``path.rank<K>`` for K > 0) — concurrent writers never share a file.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from apex_example_tpu.obs.schema import SCHEMA_VERSION  # noqa: F401
+
+
+class Counter:
+    """Monotonic count (steps, overflows, records emitted)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> int:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: inc({n}) < 0")
+        self.value += n
+        return self.value
+
+
+class Gauge:
+    """Last-value-wins scalar (loss scale, learning rate, memory)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+
+    def set(self, value) -> float:
+        self.value = float(value)
+        return self.value
+
+
+class Histogram:
+    """Streaming distribution (step times, span durations): exact
+    count/sum/min/max plus a bounded sample for percentiles."""
+
+    def __init__(self, name: str, max_samples: int = 4096):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._max_samples = max_samples
+        self._samples: List[float] = []
+
+    def observe(self, value) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        if len(self._samples) < self._max_samples:
+            self._samples.append(value)
+        else:
+            # Bounded trailing window (ring buffer): percentiles reflect
+            # the most recent max_samples observations — i.e. steady
+            # state, not compile/warmup.  count/sum/min/max stay exact
+            # over the full run.
+            self._samples[self.count % self._max_samples] = value
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        if not self._samples:
+            return 0.0
+        ordered = sorted(self._samples)
+        idx = min(int(q / 100.0 * len(ordered)), len(ordered) - 1)
+        return ordered[idx]
+
+    def summary(self) -> Dict[str, float]:
+        if not self.count:
+            return {"count": 0}
+        return {"count": self.count, "mean": self.mean, "min": self.min,
+                "max": self.max, "p50": self.percentile(50),
+                "p95": self.percentile(95)}
+
+
+class MetricsRegistry:
+    """Named metric instruments, get-or-create, one namespace.
+
+    Re-registering a name with a different instrument type is an error —
+    a silent re-type would corrupt every consumer of the snapshot.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name)
+        elif not isinstance(m, cls):
+            raise ValueError(f"metric {name!r} already registered as "
+                             f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-python dump: counters/gauges to their value, histograms
+        to their summary dict — JSON-ready."""
+        out: Dict[str, Any] = {}
+        for name, m in sorted(self._metrics.items()):
+            out[name] = m.summary() if isinstance(m, Histogram) else m.value
+        return out
+
+
+class JsonlSink:
+    """Rank-aware JSONL writer (one file per run).
+
+    ``rank``/``all_ranks`` default to the reference harness's logging
+    rule: only the main process writes.  ``rank=None`` resolves the
+    process index lazily at first write (after distributed init).
+    """
+
+    def __init__(self, path: str, all_ranks: bool = False,
+                 rank: Optional[int] = None):
+        self.path = path
+        self.all_ranks = all_ranks
+        self._rank = rank
+        self._fh = None
+        self.records_written = 0
+
+    def _resolve_rank(self) -> int:
+        if self._rank is None:
+            from apex_example_tpu.obs.logging import _process_index
+            self._rank = _process_index()
+        return self._rank
+
+    @property
+    def active(self) -> bool:
+        return self.all_ranks or self._resolve_rank() == 0
+
+    def resolved_path(self) -> str:
+        rank = self._resolve_rank()
+        return self.path if rank == 0 else f"{self.path}.rank{rank}"
+
+    def write(self, record: Dict[str, Any]) -> bool:
+        """Write one record; returns False when this rank doesn't write.
+        One file is one run (truncated at first write — validate_stream
+        requires a single run_header); flushed per line, so a killed run
+        keeps every record it emitted."""
+        if not self.active:
+            return False
+        if self._fh is None:
+            path = self.resolved_path()
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._fh = open(path, "w")
+        json.dump(record, self._fh, separators=(",", ":"))
+        self._fh.write("\n")
+        self._fh.flush()
+        self.records_written += 1
+        return True
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a sink file back into records (the round-trip tests and the
+    tools/ thin clients share this)."""
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+class TensorBoardAdapter:
+    """Feeds the existing tensorboardX writer path from a metrics dict —
+    train.py's add_scalar call sites collapse into one ``scalars()``.
+    A ``None`` writer makes every method a no-op, so call sites don't
+    need their own ``if writer is not None`` guards."""
+
+    def __init__(self, writer=None):
+        self.writer = writer
+
+    def scalars(self, values: Dict[str, float], step: int) -> None:
+        if self.writer is None:
+            return
+        for tag, value in values.items():
+            self.writer.add_scalar(tag, value, step)
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+def now() -> float:
+    """Wall-clock for record timestamps (one place to stub in tests)."""
+    return time.time()

@@ -1,0 +1,96 @@
+"""Profiler windows: capture a ``jax.profiler`` trace for exactly steps
+N..M instead of the old whole-run ``--prof`` dump.
+
+Whole-run traces of a long run are useless twice over: the file is huge,
+and the interesting steps (steady state, or a specific regression window)
+drown in compile and warmup.  A window names the steps:
+
+    --profile-window 5:8      # trace steps 5 through 8, run-relative,
+                              # 1-based, inclusive on both ends
+
+Step indices are *run-relative* (the Nth step this process executes),
+not global-step values — a resumed run's window is counted from the
+resume point, which is what you want when profiling a restarted job.
+
+Async dispatch caveat: the step call returns at enqueue, so stopping the
+trace right after step M's dispatch would truncate its device work.
+``on_step_end`` therefore blocks on the step's metrics (any output
+pytree) before ``stop_trace`` when a blocker is supplied.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from apex_example_tpu.obs.logging import rank_print
+
+DEFAULT_TRACE_DIR = "/tmp/apex_tpu_trace"
+
+
+def parse_window(spec: str) -> Tuple[int, int]:
+    """``"N:M"`` -> (N, M), 1-based inclusive; raises ValueError on
+    malformed specs so argparse surfaces a clean message."""
+    parts = spec.split(":")
+    if len(parts) != 2:
+        raise ValueError(f"--profile-window {spec!r}: expected N:M")
+    try:
+        start, stop = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(f"--profile-window {spec!r}: N and M must be "
+                         "integers") from None
+    if start < 1 or stop < start:
+        raise ValueError(f"--profile-window {spec!r}: need 1 <= N <= M")
+    return start, stop
+
+
+class ProfilerWindow:
+    """Start/stop a jax profiler trace around run-relative steps N..M.
+
+    Call ``on_step_start(i)`` before dispatching step ``i`` (1-based) and
+    ``on_step_end(i, blocker=metrics)`` after it.  ``close()`` is the
+    safety net for runs shorter than M — an open trace is always stopped.
+    """
+
+    def __init__(self, spec: str, logdir: Optional[str] = None):
+        self.start, self.stop = parse_window(spec)
+        # Resolved at call time (not a def-time default) so tests and
+        # embedders can repoint DEFAULT_TRACE_DIR.
+        self.logdir = logdir or DEFAULT_TRACE_DIR
+        self.active = False
+        self.done = False
+
+    def on_step_start(self, step_index: int) -> None:
+        if self.done or self.active or step_index != self.start:
+            return
+        jax.profiler.start_trace(self.logdir)
+        self.active = True
+
+    def on_step_end(self, step_index: int, blocker=None) -> None:
+        if not self.active or step_index < self.stop:
+            return
+        if blocker is not None:
+            jax.block_until_ready(blocker)
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        rank_print(f"profile window [{self.start}:{self.stop}] written to "
+                   f"{self.logdir}")
+
+    def close(self, blocker=None) -> None:
+        if self.active:
+            if blocker is not None:
+                jax.block_until_ready(blocker)
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            rank_print(f"profile window truncated (run ended before step "
+                       f"{self.stop}) — partial trace in {self.logdir}")
+
+
+def make_profiler_window(spec: Optional[str],
+                         logdir: Optional[str] = None
+                         ) -> Optional[ProfilerWindow]:
+    """None-propagating ctor for flag plumbing."""
+    return ProfilerWindow(spec, logdir) if spec else None

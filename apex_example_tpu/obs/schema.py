@@ -1,0 +1,153 @@
+"""The telemetry JSONL schema — pure stdlib, importable without jax.
+
+Every line a sink emits is one JSON object tagged by ``record``.  Four
+record types exist today:
+
+``run_header``   one per run, first line — identifies the run (id, argv,
+                 config snapshot, device topology, platform).
+``step``         one per train step — loss, loss scale, grad norm, step
+                 wall time, items/sec, overflow accounting, memory.
+``run_summary``  one per run, last line — first-step vs steady-state
+                 step-time delta (the compile-time estimate), totals.
+``bench``        one per bench.py measurement (the stdout JSON line's
+                 sink twin).
+``accuracy``     one per accuracy.py (seed, opt_level) cell.
+
+``validate_record`` is the single source of truth consumed by
+``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
+means extending the tables here, nowhere else.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+
+# record type -> {field: allowed python types}; None in OPTIONAL means any.
+REQUIRED: Dict[str, Dict[str, Any]] = {
+    "run_header": {
+        "record": str,
+        "schema": int,
+        "time": _NUM,
+        "run_id": str,
+        "num_devices": int,
+        "process_index": int,
+        "platform": str,
+        "config": dict,
+    },
+    "step": {
+        "record": str,
+        "step": int,
+        "epoch": int,
+        "loss": _NUM,
+        "scale": _NUM,
+        "step_time_ms": _NUM,
+        "items_per_sec": _NUM,
+    },
+    "run_summary": {
+        "record": str,
+        "steps": int,
+        "overflow_count": int,
+    },
+    "bench": {
+        "record": str,
+        "metric": str,
+        "value": _NUM,
+        "unit": str,
+    },
+    "accuracy": {
+        "record": str,
+        "opt_level": str,
+        "top1": _NUM,
+    },
+}
+
+OPTIONAL: Dict[str, Dict[str, Any]] = {
+    "run_header": {"argv": list, "num_processes": int, "arch": str},
+    "step": {
+        "grad_norm": _NUM,
+        "grads_finite": _NUM,
+        "overflow_count": int,
+        "top1": _NUM,
+        "ppl": _NUM,
+        "masked_acc": _NUM,
+        "lr": _NUM,
+        "time": _NUM,
+        "memory": dict,
+        "spans": dict,
+    },
+    "run_summary": {
+        "first_step_ms": _NUM,
+        "steady_step_ms": _NUM,
+        "compile_est_ms": _NUM,
+        "items_per_sec": _NUM,
+        "time": _NUM,
+        "spans": dict,
+    },
+    "bench": {"vs_baseline": _NUM, "mfu_pct": _NUM, "time": _NUM,
+              "config": dict},
+    "accuracy": {"seed": int, "eval_loss": _NUM, "final_train_loss": _NUM,
+                 "train_seconds": _NUM, "time": _NUM},
+}
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Errors for one parsed JSONL record (empty list == valid).
+
+    Unknown fields are rejected: the schema is the contract log-scraping
+    tools depend on, and a silently-passing typo'd field would fork it.
+    """
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, expected object"]
+    kind = rec.get("record")
+    if kind not in REQUIRED:
+        return [f"unknown record type {kind!r} "
+                f"(expected one of {sorted(REQUIRED)})"]
+    errors = []
+    required, optional = REQUIRED[kind], OPTIONAL.get(kind, {})
+    for field, types in required.items():
+        if field not in rec:
+            errors.append(f"{kind}: missing required field {field!r}")
+        elif not isinstance(rec[field], types) or isinstance(rec[field],
+                                                             bool):
+            errors.append(f"{kind}: field {field!r} is "
+                          f"{type(rec[field]).__name__}, expected "
+                          f"{types}")
+    for field, value in rec.items():
+        if field in required:
+            continue
+        if field not in optional:
+            errors.append(f"{kind}: unknown field {field!r}")
+        elif optional[field] is not None and not isinstance(value,
+                                                            optional[field]):
+            errors.append(f"{kind}: field {field!r} is "
+                          f"{type(value).__name__}, expected "
+                          f"{optional[field]}")
+    return errors
+
+
+def validate_stream(records) -> List[str]:
+    """Validate an iterable of parsed records as one run's stream: per-
+    record checks plus the stream invariants (header first, at most one
+    header/summary)."""
+    errors: List[str] = []
+    headers = summaries = 0
+    for n, rec in enumerate(records):
+        for e in validate_record(rec):
+            errors.append(f"line {n + 1}: {e}")
+        kind = rec.get("record") if isinstance(rec, dict) else None
+        if kind == "run_header":
+            headers += 1
+            if n != 0:
+                errors.append(f"line {n + 1}: run_header must be the first "
+                              "record")
+        elif kind == "run_summary":
+            summaries += 1
+    if headers > 1:
+        errors.append(f"{headers} run_header records (expected at most 1)")
+    if summaries > 1:
+        errors.append(f"{summaries} run_summary records (expected at most 1)")
+    return errors

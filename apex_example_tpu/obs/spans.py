@@ -1,0 +1,116 @@
+"""Host-side spans that mirror the device-side ``jax.named_scope`` phase
+labels, so host timelines and xprof traces share one naming convention.
+
+Two kinds of region exist in this stack and they need different tools:
+
+- **Traced (device) regions** — code under ``jit``.  Host timing there
+  is meaningless (it measures tracing, once); the right annotation is
+  ``jax.named_scope``, which lands the label in the xprof timeline.
+  :func:`device_span` is that, re-exported so the engine's phase names
+  come from the single :data:`PHASES` table below.
+- **Host regions** — the train loop's data fetch, step dispatch,
+  checkpoint IO.  :func:`span` times those with ``perf_counter``, nests,
+  and (optionally) feeds a ``span.<name>`` histogram in a
+  :class:`~apex_example_tpu.obs.metrics.MetricsRegistry`.
+
+Using the same names on both sides ("fwd_bwd" as a host span around a
+block that is "fwd_bwd" in the device trace) is the point: a future perf
+PR reads one vocabulary across JSONL telemetry and xprof.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional
+
+import jax
+
+# Canonical phase labels.  The device-side entries are emitted by
+# engine.make_train_step via device_span; the host-side entries by the
+# train loop.  Keep README's "Observability" section in sync.
+PHASES = (
+    "data",             # host: batch synthesis / prefetcher fetch
+    "step",             # host: step dispatch (+ fetch when telemetry is on)
+    "fwd_bwd",          # device: forward + scaled backward
+    "grad_allreduce",   # device: DDP gradient reduction
+    "unscale_check",    # device: unscale + finite check
+    "optimizer",        # device: fused optimizer apply
+)
+
+device_span = jax.named_scope
+
+_tls = threading.local()
+_default_registry = None
+
+
+def set_default_registry(registry) -> None:
+    """Registry every subsequent span records into (None disables)."""
+    global _default_registry
+    _default_registry = registry
+
+
+class Span:
+    """One timed host region; ``dur_ms`` is set when the context exits."""
+
+    __slots__ = ("name", "t0", "dur_ms", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = time.perf_counter()
+        self.dur_ms: Optional[float] = None
+        self.children: List["Span"] = []
+
+    @property
+    def dur_s(self) -> float:
+        return (self.dur_ms or 0.0) / 1e3
+
+    def path(self) -> str:
+        return self.name
+
+
+def _stack() -> List[Span]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def current_span() -> Optional[Span]:
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def span(name: str, registry=None, device: bool = False):
+    """Time a host region.
+
+    Nested spans attach to their parent (``Span.children``); completed
+    spans feed ``span.<dotted.path>`` histograms in ``registry`` (or the
+    default registry).  ``device=True`` additionally enters
+    ``jax.named_scope(name)``, for host regions that also dispatch traced
+    work — the xprof timeline then carries the same label.
+
+    Yields the :class:`Span`; read ``sp.dur_ms`` after the ``with`` for
+    the measured duration.
+    """
+    stack = _stack()
+    sp = Span(name)
+    parent = stack[-1] if stack else None
+    if parent is not None:
+        parent.children.append(sp)
+    stack.append(sp)
+    scope = jax.named_scope(name) if device else None
+    if scope is not None:
+        scope.__enter__()
+    try:
+        yield sp
+    finally:
+        if scope is not None:
+            scope.__exit__(None, None, None)
+        sp.dur_ms = (time.perf_counter() - sp.t0) * 1e3
+        stack.pop()
+        reg = registry if registry is not None else _default_registry
+        if reg is not None:
+            path = ".".join([s.name for s in stack] + [name])
+            reg.histogram(f"span.{path}").observe(sp.dur_ms)

@@ -46,12 +46,22 @@ def interpret() -> bool:
     return INTERPRET
 
 
+# The kernels (and the interpret-mode vma dance below) target the vma-era
+# pallas API (jax >= 0.7).  On older jax the CPU interpreter cannot run
+# them; the XLA reference implementations are the correct fallback there.
+# TPU dispatch is unaffected either way.
+from apex_example_tpu._compat import HAS_VMA as _VMA_TYPING  # noqa: E402
+from apex_example_tpu._compat import vma_of as _vma_of  # noqa: E402
+
+
 def use_pallas() -> bool:
     """Pallas path on TPU (or under the interpreter); XLA reference
     implementations elsewhere."""
     if FORCE_XLA:
         return False
-    return INTERPRET or jax.default_backend() in ("tpu", "axon")
+    if INTERPRET:
+        return _VMA_TYPING
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def use_pallas_for(*operands) -> bool:
@@ -63,6 +73,5 @@ def use_pallas_for(*operands) -> bool:
     if FORCE_XLA:
         return False
     if INTERPRET:
-        return not any(
-            getattr(jax.typeof(x), "vma", frozenset()) for x in operands)
+        return _VMA_TYPING and not any(_vma_of(x) for x in operands)
     return jax.default_backend() in ("tpu", "axon")

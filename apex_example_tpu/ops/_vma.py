@@ -11,10 +11,13 @@ from __future__ import annotations
 
 import jax
 
+from apex_example_tpu._compat import vma_of
+
+
 def sds(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
     vma = frozenset()
     for r in operands:
-        vma = vma | getattr(jax.typeof(r), "vma", frozenset())
+        vma = vma | vma_of(r)
     try:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     except TypeError:  # older jax without vma kwarg
@@ -36,7 +39,5 @@ def align_param_grad(g, param):
     summed.
     """
     from jax import lax
-    gv = getattr(jax.typeof(g), "vma", frozenset())
-    pv = getattr(jax.typeof(param), "vma", frozenset())
-    extra = tuple(sorted(gv - pv))
+    extra = tuple(sorted(vma_of(g) - vma_of(param)))
     return lax.psum(g, extra) if extra else g

@@ -45,24 +45,33 @@ def accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
 
 
 class Throughput:
-    """images/sec (or tokens/sec) meter with warmup skipping."""
+    """images/sec (or tokens/sec) meter with warmup skipping.
+
+    ``warmup_steps=0`` starts the clock at construction and counts every
+    step (the old form never set ``start`` — ``seen_steps`` begins at 1
+    so it could never equal 0 — and ``rate`` stayed 0.0 forever).
+    ``warmup_steps=K`` starts the clock at the end of step K and counts
+    items from step K+1 on, excluding compile/warmup from the rate.
+    """
 
     def __init__(self, warmup_steps: int = 2):
-        self.warmup = warmup_steps
+        self.warmup = max(int(warmup_steps), 0)
         self.items = 0
         self.seen_steps = 0
-        self.start: float | None = None
+        self.start: float | None = \
+            time.perf_counter() if self.warmup == 0 else None
 
     def step(self, n_items: int):
         self.seen_steps += 1
         if self.seen_steps == self.warmup:
             self.start = time.perf_counter()
             self.items = 0
-        elif self.seen_steps > self.warmup:
+        elif self.start is not None:
             self.items += n_items
 
     @property
     def rate(self) -> float:
         if self.start is None or self.items == 0:
             return 0.0
-        return self.items / (time.perf_counter() - self.start)
+        elapsed = time.perf_counter() - self.start
+        return self.items / max(elapsed, 1e-9)

@@ -37,11 +37,17 @@ import time
 import jax
 import jax.numpy as jnp
 
+from apex_example_tpu.obs import JsonlSink, rank_print, span
+from apex_example_tpu.obs import metrics as obs_metrics
 from apex_example_tpu.utils.flops import (model_train_flops_per_token,
                                           mfu_pct,
                                           resnet_train_flops_per_image)
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 4000.0
+
+# Optional JSONL sink (--metrics-jsonl): every _emit line also lands as a
+# schema-valid "bench" record (obs/schema.py) for the tools/ thin clients.
+_SINK: JsonlSink | None = None
 
 
 def _emit(metric: str, value: float, unit: str, vs_baseline,
@@ -59,7 +65,12 @@ def _emit(metric: str, value: float, unit: str, vs_baseline,
     }
     if flops_per_item is not None:
         rec["mfu_pct"] = round(mfu_pct(value, flops_per_item), 2)
-    print(json.dumps(rec))
+    rank_print(json.dumps(rec))
+    if _SINK is not None:
+        sunk = {"record": "bench", "time": obs_metrics.now(), **rec}
+        if sunk["vs_baseline"] is None:
+            del sunk["vs_baseline"]     # schema: omitted, never null
+        _SINK.write(sunk)
 
 
 def chain_rate(step, state, batch, steps: int, items_per_step: int,
@@ -74,11 +85,11 @@ def chain_rate(step, state, batch, steps: int, items_per_step: int,
     """
     steps = max(steps, 2)           # two chains must differ in length
     def run_chain(n, state):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, metrics = step(state, batch)
-        fetch(metrics)
-        return time.perf_counter() - t0, state
+        with span("bench_chain") as sp:
+            for _ in range(n):
+                state, metrics = step(state, batch)
+            fetch(metrics)
+        return sp.dur_s, state
 
     n1 = max(steps // 5, 1)
     if n1 >= steps:
@@ -299,7 +310,7 @@ def bench_hostpipe(args):
     from apex_example_tpu.engine import make_train_step
     from apex_example_tpu.host_runtime import NativePrefetcher, available
     if not available():
-        print("hostpipe: native runtime not buildable", file=sys.stderr)
+        rank_print("hostpipe: native runtime not buildable", file=sys.stderr)
         return
 
     policy, scaler = amp.initialize("O2")
@@ -338,7 +349,7 @@ def bench_hostpipe(args):
     float(metrics["loss"])
     host_rate = chain_rate(host_step, state, None, args.steps,
                            args.batch_size, lambda m: float(m["loss"]))
-    print(f"hostpipe: on-device {on_device:.1f} img/s, "
+    rank_print(f"hostpipe: on-device {on_device:.1f} img/s, "
           f"host-fed {host_rate:.1f} img/s "
           f"({host_rate / on_device:.2%})", file=sys.stderr)
     _emit("resnet50_ampO2_hostpipe_images_per_sec_per_chip", host_rate,
@@ -400,7 +411,14 @@ def main():
                     choices=["none", "conv", "block"],
                     help="c1/c2 rematerialization variant (PERF.md HBM "
                          "traffic experiments)")
+    ap.add_argument("--metrics-jsonl", default="", metavar="PATH",
+                    help="also write each measurement as a schema-valid "
+                         "'bench' JSONL record (obs/schema.py; "
+                         "tools/metrics_lint.py validates)")
     args = ap.parse_args()
+    global _SINK
+    if args.metrics_jsonl:
+        _SINK = JsonlSink(args.metrics_jsonl)
     _tunnel_watchdog(args.watchdog_timeout)
 
     defaults = {          # (batch_size, image_size, seq_len)
@@ -439,6 +457,8 @@ def main():
         bench_gpt(args)
     elif args.config == "hostpipe":
         bench_hostpipe(args)
+    if _SINK is not None:
+        _SINK.close()
 
 
 if __name__ == "__main__":

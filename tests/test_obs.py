@@ -1,0 +1,289 @@
+"""Observability subsystem coverage: meter math (utils/meters.py), the
+obs/ registry + JSONL schema round-trip, spans, profiler windows, and the
+tier-1 telemetry smoke test the ISSUE acceptance bar names — a 10-step C1
+run with --metrics-jsonl validated by tools/metrics_lint.py."""
+
+import importlib.util
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import train as train_mod
+from apex_example_tpu import obs
+from apex_example_tpu.obs import schema as obs_schema
+from apex_example_tpu.utils.meters import AverageMeter, Throughput
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------- meters
+
+def test_average_meter_math():
+    m = AverageMeter("loss")
+    m.update(2.0)
+    m.update(4.0, n=3)
+    assert m.val == 4.0
+    assert m.count == 4
+    assert m.avg == pytest.approx((2.0 + 3 * 4.0) / 4)
+    m.reset()
+    assert (m.val, m.sum, m.count, m.avg) == (0.0, 0.0, 0, 0.0)
+
+
+def test_throughput_zero_warmup_counts_from_first_step():
+    """warmup_steps=0 used to never set the start timestamp (seen_steps
+    starts at 1) and report 0.0 forever."""
+    thr = Throughput(warmup_steps=0)
+    thr.step(100)
+    time.sleep(0.01)
+    thr.step(100)
+    assert thr.items == 200
+    assert thr.rate > 0.0
+
+
+def test_throughput_warmup_skips_items():
+    thr = Throughput(warmup_steps=2)
+    thr.step(100)
+    assert thr.rate == 0.0          # still warming up
+    thr.step(100)
+    assert thr.items == 0           # clock starts at end of step 2
+    time.sleep(0.01)
+    thr.step(100)
+    assert thr.items == 100
+    assert thr.rate > 0.0
+
+
+def test_throughput_warmup_longer_than_run():
+    thr = Throughput(warmup_steps=5)
+    for _ in range(3):
+        thr.step(10)
+    assert thr.rate == 0.0          # never reached steady state — no crash
+
+
+# -------------------------------------------------------------- registry
+
+def test_registry_instruments():
+    reg = obs.MetricsRegistry()
+    reg.counter("steps").inc()
+    reg.counter("steps").inc(4)
+    reg.gauge("loss").set(2.5)
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        reg.histogram("t").observe(v)
+    snap = reg.snapshot()
+    assert snap["steps"] == 5
+    assert snap["loss"] == 2.5
+    assert snap["t"]["count"] == 4
+    assert snap["t"]["mean"] == pytest.approx(2.5)
+    assert snap["t"]["min"] == 1.0 and snap["t"]["max"] == 4.0
+
+
+def test_registry_type_conflict_rejected():
+    reg = obs.MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+
+
+def test_counter_rejects_negative():
+    with pytest.raises(ValueError):
+        obs.MetricsRegistry().counter("c").inc(-1)
+
+
+# ------------------------------------------------------- sink and schema
+
+def test_jsonl_sink_round_trip(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with obs.JsonlSink(path, rank=0) as sink:
+        assert sink.write({"record": "bench", "metric": "m", "value": 1.5,
+                           "unit": "x/s"})
+    [rec] = obs.read_jsonl(path)
+    assert rec == {"record": "bench", "metric": "m", "value": 1.5,
+                   "unit": "x/s"}
+    assert obs.validate_record(rec) == []
+
+
+def test_jsonl_sink_rank_awareness(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    quiet = obs.JsonlSink(path, rank=1)           # default: rank 0 only
+    assert not quiet.write({"record": "bench"})
+    assert not os.path.exists(path)
+    loud = obs.JsonlSink(path, rank=1, all_ranks=True)
+    assert loud.write({"record": "bench", "metric": "m", "value": 1.0,
+                       "unit": "u"})
+    loud.close()
+    assert os.path.exists(path + ".rank1")        # per-host file, no clash
+
+
+def test_schema_rejects_bad_records():
+    assert obs.validate_record({"record": "nope"})
+    assert obs.validate_record({"record": "step"})        # missing fields
+    good = {"record": "step", "step": 1, "epoch": 0, "loss": 1.0,
+            "scale": 1.0, "step_time_ms": 5.0, "items_per_sec": 10.0}
+    assert obs.validate_record(good) == []
+    assert obs.validate_record({**good, "typo_field": 1})  # unknown field
+    assert obs.validate_record({**good, "loss": "high"})   # wrong type
+
+
+def test_schema_stream_invariants():
+    header = {"record": "run_header", "schema": 1, "time": 0.0,
+              "run_id": "a", "num_devices": 1, "process_index": 0,
+              "platform": "cpu", "config": {}}
+    step = {"record": "step", "step": 1, "epoch": 0, "loss": 1.0,
+            "scale": 1.0, "step_time_ms": 5.0, "items_per_sec": 10.0}
+    assert obs_schema.validate_stream([header, step]) == []
+    # header not first, and duplicated
+    assert obs_schema.validate_stream([step, header, header])
+
+
+# ----------------------------------------------------------------- spans
+
+def test_spans_nest_and_record():
+    reg = obs.MetricsRegistry()
+    with obs.span("outer", registry=reg) as outer:
+        with obs.span("inner", registry=reg) as inner:
+            time.sleep(0.005)
+    assert outer.children == [inner]
+    assert inner.dur_ms >= 5.0
+    assert outer.dur_ms >= inner.dur_ms
+    snap = reg.snapshot()
+    assert snap["span.outer"]["count"] == 1
+    assert snap["span.outer.inner"]["count"] == 1   # dotted nesting path
+    assert obs.current_span() is None               # stack unwound
+
+
+def test_device_span_traces():
+    """device_span is jax.named_scope — must be usable inside jit."""
+    @jax.jit
+    def f(x):
+        with obs.device_span("fwd_bwd"):
+            return x * 2
+    assert float(f(jnp.float32(3.0))) == 6.0
+
+
+# ------------------------------------------------------ profiler windows
+
+def test_parse_window():
+    assert obs.parse_window("2:5") == (2, 5)
+    assert obs.parse_window("7:7") == (7, 7)
+    for bad in ("5", "0:3", "4:2", "a:b", "1:2:3"):
+        with pytest.raises(ValueError):
+            obs.parse_window(bad)
+
+
+def test_prof_and_window_mutually_exclusive():
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "resnet18", "--prof",
+                        "--profile-window", "1:2"])
+
+
+def test_bad_window_rejected_at_cli():
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "resnet18", "--profile-window", "3:1"])
+
+
+# ----------------------------------------------------------- rank_print
+
+def test_rank_print_is_print_on_rank0(capsys):
+    obs.rank_print("hello", 42, sep="|")
+    assert capsys.readouterr().out == "hello|42\n"
+
+
+# ------------------------------------------------------------- telemetry
+
+def test_emitter_records_and_lints(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    emitter = obs.TelemetryEmitter(obs.JsonlSink(path, rank=0))
+    emitter.run_header(config={"arch": "x", "steps": 3}, argv=["--x"])
+    for i in range(3):
+        t0 = time.perf_counter()
+        metrics = {"loss": jnp.float32(1.0 + i), "scale": jnp.float32(8.0),
+                   "grads_finite": jnp.float32(0.0 if i == 1 else 1.0),
+                   "grad_norm": jnp.float32(0.5)}
+        emitter.on_step(global_step=i + 1, epoch=0, metrics=metrics,
+                        items=64, t_start=t0)
+    emitter.close()
+    records = obs.read_jsonl(path)
+    assert [r["record"] for r in records] == \
+        ["run_header"] + ["step"] * 3 + ["run_summary"]
+    assert records[2]["overflow_count"] == 1      # the i==1 overflow step
+    assert records[-1]["overflow_count"] == 1
+    assert "first_step_ms" in records[-1]
+    lint = _load_tool("metrics_lint")
+    code, errors = lint.lint(path, require=["grad_norm"], steps=3)
+    assert code == 0, errors
+
+
+# --------------------------------------- tier-1 CLI smoke (ISSUE gate)
+
+C1_ARGS = ["--arch", "resnet18", "--dataset", "cifar10", "--opt-level",
+           "O0", "--epochs", "1", "--steps-per-epoch", "10",
+           "--batch-size", "16", "--num-devices", "1", "--print-freq", "5"]
+
+
+def test_c1_metrics_jsonl_schema_valid(tmp_path, capsys):
+    """The acceptance bar: a 10-step C1 CPU run with --metrics-jsonl emits
+    one schema-valid step record per step (loss, scale, step_time_ms,
+    items_per_sec, grad_norm) plus a run header, verified by
+    tools/metrics_lint.py — and the default stdout meters stay intact."""
+    path = str(tmp_path / "c1.jsonl")
+    assert train_mod.main(C1_ARGS + ["--metrics-jsonl", path]) == 0
+    out = capsys.readouterr().out
+    assert "epoch 0 step 10/10" in out            # stdout contract intact
+
+    lint = _load_tool("metrics_lint")
+    code, errors = lint.lint(
+        path, steps=10,
+        require=["loss", "scale", "step_time_ms", "items_per_sec",
+                 "grad_norm"])
+    assert code == 0, errors
+    assert lint.main([path, "--steps", "10", "--require", "grad_norm"]) == 0
+
+    records = obs.read_jsonl(path)
+    header = records[0]
+    assert header["record"] == "run_header"
+    assert header["config"]["arch"] == "resnet18"
+    steps = [r for r in records if r["record"] == "step"]
+    assert [r["step"] for r in steps] == list(range(1, 11))
+    # report tool runs over the same file
+    report = _load_tool("telemetry_report")
+    assert report.main([path]) == 0
+
+
+def test_profile_window_cli(tmp_path, monkeypatch):
+    """--profile-window N:M captures a trace for just that window."""
+    import apex_example_tpu.obs.profiler as prof_mod
+    logdir = str(tmp_path / "trace")
+    monkeypatch.setattr(prof_mod, "DEFAULT_TRACE_DIR", logdir)
+    args = ["--arch", "resnet18", "--dataset", "cifar10", "--opt-level",
+            "O0", "--epochs", "1", "--steps-per-epoch", "4",
+            "--batch-size", "8", "--num-devices", "1", "--print-freq", "4",
+            "--profile-window", "2:3"]
+    assert train_mod.main(args) == 0
+    assert os.path.isdir(logdir) and os.listdir(logdir)
+
+
+def test_bench_emit_writes_schema_valid_record(tmp_path, capsys, monkeypatch):
+    """bench._emit mirrors its stdout JSON line into the sink as a 'bench'
+    record (vs_baseline null on stdout, omitted in the sink)."""
+    import bench as bench_mod
+    path = str(tmp_path / "b.jsonl")
+    monkeypatch.setattr(bench_mod, "_SINK", obs.JsonlSink(path, rank=0))
+    bench_mod._emit("m", 123.45, "img/s", None)
+    bench_mod._SINK.close()
+    line = capsys.readouterr().out.strip()
+    assert json.loads(line) == {"metric": "m", "value": 123.5,
+                                "unit": "img/s", "vs_baseline": None}
+    [rec] = obs.read_jsonl(path)
+    assert rec["record"] == "bench" and "vs_baseline" not in rec
+    assert obs.validate_record(rec) == []

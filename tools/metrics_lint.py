@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Validate a telemetry JSONL file against the obs schema.
+
+Thin client of apex_example_tpu.obs.schema — no jax import needed, so it
+runs anywhere the repo is checked out:
+
+    python tools/metrics_lint.py out.jsonl
+    python tools/metrics_lint.py out.jsonl --require grad_norm --steps 10
+
+Exit status: 0 when every line parses and validates (and the --require /
+--steps demands hold), 1 otherwise.  The tier-1 smoke test
+(tests/test_obs.py) runs this over a 10-step C1 run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_schema():
+    """Load obs/schema.py directly by path: importing the package would
+    pull in jax via apex_example_tpu/__init__, and a lint tool must run
+    on hosts that only have the file."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "apex_example_tpu", "obs", "schema.py")
+    spec = importlib.util.spec_from_file_location("apex_obs_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate_stream = _load_schema().validate_stream
+
+
+def lint(path: str, require=(), steps: int = None) -> tuple[int, list]:
+    """(exit_code, errors).  ``require``: fields every step record must
+    carry beyond the schema's required set.  ``steps``: exact expected
+    step-record count."""
+    errors = []
+    records = []
+    with open(path) as fh:
+        for n, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                errors.append(f"line {n + 1}: not JSON ({e})")
+    errors.extend(validate_stream(records))
+
+    kinds = collections.Counter(
+        r.get("record") for r in records if isinstance(r, dict))
+    for i, rec in enumerate(records):
+        if isinstance(rec, dict) and rec.get("record") == "step":
+            for field in require:
+                if field not in rec:
+                    errors.append(f"line {i + 1}: step record missing "
+                                  f"required-by-caller field {field!r}")
+    if steps is not None and kinds.get("step", 0) != steps:
+        errors.append(f"expected {steps} step records, found "
+                      f"{kinds.get('step', 0)}")
+    return (1 if errors else 0), errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="JSONL file a JsonlSink wrote")
+    ap.add_argument("--require", default="",
+                    help="comma list of fields every step record must "
+                         "carry (e.g. grad_norm,items_per_sec)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="exact expected number of step records")
+    args = ap.parse_args(argv)
+    require = [f for f in args.require.split(",") if f]
+    code, errors = lint(args.path, require=require, steps=args.steps)
+    for e in errors:
+        print(f"{args.path}: {e}", file=sys.stderr)
+    if code == 0:
+        with open(args.path) as fh:
+            n = sum(1 for line in fh if line.strip())
+        print(f"{args.path}: {n} records OK")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Summarize a --metrics-jsonl telemetry file: step-time distribution,
+throughput, compile estimate, overflow accounting, span histograms.
+
+Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
+eyeball-the-stdout-meters workflow for perf PRs: run train.py with
+--metrics-jsonl, then
+
+    python tools/telemetry_report.py out.jsonl
+
+No jax import; works on any host with the file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Same no-jax file-path load as tools/metrics_lint.py: the report must run
+# on hosts that only have the JSONL file and this checkout.
+from metrics_lint import validate_stream  # noqa: E402  (sibling import)
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(q / 100 * len(sorted_vals)),
+                           len(sorted_vals) - 1)]
+
+
+def report(path: str, out=sys.stdout) -> int:
+    records = []
+    with open(path) as fh:
+        for n, line in enumerate(fh):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                # Killed runs legitimately truncate the last line
+                # (JsonlSink's contract keeps everything before it).
+                print(f"WARNING: line {n + 1}: not JSON, skipped",
+                      file=sys.stderr)
+    errors = validate_stream(records)
+    for e in errors:
+        print(f"WARNING: {e}", file=sys.stderr)
+
+    header = next((r for r in records if r.get("record") == "run_header"),
+                  None)
+    summary = next((r for r in records if r.get("record") == "run_summary"),
+                   None)
+    # Schema-invalid step records were warned about above; summarize only
+    # the ones carrying the contract fields rather than crashing.
+    steps = [r for r in records if r.get("record") == "step"
+             and all(k in r for k in ("step_time_ms", "items_per_sec",
+                                      "loss"))]
+
+    if header:
+        cfg = header.get("config", {})
+        print(f"run {header['run_id']}  platform={header['platform']}  "
+              f"devices={header['num_devices']}  "
+              f"arch={header.get('arch', cfg.get('arch', '?'))}", file=out)
+    if not steps:
+        print("no step records", file=out)
+        return 1
+
+    times = sorted(r["step_time_ms"] for r in steps)
+    rates = sorted(r["items_per_sec"] for r in steps)
+    losses = [r["loss"] for r in steps]
+    print(f"steps {len(steps)}  loss {losses[0]:.4f} -> {losses[-1]:.4f}",
+          file=out)
+    print(f"step_time_ms  p50 {_pct(times, 50):.1f}  p95 {_pct(times, 95):.1f}"
+          f"  max {times[-1]:.1f}", file=out)
+    print(f"items_per_sec p50 {_pct(rates, 50):.1f}  max {rates[-1]:.1f}",
+          file=out)
+    overflow = max((r.get("overflow_count", 0) for r in steps), default=0)
+    print(f"overflow steps {overflow}", file=out)
+    norms = [r["grad_norm"] for r in steps if "grad_norm" in r]
+    if norms:
+        s = sorted(norms)
+        print(f"grad_norm     p50 {_pct(s, 50):.3g}  max {s[-1]:.3g}",
+              file=out)
+    if summary:
+        if "compile_est_ms" in summary:
+            print(f"compile est   {summary['compile_est_ms']:.0f} ms "
+                  f"(first {summary['first_step_ms']:.0f} ms vs steady "
+                  f"{summary['steady_step_ms']:.0f} ms)", file=out)
+        for name, hist in summary.get("spans", {}).items():
+            print(f"{name}  n={hist.get('count', 0)}  "
+                  f"p50 {hist.get('p50', 0):.1f} ms  "
+                  f"p95 {hist.get('p95', 0):.1f} ms", file=out)
+    mems = [r["memory"] for r in steps if "memory" in r]
+    if mems:
+        peak = max(m.get("peak_bytes_in_use", m.get("bytes_in_use", 0))
+                   for m in mems)
+        print(f"peak device memory {peak / 2**30:.2f} GiB", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path")
+    args = ap.parse_args(argv)
+    return report(args.path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

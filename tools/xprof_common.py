@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Shared xprof trace-path handling for tools/xprof_dump.py and
+tools/xprof_parse.py (both used to re-implement the glob + mtime pick +
+plugin conversion inline)."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+
+def latest_xplane(logdir: str) -> str:
+    """Newest ``*.xplane.pb`` under ``logdir`` (jax.profiler nests them
+    under plugins/profile/<timestamp>/); raises FileNotFoundError when the
+    trace never materialized."""
+    xplanes = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                        recursive=True)
+    if not xplanes:
+        raise FileNotFoundError(f"no xplane under {logdir}")
+    return max(xplanes, key=os.path.getmtime)
+
+
+def tool_data(xplane_path: str, tool: str):
+    """Convert one xplane through the tensorboard profile plugin; returns
+    the tool payload (str or bytes, tool-dependent)."""
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+    data, _ = rtd.xspace_to_tool_data([xplane_path], tool, {})
+    return data

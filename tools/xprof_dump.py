@@ -9,14 +9,16 @@ Usage: python tools/xprof_dump.py [--batch-size 256] [--steps 5] [--top 40]
 from __future__ import annotations
 
 import argparse
-import glob
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
+
+from xprof_common import latest_xplane, tool_data
 
 
 def main():
@@ -55,14 +57,10 @@ def main():
         float(metrics["loss"])
 
     # ---- parse the xplane with the tensorboard profile plugin ----
-    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
-    xplanes = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
-                        recursive=True)
-    assert xplanes, f"no xplane under {args.logdir}"
-    xp = max(xplanes, key=os.path.getmtime)
+    xp = latest_xplane(args.logdir)
     for tool in ("framework_op_stats", "op_profile"):
         try:
-            data, _ = rtd.xspace_to_tool_data([xp], tool, {})
+            data = tool_data(xp, tool)
         except Exception as e:
             print(f"[{tool}] failed: {type(e).__name__}: {e}")
             continue

@@ -8,10 +8,13 @@ Usage: PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from xprof_common import latest_xplane, tool_data  # noqa: E402
 
 
 def main():
@@ -21,12 +24,7 @@ def main():
     ap.add_argument("--tool", default="framework_op_stats")
     args = ap.parse_args()
 
-    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
-    xplanes = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
-                        recursive=True)
-    assert xplanes, f"no xplane under {args.logdir}"
-    xp = max(xplanes, key=os.path.getmtime)
-    data, _ = rtd.xspace_to_tool_data([xp], args.tool, {})
+    data = tool_data(latest_xplane(args.logdir), args.tool)
     if isinstance(data, bytes):
         try:
             data = data.decode()

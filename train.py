@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_example_tpu import amp
+from apex_example_tpu import obs
 from apex_example_tpu.data import CIFAR10, IMAGENET, image_batch, lm_batch, \
     mlm_batch
 from apex_example_tpu.engine import (
@@ -45,6 +46,8 @@ from apex_example_tpu.optim import (DistributedFusedAdam, FusedAdagrad,
 from apex_example_tpu.parallel import (DDPConfig, LARC, is_main_process,
                                        make_data_mesh,
                                        maybe_initialize_distributed)
+from apex_example_tpu.obs import (TelemetryEmitter, TensorBoardAdapter,
+                                  make_profiler_window, rank_print, span)
 from apex_example_tpu.utils import AverageMeter, Throughput
 from apex_example_tpu.utils.checkpoint import (CheckpointManager,
                                                restore_under_mesh)
@@ -185,6 +188,20 @@ def parse_args(argv=None):
                         "(csrc/; the reference's fast_collate analog) "
                         "instead of on-device synthesis")
     p.add_argument("--print-freq", type=int, default=10)
+    # observability (obs/ subsystem; README "Observability")
+    p.add_argument("--metrics-jsonl", default="", metavar="PATH",
+                   help="emit one schema-valid telemetry record per step "
+                        "(loss, scale, grad norm, step time, items/sec, "
+                        "overflow count) plus run header/summary to this "
+                        "JSONL file; rank 0 writes by default "
+                        "(tools/metrics_lint.py validates)")
+    p.add_argument("--metrics-all-ranks", action="store_true",
+                   help="with --metrics-jsonl: every process writes its "
+                        "own per-host file (PATH.rank<K> for K > 0)")
+    p.add_argument("--profile-window", default="", metavar="N:M",
+                   help="capture a jax profiler trace for exactly run-"
+                        "relative steps N..M (1-based, inclusive) instead "
+                        "of --prof's whole-run dump")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval", action="store_true")
     p.add_argument("--eval-batches", type=int, default=10,
@@ -233,6 +250,34 @@ def make_writer(args):
         return None
     from tensorboardX import SummaryWriter
     return SummaryWriter(args.tensorboard)
+
+
+def make_telemetry(args):
+    """Flag-gated obs wiring shared by the image and LM loops: the per-step
+    telemetry emitter (--metrics-jsonl) and the profiler window
+    (--profile-window).  Also binds the span registry so host spans
+    ("data"/"step") aggregate into the run_summary."""
+    emitter = None
+    if args.metrics_jsonl:
+        registry = obs.MetricsRegistry()
+        obs.set_default_registry(registry)
+        sink = obs.JsonlSink(args.metrics_jsonl,
+                             all_ranks=args.metrics_all_ranks)
+        emitter = TelemetryEmitter(sink, registry=registry)
+        emitter.run_header(config=vars(args), argv=sys.argv[1:],
+                           arch=args.arch)
+    return emitter, make_profiler_window(args.profile_window or None)
+
+
+def close_telemetry(emitter, profwin):
+    """Counterpart of make_telemetry for the finally blocks: stop an open
+    trace window, flush the run_summary, unbind the span registry (a
+    programmatic caller must not inherit it)."""
+    if profwin is not None:
+        profwin.close()
+    if emitter is not None:
+        emitter.close()
+    obs.set_default_registry(None)
 
 
 def build_optimizer(args):
@@ -315,17 +360,26 @@ def main(argv=None):
     # use.  Launch contract in parallel/launch.py — JAX_COORDINATOR_ADDRESS
     # or the reference's MASTER_ADDR/PORT + WORLD_SIZE/RANK (hosts).
     proc_id, n_procs = maybe_initialize_distributed()
-    if n_procs > 1 and proc_id != 0:
-        # Reference behavior: only rank 0 logs; workers run silently.
-        global print
-        print = lambda *a, **k: None  # noqa: A001
+    # Reference behavior: only rank 0 writes to stdout.  rank_print (the
+    # old global-print monkeypatch's replacement, obs/logging.py) keeps
+    # rank 0 byte-identical to print() and routes worker lines to the
+    # package logger at DEBUG instead of deleting them.
+    if args.prof and args.profile_window:
+        raise SystemExit("--prof traces the whole run; pick it or "
+                         "--profile-window N:M, not both")
+    if args.profile_window:
+        from apex_example_tpu.obs import parse_window
+        try:
+            parse_window(args.profile_window)
+        except ValueError as e:
+            raise SystemExit(str(e))
     if args.prof_server:
         # Per-process port offset: single-host multi-process launches (the
         # localhost rendezvous tests/test_launch.py exercises) would
         # otherwise all bind the same port.
         port = args.prof_server + jax.process_index()
         jax.profiler.start_server(port)
-        print(f"profiler server on :{port}")
+        rank_print(f"profiler server on :{port}")
     policy, scaler = amp.initialize(
         args.opt_level, loss_scale=args.loss_scale,
         keep_batchnorm_fp32=args.keep_batchnorm_fp32)
@@ -401,12 +455,12 @@ def main(argv=None):
         mesh = make_data_mesh(devices=devices)
         if args.zero:
             step_fn = make_zero_train_step(mesh, model, optimizer, policy)
-            print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
+            rank_print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
         else:
             step_fn = make_sharded_train_step(mesh, model, optimizer,
                                               policy, ddp=ddp,
                                               grad_accum=args.grad_accum)
-            print(f"DDP over {n_dev} devices: {mesh}")
+            rank_print(f"DDP over {n_dev} devices: {mesh}")
     else:
         step_fn = jax.jit(make_train_step(model, optimizer, policy,
                                           grad_accum=args.grad_accum),
@@ -416,6 +470,8 @@ def main(argv=None):
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
         else None
     writer = make_writer(args)
+    tb = TensorBoardAdapter(writer)
+    emitter, profwin = make_telemetry(args)
     start_epoch = 0
     if args.resume:
         rmgr = CheckpointManager(args.resume)
@@ -425,7 +481,7 @@ def main(argv=None):
         else:
             state = rmgr.restore(state)
         start_epoch = int(state.step) // args.steps_per_epoch
-        print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
+        rank_print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
 
     if args.prof:
         jax.profiler.start_trace("/tmp/apex_tpu_trace")
@@ -460,31 +516,44 @@ def main(argv=None):
     else:
         eval_batch_fn = batch_fn
 
-    try:
+    run_step = 0                    # run-relative step index (1-based in
+    try:                            # the loop; drives the profiler window)
         for epoch in range(start_epoch, args.epochs):
             losses, top1s = AverageMeter("loss"), AverageMeter("top1")
             thr = Throughput(warmup_steps=2)
             for i in range(args.steps_per_epoch):
-                batch = batch_fn(global_step)
-                state, metrics = step_fn(state, batch)
-                global_step += 1
+                run_step += 1
+                if profwin is not None:
+                    profwin.on_step_start(run_step)
+                with span("data"):
+                    batch = batch_fn(global_step)
+                t0 = time.perf_counter()
+                with span("step"):
+                    state, metrics = step_fn(state, batch)
+                    global_step += 1
+                    if emitter is not None:
+                        # Inside the span: the blocking metric fetch is
+                        # part of what "step" means when telemetry is on
+                        # (obs.spans.PHASES).
+                        emitter.on_step(global_step=global_step,
+                                        epoch=epoch, metrics=metrics,
+                                        items=args.batch_size, t_start=t0)
                 thr.step(args.batch_size)
+                if profwin is not None:
+                    profwin.on_step_end(run_step, blocker=metrics)
                 if (i + 1) % args.print_freq == 0 \
                         or i + 1 == args.steps_per_epoch:
                     losses.update(float(metrics["loss"]))
                     top1s.update(float(metrics["top1"]))
-                    print(f"epoch {epoch} step "
+                    rank_print(f"epoch {epoch} step "
                           f"{i + 1}/{args.steps_per_epoch} "
                           f"{losses} {top1s} "
                           f"{thr.rate:.1f} img/s "
                           f"scale {float(metrics['scale']):.0f}")
-                    if writer is not None:
-                        writer.add_scalar("train/loss", losses.val,
-                                          global_step)
-                        writer.add_scalar("train/top1", top1s.val,
-                                          global_step)
-                        writer.add_scalar("train/img_per_sec", thr.rate,
-                                          global_step)
+                    tb.scalars({"train/loss": losses.val,
+                                "train/top1": top1s.val,
+                                "train/img_per_sec": thr.rate},
+                               global_step)
             if args.eval:
                 # Full validation loop (reference harness shape: N batches,
                 # top-1/top-5 meters, SURVEY.md §3.5) on a held-out index
@@ -497,29 +566,27 @@ def main(argv=None):
                     el.update(float(em["loss"]))
                     e1.update(float(em["top1"]))
                     e5.update(float(em["top5"]))
-                print(f"epoch {epoch} EVAL loss {el.avg:.4f} "
+                rank_print(f"epoch {epoch} EVAL loss {el.avg:.4f} "
                       f"top1 {e1.avg:.2f} top5 {e5.avg:.2f} "
                       f"({args.eval_batches} batches)")
-                if writer is not None:
-                    writer.add_scalar("eval/loss", el.avg, global_step)
-                    writer.add_scalar("eval/top1", e1.avg, global_step)
-                    writer.add_scalar("eval/top5", e5.avg, global_step)
+                tb.scalars({"eval/loss": el.avg, "eval/top1": e1.avg,
+                            "eval/top5": e5.avg}, global_step)
             if mgr is not None and is_main_process():
                 # Reference: rank 0 writes the checkpoint (SURVEY.md §4.5);
                 # state is replicated so one host's copy is the full state.
                 mgr.save(state, wait=not args.async_checkpoint)
-                print(f"saved checkpoint at step {int(state.step)}")
+                rank_print(f"saved checkpoint at step {int(state.step)}")
     finally:
+        close_telemetry(emitter, profwin)
         if prefetcher is not None:
             prefetcher.close()
-        if writer is not None:
-            writer.close()
+        tb.close()
         if mgr is not None:
             mgr.wait_until_finished()
 
     if args.prof:
         jax.profiler.stop_trace()
-        print("profile written to /tmp/apex_tpu_trace")
+        rank_print("profile written to /tmp/apex_tpu_trace")
     return 0
 
 
@@ -873,7 +940,7 @@ def _lm_main_impl(args, policy, scaler):
                                           num_chunks=pp_chunks,
                                           moe_aux_weight=args.moe_aux_weight)
         mems = None
-        print(f"PP over {pp} stages ({pp_sched}"
+        rank_print(f"PP over {pp} stages ({pp_sched}"
               + (f", V={pp_chunks}" if pp_chunks > 1 else "")
               + f"), TP over {tp}, CP over {cp}, DP over "
               f"{n_dev // (pp * tp * cp)}, "
@@ -914,7 +981,7 @@ def _lm_main_impl(args, policy, scaler):
                 max_grad_norm=args.max_grad_norm,
                 grad_accum=args.grad_accum)
             mems = model.init_mems(args.batch_size)
-        print(f"TP over {tp} devices, DP over {n_dev // tp}"
+        rank_print(f"TP over {tp} devices, DP over {n_dev // tp}"
               + (", ZeRO-1 opt-state over data" if args.zero else "")
               + f": {mesh}")
     elif cp > 1:
@@ -998,7 +1065,7 @@ def _lm_main_impl(args, policy, scaler):
                                               grad_accum=args.grad_accum,
                                               state_shardings=cp_shardings)
         mems = None
-        print(f"CP over {cp} sequence shards (local seq "
+        rank_print(f"CP over {cp} sequence shards (local seq "
               f"{args.seq_len // cp}), TP over {tp}, DP over "
               f"{n_dev // (cp * tp)}"
               + (f", MoE over {args.moe_experts} experts"
@@ -1054,7 +1121,7 @@ def _lm_main_impl(args, policy, scaler):
             objective="mlm" if is_bert else "lm",
             state_shardings=shardings)
         mems = None
-        print(f"MoE over {args.moe_experts} experts "
+        rank_print(f"MoE over {args.moe_experts} experts "
               f"({args.moe_experts // ep}/device, capacity factor "
               f"{args.moe_capacity_factor}), TP over {tp}, DP over {ep}: "
               f"{mesh}")
@@ -1075,7 +1142,7 @@ def _lm_main_impl(args, policy, scaler):
             step_fn = make_zero_train_step(mesh, model, optimizer, policy,
                                            loss_fn=loss_fn,
                                            compute_accuracy=False)
-            print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
+            rank_print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
         elif n_dev > 1:
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_train_step(
@@ -1162,6 +1229,8 @@ def _lm_main_impl(args, policy, scaler):
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
         else None
     writer = make_writer(args)
+    tb = TensorBoardAdapter(writer)
+    emitter, profwin = make_telemetry(args)
     start_epoch = 0
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
@@ -1176,7 +1245,7 @@ def _lm_main_impl(args, policy, scaler):
         else:
             state = CheckpointManager(args.resume).restore(state)
         start_epoch = int(state.step) // args.steps_per_epoch
-        print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
+        rank_print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
 
     if args.prof:
         jax.profiler.start_trace("/tmp/apex_tpu_trace")
@@ -1219,32 +1288,46 @@ def _lm_main_impl(args, policy, scaler):
                 return jnp.asarray(ids), (jnp.asarray(labels),
                                           jnp.asarray(w))
             return jnp.asarray(ids), jnp.asarray(labels)
+    run_step = 0
     try:
         for epoch in range(start_epoch, args.epochs):
             losses = AverageMeter("loss")
             thr = Throughput(warmup_steps=2)
             for i in range(args.steps_per_epoch):
-                batch = batch_fn(global_step)
-                if is_bert or is_gpt:
-                    state, metrics = step_fn(state, batch)
-                else:
-                    state, mems, metrics = step_fn(state, mems, batch)
-                global_step += 1
+                run_step += 1
+                if profwin is not None:
+                    profwin.on_step_start(run_step)
+                with span("data"):
+                    batch = batch_fn(global_step)
+                t0 = time.perf_counter()
+                with span("step"):
+                    if is_bert or is_gpt:
+                        state, metrics = step_fn(state, batch)
+                    else:
+                        state, mems, metrics = step_fn(state, mems, batch)
+                    global_step += 1
+                    if emitter is not None:
+                        # Inside the span: see the image loop.
+                        emitter.on_step(
+                            global_step=global_step, epoch=epoch,
+                            metrics=metrics,
+                            items=args.batch_size * args.seq_len,
+                            t_start=t0)
                 thr.step(args.batch_size * args.seq_len)
+                if profwin is not None:
+                    profwin.on_step_end(run_step, blocker=metrics)
                 if (i + 1) % args.print_freq == 0 \
                         or i + 1 == args.steps_per_epoch:
                     losses.update(float(metrics["loss"]))
                     extra = (f"ppl {float(metrics['ppl']):.1f} " if "ppl" in
                              metrics else "")
-                    print(f"epoch {epoch} step {i + 1}/"
+                    rank_print(f"epoch {epoch} step {i + 1}/"
                           f"{args.steps_per_epoch} "
                           f"{losses} {extra}{thr.rate:.0f} tok/s "
                           f"scale {float(metrics['scale']):.0f}")
-                    if writer is not None:
-                        writer.add_scalar("train/loss", losses.val,
-                                          global_step)
-                        writer.add_scalar("train/tok_per_sec", thr.rate,
-                                          global_step)
+                    tb.scalars({"train/loss": losses.val,
+                                "train/tok_per_sec": thr.rate},
+                               global_step)
             if eval_fn is not None:
                 # Held-out token streams at a disjoint index range (the
                 # image path's contract); TXL threads fresh eval mems.
@@ -1269,29 +1352,27 @@ def _lm_main_impl(args, policy, scaler):
                     el.update(float(em["loss"]))
                 metric = ("masked_acc", e2.avg) if is_bert \
                     else ("ppl", math.exp(el.avg))
-                print(f"epoch {epoch} EVAL loss {el.avg:.4f} "
+                rank_print(f"epoch {epoch} EVAL loss {el.avg:.4f} "
                       f"{metric[0]} {metric[1]:.2f} "
                       f"({args.eval_batches} batches)")
-                if writer is not None:
-                    writer.add_scalar("eval/loss", el.avg, global_step)
-                    writer.add_scalar(f"eval/{metric[0]}", metric[1],
-                                      global_step)
+                tb.scalars({"eval/loss": el.avg,
+                            f"eval/{metric[0]}": metric[1]}, global_step)
             if mgr is not None and is_main_process():
                 mgr.save(state, wait=not args.async_checkpoint)
-                print(f"saved checkpoint at step {int(state.step)}")
+                rank_print(f"saved checkpoint at step {int(state.step)}")
     finally:
         # Join pending async checkpoint writes even when unwinding on an
         # exception — an announced save must exist on disk (main() gives
         # its image path the same protection).
+        close_telemetry(emitter, profwin)
         if prefetcher is not None:
             prefetcher.close()
-        if writer is not None:
-            writer.close()
+        tb.close()
         if mgr is not None:
             mgr.wait_until_finished()
     if args.prof:
         jax.profiler.stop_trace()
-        print("profile written to /tmp/apex_tpu_trace")
+        rank_print("profile written to /tmp/apex_tpu_trace")
     return 0
 
 
