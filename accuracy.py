@@ -30,7 +30,8 @@ from apex_example_tpu.data import CIFAR10, IMAGENET, image_batch
 from apex_example_tpu.engine import (create_train_state, make_eval_step,
                                      make_train_step)
 from apex_example_tpu.models import ARCHS
-from apex_example_tpu.obs import JsonlSink, rank_print, span
+from apex_example_tpu.obs import (FlightRecorder, JsonlSink, StallWatchdog,
+                                  rank_print, span)
 from apex_example_tpu.obs import metrics as obs_metrics
 from apex_example_tpu.optim import FusedSGD, build_schedule
 
@@ -124,8 +125,29 @@ def main(argv=None):
                     help="also emit one schema-valid 'accuracy' JSONL "
                          "record per (seed, opt level) cell as it lands "
                          "(obs/schema.py; tools/metrics_lint.py validates)")
+    ap.add_argument("--flight-recorder", action="store_true",
+                    help="with --metrics-jsonl: emit a 'crash_dump' "
+                         "record on crash/SIGTERM (obs/flight.py)")
+    ap.add_argument("--stall-timeout", type=float, default=0.0,
+                    metavar="S",
+                    help="with --metrics-jsonl: emit a 'stall' record "
+                         "with thread stacks if no (seed, opt level) cell "
+                         "completes for S seconds (0 disables; a cell "
+                         "includes compile + its whole train loop — size "
+                         "generously)")
     args = ap.parse_args(argv)
+    if (args.flight_recorder or args.stall_timeout > 0) \
+            and not args.metrics_jsonl:
+        raise SystemExit("--flight-recorder/--stall-timeout write to the "
+                         "telemetry sink; add --metrics-jsonl PATH")
     sink = JsonlSink(args.metrics_jsonl) if args.metrics_jsonl else None
+    recorder = watchdog = None
+    if sink is not None and args.flight_recorder:
+        recorder = FlightRecorder(sink=sink, config=vars(args))
+        recorder.install()
+    if sink is not None and args.stall_timeout > 0:
+        watchdog = StallWatchdog(sink, deadline_s=args.stall_timeout)
+        watchdog.start()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
@@ -155,6 +177,10 @@ def main(argv=None):
         or [args.seed]
     levels = [lvl.strip() for lvl in args.opt_levels.split(",")]
     per_seed = {}
+    cells = 0
+    # NOTE: no try/finally here — on an uncaught exception the flight
+    # recorder's sys.excepthook backstop writes the crash_dump (nothing
+    # in between closes the sink), and the watchdog thread is a daemon.
     for seed in seeds:
         results = {}
         for lvl in levels:
@@ -162,6 +188,9 @@ def main(argv=None):
                         label_noise=args.label_noise,
                         num_devices=args.num_devices)
             results[lvl] = r
+            cells += 1
+            if watchdog is not None:
+                watchdog.notify_step(cells)
             rank_print(f"seed {seed} {lvl}: top1 {r['top1']:.2f}%  "
                        f"eval_loss {r['eval_loss']:.4f}  "
                        f"({r['train_seconds']}s)")
@@ -202,6 +231,10 @@ def main(argv=None):
                    f"at convergence)")
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
+    if watchdog is not None:
+        watchdog.close()
+    if recorder is not None:
+        recorder.close()
     if sink is not None:
         sink.close()
     rank_print(f"wrote {args.out}")

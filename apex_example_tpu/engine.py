@@ -33,6 +33,7 @@ from apex_example_tpu import amp as amp_lib
 from apex_example_tpu._compat import axis_size, pcast, vma_of
 from apex_example_tpu.amp.policy import Policy
 from apex_example_tpu.amp.scaler import ScalerState
+from apex_example_tpu.obs import numerics as numerics_lib
 from apex_example_tpu.obs.spans import device_span
 from apex_example_tpu.parallel.distributed import DDPConfig, allreduce_grads
 from apex_example_tpu.parallel.mesh import DATA_AXIS
@@ -108,7 +109,8 @@ def make_train_step(model, optimizer, policy: Policy,
                     loss_fn: Callable = cross_entropy_loss,
                     compute_accuracy: bool = True,
                     grad_accum: int = 1,
-                    finite_reduce_axes=None):
+                    finite_reduce_axes=None,
+                    numerics: bool = False):
     """Build the single-device (or per-shard) train step.
 
     ``optimizer`` is a fused optimizer (init/apply) from
@@ -131,6 +133,14 @@ def make_train_step(model, optimizer, policy: Policy,
     state diverges across the mesh.  Replicated-param-only steps (DDP,
     CP) don't need it — their grads arrive psum-ed, so the flag is
     already mesh-invariant.
+
+    ``numerics=True`` adds overflow provenance to the metrics: per-top-
+    level-module non-finite counts + grad norms (``metrics["numerics"]``,
+    obs/numerics.module_grad_stats), computed right next to the finite
+    check that already reads every grad element so XLA fuses the
+    reductions into the same pass.  Like ``grad_norm`` it is skipped
+    under ``finite_reduce_axes`` (shard-varying expert grads would make
+    the per-module stats mesh-variant).
     """
     opt = _wrap_optimizer(optimizer)
     ddp = ddp or DDPConfig()
@@ -259,6 +269,12 @@ def make_train_step(model, optimizer, policy: Policy,
             # weights) and a naive global norm would be mesh-variant,
             # violating the replicated metrics out_spec.
             metrics["grad_norm"] = optax.global_norm(grads)
+            if numerics:
+                # Per-module overflow provenance, fused into the same
+                # every-grad-element pass as the finite check above
+                # (obs/numerics.py; host side reads it via the
+                # NumericsMonitor when --numerics-check is on).
+                metrics["numerics"] = numerics_lib.module_grad_stats(grads)
         # top1 only makes sense for integer-class labels; structured label
         # pytrees (e.g. BERT's (labels, weights)) must not silently broadcast
         # into a garbage metric.
@@ -306,7 +322,8 @@ def make_sharded_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                             compute_accuracy: bool = True,
                             axis_name: str = DATA_AXIS,
                             donate: bool = True,
-                            grad_accum: int = 1):
+                            grad_accum: int = 1,
+                            numerics: bool = False):
     """DDP train step: shard_map over the data axis, jitted, state donated.
 
     State is replicated (P()), the batch is split on axis 0.  Inside the
@@ -316,7 +333,7 @@ def make_sharded_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     per_shard = make_train_step(model, optimizer, policy, ddp=ddp,
                                 axis_name=axis_name, loss_fn=loss_fn,
                                 compute_accuracy=compute_accuracy,
-                                grad_accum=grad_accum)
+                                grad_accum=grad_accum, numerics=numerics)
 
     def step_and_sync(state, batch):
         new_state, metrics = per_shard(state, batch)
@@ -477,7 +494,8 @@ def make_gspmd_train_step(mesh: Mesh, model, optimizer, policy: Policy,
                           loss_fn: Callable = cross_entropy_loss,
                           compute_accuracy: bool = True,
                           donate: bool = True,
-                          grad_accum: int = 1):
+                          grad_accum: int = 1,
+                          numerics: bool = False):
     """Tensor/sequence-parallel train step — the *annotate, don't
     orchestrate* counterpart of :func:`make_sharded_train_step`.
 
@@ -501,7 +519,7 @@ def make_gspmd_train_step(mesh: Mesh, model, optimizer, policy: Policy,
     step = make_train_step(model, optimizer, policy, axis_name=None,
                            loss_fn=loss_fn,
                            compute_accuracy=compute_accuracy,
-                           grad_accum=grad_accum)
+                           grad_accum=grad_accum, numerics=numerics)
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))
     metrics_sh = NamedSharding(mesh, P())
     return jax.jit(step,

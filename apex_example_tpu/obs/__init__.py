@@ -1,6 +1,8 @@
 """apex_example_tpu.obs — the unified observability subsystem.
 
-One layer, four concerns (README "Observability" documents the schema):
+Two strata (README "Observability" / "Diagnostics" document the schema):
+
+The emission layer (the happy path):
 
 - :mod:`~apex_example_tpu.obs.logging`   rank-aware logging
   (``rank_print``: rank 0 is byte-identical to ``print``; workers log at
@@ -16,14 +18,28 @@ One layer, four concerns (README "Observability" documents the schema):
   delta, memory) and :mod:`~apex_example_tpu.obs.profiler` windows
   (``--profile-window N:M``).
 
+The diagnostics stratum (the failure path, schema v2):
+
+- :mod:`~apex_example_tpu.obs.flight`    flight recorder — last-K step
+  ring + crash hooks (signals/excepthook/atexit/faulthandler) emitting
+  ``crash_dump`` + an aborted run summary on abnormal exit.
+- :mod:`~apex_example_tpu.obs.watchdog`  stall watchdog thread —
+  ``stall`` records with all-thread stacks when no step completes within
+  a deadline; optional one-shot profiler window.
+- :mod:`~apex_example_tpu.obs.numerics`  overflow provenance — per-
+  module non-finite counts fused into the engine's finite-check pass,
+  surfaced as ``overflow_event`` records naming the offending module(s).
+
 The JSONL schema itself lives in :mod:`~apex_example_tpu.obs.schema`
 (pure stdlib — tools can validate without importing jax).
 """
 
+from apex_example_tpu.obs.flight import FlightRecorder, format_thread_stacks
 from apex_example_tpu.obs.logging import get_logger, rank_print
 from apex_example_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                           JsonlSink, MetricsRegistry,
                                           TensorBoardAdapter, read_jsonl)
+from apex_example_tpu.obs.numerics import NumericsMonitor, module_grad_stats
 from apex_example_tpu.obs.profiler import (DEFAULT_TRACE_DIR, ProfilerWindow,
                                            make_profiler_window,
                                            parse_window)
@@ -33,12 +49,15 @@ from apex_example_tpu.obs.spans import (PHASES, current_span, device_span,
                                         set_default_registry, span)
 from apex_example_tpu.obs.telemetry import TelemetryEmitter, \
     device_memory_stats
+from apex_example_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
-    "Counter", "DEFAULT_TRACE_DIR", "Gauge", "Histogram", "JsonlSink",
-    "MetricsRegistry", "PHASES", "ProfilerWindow", "SCHEMA_VERSION",
-    "TelemetryEmitter", "TensorBoardAdapter", "current_span",
-    "device_memory_stats", "device_span", "get_logger",
-    "make_profiler_window", "parse_window", "rank_print", "read_jsonl",
-    "set_default_registry", "span", "validate_record", "validate_stream",
+    "Counter", "DEFAULT_TRACE_DIR", "FlightRecorder", "Gauge", "Histogram",
+    "JsonlSink", "MetricsRegistry", "NumericsMonitor", "PHASES",
+    "ProfilerWindow", "SCHEMA_VERSION", "StallWatchdog", "TelemetryEmitter",
+    "TensorBoardAdapter", "current_span", "device_memory_stats",
+    "device_span", "format_thread_stacks", "get_logger",
+    "make_profiler_window", "module_grad_stats", "parse_window",
+    "rank_print", "read_jsonl", "set_default_registry", "span",
+    "validate_record", "validate_stream",
 ]

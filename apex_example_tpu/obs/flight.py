@@ -1,0 +1,253 @@
+"""Flight recorder: make the failure path as observable as the happy one.
+
+The telemetry emitter (obs/telemetry.py) only observes runs that end
+well — a crashed run never reaches ``close()``, so its JSONL stream just
+stops.  The flight recorder keeps a bounded ring of the last K step
+records plus a config/environment snapshot, installs the process-level
+failure hooks (``faulthandler``, SIGTERM/SIGINT handlers,
+``sys.excepthook``, ``atexit``), and on abnormal exit writes two records
+to the SAME JSONL sink the run was already streaming to:
+
+- a ``crash_dump`` — reason, traceback or all-thread stacks, the last-K
+  step ring, the metrics-registry snapshot, device memory, config + env;
+- the run's ``run_summary`` marked ``aborted: true`` (via
+  ``TelemetryEmitter.abort``), so consumers never have to infer an abort
+  from a missing summary.
+
+A clean ``close()`` disarms everything: handlers restored, atexit
+unregistered, no records written.  Dump-once semantics: whichever hook
+fires first (signal, unwinding exception seen by train.py's ``finally``,
+excepthook backstop, atexit backstop) wins; the rest are no-ops.
+
+Signal semantics: the dump is written, then the PREVIOUS disposition
+runs — SIGTERM re-delivers with the prior handler restored (the process
+still dies with exit status 143), SIGINT chains to Python's default
+handler (KeyboardInterrupt unwinds normally, so ``finally`` blocks run).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import faulthandler
+import os
+import platform
+import signal
+import sys
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from apex_example_tpu.obs import metrics as metrics_lib
+from apex_example_tpu.obs.telemetry import (TelemetryEmitter,
+                                            device_memory_stats)
+
+# Bounded dump payloads: a crash record must stay one JSONL line that
+# tools can parse, not a core file.
+_MAX_TRACEBACK_CHARS = 16_000
+_MAX_STACKS_CHARS = 16_000
+DEFAULT_KEEP = 64
+
+
+def format_thread_stacks(limit: int = _MAX_STACKS_CHARS) -> str:
+    """One string with every live thread's current stack — the python-side
+    analog of faulthandler's dump, but capturable into a JSON record.
+    (Shared with obs/watchdog.py's stall records.)"""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        name = names.get(ident, "?")
+        parts.append(f"--- thread {name} ({ident}) ---\n"
+                     + "".join(traceback.format_stack(frame)))
+    out = "\n".join(parts)
+    return out[-limit:] if len(out) > limit else out
+
+
+def _json_safe_config(config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    return {k: v for k, v in (config or {}).items()
+            if isinstance(v, (str, int, float, bool, type(None)))}
+
+
+class FlightRecorder:
+    """Crash forensics bound to a run's JSONL sink.
+
+    ``emitter`` is the run's TelemetryEmitter when there is one (train.py)
+    — the recorder then rides its sink, snapshots its registry, and writes
+    the aborted summary through ``emitter.abort``.  Sink-only callers
+    (bench.py / accuracy.py) pass ``sink`` instead and get a crash_dump
+    plus a minimal aborted summary.
+
+    Wire-up shape (what train.make_telemetry does)::
+
+        recorder = FlightRecorder(emitter, config=vars(args))
+        recorder.install()
+        emitter.add_observer(recorder.on_record)   # feeds the ring
+        ...
+        recorder.close()                           # clean exit: disarm
+    """
+
+    def __init__(self, emitter: Optional[TelemetryEmitter] = None,
+                 sink: Optional[metrics_lib.JsonlSink] = None,
+                 keep: int = DEFAULT_KEEP,
+                 config: Optional[Dict[str, Any]] = None):
+        if sink is None:
+            if emitter is None:
+                raise ValueError("FlightRecorder needs an emitter or a sink")
+            sink = emitter.sink
+        self.emitter = emitter
+        self.sink = sink
+        self.ring: collections.deque = collections.deque(maxlen=max(keep, 1))
+        self.config = _json_safe_config(config)
+        self._prev_signal: Dict[int, Any] = {}
+        self._prev_excepthook = None
+        self._installed = False
+        self._closed = False
+        self._dumped = False
+
+    # ------------------------------------------------------------- feed
+
+    def on_record(self, record: Dict[str, Any], metrics=None) -> None:
+        """TelemetryEmitter observer: keep the last K step records."""
+        if record.get("record") == "step":
+            self.ring.append(record)
+
+    # ------------------------------------------------------------ hooks
+
+    def install(self, signals=(signal.SIGTERM, signal.SIGINT),
+                excepthook: bool = True, at_exit: bool = True,
+                enable_faulthandler: bool = True) -> None:
+        """Arm the failure hooks.  Signal handlers only install from the
+        main thread (CPython's constraint); embedders running the loop in
+        a worker thread keep the excepthook/atexit coverage."""
+        if self._installed:
+            return
+        self._installed = True
+        if enable_faulthandler and not faulthandler.is_enabled():
+            # Native faults (SIGSEGV/SIGABRT from a kernel or the runtime)
+            # can't run python code — stderr stacks are the best possible.
+            faulthandler.enable()
+        if threading.current_thread() is threading.main_thread():
+            for sig in signals:
+                try:
+                    self._prev_signal[sig] = signal.signal(sig,
+                                                           self._on_signal)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
+        if excepthook:
+            self._prev_excepthook = sys.excepthook
+            sys.excepthook = self._on_excepthook
+        if at_exit:
+            atexit.register(self._on_atexit)
+
+    def close(self) -> None:
+        """Clean-exit disarm: restore handlers, unregister atexit.  After
+        this, no hook writes anything."""
+        if self._closed:
+            return
+        self._closed = True
+        for sig, prev in self._prev_signal.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev_signal.clear()
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        atexit.unregister(self._on_atexit)
+
+    # ------------------------------------------------------------- dump
+
+    def environment(self) -> Dict[str, str]:
+        env = {"python": platform.python_version(),
+               "platform": platform.platform(),
+               "argv": " ".join(sys.argv)}
+        try:
+            import jax
+            env["jax"] = jax.__version__
+        except Exception:  # pragma: no cover
+            pass
+        return env
+
+    def crash_dump(self, reason: str, exc_info=None,
+                   thread_stacks: bool = False) -> Optional[Dict[str, Any]]:
+        """Write the ``crash_dump`` record + the aborted run summary.
+        Idempotent: the first caller wins (every hook funnels here)."""
+        if self._dumped:
+            return None
+        self._dumped = True
+        rec: Dict[str, Any] = {
+            "record": "crash_dump",
+            "time": metrics_lib.now(),
+            "reason": reason,
+            "env": self.environment(),
+        }
+        if self.config:
+            rec["config"] = self.config
+        if self.ring:
+            rec["step"] = int(self.ring[-1].get("step", 0))
+            rec["last_steps"] = list(self.ring)
+        if self.emitter is not None:
+            rec["run_id"] = self.emitter.run_id
+            try:
+                rec["registry"] = self.emitter.registry.snapshot()
+            except Exception:  # pragma: no cover
+                pass
+        if exc_info is not None:
+            tb = "".join(traceback.format_exception(*exc_info))
+            rec["traceback"] = tb[-_MAX_TRACEBACK_CHARS:]
+        if thread_stacks:
+            rec["thread_stacks"] = format_thread_stacks()
+        try:
+            mem = device_memory_stats()
+        except Exception:  # pragma: no cover
+            mem = None
+        if mem:
+            rec["memory"] = mem
+        self.sink.write(rec)
+        if self.emitter is not None:
+            self.emitter.abort(reason)
+        else:
+            self.sink.write({"record": "run_summary",
+                             "time": metrics_lib.now(),
+                             "steps": len(self.ring),
+                             "overflow_count": 0,
+                             "aborted": True, "abort_reason": reason})
+            self.sink.close()
+        return rec
+
+    # ---------------------------------------------------- hook targets
+
+    def _on_signal(self, signum, frame) -> None:
+        name = signal.Signals(signum).name
+        self.crash_dump(f"signal:{name}", thread_stacks=True)
+        prev = self._prev_signal.get(signum, signal.SIG_DFL)
+        try:
+            signal.signal(signum, prev if not callable(prev)
+                          else signal.SIG_DFL)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+        if callable(prev):
+            # SIGINT's default is signal.default_int_handler — chaining
+            # raises KeyboardInterrupt here, so finally blocks still run.
+            prev(signum, frame)
+        else:
+            # Re-deliver with the prior disposition restored: the process
+            # exits with the conventional 128+signum status.
+            os.kill(os.getpid(), signum)
+
+    def _on_excepthook(self, etype, value, tb) -> None:
+        # Backstop for exceptions that escape without passing a finally
+        # that calls crash_dump (train.py's close_telemetry normally beats
+        # this hook).  SystemExit is a normal CLI exit, not a crash.
+        if not issubclass(etype, SystemExit):
+            self.crash_dump(f"exception:{etype.__name__}",
+                            exc_info=(etype, value, tb))
+        prev = self._prev_excepthook or sys.__excepthook__
+        prev(etype, value, tb)
+
+    def _on_atexit(self) -> None:
+        # Interpreter teardown without close(): os._exit-adjacent paths,
+        # sys.exit deep in a library, a worker dropping the run on the
+        # floor.  A clean close() unregisters this.
+        self.crash_dump("atexit:run never closed")

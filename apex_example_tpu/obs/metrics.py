@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -86,8 +87,11 @@ class Histogram:
         if not self._samples:
             return 0.0
         ordered = sorted(self._samples)
-        idx = min(int(q / 100.0 * len(ordered)), len(ordered) - 1)
-        return ordered[idx]
+        # Nearest-rank: the ceil(q/100 * n)-th ordered value (1-based).
+        # The old truncating int(q/100 * n) biased HIGH on small even
+        # samples (p50 of [1, 2, 3, 4] returned 3, not 2).
+        idx = math.ceil(q / 100.0 * len(ordered)) - 1
+        return ordered[min(max(idx, 0), len(ordered) - 1)]
 
     def summary(self) -> Dict[str, float]:
         if not self.count:
@@ -148,6 +152,12 @@ class JsonlSink:
         self.all_ranks = all_ranks
         self._rank = rank
         self._fh = None
+        self._opened = False
+        # Reentrant: the flight recorder's signal handler may interrupt the
+        # main thread inside write() and write its crash_dump from the same
+        # thread; the watchdog thread contends cross-thread.  Records stay
+        # intact either way because each lands as ONE fh.write() call.
+        self._lock = threading.RLock()
         self.records_written = 0
 
     def _resolve_rank(self) -> int:
@@ -166,27 +176,34 @@ class JsonlSink:
 
     def write(self, record: Dict[str, Any]) -> bool:
         """Write one record; returns False when this rank doesn't write.
-        One file is one run (truncated at first write — validate_stream
-        requires a single run_header); flushed per line, so a killed run
-        keeps every record it emitted."""
+        One file is one run (truncated at first open — validate_stream
+        requires a single run_header; a write after close() re-opens in
+        append mode instead of destroying the run); flushed per line, so
+        a killed run keeps every record it emitted."""
         if not self.active:
             return False
-        if self._fh is None:
-            path = self.resolved_path()
-            parent = os.path.dirname(path)
-            if parent:
-                os.makedirs(parent, exist_ok=True)
-            self._fh = open(path, "w")
-        json.dump(record, self._fh, separators=(",", ":"))
-        self._fh.write("\n")
-        self._fh.flush()
-        self.records_written += 1
+        with self._lock:
+            if self._fh is None:
+                path = self.resolved_path()
+                parent = os.path.dirname(path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                self._fh = open(path, "a" if self._opened else "w")
+                self._opened = True
+            # One fh.write() per record: a C-level call is atomic w.r.t.
+            # same-thread signal handlers, so a crash_dump written from a
+            # handler never lands inside a half-written step line.
+            line = json.dumps(record, separators=(",", ":")) + "\n"
+            self._fh.write(line)
+            self._fh.flush()
+            self.records_written += 1
         return True
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self

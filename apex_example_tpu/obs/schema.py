@@ -1,7 +1,8 @@
 """The telemetry JSONL schema — pure stdlib, importable without jax.
 
-Every line a sink emits is one JSON object tagged by ``record``.  Four
-record types exist today:
+Every line a sink emits is one JSON object tagged by ``record``.
+
+Version 1 (the happy path):
 
 ``run_header``   one per run, first line — identifies the run (id, argv,
                  config snapshot, device topology, platform).
@@ -13,6 +14,23 @@ record types exist today:
                  sink twin).
 ``accuracy``     one per accuracy.py (seed, opt_level) cell.
 
+Version 2 adds the diagnostics stratum (the failure path):
+
+``crash_dump``      emitted by the flight recorder (obs/flight.py) on
+                    abnormal exit — reason, traceback / thread stacks,
+                    the last-K step records, registry snapshot, device
+                    memory, config + environment.
+``stall``           emitted by the stall watchdog (obs/watchdog.py) when
+                    no step completes within the deadline — all-thread
+                    stacks, seconds since the last step.
+``overflow_event``  emitted by the numerics monitor (obs/numerics.py) —
+                    names the top-level module(s) whose grads went
+                    non-finite, with per-module counts and norms.
+
+plus ``aborted``/``abort_reason`` on ``run_summary`` (a crashed run's
+summary carries ``aborted: true``).  v2 is a strict superset of v1:
+every v1 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.
@@ -22,7 +40,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 _NUM = (int, float)
 
@@ -63,6 +81,23 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "opt_level": str,
         "top1": _NUM,
     },
+    # --- schema v2: diagnostics records (failure-path observability) ---
+    "crash_dump": {
+        "record": str,
+        "time": _NUM,
+        "reason": str,
+    },
+    "stall": {
+        "record": str,
+        "time": _NUM,
+        "seconds_since_step": _NUM,
+    },
+    "overflow_event": {
+        "record": str,
+        "time": _NUM,
+        "step": int,
+        "modules": list,
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -86,11 +121,39 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "items_per_sec": _NUM,
         "time": _NUM,
         "spans": dict,
+        # v2: a crashed/killed run's summary is marked, not absent.
+        "aborted": bool,
+        "abort_reason": str,
     },
     "bench": {"vs_baseline": _NUM, "mfu_pct": _NUM, "time": _NUM,
               "config": dict},
     "accuracy": {"seed": int, "eval_loss": _NUM, "final_train_loss": _NUM,
                  "train_seconds": _NUM, "time": _NUM},
+    "crash_dump": {
+        "run_id": str,
+        "step": int,            # last completed step at dump time
+        "traceback": str,       # uncaught-exception path
+        "thread_stacks": str,   # signal path: all-thread stack dump
+        "last_steps": list,     # the flight recorder's bounded ring
+        "registry": dict,       # MetricsRegistry.snapshot()
+        "memory": dict,         # device_memory_stats() subset
+        "env": dict,            # python/platform/jax versions, argv
+        "config": dict,         # JSON-safe argparse snapshot
+    },
+    "stall": {
+        "run_id": str,
+        "step": int,            # last completed step before the stall
+        "deadline_s": _NUM,
+        "thread_stacks": str,
+        "trace_dir": str,       # set when a one-shot profiler window armed
+    },
+    "overflow_event": {
+        "run_id": str,
+        "module_stats": dict,   # {module: {nonfinite, grad_norm}}
+        "scale": _NUM,
+        "loss": _NUM,
+        "mode": str,            # the --numerics-check mode that fired
+    },
 }
 
 

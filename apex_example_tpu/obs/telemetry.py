@@ -84,6 +84,17 @@ class TelemetryEmitter:
         self._items = 0
         self._t_run0 = time.perf_counter()
         self._closed = False
+        # Diagnostics hookup (obs/flight.py, obs/watchdog.py,
+        # obs/numerics.py): each callback sees (step_record, raw_metrics)
+        # after the record lands in the sink.
+        self._observers: List = []
+
+    def add_observer(self, callback) -> None:
+        """``callback(record, metrics)`` runs after every emitted step —
+        the flight recorder's ring, the watchdog's heartbeat, and the
+        numerics monitor all subscribe here so the train loops stay a
+        single ``emitter.on_step`` call."""
+        self._observers.append(callback)
 
     def run_header(self, config: Dict[str, Any], argv: Optional[list] = None,
                    **extra) -> Dict[str, Any]:
@@ -152,6 +163,8 @@ class TelemetryEmitter:
             reg.gauge("grad_norm").set(rec["grad_norm"])
 
         self.sink.write(rec)
+        for callback in self._observers:
+            callback(rec, metrics)
         return rec
 
     def summary(self) -> Dict[str, Any]:
@@ -186,4 +199,17 @@ class TelemetryEmitter:
         self._closed = True
         if self._steps:
             self.sink.write(self.summary())
+        self.sink.close()
+
+    def abort(self, reason: str) -> None:
+        """The crash-path close (obs/flight.py): always write the run
+        summary — even at 0 steps — marked ``aborted: true``, so stream
+        consumers can tell a killed run from one that ended well."""
+        if self._closed:
+            return
+        self._closed = True
+        rec = self.summary()
+        rec["aborted"] = True
+        rec["abort_reason"] = reason
+        self.sink.write(rec)
         self.sink.close()

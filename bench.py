@@ -37,7 +37,8 @@ import time
 import jax
 import jax.numpy as jnp
 
-from apex_example_tpu.obs import JsonlSink, rank_print, span
+from apex_example_tpu.obs import (FlightRecorder, JsonlSink, StallWatchdog,
+                                  rank_print, span)
 from apex_example_tpu.obs import metrics as obs_metrics
 from apex_example_tpu.utils.flops import (model_train_flops_per_token,
                                           mfu_pct,
@@ -48,6 +49,11 @@ BASELINE_IMG_PER_SEC_PER_CHIP = 4000.0
 # Optional JSONL sink (--metrics-jsonl): every _emit line also lands as a
 # schema-valid "bench" record (obs/schema.py) for the tools/ thin clients.
 _SINK: JsonlSink | None = None
+# Optional stall watchdog (--stall-timeout): each emitted measurement is
+# its heartbeat — a bench config that hangs mid-measurement leaves a
+# 'stall' record with thread stacks instead of silence.
+_WATCHDOG: StallWatchdog | None = None
+_EMITS = 0
 
 
 def _emit(metric: str, value: float, unit: str, vs_baseline,
@@ -71,6 +77,10 @@ def _emit(metric: str, value: float, unit: str, vs_baseline,
         if sunk["vs_baseline"] is None:
             del sunk["vs_baseline"]     # schema: omitted, never null
         _SINK.write(sunk)
+    if _WATCHDOG is not None:
+        global _EMITS
+        _EMITS += 1
+        _WATCHDOG.notify_step(_EMITS)
 
 
 def chain_rate(step, state, batch, steps: int, items_per_step: int,
@@ -415,10 +425,30 @@ def main():
                     help="also write each measurement as a schema-valid "
                          "'bench' JSONL record (obs/schema.py; "
                          "tools/metrics_lint.py validates)")
+    ap.add_argument("--flight-recorder", action="store_true",
+                    help="with --metrics-jsonl: emit a 'crash_dump' "
+                         "record on crash/SIGTERM (obs/flight.py)")
+    ap.add_argument("--stall-timeout", type=float, default=0.0,
+                    metavar="S",
+                    help="with --metrics-jsonl: emit a 'stall' record "
+                         "with thread stacks if no measurement lands for "
+                         "S seconds (0 disables; covers compile time)")
     args = ap.parse_args()
-    global _SINK
+    global _SINK, _WATCHDOG
+    recorder = None
+    if (args.flight_recorder or args.stall_timeout > 0) \
+            and not args.metrics_jsonl:
+        raise SystemExit("--flight-recorder/--stall-timeout write to the "
+                         "telemetry sink; add --metrics-jsonl PATH")
     if args.metrics_jsonl:
         _SINK = JsonlSink(args.metrics_jsonl)
+        if args.flight_recorder:
+            recorder = FlightRecorder(sink=_SINK, config=vars(args))
+            recorder.install()
+        if args.stall_timeout > 0:
+            _WATCHDOG = StallWatchdog(_SINK,
+                                      deadline_s=args.stall_timeout)
+            _WATCHDOG.start()
     _tunnel_watchdog(args.watchdog_timeout)
 
     defaults = {          # (batch_size, image_size, seq_len)
@@ -435,30 +465,43 @@ def main():
     if args.seq_len is None:
         args.seq_len = ds
 
-    if args.config == "c1":
-        bench_image_single(
-            args, arch="resnet18", opt_level="O0",
-            image_size=args.image_size, num_classes=10,
-            metric="resnet18_cifar_fp32_images_per_sec_per_chip",
-            vs_target=False)
-    elif args.config == "c2":
-        bench_image_single(
-            args, arch="resnet50", opt_level="O2",
-            image_size=args.image_size, num_classes=1000,
-            metric="resnet50_imagenet_ampO2_bf16_train_images_per_sec_per_chip",
-            vs_target=True)
-    elif args.config == "c3":
-        bench_c3(args)
-    elif args.config == "c4":
-        bench_c4(args)
-    elif args.config == "c5":
-        bench_c5(args)
-    elif args.config == "gpt":
-        bench_gpt(args)
-    elif args.config == "hostpipe":
-        bench_hostpipe(args)
-    if _SINK is not None:
-        _SINK.close()
+    try:
+        if args.config == "c1":
+            bench_image_single(
+                args, arch="resnet18", opt_level="O0",
+                image_size=args.image_size, num_classes=10,
+                metric="resnet18_cifar_fp32_images_per_sec_per_chip",
+                vs_target=False)
+        elif args.config == "c2":
+            bench_image_single(
+                args, arch="resnet50", opt_level="O2",
+                image_size=args.image_size, num_classes=1000,
+                metric="resnet50_imagenet_ampO2_bf16_train_images_per_sec"
+                       "_per_chip",
+                vs_target=True)
+        elif args.config == "c3":
+            bench_c3(args)
+        elif args.config == "c4":
+            bench_c4(args)
+        elif args.config == "c5":
+            bench_c5(args)
+        elif args.config == "gpt":
+            bench_gpt(args)
+        elif args.config == "hostpipe":
+            bench_hostpipe(args)
+    finally:
+        # Crash-aware teardown (sys.exc_info is live inside a finally):
+        # an unwinding exception leaves a crash_dump, not a silent stream.
+        if _WATCHDOG is not None:
+            _WATCHDOG.close()
+        exc = sys.exc_info()
+        if recorder is not None:
+            if exc[0] is not None and not issubclass(exc[0], SystemExit):
+                recorder.crash_dump(f"exception:{exc[0].__name__}",
+                                    exc_info=exc)
+            recorder.close()
+        if _SINK is not None:
+            _SINK.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,650 @@
+"""Diagnostics-stratum coverage (obs/flight.py, obs/watchdog.py,
+obs/numerics.py, tools/fleet_report.py; ISSUE 2):
+
+- schema v2 records + v1 back-compat,
+- Histogram.percentile nearest-rank regression (the off-by-one fix),
+- flight-recorder crash dumps (unit + a SIGTERM'd subprocess C1 run),
+- stall-watchdog stall records and disarm,
+- overflow provenance: NaN-injection naming the poisoned module,
+- fleet_report straggler / overflow-divergence detection,
+- metrics_lint --require-summary exit codes, telemetry_report abort
+  summaries, and the jax-free import guard for every tools/ thin client.
+
+Subprocess tests carry the ``diag`` marker (pytest.ini) so the crash-path
+suite is selectable with ``-m diag``; everything here rides tier-1.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import train as train_mod
+from apex_example_tpu import amp, obs
+from apex_example_tpu.obs import schema as obs_schema
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _header(rank=0):
+    return {"record": "run_header", "schema": obs_schema.SCHEMA_VERSION,
+            "time": 0.0, "run_id": "r", "num_devices": 1,
+            "process_index": rank, "platform": "cpu", "config": {}}
+
+
+def _step(i, ms=10.0, loss=1.0, finite=1.0):
+    return {"record": "step", "step": i, "epoch": 0, "loss": loss,
+            "scale": 1.0, "step_time_ms": ms, "items_per_sec": 100.0,
+            "grads_finite": finite}
+
+
+def _write_stream(path, records):
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+
+
+# ------------------------------------------------------- schema v2
+
+def test_schema_v2_diagnostics_records_validate():
+    crash = {"record": "crash_dump", "time": 1.0, "reason": "signal:SIGTERM",
+             "step": 7, "thread_stacks": "...", "last_steps": [_step(7)],
+             "registry": {}, "env": {"python": "3"}, "config": {}}
+    stall = {"record": "stall", "time": 1.0, "seconds_since_step": 12.5,
+             "step": 3, "deadline_s": 10.0, "thread_stacks": "..."}
+    overflow = {"record": "overflow_event", "time": 1.0, "step": 4,
+                "modules": ["branch_a"], "module_stats":
+                {"branch_a": {"nonfinite": 3, "grad_norm": 1.0}},
+                "mode": "overflow", "scale": 65536.0}
+    aborted = {"record": "run_summary", "steps": 7, "overflow_count": 0,
+               "aborted": True, "abort_reason": "signal:SIGTERM"}
+    for rec in (crash, stall, overflow, aborted):
+        assert obs.validate_record(rec) == [], rec["record"]
+    assert obs_schema.validate_stream(
+        [_header(), _step(1), overflow, crash, aborted]) == []
+
+
+def test_schema_v1_streams_still_validate():
+    """v2 is a strict superset: a pre-PR stream (schema field 1, no
+    diagnostics records) must keep validating byte-for-byte."""
+    v1_header = dict(_header(), schema=1)
+    v1_summary = {"record": "run_summary", "steps": 2, "overflow_count": 0,
+                  "first_step_ms": 50.0, "steady_step_ms": 5.0,
+                  "compile_est_ms": 45.0}
+    assert obs_schema.validate_stream(
+        [v1_header, _step(1), _step(2), v1_summary]) == []
+
+
+def test_schema_still_rejects_unknown_and_malformed():
+    assert obs.validate_record({"record": "crash_dump"})   # missing fields
+    assert obs.validate_record(
+        {"record": "overflow_event", "time": 1.0, "step": 1,
+         "modules": "branch_a"})                           # str, not list
+    assert obs.validate_record(
+        {"record": "stall", "time": 1.0, "seconds_since_step": 1.0,
+         "typo_field": 1})                                 # unknown field
+
+
+# --------------------------------------- percentile (satellite fix)
+
+def test_histogram_percentile_nearest_rank():
+    """int(q/100*n) biased high on small even samples: p50 of [1,2,3,4]
+    returned 3.  Nearest-rank is ceil(q/100*n)-1: the 2nd value, 2."""
+    h = obs.Histogram("t")
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        h.observe(v)
+    assert h.percentile(50) == 2.0
+    assert h.percentile(100) == 4.0
+    assert h.percentile(0) == 1.0
+    h5 = obs.Histogram("t5")
+    for v in [5.0, 1.0, 3.0, 2.0, 4.0]:    # unsorted on purpose
+        h5.observe(v)
+    assert h5.percentile(50) == 3.0
+    h1 = obs.Histogram("t1")
+    h1.observe(7.0)
+    assert h1.percentile(50) == 7.0 and h1.percentile(95) == 7.0
+    h100 = obs.Histogram("t100")
+    for v in range(1, 101):
+        h100.observe(float(v))
+    assert h100.percentile(95) == 95.0
+    assert h100.percentile(50) == 50.0
+
+
+# -------------------------------------------------- flight recorder
+
+def test_flight_recorder_crash_dump_and_aborted_summary(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    emitter = obs.TelemetryEmitter(obs.JsonlSink(path, rank=0))
+    emitter.run_header(config={"arch": "x"})
+    recorder = obs.FlightRecorder(emitter, keep=3,
+                                  config={"arch": "x", "fn": print})
+    emitter.add_observer(recorder.on_record)
+    for i in range(5):
+        emitter.on_step(global_step=i + 1, epoch=0,
+                        metrics={"loss": 1.0, "scale": 1.0},
+                        items=4, t_start=time.perf_counter())
+    rec = recorder.crash_dump("signal:SIGTERM", thread_stacks=True)
+    assert rec is not None
+    assert recorder.crash_dump("again") is None        # dump-once
+
+    records = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(records) == []
+    crash = next(r for r in records if r["record"] == "crash_dump")
+    assert crash["reason"] == "signal:SIGTERM"
+    assert crash["step"] == 5
+    assert [s["step"] for s in crash["last_steps"]] == [3, 4, 5]  # ring
+    assert "fn" not in crash["config"]                 # JSON-safe subset
+    assert "MainThread" in crash["thread_stacks"]
+    summary = records[-1]
+    assert summary["record"] == "run_summary"
+    assert summary["aborted"] is True
+    assert summary["abort_reason"] == "signal:SIGTERM"
+    assert summary["steps"] == 5
+    lint = _load_tool("metrics_lint")
+    code, errors = lint.lint(path, require_summary=True)
+    assert code == 0, errors
+
+
+def test_flight_recorder_install_close_restores_hooks():
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_hook = sys.excepthook
+    sink = obs.JsonlSink("/tmp/unused_diag.jsonl", rank=1)   # inactive rank
+    recorder = obs.FlightRecorder(sink=sink)
+    recorder.install()
+    assert signal.getsignal(signal.SIGTERM) == recorder._on_signal
+    assert sys.excepthook == recorder._on_excepthook
+    recorder.close()
+    assert signal.getsignal(signal.SIGTERM) == prev_term
+    assert signal.getsignal(signal.SIGINT) == prev_int
+    assert sys.excepthook == prev_hook
+
+
+def test_flight_recorder_sink_only_mode(tmp_path):
+    """bench.py/accuracy.py form: no emitter — crash_dump plus a minimal
+    aborted summary."""
+    path = str(tmp_path / "b.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    recorder = obs.FlightRecorder(sink=sink)
+    recorder.crash_dump("exception:RuntimeError")
+    records = obs.read_jsonl(path)
+    assert [r["record"] for r in records] == ["crash_dump", "run_summary"]
+    assert records[1]["aborted"] is True
+    assert obs_schema.validate_stream(records) == []
+
+
+def test_close_telemetry_dumps_on_unwinding_exception(tmp_path):
+    """train.py's finally path: an exception unwinding through
+    close_telemetry yields crash_dump + aborted summary, not a clean
+    close."""
+    path = str(tmp_path / "u.jsonl")
+    emitter = obs.TelemetryEmitter(obs.JsonlSink(path, rank=0))
+    emitter.run_header(config={})
+    recorder = obs.FlightRecorder(emitter)
+    recorder.install()
+    with pytest.raises(RuntimeError):
+        try:
+            raise RuntimeError("boom")
+        finally:
+            train_mod.close_telemetry(emitter, None, recorder, None)
+    records = obs.read_jsonl(path)
+    kinds = [r["record"] for r in records]
+    assert "crash_dump" in kinds
+    crash = next(r for r in records if r["record"] == "crash_dump")
+    assert crash["reason"] == "exception:RuntimeError"
+    assert "boom" in crash["traceback"]
+    assert records[-1]["aborted"] is True
+    # hooks restored by the close inside close_telemetry
+    assert signal.getsignal(signal.SIGTERM) != recorder._on_signal
+
+
+# ---------------------------------------------------- stall watchdog
+
+def test_watchdog_emits_stall_and_rearms(tmp_path):
+    path = str(tmp_path / "w.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    wd = obs.StallWatchdog(sink, deadline_s=0.05, run_id="r", poll_s=0.01)
+    wd.start()
+    try:
+        time.sleep(0.2)                       # first gap: must fire ONCE
+        assert wd.stall_count == 1
+        wd.notify_step(7)                     # recover + re-arm
+        time.sleep(0.2)                       # second gap: fires again
+        assert wd.stall_count == 2
+    finally:
+        wd.close()
+    count_at_close = wd.stall_count
+    time.sleep(0.15)                          # disarmed: no more records
+    assert wd.stall_count == count_at_close
+    records = obs.read_jsonl(path)
+    assert [r["record"] for r in records] == ["stall", "stall"]
+    assert all(obs.validate_record(r) == [] for r in records)
+    assert records[0]["seconds_since_step"] >= 0.05
+    assert "MainThread" in records[0]["thread_stacks"]
+    assert records[1]["step"] == 7            # last completed step
+    assert records[0]["run_id"] == "r"
+
+
+def test_watchdog_quiet_while_steps_flow(tmp_path):
+    path = str(tmp_path / "q.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    wd = obs.StallWatchdog(sink, deadline_s=0.2, poll_s=0.01)
+    wd.start()
+    try:
+        for i in range(10):
+            time.sleep(0.02)
+            wd.notify_step(i + 1)
+    finally:
+        wd.close()
+    assert wd.stall_count == 0
+    assert not os.path.exists(path)           # nothing ever written
+
+
+def test_watchdog_rejects_nonpositive_deadline(tmp_path):
+    with pytest.raises(ValueError):
+        obs.StallWatchdog(obs.JsonlSink(str(tmp_path / "x"), rank=0),
+                          deadline_s=0.0)
+
+
+# ------------------------------------------------ overflow provenance
+
+class _TwoBranch:
+    """Built lazily: flax import kept inside the factory."""
+
+    @staticmethod
+    def build():
+        import flax.linen as nn
+
+        class TwoBranch(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True):
+                a = nn.Dense(4, name="branch_a")(x)
+                b = nn.Dense(4, name="branch_b")(x)
+                # tanh: its backward multiplies by values computed FROM
+                # branch_a's params, so poisoned params yield NaN grads
+                # (a linear branch's grads would stay finite).
+                return jnp.tanh(a) + b
+
+        return TwoBranch()
+
+
+def test_module_grad_stats_names_nonfinite_module():
+    grads = {"branch_a": {"kernel": jnp.array([1.0, jnp.nan, jnp.inf])},
+             "branch_b": {"kernel": jnp.array([3.0, 4.0])}}
+    stats = obs.module_grad_stats(grads)
+    assert int(stats["branch_a"]["nonfinite"]) == 2
+    assert int(stats["branch_b"]["nonfinite"]) == 0
+    assert float(stats["branch_b"]["grad_norm"]) == pytest.approx(5.0)
+
+
+def test_nan_injection_overflow_event_names_poisoned_module(tmp_path):
+    """The acceptance bar: a NaN-poisoned module is NAMED by the
+    overflow_event the engine + NumericsMonitor emit."""
+    from apex_example_tpu.engine import create_train_state, make_train_step
+
+    model = _TwoBranch.build()
+    policy, scaler = amp.initialize("O0", loss_scale="dynamic")
+    import optax
+    x = jnp.ones((4, 8), jnp.float32)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(0.1), x, policy, scaler,
+                               train_kwargs={"train": False})
+    step_fn = jax.jit(make_train_step(
+        model, optax.sgd(0.1), policy, compute_accuracy=False,
+        loss_fn=lambda logits, y: logits.astype(jnp.float32).mean(),
+        numerics=True))
+
+    # Clean step first: grads finite, no overflow_event in overflow mode.
+    path = str(tmp_path / "n.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    monitor = obs.NumericsMonitor(sink, mode="overflow", run_id="r")
+    new_state, metrics = step_fn(state, (x, jnp.zeros((4,), jnp.int32)))
+    assert monitor.on_step(1, metrics) is None
+    assert float(metrics["grads_finite"]) == 1.0
+
+    # Poison branch_a's params; branch_b's grads stay finite (additive
+    # heads: the NaN branch's cotangent never reaches branch_b).
+    params = dict(state.params)
+    params["branch_a"] = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, jnp.nan), dict(params["branch_a"]))
+    poisoned = state.replace(params=params)
+    _, metrics = step_fn(poisoned, (x, jnp.zeros((4,), jnp.int32)))
+    assert float(metrics["grads_finite"]) == 0.0
+    rec = monitor.on_step(2, metrics)
+    assert rec is not None
+    assert rec["modules"] == ["branch_a"]
+    assert rec["module_stats"]["branch_a"]["nonfinite"] > 0
+    assert rec["module_stats"]["branch_b"]["nonfinite"] == 0
+    assert obs.validate_record(rec) == []
+    sink.close()
+    records = obs.read_jsonl(path)
+    assert [r["record"] for r in records] == ["overflow_event"]
+
+
+def test_numerics_monitor_always_mode_and_bounds(tmp_path):
+    sink = obs.JsonlSink(str(tmp_path / "a.jsonl"), rank=0)
+    monitor = obs.NumericsMonitor(sink, mode="always", max_events=2)
+    metrics = {"grads_finite": 1.0, "numerics":
+               {"m": {"nonfinite": jnp.asarray(0), "grad_norm":
+                      jnp.asarray(1.0)}}}
+    assert monitor.on_step(1, metrics)["modules"] == []   # finite, named no-one
+    assert monitor.on_step(2, metrics) is not None
+    assert monitor.on_step(3, metrics) is None            # max_events cap
+    with pytest.raises(ValueError):
+        obs.NumericsMonitor(sink, mode="bogus")
+
+
+# ----------------------------------------------------- fleet report
+
+def _rank_stream(path, rank, n=12, steady_ms=10.0, overflow_at=(),
+                 summary=True, tail_ms=None):
+    recs = [_header(rank)]
+    for i in range(1, n + 1):
+        ms = steady_ms * 10 if i == 1 else steady_ms       # compile step
+        if tail_ms is not None and i > n // 2:
+            ms = tail_ms
+        recs.append(_step(i, ms=ms,
+                          finite=0.0 if i in overflow_at else 1.0))
+    if summary:
+        recs.append({"record": "run_summary", "steps": n,
+                     "overflow_count": len(overflow_at)})
+    _write_stream(path, recs)
+
+
+def test_fleet_report_flags_injected_straggler(tmp_path, capsys):
+    """The acceptance bar: a 2-rank fixture with one injected straggler
+    (3x the step time) gets flagged, with rank auto-discovery."""
+    base = str(tmp_path / "out.jsonl")
+    _rank_stream(base, 0, steady_ms=10.0)
+    _rank_stream(base + ".rank1", 1, steady_ms=31.0)
+    fleet = _load_tool("fleet_report")
+    rc = fleet.main([base])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "STRAGGLER: rank 1" in out
+    assert "anomalies: 1" in out
+
+
+def test_fleet_report_clean_fleet_exits_zero(tmp_path, capsys):
+    base = str(tmp_path / "out.jsonl")
+    _rank_stream(base, 0, steady_ms=10.0)
+    _rank_stream(base + ".rank1", 1, steady_ms=10.5)
+    fleet = _load_tool("fleet_report")
+    assert fleet.main([base]) == 0
+    assert "anomalies: 0" in capsys.readouterr().out
+
+
+def test_fleet_report_overflow_divergence_and_abort(tmp_path, capsys):
+    base = str(tmp_path / "out.jsonl")
+    _rank_stream(base, 0, overflow_at=(3,))
+    _rank_stream(base + ".rank1", 1, overflow_at=(), summary=False)
+    fleet = _load_tool("fleet_report")
+    rc = fleet.main([base])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "OVERFLOW DIVERGENCE" in out
+    assert "ABORTED: rank 1" in out
+
+
+def test_fleet_report_step_time_regression(tmp_path, capsys):
+    base = str(tmp_path / "solo.jsonl")
+    _rank_stream(base, 0, n=16, steady_ms=10.0, tail_ms=20.0)
+    fleet = _load_tool("fleet_report")
+    assert fleet.main([base]) == 1
+    assert "STEP-TIME REGRESSION" in capsys.readouterr().out
+
+
+def test_fleet_report_ignores_non_rank_siblings(tmp_path, capsys):
+    """A stale out.jsonl.rank1.bak next to the real files must be skipped
+    by discovery, not crash the sort."""
+    base = str(tmp_path / "out.jsonl")
+    _rank_stream(base, 0, steady_ms=10.0)
+    _rank_stream(base + ".rank1", 1, steady_ms=10.0)
+    with open(base + ".rank1.bak", "w") as fh:
+        fh.write("garbage\n")
+    fleet = _load_tool("fleet_report")
+    assert fleet.main([base]) == 0
+    assert "fleet: 2 rank(s)" in capsys.readouterr().out
+
+
+def test_fleet_report_unusable_input(tmp_path, capsys):
+    empty = str(tmp_path / "empty.jsonl")
+    _write_stream(empty, [_header()])
+    fleet = _load_tool("fleet_report")
+    assert fleet.main([empty]) == 2
+
+
+# ----------------------------------------- lint + report satellites
+
+def test_metrics_lint_require_summary_exit_codes(tmp_path):
+    lint = _load_tool("metrics_lint")
+    complete = str(tmp_path / "ok.jsonl")
+    _write_stream(complete, [_header(), _step(1),
+                             {"record": "run_summary", "steps": 1,
+                              "overflow_count": 0}])
+    truncated = str(tmp_path / "cut.jsonl")
+    _write_stream(truncated, [_header(), _step(1)])
+    invalid = str(tmp_path / "bad.jsonl")
+    _write_stream(invalid, [{"record": "nope"}])
+
+    assert lint.lint(complete, require_summary=True)[0] == 0
+    assert lint.lint(truncated)[0] == 0                 # valid, no demand
+    code, errors = lint.lint(truncated, require_summary=True)
+    assert code == 2 and "run_summary" in errors[0]
+    assert lint.lint(invalid, require_summary=True)[0] == 1
+    assert lint.main([truncated, "--require-summary"]) == 2
+
+
+def test_telemetry_report_flags_aborted_runs(tmp_path, capsys):
+    report = _load_tool("telemetry_report")
+    # (a) stream that just stops — no summary at all
+    cut = str(tmp_path / "cut.jsonl")
+    _write_stream(cut, [_header(), _step(1), _step(2, finite=0.0)])
+    assert report.main([cut]) == 0
+    out = capsys.readouterr().out
+    assert "ABORTED RUN" in out
+    assert "overflow steps" in out and "(at 2)" in out  # indices listed
+    # (b) flight-recorder stream: crash_dump + aborted summary
+    crashed = str(tmp_path / "crash.jsonl")
+    _write_stream(crashed, [
+        _header(), _step(1),
+        {"record": "crash_dump", "time": 1.0, "reason": "signal:SIGTERM",
+         "step": 1},
+        {"record": "stall", "time": 1.0, "seconds_since_step": 33.0},
+        {"record": "run_summary", "steps": 1, "overflow_count": 0,
+         "aborted": True, "abort_reason": "signal:SIGTERM"}])
+    assert report.main([crashed]) == 0
+    out = capsys.readouterr().out
+    assert "ABORTED RUN: signal:SIGTERM" in out
+    assert "crash_dump at step 1" in out
+    assert "stalls: 1" in out
+
+
+def test_telemetry_report_bench_stream_is_not_aborted(tmp_path, capsys):
+    """bench.py/accuracy.py streams never carry a run_summary by design —
+    they must not be labeled ABORTED."""
+    report = _load_tool("telemetry_report")
+    bench = str(tmp_path / "bench.jsonl")
+    _write_stream(bench, [{"record": "bench", "metric": "m", "value": 1.0,
+                           "unit": "img/s"}])
+    report.main([bench])
+    assert "ABORTED" not in capsys.readouterr().out
+
+
+# ------------------------------------------------- CLI flag guards
+
+def test_diag_flags_require_metrics_jsonl():
+    for extra in (["--flight-recorder"], ["--stall-timeout", "5"],
+                  ["--numerics-check", "overflow"]):
+        with pytest.raises(SystemExit):
+            train_mod.main(["--arch", "resnet18"] + extra)
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "resnet18", "--metrics-jsonl", "/tmp/x",
+                        "--stall-trace"])                 # needs timeout
+    with pytest.raises(SystemExit):
+        train_mod.main(["--arch", "transformer_xl_tiny", "--metrics-jsonl",
+                        "/tmp/x", "--numerics-check", "overflow"])
+
+
+# --------------------------------- tier-1 CLI smoke (clean diag run)
+
+C1_DIAG_ARGS = ["--arch", "resnet18", "--dataset", "cifar10", "--opt-level",
+                "O0", "--epochs", "1", "--steps-per-epoch", "4",
+                "--batch-size", "8", "--num-devices", "1",
+                "--print-freq", "4"]
+
+
+def test_c1_clean_run_with_diagnostics_armed(tmp_path, capsys):
+    """Recorder + watchdog + numerics armed on a clean run: zero crash/
+    stall records, an UN-aborted summary, per-step overflow_events in
+    'always' mode (empty modules — nothing overflowed), hooks disarmed,
+    stdout meters intact."""
+    path = str(tmp_path / "clean.jsonl")
+    prev_term = signal.getsignal(signal.SIGTERM)
+    rc = train_mod.main(C1_DIAG_ARGS + [
+        "--metrics-jsonl", path, "--flight-recorder",
+        "--stall-timeout", "600", "--numerics-check", "always"])
+    assert rc == 0
+    assert "epoch 0 step 4/4" in capsys.readouterr().out
+    assert signal.getsignal(signal.SIGTERM) == prev_term   # disarmed
+    records = obs.read_jsonl(path)
+    kinds = [r["record"] for r in records]
+    assert "crash_dump" not in kinds and "stall" not in kinds
+    assert kinds.count("overflow_event") == 4              # always mode
+    events = [r for r in records if r["record"] == "overflow_event"]
+    assert all(r["modules"] == [] for r in events)         # all finite
+    summary = records[-1]
+    assert summary["record"] == "run_summary"
+    assert "aborted" not in summary
+    lint = _load_tool("metrics_lint")
+    code, errors = lint.lint(path, steps=4, require_summary=True)
+    assert code == 0, errors
+
+
+# ------------------------------------- subprocess crash-path (diag)
+
+@pytest.mark.diag
+def test_sigterm_mid_flight_yields_crash_dump(tmp_path):
+    """The acceptance bar: SIGTERM a C1 run mid-flight; the JSONL must
+    hold a schema-valid crash_dump + aborted run_summary and pass
+    metrics_lint --require-summary."""
+    path = str(tmp_path / "killed.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join(REPO, "train.py"),
+           "--arch", "resnet18", "--dataset", "cifar10", "--opt-level",
+           "O0", "--epochs", "1", "--steps-per-epoch", "2000",
+           "--batch-size", "8", "--num-devices", "1",
+           "--metrics-jsonl", path, "--flight-recorder",
+           "--flight-recorder-keep", "8"]
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 300
+        steps_seen = 0
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            if os.path.exists(path):
+                with open(path) as fh:
+                    steps_seen = sum(1 for line in fh
+                                     if '"record":"step"' in line)
+                if steps_seen >= 3:
+                    break
+            time.sleep(0.25)
+        assert proc.poll() is None, (
+            f"run ended before it could be killed:\n"
+            f"{proc.communicate()[1].decode(errors='replace')[-2000:]}")
+        assert steps_seen >= 3, "no steps within the deadline"
+        proc.send_signal(signal.SIGTERM)
+        proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    # SIG_DFL re-delivery: conventional 128+15 (or raw -15 from wait4)
+    assert proc.returncode in (-signal.SIGTERM, 128 + signal.SIGTERM)
+
+    records = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(records) == []
+    kinds = [r["record"] for r in records]
+    assert "crash_dump" in kinds
+    crash = next(r for r in records if r["record"] == "crash_dump")
+    assert crash["reason"] == "signal:SIGTERM"
+    assert 1 <= len(crash["last_steps"]) <= 8              # bounded ring
+    summary = records[-1]
+    assert summary["record"] == "run_summary"
+    assert summary["aborted"] is True
+    assert summary["abort_reason"] == "signal:SIGTERM"
+    assert summary["steps"] >= 3
+    lint = _load_tool("metrics_lint")
+    code, errors = lint.lint(path, require_summary=True)
+    assert code == 0, errors
+    report = _load_tool("telemetry_report")
+    assert report.main([path]) == 0
+
+
+# ---------------------------------------- jax-free tools guard (diag)
+
+def _thin_clients():
+    """Every tools/*.py that does not import jax — the thin-client set
+    the guard applies to (new jax-free tools join automatically)."""
+    tools_dir = os.path.join(REPO, "tools")
+    out = []
+    for name in sorted(os.listdir(tools_dir)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(tools_dir, name)) as fh:
+            src = fh.read()
+        if "import jax" not in src:
+            out.append(name[:-3])
+    return out
+
+
+@pytest.mark.diag
+def test_thin_clients_run_without_jax(tmp_path):
+    """The JSONL thin clients must run on hosts WITHOUT jax installed: a
+    poisoned jax module sits first on PYTHONPATH, so any import of jax
+    (direct or transitive) fails loudly."""
+    clients = _thin_clients()
+    # the diagnostics/telemetry clients must be in the set — if one grew
+    # a jax import, that IS the regression this test exists to catch
+    for required in ("metrics_lint", "telemetry_report", "fleet_report"):
+        assert required in clients, f"{required} now imports jax"
+
+    block = tmp_path / "block"
+    block.mkdir()
+    (block / "jax.py").write_text(
+        "raise ImportError('jax is blocked: tools/ thin clients must run "
+        "without jax installed')\n")
+    stream = tmp_path / "s.jsonl"
+    _write_stream(str(stream), [_header(), _step(1),
+                                {"record": "run_summary", "steps": 1,
+                                 "overflow_count": 0}])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(block) + os.pathsep + env.get("PYTHONPATH", "")
+    real_args = {"metrics_lint": [str(stream)],
+                 "telemetry_report": [str(stream)],
+                 "fleet_report": [str(stream)]}
+    for tool in clients:
+        argv = real_args.get(tool, ["--help"])
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", f"{tool}.py")]
+            + argv, env=env, cwd=str(tmp_path), capture_output=True,
+            text=True, timeout=60)
+        assert r.returncode == 0, (tool, r.stdout[-500:], r.stderr[-1000:])

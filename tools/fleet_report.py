@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Cross-host fleet report: merge per-rank --metrics-jsonl files and find
+the rank that is ruining everyone's day.
+
+Multi-host runs with ``--metrics-all-ranks`` write one JSONL file per
+process (``out.jsonl`` for rank 0, ``out.jsonl.rankK`` for K > 0); every
+rank's step dispatch is gated on the same collectives, so ONE slow or
+sick host drags the whole fleet — production TPU practice says stragglers
+and silent per-host faults dominate debugging time.  This tool
+cross-compares the files no other tool reads together:
+
+    python tools/fleet_report.py out.jsonl            # auto-discovers
+                                                      # out.jsonl.rank*
+    python tools/fleet_report.py r0.jsonl r1.jsonl    # explicit files
+
+Checks:
+- per-rank status: aborted (crash_dump / aborted summary / no summary),
+  stalls, step-record counts that diverge across ranks;
+- straggler: a rank whose steady-state p50 step time exceeds
+  ``--straggler-factor`` x the fleet median of p50s;
+- overflow divergence: ranks disagreeing on WHICH steps overflowed
+  (data-parallel overflow skips are a collective decision — divergence
+  means replicated state has forked);
+- loss spikes (step loss > ``--spike-factor`` x the rank's median) and
+  step-time regression (second-half p50 > ``--regress-factor`` x
+  first-half p50, compile step excluded).
+
+No jax import; works on any host with the files.  Exit codes: 0 = no
+anomalies, 1 = anomalies flagged, 2 = unusable input (no readable files /
+no step records anywhere).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Same no-jax file-path load as tools/telemetry_report.py.
+from metrics_lint import pct as _pct  # noqa: E402  (sibling import)
+from metrics_lint import validate_stream  # noqa: E402
+
+
+def _median(vals: List[float]) -> float:
+    return _pct(sorted(vals), 50)
+
+
+def discover(paths: List[str]) -> Dict[int, str]:
+    """Map rank -> file.  A single path expands to itself + its
+    ``.rankK`` siblings; explicit lists take ranks from the suffix (or
+    positionally when none carries one)."""
+    if len(paths) == 1 and not re.search(r"\.rank\d+$", paths[0]):
+        base = paths[0]
+        # Filter before sorting: a stale sibling like out.jsonl.rank1.bak
+        # matches the glob but not the rank shape — skip it, don't crash.
+        siblings = [p for p in glob.glob(glob.escape(base) + ".rank*")
+                    if re.search(r"\.rank\d+$", p)]
+        paths = [base] + sorted(
+            siblings, key=lambda p: int(p.rsplit("rank", 1)[1]))
+    out: Dict[int, str] = {}
+    for i, path in enumerate(paths):
+        m = re.search(r"\.rank(\d+)$", path)
+        out[int(m.group(1)) if m else i] = path
+    return out
+
+
+def load_rank(path: str) -> Optional[dict]:
+    """Parse + summarize one rank's stream (None when unreadable)."""
+    records = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass                    # killed runs truncate the tail
+    except OSError as e:
+        print(f"WARNING: {path}: {e}", file=sys.stderr)
+        return None
+    steps = [r for r in records if isinstance(r, dict)
+             and r.get("record") == "step" and "step_time_ms" in r]
+    summary = next((r for r in records
+                    if r.get("record") == "run_summary"), None)
+    crash = next((r for r in records
+                  if r.get("record") == "crash_dump"), None)
+    stalls = [r for r in records if r.get("record") == "stall"]
+    overflow_steps = sorted(r["step"] for r in steps
+                            if r.get("grads_finite", 1) < 1)
+    times = [r["step_time_ms"] for r in steps]
+    return {
+        "path": path,
+        "records": records,
+        "schema_errors": validate_stream(records),
+        "steps": steps,
+        "n_steps": len(steps),
+        "times_ms": times,
+        # steady state: the first step is trace+compile+execute
+        "steady_ms": times[1:] if len(times) > 1 else times,
+        "losses": [r["loss"] for r in steps if "loss" in r],
+        "overflow_steps": overflow_steps,
+        "summary": summary,
+        "crash": crash,
+        "stalls": stalls,
+        "aborted": (crash is not None or summary is None
+                    or bool(summary.get("aborted"))),
+        "abort_reason": (crash or {}).get(
+            "reason", (summary or {}).get(
+                "abort_reason",
+                None if summary is not None else "no run_summary")),
+    }
+
+
+def analyze(ranks: Dict[int, dict], straggler_factor: float,
+            spike_factor: float, regress_factor: float,
+            out=sys.stdout) -> int:
+    """Print the report; returns the anomaly count."""
+    anomalies = 0
+    ids = sorted(ranks)
+
+    # ---- fleet table -------------------------------------------------
+    counts = sorted({ranks[i]["n_steps"] for i in ids})
+    print(f"fleet: {len(ids)} rank(s), "
+          + (f"{counts[0]} steps each" if len(counts) == 1 else
+             f"step counts DIVERGE {counts}"), file=out)
+    print("rank  steps  p50_ms    p95_ms    overflows  status", file=out)
+    p50s = {}
+    for i in ids:
+        r = ranks[i]
+        steady = sorted(r["steady_ms"])
+        p50s[i] = _pct(steady, 50)
+        status = "ok"
+        if r["aborted"]:
+            status = f"ABORTED ({r['abort_reason']})"
+        elif r["stalls"]:
+            status = f"stalled x{len(r['stalls'])}"
+        print(f"{i:<5} {r['n_steps']:<6} {p50s[i]:<9.1f} "
+              f"{_pct(steady, 95):<9.1f} {len(r['overflow_steps']):<10} "
+              f"{status}", file=out)
+
+    # ---- cross-rank checks ------------------------------------------
+    if len(counts) > 1:
+        anomalies += 1
+        print(f"DIVERGENT STEP COUNTS: {counts} — a rank fell out of the "
+              "run early", file=out)
+    for i in ids:
+        if ranks[i]["aborted"]:
+            anomalies += 1
+            print(f"ABORTED: rank {i} ({ranks[i]['abort_reason']})",
+                  file=out)
+        for s in ranks[i]["stalls"]:
+            anomalies += 1
+            print(f"STALL: rank {i} at step {s.get('step', '?')} — "
+                  f"{s.get('seconds_since_step', 0):.0f}s without a step",
+                  file=out)
+
+    fleet_median = _median([p50s[i] for i in ids]) if ids else 0.0
+    if fleet_median > 0:
+        for i in ids:
+            if p50s[i] > straggler_factor * fleet_median:
+                anomalies += 1
+                print(f"STRAGGLER: rank {i} p50 {p50s[i]:.1f} ms = "
+                      f"{p50s[i] / fleet_median:.2f}x the fleet median "
+                      f"{fleet_median:.1f} ms", file=out)
+
+    overflow_sets = {i: set(ranks[i]["overflow_steps"]) for i in ids}
+    union = set().union(*overflow_sets.values()) if ids else set()
+    if union and any(overflow_sets[i] != union for i in ids):
+        anomalies += 1
+        detail = ", ".join(
+            f"rank {i}: {sorted(overflow_sets[i])}" for i in ids)
+        print("OVERFLOW DIVERGENCE: ranks disagree on which steps "
+              f"overflowed ({detail}) — the overflow-skip decision must "
+              "be collective; replicated state has likely forked",
+              file=out)
+
+    # ---- per-rank anomaly rules -------------------------------------
+    for i in ids:
+        r = ranks[i]
+        if len(r["losses"]) >= 4:
+            med = _median(r["losses"])
+            spikes = [(rec["step"], rec["loss"]) for rec in r["steps"]
+                      if "loss" in rec and med > 0
+                      and rec["loss"] > spike_factor * med]
+            if spikes:
+                anomalies += 1
+                step, loss = spikes[0]
+                print(f"LOSS SPIKE: rank {i} step {step} loss {loss:.4g} "
+                      f"> {spike_factor:.1f}x median {med:.4g} "
+                      f"({len(spikes)} step(s))", file=out)
+        steady = r["steady_ms"]
+        if len(steady) >= 8:
+            half = len(steady) // 2
+            first, second = (_median(steady[:half]), _median(steady[half:]))
+            if first > 0 and second > regress_factor * first:
+                anomalies += 1
+                print(f"STEP-TIME REGRESSION: rank {i} second-half p50 "
+                      f"{second:.1f} ms = {second / first:.2f}x first-half "
+                      f"{first:.1f} ms", file=out)
+        for e in r["schema_errors"]:
+            print(f"WARNING: rank {i}: {e}", file=sys.stderr)
+
+    print(f"anomalies: {anomalies}", file=out)
+    return anomalies
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="cross-host straggler/anomaly report over per-rank "
+                    "--metrics-jsonl files")
+    ap.add_argument("paths", nargs="+",
+                    help="rank-0 file (siblings .rankK auto-discovered) "
+                         "or an explicit list of per-rank files")
+    ap.add_argument("--straggler-factor", type=float, default=1.25,
+                    help="flag ranks whose steady p50 exceeds this factor "
+                         "x the fleet median (default 1.25)")
+    ap.add_argument("--spike-factor", type=float, default=3.0,
+                    help="flag steps whose loss exceeds this factor x the "
+                         "rank's median loss (default 3)")
+    ap.add_argument("--regress-factor", type=float, default=1.3,
+                    help="flag ranks whose second-half p50 step time "
+                         "exceeds this factor x the first half "
+                         "(default 1.3)")
+    args = ap.parse_args(argv)
+
+    files = discover(args.paths)
+    ranks = {i: r for i, r in
+             ((i, load_rank(p)) for i, p in sorted(files.items()))
+             if r is not None}
+    if not ranks or not any(r["n_steps"] for r in ranks.values()):
+        print("no step records in any input", file=sys.stderr)
+        return 2
+    anomalies = analyze(ranks, args.straggler_factor, args.spike_factor,
+                        args.regress_factor)
+    return 1 if anomalies else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,10 +6,21 @@ runs anywhere the repo is checked out:
 
     python tools/metrics_lint.py out.jsonl
     python tools/metrics_lint.py out.jsonl --require grad_norm --steps 10
+    python tools/metrics_lint.py out.jsonl --require-summary
 
-Exit status: 0 when every line parses and validates (and the --require /
---steps demands hold), 1 otherwise.  The tier-1 smoke test
-(tests/test_obs.py) runs this over a 10-step C1 run.
+Schema v2 streams (the diagnostics records: crash_dump / stall /
+overflow_event, aborted run summaries) validate alongside v1 streams —
+the schema tables are a strict superset.
+
+Exit status (the contract CI scripts key on):
+  0   every line parses and validates, and the --require / --steps /
+      --require-summary demands hold;
+  1   parse or schema-validation errors (or a --require/--steps miss);
+  2   the stream validated but carries no run_summary and
+      --require-summary was demanded (i.e. an aborted/killed run whose
+      flight recorder never fired).
+The tier-1 smoke tests (tests/test_obs.py, tests/test_diag.py) run this
+over 10-step C1 runs, clean and SIGTERM'd.
 """
 
 from __future__ import annotations
@@ -18,6 +29,7 @@ import argparse
 import collections
 import importlib.util
 import json
+import math
 import os
 import sys
 
@@ -37,10 +49,24 @@ def _load_schema():
 validate_stream = _load_schema().validate_stream
 
 
-def lint(path: str, require=(), steps: int = None) -> tuple[int, list]:
+def pct(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list: the
+    ceil(q/100 * n)-th value (1-based), clamped.  Shared by the report
+    tools (telemetry_report, fleet_report); obs/metrics.Histogram applies
+    the same formula on the jax side of the fence."""
+    if not sorted_vals:
+        return 0.0
+    idx = math.ceil(q / 100.0 * len(sorted_vals)) - 1
+    return sorted_vals[min(max(idx, 0), len(sorted_vals) - 1)]
+
+
+def lint(path: str, require=(), steps: int = None,
+         require_summary: bool = False) -> tuple[int, list]:
     """(exit_code, errors).  ``require``: fields every step record must
     carry beyond the schema's required set.  ``steps``: exact expected
-    step-record count."""
+    step-record count.  ``require_summary``: demand a run_summary record
+    — an otherwise-valid stream without one exits 2 (see module
+    docstring), distinguishing "invalid" from "aborted"."""
     errors = []
     records = []
     with open(path) as fh:
@@ -65,7 +91,11 @@ def lint(path: str, require=(), steps: int = None) -> tuple[int, list]:
     if steps is not None and kinds.get("step", 0) != steps:
         errors.append(f"expected {steps} step records, found "
                       f"{kinds.get('step', 0)}")
-    return (1 if errors else 0), errors
+    if errors:
+        return 1, errors
+    if require_summary and not kinds.get("run_summary"):
+        return 2, ["stream ends without a run_summary (aborted run?)"]
+    return 0, []
 
 
 def main(argv=None) -> int:
@@ -76,9 +106,13 @@ def main(argv=None) -> int:
                          "carry (e.g. grad_norm,items_per_sec)")
     ap.add_argument("--steps", type=int, default=None,
                     help="exact expected number of step records")
+    ap.add_argument("--require-summary", action="store_true",
+                    help="demand a run_summary record; a valid stream "
+                         "without one exits 2 (aborted run)")
     args = ap.parse_args(argv)
     require = [f for f in args.require.split(",") if f]
-    code, errors = lint(args.path, require=require, steps=args.steps)
+    code, errors = lint(args.path, require=require, steps=args.steps,
+                        require_summary=args.require_summary)
     for e in errors:
         print(f"{args.path}: {e}", file=sys.stderr)
     if code == 0:

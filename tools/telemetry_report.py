@@ -1,6 +1,9 @@
 #!/usr/bin/env python
 """Summarize a --metrics-jsonl telemetry file: step-time distribution,
-throughput, compile estimate, overflow accounting, span histograms.
+throughput, compile estimate, overflow accounting, span histograms — and
+the failure path: aborted runs (a stream that ends without a
+run_summary, or one marked ``aborted: true``), overflow step indices,
+``crash_dump`` / ``stall`` diagnostics records when present.
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -22,14 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # Same no-jax file-path load as tools/metrics_lint.py: the report must run
 # on hosts that only have the JSONL file and this checkout.
-from metrics_lint import validate_stream  # noqa: E402  (sibling import)
-
-
-def _pct(sorted_vals, q):
-    if not sorted_vals:
-        return 0.0
-    return sorted_vals[min(int(q / 100 * len(sorted_vals)),
-                           len(sorted_vals) - 1)]
+from metrics_lint import pct as _pct  # noqa: E402  (sibling import)
+from metrics_lint import validate_stream  # noqa: E402
 
 
 def report(path: str, out=sys.stdout) -> int:
@@ -53,6 +50,10 @@ def report(path: str, out=sys.stdout) -> int:
                   None)
     summary = next((r for r in records if r.get("record") == "run_summary"),
                    None)
+    crashes = [r for r in records if r.get("record") == "crash_dump"]
+    stalls = [r for r in records if r.get("record") == "stall"]
+    overflow_events = [r for r in records
+                       if r.get("record") == "overflow_event"]
     # Schema-invalid step records were warned about above; summarize only
     # the ones carrying the contract fields rather than crashing.
     steps = [r for r in records if r.get("record") == "step"
@@ -64,6 +65,30 @@ def report(path: str, out=sys.stdout) -> int:
         print(f"run {header['run_id']}  platform={header['platform']}  "
               f"devices={header['num_devices']}  "
               f"arch={header.get('arch', cfg.get('arch', '?'))}", file=out)
+    # A TRAIN run is the happy path only when it closed with an unmarked
+    # summary; everything else is an abort and says so up front.  Streams
+    # with no run_header and no steps (bench.py / accuracy.py records)
+    # never write a summary by design — not aborts.
+    is_train_stream = header is not None or any(
+        r.get("record") == "step" for r in records)
+    if summary is None:
+        if is_train_stream:
+            print("ABORTED RUN: stream ends without a run_summary (killed "
+                  "before the flight recorder could fire, or no "
+                  "--flight-recorder)", file=out)
+    elif summary.get("aborted"):
+        reason = summary.get("abort_reason", "unknown reason")
+        print(f"ABORTED RUN: {reason}", file=out)
+    for c in crashes:
+        where = f" at step {c['step']}" if "step" in c else ""
+        print(f"crash_dump{where}: {c.get('reason', '?')}", file=out)
+        tb = c.get("traceback", "").strip().splitlines()
+        if tb:
+            print(f"  {tb[-1]}", file=out)
+    if stalls:
+        worst = max(s.get("seconds_since_step", 0) for s in stalls)
+        print(f"stalls: {len(stalls)} (longest {worst:.0f}s without a "
+              "step)", file=out)
     if not steps:
         print("no step records", file=out)
         return 1
@@ -78,7 +103,21 @@ def report(path: str, out=sys.stdout) -> int:
     print(f"items_per_sec p50 {_pct(rates, 50):.1f}  max {rates[-1]:.1f}",
           file=out)
     overflow = max((r.get("overflow_count", 0) for r in steps), default=0)
-    print(f"overflow steps {overflow}", file=out)
+    # .get throughout: this tool summarizes broken streams, it must not
+    # crash on a record missing a field the schema calls required.
+    overflow_at = [r.get("step", "?") for r in steps
+                   if r.get("grads_finite", 1) < 1]
+    shown = ", ".join(str(s) for s in overflow_at[:20]) + \
+        (", ..." if len(overflow_at) > 20 else "")
+    print(f"overflow steps {overflow}"
+          + (f" (at {shown})" if overflow_at else ""), file=out)
+    for ev in overflow_events[:10]:
+        mods = ", ".join(ev.get("modules", [])) or "-"
+        print(f"overflow_event step {ev.get('step', '?')}: non-finite "
+              f"grads in [{mods}]", file=out)
+    if len(overflow_events) > 10:
+        print(f"... {len(overflow_events) - 10} more overflow_event "
+              "record(s)", file=out)
     norms = [r["grad_norm"] for r in steps if "grad_norm" in r]
     if norms:
         s = sorted(norms)

@@ -202,6 +202,33 @@ def parse_args(argv=None):
                    help="capture a jax profiler trace for exactly run-"
                         "relative steps N..M (1-based, inclusive) instead "
                         "of --prof's whole-run dump")
+    # diagnostics stratum (obs/flight.py, obs/watchdog.py, obs/numerics.py;
+    # README "Diagnostics") — all write to the --metrics-jsonl sink
+    p.add_argument("--flight-recorder", action="store_true",
+                   help="with --metrics-jsonl: keep a ring of the last K "
+                        "step records and, on crash/SIGTERM/SIGINT, emit "
+                        "a 'crash_dump' record plus an aborted run "
+                        "summary to the JSONL sink (obs/flight.py)")
+    p.add_argument("--flight-recorder-keep", type=int, default=64,
+                   metavar="K",
+                   help="step records the flight recorder's ring retains")
+    p.add_argument("--stall-timeout", type=float, default=0.0, metavar="S",
+                   help="with --metrics-jsonl: if no step completes for S "
+                        "seconds, dump all-thread stacks and emit a "
+                        "'stall' record (0 disables; the deadline covers "
+                        "the first step's compile — size it accordingly)")
+    p.add_argument("--stall-trace", action="store_true",
+                   help="with --stall-timeout: on the first stall, arm a "
+                        "one-shot profiler trace (stall start to first "
+                        "recovered step) in the --profile-window trace dir")
+    p.add_argument("--numerics-check", default="off",
+                   choices=["off", "overflow", "always"],
+                   help="overflow provenance fused into the engine's "
+                        "finite-check pass: per-module non-finite counts "
+                        "+ grad norms, emitted as 'overflow_event' "
+                        "records naming the offending module(s) "
+                        "('overflow': only on overflow steps; 'always': "
+                        "every step; requires --metrics-jsonl)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval", action="store_true")
     p.add_argument("--eval-batches", type=int, default=10,
@@ -254,10 +281,12 @@ def make_writer(args):
 
 def make_telemetry(args):
     """Flag-gated obs wiring shared by the image and LM loops: the per-step
-    telemetry emitter (--metrics-jsonl) and the profiler window
-    (--profile-window).  Also binds the span registry so host spans
-    ("data"/"step") aggregate into the run_summary."""
-    emitter = None
+    telemetry emitter (--metrics-jsonl), the profiler window
+    (--profile-window), and the diagnostics stratum (--flight-recorder /
+    --stall-timeout / --numerics-check) riding the emitter as observers.
+    Also binds the span registry so host spans ("data"/"step") aggregate
+    into the run_summary."""
+    emitter = recorder = watchdog = None
     if args.metrics_jsonl:
         registry = obs.MetricsRegistry()
         obs.set_default_registry(registry)
@@ -266,15 +295,43 @@ def make_telemetry(args):
         emitter = TelemetryEmitter(sink, registry=registry)
         emitter.run_header(config=vars(args), argv=sys.argv[1:],
                            arch=args.arch)
-    return emitter, make_profiler_window(args.profile_window or None)
+        if args.flight_recorder:
+            recorder = obs.FlightRecorder(emitter, config=vars(args),
+                                          keep=args.flight_recorder_keep)
+            recorder.install()
+            emitter.add_observer(recorder.on_record)
+        if args.stall_timeout > 0:
+            from apex_example_tpu.obs import DEFAULT_TRACE_DIR
+            watchdog = obs.StallWatchdog(
+                sink, deadline_s=args.stall_timeout, run_id=emitter.run_id,
+                trace_dir=DEFAULT_TRACE_DIR if args.stall_trace else None)
+            watchdog.start()
+            emitter.add_observer(watchdog.on_record)
+        if args.numerics_check != "off":
+            monitor = obs.NumericsMonitor(sink, mode=args.numerics_check,
+                                          run_id=emitter.run_id)
+            emitter.add_observer(monitor.on_record)
+    return emitter, make_profiler_window(args.profile_window or None), \
+        recorder, watchdog
 
 
-def close_telemetry(emitter, profwin):
+def close_telemetry(emitter, profwin, recorder=None, watchdog=None):
     """Counterpart of make_telemetry for the finally blocks: stop an open
-    trace window, flush the run_summary, unbind the span registry (a
-    programmatic caller must not inherit it)."""
+    trace window, disarm the watchdog, flush the run_summary, unbind the
+    span registry (a programmatic caller must not inherit it).  Called
+    while an exception is unwinding (sys.exc_info is live inside a
+    finally), it routes through the flight recorder instead: crash_dump +
+    aborted summary, not a clean close."""
     if profwin is not None:
         profwin.close()
+    if watchdog is not None:
+        watchdog.close()
+    exc = sys.exc_info()
+    if recorder is not None and exc[0] is not None \
+            and not issubclass(exc[0], SystemExit):
+        recorder.crash_dump(f"exception:{exc[0].__name__}", exc_info=exc)
+    if recorder is not None:
+        recorder.close()
     if emitter is not None:
         emitter.close()
     obs.set_default_registry(None)
@@ -367,6 +424,23 @@ def main(argv=None):
     if args.prof and args.profile_window:
         raise SystemExit("--prof traces the whole run; pick it or "
                          "--profile-window N:M, not both")
+    if (args.flight_recorder or args.stall_timeout > 0
+            or args.numerics_check != "off") and not args.metrics_jsonl:
+        raise SystemExit("--flight-recorder/--stall-timeout/"
+                         "--numerics-check write to the telemetry sink; "
+                         "add --metrics-jsonl PATH")
+    if args.stall_trace and args.stall_timeout <= 0:
+        raise SystemExit("--stall-trace arms on a stall; it needs "
+                         "--stall-timeout S")
+    if args.numerics_check != "off" and (
+            args.zero or args.pipeline_parallel > 1
+            or args.context_parallel > 1 or args.moe_experts
+            or args.arch.startswith("transformer_xl")):
+        raise SystemExit("--numerics-check rides the shared engine step's "
+                         "finite-check pass (engine.make_train_step); the "
+                         "--zero/--pipeline-parallel/--context-parallel/"
+                         "--moe-experts and transformer_xl steps own their "
+                         "own grad pipelines and are not wired yet")
     if args.profile_window:
         from apex_example_tpu.obs import parse_window
         try:
@@ -457,21 +531,23 @@ def main(argv=None):
             step_fn = make_zero_train_step(mesh, model, optimizer, policy)
             rank_print(f"ZeRO-1 DDP over {n_dev} devices: {mesh}")
         else:
-            step_fn = make_sharded_train_step(mesh, model, optimizer,
-                                              policy, ddp=ddp,
-                                              grad_accum=args.grad_accum)
+            step_fn = make_sharded_train_step(
+                mesh, model, optimizer, policy, ddp=ddp,
+                grad_accum=args.grad_accum,
+                numerics=args.numerics_check != "off")
             rank_print(f"DDP over {n_dev} devices: {mesh}")
     else:
-        step_fn = jax.jit(make_train_step(model, optimizer, policy,
-                                          grad_accum=args.grad_accum),
-                          donate_argnums=(0,))
+        step_fn = jax.jit(make_train_step(
+            model, optimizer, policy, grad_accum=args.grad_accum,
+            numerics=args.numerics_check != "off"),
+            donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_step(model))
 
     mgr = CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir \
         else None
     writer = make_writer(args)
     tb = TensorBoardAdapter(writer)
-    emitter, profwin = make_telemetry(args)
+    emitter, profwin, recorder, watchdog = make_telemetry(args)
     start_epoch = 0
     if args.resume:
         rmgr = CheckpointManager(args.resume)
@@ -577,7 +653,7 @@ def main(argv=None):
                 mgr.save(state, wait=not args.async_checkpoint)
                 rank_print(f"saved checkpoint at step {int(state.step)}")
     finally:
-        close_telemetry(emitter, profwin)
+        close_telemetry(emitter, profwin, recorder, watchdog)
         if prefetcher is not None:
             prefetcher.close()
         tb.close()
@@ -968,12 +1044,11 @@ def _lm_main_impl(args, policy, scaler):
             sample[:1], policy, scaler,
             zero_axis=_DATA if args.zero else None)
         if is_bert or is_gpt:
-            step_fn = make_gspmd_train_step(mesh, model, optimizer, policy,
-                                            shardings,
-                                            loss_fn=mlm_loss if is_bert
-                                            else lm_loss,
-                                            compute_accuracy=False,
-                                            grad_accum=args.grad_accum)
+            step_fn = make_gspmd_train_step(
+                mesh, model, optimizer, policy, shardings,
+                loss_fn=mlm_loss if is_bert else lm_loss,
+                compute_accuracy=False, grad_accum=args.grad_accum,
+                numerics=args.numerics_check != "off")
             mems = None
         else:
             step_fn = make_gspmd_txl_train_step(
@@ -1147,13 +1222,14 @@ def _lm_main_impl(args, policy, scaler):
             mesh = make_data_mesh(devices=devices)
             step_fn = make_sharded_train_step(
                 mesh, model, optimizer, policy, loss_fn=loss_fn,
-                compute_accuracy=False, grad_accum=args.grad_accum)
+                compute_accuracy=False, grad_accum=args.grad_accum,
+                numerics=args.numerics_check != "off")
         else:
-            step_fn = jax.jit(make_train_step(model, optimizer, policy,
-                                              loss_fn=loss_fn,
-                                              compute_accuracy=False,
-                                              grad_accum=args.grad_accum),
-                              donate_argnums=(0,))
+            step_fn = jax.jit(make_train_step(
+                model, optimizer, policy, loss_fn=loss_fn,
+                compute_accuracy=False, grad_accum=args.grad_accum,
+                numerics=args.numerics_check != "off"),
+                donate_argnums=(0,))
     else:
         # grad accumulation slices the BATCH axis (independent streams), so
         # each stream's recurrence carry stays exact — see
@@ -1230,7 +1306,7 @@ def _lm_main_impl(args, policy, scaler):
         else None
     writer = make_writer(args)
     tb = TensorBoardAdapter(writer)
-    emitter, profwin = make_telemetry(args)
+    emitter, profwin, recorder, watchdog = make_telemetry(args)
     start_epoch = 0
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
@@ -1364,7 +1440,7 @@ def _lm_main_impl(args, policy, scaler):
         # Join pending async checkpoint writes even when unwinding on an
         # exception — an announced save must exist on disk (main() gives
         # its image path the same protection).
-        close_telemetry(emitter, profwin)
+        close_telemetry(emitter, profwin, recorder, watchdog)
         if prefetcher is not None:
             prefetcher.close()
         tb.close()
