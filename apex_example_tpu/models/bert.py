@@ -117,6 +117,13 @@ class BertSelfAttention(nn.Module):
     # takes ONE token, writes its k/v at the running index, and attends
     # against the filled prefix.  models/gpt.generate drives it.
     decode: bool = False
+    # Slot-indexed decode (with decode=True): cache_index is PER ROW
+    # ([B] instead of a shared scalar) — each batch row is an independent
+    # request slot with its own fill level, so one compiled decode step
+    # advances requests that arrived at different times.  k/v land via a
+    # per-row scatter and the live-prefix mask is per-row.  The
+    # continuous-batching engine (serve/slots.py) owns slot lifecycle.
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -168,8 +175,16 @@ class BertSelfAttention(nn.Module):
                                k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros, v.shape,
                                v.dtype)
-            ci = self.variable("cache", "cache_index",
-                               lambda: jnp.zeros((), jnp.int32))
+            if self.slot_decode:
+                # per-slot fill levels: row b's next write lands at
+                # index[b]; reset to 0 on admit (serve/slots.py) without
+                # touching the k/v pages — the live mask hides stale rows.
+                ci = self.variable("cache", "cache_index",
+                                   lambda: jnp.zeros((k.shape[0],),
+                                                     jnp.int32))
+            else:
+                ci = self.variable("cache", "cache_index",
+                                   lambda: jnp.zeros((), jnp.int32))
             if cache_ready:      # per-token decode step (cache exists)
                 if x.shape[1] != 1:
                     raise ValueError("decode takes ONE token per call "
@@ -177,20 +192,31 @@ class BertSelfAttention(nn.Module):
                                      "[B, max_len] shape is for cache "
                                      "allocation at init only")
                 idx = ci.value
-                ck.value = _lax.dynamic_update_slice(ck.value, k,
-                                                     (0, idx, 0, 0))
-                cv.value = _lax.dynamic_update_slice(cv.value, v,
-                                                     (0, idx, 0, 0))
-                ci.value = idx + 1
-                # keys beyond the running index are unwritten cache slots
-                live = jnp.arange(ck.value.shape[1]) <= idx
+                if self.slot_decode:
+                    rows = jnp.arange(k.shape[0])
+                    ck.value = ck.value.at[rows, idx].set(k[:, 0])
+                    cv.value = cv.value.at[rows, idx].set(v[:, 0])
+                    ci.value = idx + 1
+                    # per-row live prefix: slot b attends keys <= idx[b]
+                    live = (jnp.arange(ck.value.shape[1])[None, :]
+                            <= idx[:, None])
+                    mask = live[:, None, None, :]
+                else:
+                    ck.value = _lax.dynamic_update_slice(ck.value, k,
+                                                         (0, idx, 0, 0))
+                    cv.value = _lax.dynamic_update_slice(cv.value, v,
+                                                         (0, idx, 0, 0))
+                    ci.value = idx + 1
+                    # keys beyond the running index are unwritten slots
+                    live = jnp.arange(ck.value.shape[1]) <= idx
+                    mask = live[None, None, None]
                 # head_spec: under TP the cache shards over heads ('model')
                 # exactly like training attention — the constraint keeps
                 # GSPMD from gathering the [B, max_len, h, hd] cache.
                 ctx = _softmax_attention(q, head_spec(ck.value),
                                          head_spec(cv.value),
                                          self.softmax_dtype, self.dtype,
-                                         bool_mask=live[None, None, None])
+                                         bool_mask=mask)
                 return dense_out(ctx.reshape(*x.shape[:-1], d))
             # init trace on the [B, max_len] dummy: cache allocated above;
             # fall through to the standard causal path so params/shapes
@@ -281,6 +307,7 @@ class BertLayer(nn.Module):
     causal: bool = False
     cp_mode: str = "ring"
     decode: bool = False
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -299,6 +326,7 @@ class BertLayer(nn.Module):
                                  causal=self.causal,
                                  cp_mode=self.cp_mode,
                                  decode=self.decode,
+                                 slot_decode=self.slot_decode,
                                  name="attention")(x, mask_bias)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))

@@ -63,6 +63,14 @@ class GPTForCausalLM(nn.Module):
     # a [B, max_len] dummy to allocate per-layer caches, then apply one
     # token at a time with mutable=["cache"].
     decode: bool = False
+    # Slot-indexed decode (with decode=True): every cache index —
+    # cache_position here, cache_index in each attention layer — is PER
+    # ROW ([B] instead of a shared scalar), so each batch row is an
+    # independent request slot with its own position/fill level.  This is
+    # the substrate the continuous-batching engine (serve/) schedules on:
+    # one compiled step advances all live slots regardless of when each
+    # request arrived.
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -101,16 +109,26 @@ class GPTForCausalLM(nn.Module):
             raise ValueError("decode (KV-cache) is the dense/TP inference "
                              "path: no CP/MoE/sequence-parallel "
                              "composition")
+        if self.slot_decode and not self.decode:
+            raise ValueError("slot_decode modifies the KV-cache indices; "
+                             "it requires decode=True")
         x = word_emb(input_ids)
         pos = jnp.arange(L)[None, :]
         if self.decode:
             # position = running cache index (checked BEFORE .variable
             # creates it: at allocation time the dummy covers 0..L-1)
             cache_ready = self.has_variable("cache", "cache_position")
-            pi = self.variable("cache", "cache_position",
-                               lambda: jnp.zeros((), jnp.int32))
+            if self.slot_decode:
+                pi = self.variable("cache", "cache_position",
+                                   lambda: jnp.zeros((b,), jnp.int32))
+            else:
+                pi = self.variable("cache", "cache_position",
+                                   lambda: jnp.zeros((), jnp.int32))
             if cache_ready:      # per-token decode step
-                pos = pos + pi.value
+                # slot mode: per-row positions (each slot is its own
+                # request, mid-prefill or mid-decode independently)
+                pos = pos + (pi.value[:, None] if self.slot_decode
+                             else pi.value)
                 pi.value = pi.value + L
         if self.context_parallel:
             from jax import lax as _lax
@@ -150,6 +168,7 @@ class GPTForCausalLM(nn.Module):
                           moe_top_k=self.moe_top_k,
                           causal=True, cp_mode=self.cp_mode,
                           decode=self.decode,
+                          slot_decode=self.slot_decode,
                           name=f"layer_{i}")(x, None)
             if self.moe_experts:
                 x, aux = x
@@ -184,9 +203,51 @@ def gpt_tiny(**kw) -> GPTForCausalLM:
     return GPTForCausalLM(**kw)
 
 
+def sample_tokens(rng, logits: jnp.ndarray, temperature,
+                  top_k=0) -> jnp.ndarray:
+    """Next-token selection over [B, V] logits with RUNTIME temperature and
+    top-k — both enter as traced values (scalars or per-row [B] vectors),
+    so ONE compiled decode program serves every sampling configuration.
+    Per-row vectors are how the continuous-batching engine
+    (serve/engine.py) mixes greedy and sampled requests in one batch.
+
+    temperature == 0 selects argmax (greedy); top_k == 0 samples the full
+    softmax; top_k > 0 restricts sampling to the k highest logits (a tie
+    at the threshold keeps >= k candidates).
+
+    The expensive lanes are fenced by runtime ``lax.cond``s, so a batch
+    that is entirely greedy executes only the argmax, and the full-vocab
+    sort runs only when some row actually wants top-k — the hot decode
+    path does not pay for sampling features it isn't using.
+    """
+    B, V = logits.shape
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def topk_filter(lg):
+        # Runtime k rules out lax.top_k (static k): the per-row cutoff
+        # is the k-th largest logit via a descending sort, k clamped
+        # into [1, V]; rows with k == 0 skip the filter.
+        kk = jnp.clip(k, 1, V)
+        desc = -jnp.sort(-lg, axis=-1)
+        thresh = jnp.take_along_axis(desc, (kk - 1)[:, None], axis=-1)
+        return jnp.where((k[:, None] > 0) & (lg < thresh), -jnp.inf, lg)
+
+    def sample(lg):
+        filtered = lax.cond(jnp.any(k > 0), topk_filter, lambda x: x, lg)
+        # max() keeps the t == 0 lanes finite; their sample is discarded
+        # by the where below (greedy wins), so their distribution is moot.
+        return jax.random.categorical(
+            rng, filtered / jnp.maximum(t, 1e-6)[:, None]).astype(jnp.int32)
+
+    sampled = lax.cond(jnp.any(t > 0), sample, lambda lg: greedy, logits)
+    return jnp.where(t > 0, sampled, greedy)
+
+
 def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
-             max_len: int, temperature: float = 0.0, rng=None
-             ) -> jnp.ndarray:
+             max_len: int, temperature: float = 0.0, rng=None,
+             top_k: int = 0) -> jnp.ndarray:
     """Autoregressive generation with a KV cache (greedy at temperature 0,
     categorical sampling otherwise).
 
@@ -220,6 +281,9 @@ def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and rng is None:
         raise ValueError("temperature > 0 samples; pass rng=PRNGKey")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0 (0 = full softmax), "
+                         f"got {top_k}")
     dec = model.clone(decode=True, fused_attention=False)
     # cache ALLOCATION without compute: eval_shape traces the init only
     # abstractly (no training-scale dummy forward actually runs), then the
@@ -232,37 +296,37 @@ def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
     tokens = jnp.zeros((B, max_len), jnp.int32).at[:, :P].set(prompt)
     if rng is None:
         rng = jax.random.PRNGKey(0)          # carried but unused (greedy)
-    run = _decode_loop(dec, max_len, float(temperature))
+    run = _decode_loop(dec, max_len)
+    args = (params, tokens, cache, rng, jnp.asarray(P, jnp.int32),
+            jnp.asarray(float(temperature), jnp.float32),
+            jnp.asarray(int(top_k), jnp.int32))
     if model.tensor_parallel:
         from apex_example_tpu.ops import _config as ops_config
         with ops_config.force_xla():
-            return run(params, tokens, cache, rng, jnp.asarray(P, jnp.int32))
-    return run(params, tokens, cache, rng, jnp.asarray(P, jnp.int32))
+            return run(*args)
+    return run(*args)
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_loop(dec: GPTForCausalLM, max_len: int,
-                 temperature: float):
+def _decode_loop(dec: GPTForCausalLM, max_len: int):
     """Jitted scan for :func:`generate`, cached on the static
     configuration (the module is a frozen dataclass, so it keys the
     cache): repeated generate() calls reuse one compiled program, and
     params enter as an ARGUMENT — baked-as-constants weights would bloat
-    the executable and defeat the cache."""
+    the executable and defeat the cache.  temperature and top_k ride as
+    TRACED scalars through :func:`sample_tokens`, so one compiled program
+    serves every sampling configuration — temperature used to be part of
+    this cache key and recompiled the loop per distinct value."""
 
-    def step(params, P, carry, t):
+    def step(params, P, temperature, top_k, carry, t):
         tokens, cache, rng = carry
         B = tokens.shape[0]
         tok = lax.dynamic_slice(tokens, (0, t), (B, 1))
         logits, mut = dec.apply({"params": params, "cache": cache}, tok,
                                 train=False, mutable=["cache"])
         cache = mut["cache"]
-        last = logits[:, -1]
-        if temperature == 0.0:
-            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-        else:
-            rng, key = jax.random.split(rng)
-            nxt = jax.random.categorical(
-                key, last / temperature).astype(jnp.int32)
+        rng, key = jax.random.split(rng)
+        nxt = sample_tokens(key, logits[:, -1], temperature, top_k)
         # inside the prompt, keep the given token (prefill); past it,
         # write the model's choice
         cur = lax.dynamic_slice(tokens, (0, t + 1), (B, 1))[:, 0]
@@ -271,12 +335,12 @@ def _decode_loop(dec: GPTForCausalLM, max_len: int,
         return (tokens, cache, rng), None
 
     @jax.jit
-    def run(params, tokens, cache, rng, P):
+    def run(params, tokens, cache, rng, P, temperature, top_k):
         # P rides as a TRACED scalar (only `t + 1 < P` consumes it), so
         # one compiled program serves every prompt length at this shape.
-        (tokens, _, _), _ = lax.scan(functools.partial(step, params, P),
-                                     (tokens, cache, rng),
-                                     jnp.arange(max_len - 1))
+        (tokens, _, _), _ = lax.scan(
+            functools.partial(step, params, P, temperature, top_k),
+            (tokens, cache, rng), jnp.arange(max_len - 1))
         return tokens
 
     return run

@@ -38,7 +38,8 @@ from apex_example_tpu.obs.flight import FlightRecorder, format_thread_stacks
 from apex_example_tpu.obs.logging import get_logger, rank_print
 from apex_example_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                           JsonlSink, MetricsRegistry,
-                                          TensorBoardAdapter, read_jsonl)
+                                          TensorBoardAdapter, nearest_rank,
+                                          read_jsonl)
 from apex_example_tpu.obs.numerics import NumericsMonitor, module_grad_stats
 from apex_example_tpu.obs.profiler import (DEFAULT_TRACE_DIR, ProfilerWindow,
                                            make_profiler_window,
@@ -57,7 +58,8 @@ __all__ = [
     "ProfilerWindow", "SCHEMA_VERSION", "StallWatchdog", "TelemetryEmitter",
     "TensorBoardAdapter", "current_span", "device_memory_stats",
     "device_span", "format_thread_stacks", "get_logger",
-    "make_profiler_window", "module_grad_stats", "parse_window",
-    "rank_print", "read_jsonl", "set_default_registry", "span",
+    "make_profiler_window", "module_grad_stats", "nearest_rank",
+    "parse_window", "rank_print", "read_jsonl", "set_default_registry",
+    "span",
     "validate_record", "validate_stream",
 ]

@@ -51,6 +51,20 @@ class Gauge:
         return self.value
 
 
+def nearest_rank(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over a PRE-SORTED sequence: the
+    ceil(q/100 * n)-th value (1-based), clamped.  The one percentile
+    convention in the repo — Histogram and the serving engine's summary
+    use this function; tools/metrics_lint.py carries a standalone copy
+    of the same formula because the thin clients must run without the
+    package installed.  (The old truncating int(q/100 * n) biased HIGH
+    on small even samples: p50 of [1, 2, 3, 4] returned 3, not 2.)"""
+    if not sorted_vals:
+        return 0.0
+    idx = math.ceil(q / 100.0 * len(sorted_vals)) - 1
+    return sorted_vals[min(max(idx, 0), len(sorted_vals) - 1)]
+
+
 class Histogram:
     """Streaming distribution (step times, span durations): exact
     count/sum/min/max plus a bounded sample for percentiles."""
@@ -84,14 +98,7 @@ class Histogram:
         return self.sum / self.count if self.count else 0.0
 
     def percentile(self, q: float) -> float:
-        if not self._samples:
-            return 0.0
-        ordered = sorted(self._samples)
-        # Nearest-rank: the ceil(q/100 * n)-th ordered value (1-based).
-        # The old truncating int(q/100 * n) biased HIGH on small even
-        # samples (p50 of [1, 2, 3, 4] returned 3, not 2).
-        idx = math.ceil(q / 100.0 * len(ordered)) - 1
-        return ordered[min(max(idx, 0), len(ordered) - 1)]
+        return nearest_rank(sorted(self._samples), q)
 
     def summary(self) -> Dict[str, float]:
         if not self.count:

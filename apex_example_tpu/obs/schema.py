@@ -31,6 +31,18 @@ plus ``aborted``/``abort_reason`` on ``run_summary`` (a crashed run's
 summary carries ``aborted: true``).  v2 is a strict superset of v1:
 every v1 stream validates unchanged.
 
+Version 3 adds the serving stratum (serve.py / serve/):
+
+``request_complete``  one per finished inference request — token counts,
+                      TTFT/TPOT, finish reason, slot/step provenance.
+``serve_summary``     one per serving run, last line — request/token
+                      totals, throughput, latency percentile dicts,
+                      slot occupancy.
+
+v3 is again a strict superset: every v1/v2 stream validates unchanged
+(a serving stream carries a ``run_header`` but no ``run_summary`` —
+``serve_summary`` is its closing record).
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.
@@ -40,7 +52,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 _NUM = (int, float)
 
@@ -98,6 +110,24 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "step": int,
         "modules": list,
     },
+    # --- schema v3: serving records (serve.py / serve/engine.py) ---
+    "request_complete": {
+        "record": str,
+        "time": _NUM,
+        "request_id": str,
+        "prompt_tokens": int,
+        "output_tokens": int,
+        "ttft_ms": _NUM,
+        "tpot_ms": _NUM,
+        "finish_reason": str,
+    },
+    "serve_summary": {
+        "record": str,
+        "time": _NUM,
+        "requests": int,
+        "output_tokens": int,
+        "tokens_per_sec": _NUM,
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -153,6 +183,30 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "scale": _NUM,
         "loss": _NUM,
         "mode": str,            # the --numerics-check mode that fired
+    },
+    "request_complete": {
+        "run_id": str,
+        "slot": int,            # the slot the request decoded in
+        "queue_wait_ms": _NUM,  # arrival -> admission
+        "e2e_ms": _NUM,         # arrival -> completion
+        "admitted_step": int,   # engine tick provenance (interleaving
+        "finished_step": int,   #   audits key on these)
+        "temperature": _NUM,
+        "top_k": int,
+    },
+    "serve_summary": {
+        "run_id": str,
+        "steps": int,           # engine ticks (incl. idle virtual-time)
+        "compute_steps": int,   # ticks that ran the decode program
+        "slots": int,
+        "max_len": int,
+        "duration_s": _NUM,
+        "occupancy": _NUM,      # mean live-slot fraction per compute step
+        "ttft_ms": dict,        # {p50, p95, max} nearest-rank
+        "tpot_ms": dict,
+        "queue_wait_ms": dict,
+        "aborted": bool,
+        "abort_reason": str,
     },
 }
 

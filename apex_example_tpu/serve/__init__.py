@@ -1,0 +1,25 @@
+"""apex_example_tpu.serve — continuous-batching inference.
+
+The serving counterpart of the training engine: a slot pool over one
+shared per-layer KV cache (``serve/slots.py``), a scheduler loop that
+advances every live request with ONE compiled decode step per tick
+(``serve/engine.py``), a thread-safe request queue with the timestamp
+trail TTFT/TPOT metrics derive from (``serve/queue.py``), and a
+deterministic synthetic load generator (``serve/loadgen.py``).
+
+``serve.py`` at the repo root is the CLI driver (checkpoint restore or
+random init, synthetic stream, schema-v3 JSONL serving records);
+``tools/serve_report.py`` is the jax-free summary client.
+"""
+
+from apex_example_tpu.serve.engine import (ServeEngine,
+                                           request_complete_record)
+from apex_example_tpu.serve.loadgen import parse_range, synthetic_requests
+from apex_example_tpu.serve.queue import Completion, Request, RequestQueue
+from apex_example_tpu.serve.slots import Slot, SlotPool
+
+__all__ = [
+    "Completion", "Request", "RequestQueue", "ServeEngine", "Slot",
+    "SlotPool", "parse_range", "request_complete_record",
+    "synthetic_requests",
+]

@@ -1,0 +1,249 @@
+"""The continuous-batching scheduler loop.
+
+One engine tick = admit + step + harvest:
+
+1. **admit** — pop admissible requests from the queue into free slots
+   (serve/slots.py resets that row's cache indices; the request's prompt
+   becomes the slot's token feed).
+2. **step** — ONE compiled decode program advances every live slot by
+   one token.  Prefill and decode share the program exactly as in
+   models/gpt.generate: a slot still inside its prompt feeds the next
+   prompt token and discards the model's prediction; a slot past its
+   prompt feeds its previously sampled token and keeps the new one.
+   Because the cache indices are per-slot, requests admitted at
+   different ticks coexist in one batch — continuous batching.
+3. **harvest** — detect EOS / length completions, evict their slots,
+   emit ``request_complete`` records (obs schema v3).
+
+The per-tick host sync (fetching the sampled tokens) is the deliberate
+cost of host-side scheduling, mirroring the telemetry layer's stance on
+device fetches: the batch geometry stays static, so the compiled program
+never changes — the TPU-native substrate for a serving engine.
+
+Sampling is per-slot (temperature / top_k vectors through
+models/gpt.sample_tokens), so greedy and sampled requests batch together.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_example_tpu.models.gpt import sample_tokens
+from apex_example_tpu.obs.metrics import nearest_rank
+from apex_example_tpu.serve.queue import Completion, Request, RequestQueue
+from apex_example_tpu.serve.slots import SlotPool
+
+
+def _now() -> float:
+    return time.time()
+
+
+def _pct_dict(vals_ms: List[float]) -> Dict[str, float]:
+    s = sorted(vals_ms)
+    return {"p50": round(nearest_rank(s, 50), 3),
+            "p95": round(nearest_rank(s, 95), 3),
+            "max": round(s[-1], 3) if s else 0.0}
+
+
+@functools.lru_cache(maxsize=8)
+def _slot_step(dec):
+    """One compiled decode step for a slot-decode model clone (cached on
+    the frozen module config, params as an argument — the same contract
+    as models/gpt._decode_loop)."""
+
+    @jax.jit
+    def step(params, cache, tok, rng, temperature, top_k):
+        logits, mut = dec.apply({"params": params, "cache": cache}, tok,
+                                train=False, mutable=["cache"])
+        nxt = sample_tokens(rng, logits[:, -1], temperature, top_k)
+        return mut["cache"], nxt
+
+    return step
+
+
+def request_complete_record(comp: Completion,
+                            run_id: Optional[str] = None) -> Dict[str, Any]:
+    """The schema-v3 ``request_complete`` record for one completion."""
+    rec: Dict[str, Any] = {
+        "record": "request_complete",
+        "time": _now(),
+        "request_id": comp.request.uid,
+        "prompt_tokens": len(comp.request.prompt),
+        "output_tokens": len(comp.tokens),
+        "ttft_ms": round(comp.ttft_s * 1e3, 3),
+        "tpot_ms": round(comp.tpot_s * 1e3, 3),
+        "finish_reason": comp.finish_reason,
+        "slot": comp.slot,
+        "queue_wait_ms": round(comp.queue_wait_s * 1e3, 3),
+        "e2e_ms": round(comp.e2e_s * 1e3, 3),
+        "admitted_step": comp.admitted_step,
+        "finished_step": comp.finished_step,
+        "temperature": float(comp.request.temperature),
+        "top_k": int(comp.request.top_k),
+    }
+    if run_id:
+        rec["run_id"] = run_id
+    return rec
+
+
+class ServeEngine:
+    """Continuous-batching engine over a GPT-family model.
+
+    ``model`` is the plain module, ``params`` its trained (or random)
+    weights; the engine derives the slot-decode clone via its SlotPool.
+    ``sink`` (an obs.JsonlSink), when given, receives one
+    ``request_complete`` per finished request; the caller writes the
+    run header and the final ``serve_summary`` (see serve.py).
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 4,
+                 max_len: int = 128, rng=None,
+                 queue: Optional[RequestQueue] = None,
+                 sink=None, run_id: Optional[str] = None):
+        self.pool = SlotPool(model, num_slots, max_len)
+        self.params = params
+        self.queue = queue if queue is not None else RequestQueue()
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.sink = sink
+        self.run_id = run_id
+        self.step_count = 0
+        self.compute_steps = 0
+        self.completions: List[Completion] = []
+        self._step_fn = _slot_step(self.pool.dec)
+        self._t0 = time.perf_counter()
+        self._tokens_out = 0
+        self._occupancy_sum = 0
+
+    # ---------------------------------------------------------- intake
+
+    def submit(self, request: Request) -> None:
+        self.queue.submit(request)
+
+    # ------------------------------------------------------------ tick
+
+    def step(self) -> bool:
+        """One engine tick.  Returns True when a decode step ran (some
+        slot was live); False is an idle tick (virtual time still
+        advances, so ``arrival_step`` gates keep maturing)."""
+        pool = self.pool
+        self.queue.mature(self.step_count)
+        while pool.free_count:
+            req = self.queue.pop(self.step_count)
+            if req is None:
+                break
+            pool.admit(req, self.step_count)
+        live = pool.live
+        if not live:
+            self.step_count += 1
+            return False
+
+        S = pool.num_slots
+        tok = np.zeros((S, 1), np.int32)
+        temps = np.zeros((S,), np.float32)
+        ks = np.zeros((S,), np.int32)
+        for i in live:
+            slot = pool.slots[i]
+            tok[i, 0] = slot.next_token()
+            temps[i] = slot.request.temperature
+            ks[i] = slot.request.top_k
+        self.rng, key = jax.random.split(self.rng)
+        pool.cache, nxt = self._step_fn(
+            self.params, pool.cache, jnp.asarray(tok), key,
+            jnp.asarray(temps), jnp.asarray(ks))
+        nxt = np.asarray(nxt)          # the scheduler's host sync
+        now = time.perf_counter()
+
+        for i in live:
+            slot = pool.slots[i]
+            slot.cursor += 1
+            if slot.prefilling:
+                continue               # prompt token fed; output discarded
+            out = int(nxt[i])
+            if slot.n_generated == 0:
+                slot.t_first_token = now
+            slot.tokens.append(out)
+            slot.n_generated += 1
+            self._tokens_out += 1
+            req = slot.request
+            reason = None
+            if req.eos_id is not None and out == req.eos_id:
+                reason = "eos"
+            elif slot.n_generated >= pool.max_new_for(req):
+                reason = "length"
+            if reason is not None:
+                self._finish(i, reason, now)
+        self.compute_steps += 1
+        self._occupancy_sum += len(live)
+        self.step_count += 1
+        return True
+
+    def _finish(self, idx: int, reason: str, now: float) -> None:
+        slot = self.pool.slots[idx]
+        comp = Completion(
+            request=slot.request,
+            tokens=slot.tokens[slot.n_prompt:],
+            finish_reason=reason,
+            slot=idx,
+            admitted_step=slot.admitted_step,
+            finished_step=self.step_count,
+            t_admitted=slot.t_admitted,
+            t_first_token=slot.t_first_token,
+            t_finish=now)
+        self.completions.append(comp)
+        self.pool.evict(idx)
+        if self.sink is not None:
+            self.sink.write(request_complete_record(comp, self.run_id))
+
+    # ------------------------------------------------------------ loop
+
+    def run(self, max_steps: Optional[int] = None,
+            idle_wait_s: float = 0.0) -> List[Completion]:
+        """Drive ticks until the queue is drained and every slot is free
+        (or ``max_steps`` ticks).  ``idle_wait_s`` throttles idle spins
+        when a producer thread feeds the queue in wall-clock time."""
+        while max_steps is None or self.step_count < max_steps:
+            if self.queue.drained() and not self.pool.any_live():
+                break
+            ran = self.step()
+            if not ran and idle_wait_s:
+                time.sleep(idle_wait_s)
+        return self.completions
+
+    # --------------------------------------------------------- metrics
+
+    def summary_record(self) -> Dict[str, Any]:
+        """The schema-v3 ``serve_summary`` for everything completed so
+        far (the caller writes it to the sink and closes)."""
+        duration = time.perf_counter() - self._t0
+        comps = self.completions
+        rec: Dict[str, Any] = {
+            "record": "serve_summary",
+            "time": _now(),
+            "requests": len(comps),
+            "output_tokens": self._tokens_out,
+            "tokens_per_sec": round(self._tokens_out / max(duration, 1e-9),
+                                    1),
+            "steps": self.step_count,
+            "compute_steps": self.compute_steps,
+            "slots": self.pool.num_slots,
+            "max_len": self.pool.max_len,
+            "duration_s": round(duration, 3),
+        }
+        if self.compute_steps:
+            rec["occupancy"] = round(
+                self._occupancy_sum / (self.compute_steps
+                                       * self.pool.num_slots), 3)
+        if comps:
+            rec["ttft_ms"] = _pct_dict([c.ttft_s * 1e3 for c in comps])
+            rec["tpot_ms"] = _pct_dict([c.tpot_s * 1e3 for c in comps])
+            rec["queue_wait_ms"] = _pct_dict(
+                [c.queue_wait_s * 1e3 for c in comps])
+        if self.run_id:
+            rec["run_id"] = self.run_id
+        return rec

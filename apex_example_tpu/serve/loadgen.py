@@ -1,0 +1,66 @@
+"""Synthetic request stream for the serving engine.
+
+Deterministic under a seed: prompt contents, lengths, output budgets and
+arrival staggering all come from one RandomState, so a serving run is
+reproducible end-to-end (the checkpoint→serve round-trip test and the
+CLI's --seed rely on this).
+
+Arrivals are expressed in VIRTUAL engine steps (``Request.arrival_step``)
+— the engine's admission gate compares against its tick counter, which
+makes "staggered arrivals" deterministic regardless of host speed.  A
+wall-clock producer thread can instead submit these same requests late
+and leave ``arrival_step`` None.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from apex_example_tpu.serve.queue import Request
+
+
+def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
+                       prompt_len: Tuple[int, int] = (4, 12),
+                       max_new: Tuple[int, int] = (4, 16),
+                       temperature: float = 0.0, top_k: int = 0,
+                       eos_id: Optional[int] = None,
+                       stagger: int = 0) -> List[Request]:
+    """``n`` requests with uniform prompt/output lengths in the given
+    inclusive ranges; request i arrives at virtual step ``i * stagger``
+    (stagger 0 = all at once)."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 requests, got {n}")
+    if prompt_len[0] < 1 or prompt_len[0] > prompt_len[1]:
+        raise ValueError(f"bad prompt_len range {prompt_len}")
+    if max_new[0] < 1 or max_new[0] > max_new[1]:
+        raise ValueError(f"bad max_new range {max_new}")
+    rs = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        p = int(rs.randint(prompt_len[0], prompt_len[1] + 1))
+        m = int(rs.randint(max_new[0], max_new[1] + 1))
+        prompt = rs.randint(0, vocab_size, size=(p,)).tolist()
+        out.append(Request(prompt=prompt, max_new_tokens=m,
+                           temperature=temperature, top_k=top_k,
+                           eos_id=eos_id,
+                           arrival_step=i * stagger if stagger else None))
+    return out
+
+
+def parse_range(spec: str, name: str) -> Tuple[int, int]:
+    """CLI range syntax: "8" (fixed) or "4:12" (inclusive range)."""
+    parts = spec.split(":")
+    try:
+        if len(parts) == 1:
+            lo = hi = int(parts[0])
+        elif len(parts) == 2:
+            lo, hi = int(parts[0]), int(parts[1])
+        else:
+            raise ValueError
+    except ValueError:
+        raise ValueError(f"--{name} wants N or MIN:MAX, got {spec!r}")
+    if lo < 1 or lo > hi:
+        raise ValueError(f"--{name}: bad range {lo}:{hi}")
+    return lo, hi

@@ -1,0 +1,171 @@
+"""Request queue + request/completion records for the serving engine.
+
+Pure host-side bookkeeping (no jax import): the engine thread pops
+admissible requests, the load generator (or any producer thread) submits
+them.  Every latency metric the serving stack reports — TTFT, TPOT, queue
+wait — is derived from the four timestamps a request accumulates on its
+way through (arrival, admission, first token, completion), so they live
+here next to the dataclasses rather than in the engine.
+
+Arrival gating supports two clocks:
+
+- wall clock (the serving default): a producer thread submits when the
+  request "arrives"; the engine admits whatever is in the queue.
+- virtual step time (``arrival_step``): the request is submitted up
+  front but becomes admissible only once the engine's step counter
+  reaches ``arrival_step``.  Deterministic staggered arrivals — what the
+  tier-1 continuous-batching test pins (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+_uid = itertools.count()
+
+
+def _next_uid() -> str:
+    return f"req-{next(_uid):06d}"
+
+
+@dataclass
+class Request:
+    """One generation request.  ``prompt`` is a token-id list; sampling is
+    per-request (temperature 0 = greedy, top_k 0 = full softmax) — the
+    engine batches mixed sampling configs in one compiled step."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: Optional[int] = None
+    uid: str = field(default_factory=_next_uid)
+    # Virtual-time admission gate (None = admissible immediately).
+    arrival_step: Optional[int] = None
+    # Wall-clock arrival.  For ungated requests this is submission time;
+    # for arrival_step-gated ones RequestQueue.mature() RE-STAMPS it at
+    # the tick the gate passes — the request "arrives" then, and TTFT /
+    # queue-wait must not charge the virtual pre-arrival wait to the
+    # engine (the load generator builds all requests up front).
+    t_arrival: float = field(default_factory=time.perf_counter)
+    _arrival_stamped: bool = field(default=False, repr=False)
+
+    def __post_init__(self):
+        if len(self.prompt) < 1:
+            raise ValueError(f"{self.uid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"{self.uid}: max_new_tokens must be >= 1")
+        if self.temperature < 0:
+            raise ValueError(f"{self.uid}: temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError(f"{self.uid}: top_k must be >= 0")
+
+
+@dataclass
+class Completion:
+    """A finished request: the generated tokens (prompt excluded) plus the
+    slot/step/timestamp trail the serving metrics are computed from."""
+
+    request: Request
+    tokens: List[int]
+    finish_reason: str          # "eos" | "length"
+    slot: int
+    admitted_step: int
+    finished_step: int
+    t_admitted: float
+    t_first_token: float
+    t_finish: float
+
+    @property
+    def ttft_s(self) -> float:
+        """Time to first token, measured from ARRIVAL (queue wait is part
+        of the latency a caller sees)."""
+        return self.t_first_token - self.request.t_arrival
+
+    @property
+    def tpot_s(self) -> float:
+        """Time per output token after the first (0 for 1-token outputs)."""
+        n = len(self.tokens)
+        if n <= 1:
+            return 0.0
+        return (self.t_finish - self.t_first_token) / (n - 1)
+
+    @property
+    def queue_wait_s(self) -> float:
+        return self.t_admitted - self.request.t_arrival
+
+    @property
+    def e2e_s(self) -> float:
+        return self.t_finish - self.request.t_arrival
+
+
+class RequestQueue:
+    """Thread-safe FIFO with virtual-time admission gating.
+
+    ``pop(step)`` returns the head request if it is admissible at engine
+    step ``step`` (its ``arrival_step`` gate has passed), else None —
+    FIFO order is preserved: a gated head blocks later requests even if
+    their gates passed, matching a real ingress queue.
+    """
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def submit(self, request: Request) -> None:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            self._q.append(request)
+
+    def submit_all(self, requests) -> None:
+        for r in requests:
+            self.submit(r)
+
+    def mature(self, step: int) -> None:
+        """Stamp wall-clock arrival on every gated request whose
+        ``arrival_step`` has been reached at engine tick ``step`` — even
+        the ones not yet poppable (all slots busy): time spent waiting
+        AFTER the gate passes is genuine queue wait and must count.
+        The engine calls this once per tick, before admission."""
+        now = time.perf_counter()
+        with self._lock:
+            for req in self._q:
+                if (req.arrival_step is not None and not
+                        req._arrival_stamped and req.arrival_step <= step):
+                    req.t_arrival = now
+                    req._arrival_stamped = True
+
+    def pop(self, step: int) -> Optional[Request]:
+        with self._lock:
+            if not self._q:
+                return None
+            head = self._q[0]
+            if head.arrival_step is not None and head.arrival_step > step:
+                return None
+            return self._q.popleft()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def close(self) -> None:
+        """No more submissions; the engine drains what is queued and
+        exits its loop when the queue is empty and every slot is free."""
+        with self._lock:
+            self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def drained(self) -> bool:
+        with self._lock:
+            return self._closed and not self._q

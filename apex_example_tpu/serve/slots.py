@@ -1,0 +1,154 @@
+"""Slot pool: a fixed-size request→row mapping over the shared KV cache.
+
+The cache the pool owns is the model's own decode cache (flax 'cache'
+collection under ``decode=True, slot_decode=True``): per layer,
+``cached_key``/``cached_value`` pages of shape [SLOTS, max_len, H, D]
+plus per-slot fill indices ([SLOTS] ``cache_index`` per layer and the
+top-level [SLOTS] ``cache_position``).  A request is admitted by
+resetting ONE row's indices to zero — the k/v pages are left untouched
+(stale keys beyond the fill index are masked out by the per-slot live
+mask inside attention, models/bert.py), so admit/evict costs O(1) index
+writes, not an O(max_len·H·D) page clear.
+
+The pool is host-side bookkeeping plus that one jitted index-reset; the
+scheduler loop that feeds tokens through the slots lives in
+serve/engine.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu.serve.queue import Request
+
+_INDEX_LEAVES = ("cache_index", "cache_position")
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return getattr(last, "key", getattr(last, "name", str(last)))
+
+
+@jax.jit
+def _reset_slot_indices(cache, slot):
+    """Zero every per-slot index leaf at row ``slot`` (traced, so one
+    compiled program serves every slot id)."""
+    def reset(path, leaf):
+        if _leaf_name(path) in _INDEX_LEAVES:
+            return leaf.at[slot].set(0)
+        return leaf
+    return jax.tree_util.tree_map_with_path(reset, cache)
+
+
+@dataclass
+class Slot:
+    """Host-side state of one live request in a slot.
+
+    ``tokens`` is the full sequence (prompt + generated so far);
+    ``cursor`` counts tokens already fed to the model.  Invariant during
+    decode: ``len(tokens) == cursor + 1`` (the newest element is the next
+    token to feed); during prefill ``cursor < n_prompt`` and generated
+    output is still being discarded.
+    """
+
+    request: Request
+    admitted_step: int
+    t_admitted: float
+    tokens: List[int] = field(default_factory=list)
+    cursor: int = 0
+    n_generated: int = 0
+    t_first_token: Optional[float] = None
+
+    @property
+    def n_prompt(self) -> int:
+        return len(self.request.prompt)
+
+    @property
+    def prefilling(self) -> bool:
+        return self.cursor < self.n_prompt
+
+    def next_token(self) -> int:
+        return self.tokens[self.cursor]
+
+
+class SlotPool:
+    """``num_slots`` rows over one shared decode cache.
+
+    ``model`` is the plain (training) GPT module; the pool derives the
+    slot-decode clone and allocates the cache via an abstract init trace
+    (no real forward runs), exactly like models/gpt.generate.
+    """
+
+    def __init__(self, model, num_slots: int, max_len: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if model.max_position < max_len:
+            raise ValueError(f"max_len {max_len} exceeds the model's "
+                             f"position table ({model.max_position})")
+        self.dec = model.clone(decode=True, slot_decode=True,
+                               fused_attention=False)
+        self.num_slots = num_slots
+        self.max_len = max_len
+        shapes = jax.eval_shape(
+            self.dec.init, jax.random.PRNGKey(0),
+            jnp.zeros((num_slots, max_len), jnp.int32))["cache"]
+        self.cache = jax.tree_util.tree_map(
+            lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+        self.slots: List[Optional[Slot]] = [None] * num_slots
+        self._free: List[int] = list(range(num_slots))[::-1]  # pop() = slot 0 first
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def live(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def any_live(self) -> bool:
+        return len(self._free) < self.num_slots
+
+    # -------------------------------------------------------- lifecycle
+
+    def admit(self, request: Request, step: int) -> int:
+        """Insert ``request`` into a free slot: reset that row's cache
+        indices and seed the host state.  Returns the slot id."""
+        if not self._free:
+            raise RuntimeError("no free slot (admission must check "
+                               "free_count first)")
+        n_prompt = len(request.prompt)
+        if n_prompt >= self.max_len:
+            raise ValueError(
+                f"{request.uid}: prompt length {n_prompt} must be < "
+                f"cache max_len {self.max_len}")
+        idx = self._free.pop()
+        self.cache = _reset_slot_indices(self.cache,
+                                         jnp.asarray(idx, jnp.int32))
+        self.slots[idx] = Slot(request=request, admitted_step=step,
+                               t_admitted=time.perf_counter(),
+                               tokens=[int(t) for t in request.prompt])
+        return idx
+
+    def evict(self, idx: int) -> None:
+        """Free a slot (finished or cancelled).  The cache row keeps its
+        stale contents; the next admit resets the indices."""
+        if self.slots[idx] is None:
+            raise RuntimeError(f"slot {idx} is already free")
+        self.slots[idx] = None
+        self._free.append(idx)
+
+    def max_new_for(self, request: Request) -> int:
+        """Effective output budget: the request's ask, clamped so the
+        total sequence fits the cache row."""
+        return min(request.max_new_tokens,
+                   self.max_len - len(request.prompt))

@@ -66,6 +66,32 @@ class CheckpointManager:
         self._mgr.close()
 
 
+def restore_params(directory: str, step: Optional[int] = None):
+    """Template-free restore of just the ``params`` subtree — the serving
+    path (serve.py).
+
+    Training restore needs a TrainState template because orbax restores
+    into the template's shapes/shardings, and the optimizer state's
+    structure depends on which optimizer trained the run.  Serving wants
+    none of that: restore the saved pytree raw (nested dicts, the
+    StandardRestore no-template form) and keep only ``params`` — the one
+    subtree whose structure the model itself defines.
+    """
+    mgr = ocp.CheckpointManager(os.path.abspath(directory))
+    try:
+        step = mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+        restored = mgr.restore(step, args=ocp.args.StandardRestore())
+        if not isinstance(restored, dict) or "params" not in restored:
+            raise ValueError(
+                f"checkpoint at {directory} step {step} holds no 'params' "
+                "subtree (not a TrainState checkpoint?)")
+        return restored["params"]
+    finally:
+        mgr.close()
+
+
 def restore_under_mesh(mgr: CheckpointManager, state: TrainState, mesh,
                        zero_optimizer=None) -> TrainState:
     """Restore a checkpoint into a state that will run under ``mesh``.

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Continuous-batching inference CLI (the serving counterpart of train.py).
+
+Restores GPT params from a CheckpointManager checkpoint (template-free —
+serving needs no optimizer state) or random-inits for smoke runs, then
+drives the slot-based engine (apex_example_tpu/serve/) against a
+deterministic synthetic request stream with staggered arrivals.
+
+    # random-init smoke: 16 requests through 4 slots
+    python serve.py --requests 16 --slots 4 --metrics-jsonl serve.jsonl
+
+    # serve a trained checkpoint, sampled with per-request top-k
+    python serve.py --arch gpt_tiny --checkpoint-dir ckpts \\
+        --temperature 0.8 --top-k 40 --metrics-jsonl serve.jsonl
+
+    # then summarize latency percentiles (jax-free):
+    python tools/serve_report.py serve.jsonl
+
+With --metrics-jsonl the run emits schema-v3 records through the obs
+sink: a run_header, one ``request_complete`` per finished request
+(TTFT/TPOT/queue-wait/slot provenance) and a closing ``serve_summary``
+(throughput, latency percentiles, slot occupancy).  The stream passes
+tools/metrics_lint.py like every other obs stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="continuous-batching GPT inference")
+    p.add_argument("--arch", default="gpt_tiny",
+                   choices=["gpt_tiny", "gpt_base"])
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="CheckpointManager directory to restore params "
+                        "from (omit = random init, smoke mode)")
+    p.add_argument("--checkpoint-step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="KV-cache slot count (the max decode batch)")
+    p.add_argument("--max-len", type=int, default=None,
+                   help="per-slot cache length (default: the model's "
+                        "position table, capped at 128 for gpt_tiny)")
+    p.add_argument("--requests", type=int, default=16,
+                   help="synthetic request count")
+    p.add_argument("--prompt-len", default="4:12",
+                   help="prompt length, N or MIN:MAX tokens")
+    p.add_argument("--max-new", default="4:16",
+                   help="output budget, N or MIN:MAX tokens")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy, >0 samples")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="restrict sampling to the k highest logits "
+                        "(0 = full softmax)")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="token id that ends a request early")
+    p.add_argument("--stagger", type=int, default=2,
+                   help="virtual engine steps between request arrivals "
+                        "(0 = all arrive at once)")
+    p.add_argument("--steps", type=int, default=0,
+                   help="engine tick cap (0 = run until drained)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="emit schema-v3 serving records to this JSONL")
+    return p
+
+
+def run_serve(args):
+    """Build, restore, drive.  Returns (completions, summary_record, rc)
+    — split from main() so tests can assert on the served tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_example_tpu import obs
+    from apex_example_tpu.models.gpt import gpt_base, gpt_tiny
+    from apex_example_tpu.serve import (ServeEngine, parse_range,
+                                        synthetic_requests)
+    from apex_example_tpu.utils.checkpoint import restore_params
+
+    model = {"gpt_tiny": gpt_tiny, "gpt_base": gpt_base}[args.arch]()
+    max_len = args.max_len
+    if max_len is None:
+        max_len = min(model.max_position, 128)
+    prompt_len = parse_range(args.prompt_len, "prompt-len")
+    max_new = parse_range(args.max_new, "max-new")
+    if prompt_len[1] >= max_len:
+        raise SystemExit(f"--prompt-len max {prompt_len[1]} must be < "
+                         f"--max-len {max_len}")
+
+    if args.checkpoint_dir:
+        params = restore_params(args.checkpoint_dir, args.checkpoint_step)
+        source = f"checkpoint {args.checkpoint_dir}"
+    else:
+        params = model.init(
+            jax.random.PRNGKey(args.seed),
+            jnp.zeros((1, 4), jnp.int32))["params"]
+        source = "random init (smoke mode)"
+
+    emitter = sink = None
+    run_id = None
+    if args.metrics_jsonl:
+        sink = obs.JsonlSink(args.metrics_jsonl)
+        emitter = obs.TelemetryEmitter(sink)
+        emitter.run_header(config=vars(args), argv=sys.argv,
+                           arch=args.arch)
+        run_id = emitter.run_id
+
+    requests = synthetic_requests(
+        args.requests, vocab_size=model.vocab_size, seed=args.seed,
+        prompt_len=prompt_len, max_new=max_new,
+        temperature=args.temperature, top_k=args.top_k,
+        eos_id=args.eos_id, stagger=args.stagger)
+    engine = ServeEngine(model, params, num_slots=args.slots,
+                         max_len=max_len,
+                         rng=jax.random.PRNGKey(args.seed),
+                         sink=sink, run_id=run_id)
+    engine.queue.submit_all(requests)
+    engine.queue.close()
+
+    print(f"serve: {args.requests} request(s)  arch={args.arch}  "
+          f"slots={args.slots}  max_len={max_len}  params from {source}")
+    completions = engine.run(max_steps=args.steps or None)
+    summary = engine.summary_record()
+    if sink is not None:
+        sink.write(summary)
+        sink.close()
+
+    rc = 0 if len(completions) == len(requests) else 1
+    print(f"done: {len(completions)}/{args.requests} completed  "
+          f"out_tokens={summary['output_tokens']}  "
+          f"tok/s={summary['tokens_per_sec']}  "
+          f"steps={summary['steps']}  "
+          f"occupancy={summary.get('occupancy', 0.0)}")
+    for name in ("ttft_ms", "tpot_ms", "queue_wait_ms"):
+        d = summary.get(name)
+        if d:
+            print(f"{name:14s} p50 {d['p50']:.1f}  p95 {d['p95']:.1f}  "
+                  f"max {d['max']:.1f}")
+    if rc:
+        print(f"WARNING: {len(requests) - len(completions)} request(s) "
+              f"unfinished at the --steps cap", file=sys.stderr)
+    return completions, summary, rc
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _, _, rc = run_serve(args)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -622,9 +622,10 @@ def test_thin_clients_run_without_jax(tmp_path):
     poisoned jax module sits first on PYTHONPATH, so any import of jax
     (direct or transitive) fails loudly."""
     clients = _thin_clients()
-    # the diagnostics/telemetry clients must be in the set — if one grew
-    # a jax import, that IS the regression this test exists to catch
-    for required in ("metrics_lint", "telemetry_report", "fleet_report"):
+    # the diagnostics/telemetry/serving clients must be in the set — if
+    # one grew a jax import, that IS the regression this test catches
+    for required in ("metrics_lint", "telemetry_report", "fleet_report",
+                     "serve_report"):
         assert required in clients, f"{required} now imports jax"
 
     block = tmp_path / "block"
@@ -636,11 +637,21 @@ def test_thin_clients_run_without_jax(tmp_path):
     _write_stream(str(stream), [_header(), _step(1),
                                 {"record": "run_summary", "steps": 1,
                                  "overflow_count": 0}])
+    serve_stream = tmp_path / "serve.jsonl"
+    _write_stream(str(serve_stream), [
+        _header(),
+        {"record": "request_complete", "time": 1.0, "request_id": "r-0",
+         "prompt_tokens": 4, "output_tokens": 6, "ttft_ms": 10.0,
+         "tpot_ms": 1.5, "finish_reason": "length", "slot": 0,
+         "queue_wait_ms": 2.0, "e2e_ms": 20.0},
+        {"record": "serve_summary", "time": 2.0, "requests": 1,
+         "output_tokens": 6, "tokens_per_sec": 50.0}])
     env = dict(os.environ)
     env["PYTHONPATH"] = str(block) + os.pathsep + env.get("PYTHONPATH", "")
     real_args = {"metrics_lint": [str(stream)],
                  "telemetry_report": [str(stream)],
-                 "fleet_report": [str(stream)]}
+                 "fleet_report": [str(stream)],
+                 "serve_report": [str(serve_stream)]}
     for tool in clients:
         argv = real_args.get(tool, ["--help"])
         r = subprocess.run(

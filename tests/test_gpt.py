@@ -277,6 +277,35 @@ def test_generate_sampling():
         generate(model, params, prompt, max_len=8, temperature=0.8)
 
 
+def test_generate_one_compiled_program_across_sampling_configs():
+    """Regression (ISSUE 3 satellite): temperature used to be part of
+    _decode_loop's lru_cache key — every distinct temperature recompiled
+    the scan.  It now rides as a runtime scalar (with top_k): two
+    temperatures, one cache entry."""
+    from apex_example_tpu.models.gpt import _decode_loop, generate
+    model = gpt_tiny()
+    V = model.vocab_size
+    prompt = jnp.asarray(
+        np.random.RandomState(2).randint(0, V, (2, 3)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+    before = _decode_loop.cache_info().currsize
+    # max_len=11 is unique to this test, so the delta below is exact.
+    g = generate(model, params, prompt, max_len=11)
+    s1 = generate(model, params, prompt, max_len=11, temperature=0.8,
+                  rng=jax.random.PRNGKey(7))
+    generate(model, params, prompt, max_len=11, temperature=0.3,
+             rng=jax.random.PRNGKey(7), top_k=5)
+    assert _decode_loop.cache_info().currsize - before == 1
+    # the shared program still distinguishes the configs
+    assert not np.array_equal(np.array(g), np.array(s1))
+    # top_k=1 collapses to greedy at any temperature
+    k1 = generate(model, params, prompt, max_len=11, temperature=1.5,
+                  rng=jax.random.PRNGKey(9), top_k=1)
+    np.testing.assert_array_equal(np.array(k1), np.array(g))
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, max_len=11, top_k=-1)
+
+
 @pytest.mark.parametrize("mode", ["ring", "ulysses"])
 def test_gpt_cp_tp_train_matches_dense(devices8, mode):
     """GPT CP x TP: the causal CP attention program over 'context' with

@@ -1,0 +1,368 @@
+"""Continuous-batching inference subsystem (serve/, serve.py; ISSUE 3):
+
+- the tier-1 acceptance smoke: 8 staggered mixed-length requests through
+  a 4-slot engine — greedy outputs token-identical to one-shot
+  generate(), completions interleaving across admission waves, the
+  emitted JSONL passing metrics_lint and serve_report,
+- per-slot top-k sampling (determinism under a fixed rng; top_k=1 ==
+  greedy),
+- checkpoint -> serve round trip (CheckpointManager save, template-free
+  restore in serve.py, served == generate() on the restored params),
+- schema v3 records + v1/v2 back-compat,
+- queue/slot-pool unit coverage and the serve.py CLI surface.
+
+All engine tests share one slot geometry (SLOTS=4, MAX_LEN=32) and one
+generate() max_len so the compiled decode programs are built once per
+session — the suite rides tier-1 and must stay cheap.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import serve as serve_mod
+from apex_example_tpu import obs
+from apex_example_tpu.models.gpt import generate, gpt_tiny
+from apex_example_tpu.obs import schema as obs_schema
+from apex_example_tpu.serve import (Request, RequestQueue, ServeEngine,
+                                    SlotPool, parse_range,
+                                    synthetic_requests)
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLOTS, MAX_LEN = 4, 32
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _run_engine(model, params, requests, rng_seed=0, sink=None,
+                run_id=None, max_steps=2000):
+    eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                      rng=jax.random.PRNGKey(rng_seed), sink=sink,
+                      run_id=run_id)
+    eng.queue.submit_all(requests)
+    eng.queue.close()
+    comps = eng.run(max_steps=max_steps)
+    return eng, comps
+
+
+# ------------------------------------------- tier-1 acceptance smoke
+
+def test_continuous_batching_smoke(model_and_params, tmp_path, capsys):
+    """The acceptance bar: >= 8 synthetic requests, staggered arrivals,
+    mixed prompt/output lengths, SLOTS=4 — greedy outputs token-identical
+    to one-shot generate(), completions interleaved across admission
+    waves, JSONL lints, serve_report shows nonzero TTFT/TPOT."""
+    model, params = model_and_params
+    path = str(tmp_path / "serve.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    emitter = obs.TelemetryEmitter(sink)
+    emitter.run_header(config={"slots": SLOTS, "max_len": MAX_LEN},
+                       arch="gpt_tiny")
+    reqs = synthetic_requests(8, vocab_size=model.vocab_size, seed=3,
+                              prompt_len=(3, 8), max_new=(3, 12),
+                              stagger=4)
+    # mixed lengths actually present
+    assert len({len(r.prompt) for r in reqs}) > 1
+    assert len({r.max_new_tokens for r in reqs}) > 1
+    eng, comps = _run_engine(model, params, reqs, sink=sink,
+                             run_id=emitter.run_id)
+    sink.write(eng.summary_record())
+    sink.close()
+    assert len(comps) == 8
+
+    # (a) token-identical to the one-shot decode path: generate() at the
+    # shared max_len, compared on the request's output budget prefix.
+    by_uid = {c.request.uid: c for c in comps}
+    for r in reqs:
+        c = by_uid[r.uid]
+        P = len(r.prompt)
+        n = len(c.tokens)
+        assert n == min(r.max_new_tokens, MAX_LEN - P)
+        ref = generate(model, params, jnp.asarray([r.prompt], jnp.int32),
+                       max_len=MAX_LEN)
+        np.testing.assert_array_equal(np.asarray(ref)[0, P:P + n],
+                                      np.asarray(c.tokens, np.int32),
+                                      err_msg=r.uid)
+
+    # (b) continuous batching actually happened: some request was
+    # admitted while an earlier-admitted one was still decoding, and
+    # slots were reused across admission waves.
+    assert any(a.admitted_step < b.admitted_step <= a.finished_step
+               for a in comps for b in comps)
+    slot_uses = [c.slot for c in comps]
+    assert len(slot_uses) > len(set(slot_uses))      # some slot reused
+    assert eng.pool.free_count == SLOTS              # all evicted
+
+    # (c) the stream is schema-valid and the report derives nonzero
+    # latency percentiles from it.
+    lint = _load_tool("metrics_lint")
+    code, errors = lint.lint(path)
+    assert code == 0, errors
+    records = obs.read_jsonl(path)
+    reqs_rec = [r for r in records if r["record"] == "request_complete"]
+    assert len(reqs_rec) == 8
+    assert all(r["ttft_ms"] > 0 and r["tpot_ms"] > 0 for r in reqs_rec)
+    summary = records[-1]
+    assert summary["record"] == "serve_summary"
+    assert summary["requests"] == 8
+    assert summary["ttft_ms"]["p50"] > 0
+    assert summary["tpot_ms"]["p50"] > 0
+    report = _load_tool("serve_report")
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "ttft_ms" in out and "tpot_ms" in out
+    assert "finish reasons: length x8" in out
+
+
+# ------------------------------------------------- per-slot sampling
+
+def test_topk_sampling_deterministic_and_topk1_greedy(model_and_params):
+    """Satellite: per-slot top-k — fixed rng => identical streams;
+    top_k=1 collapses to greedy regardless of temperature."""
+    model, params = model_and_params
+    mk = lambda k, t: synthetic_requests(
+        4, vocab_size=model.vocab_size, seed=5, prompt_len=(3, 6),
+        max_new=(4, 8), temperature=t, top_k=k, stagger=2)
+    _, c1 = _run_engine(model, params, mk(3, 1.0), rng_seed=11)
+    _, c2 = _run_engine(model, params, mk(3, 1.0), rng_seed=11)
+    toks = lambda comps: [c.tokens for c in
+                          sorted(comps, key=lambda c: c.request.uid)]
+    assert toks(c1) == toks(c2)                      # deterministic
+    _, ck = _run_engine(model, params, mk(1, 1.5), rng_seed=11)
+    _, cg = _run_engine(model, params, mk(0, 0.0), rng_seed=7)
+    assert toks(ck) == toks(cg)                      # top_k=1 == greedy
+
+
+def test_eos_finishes_request(model_and_params):
+    model, params = model_and_params
+    prompt = [5, 9, 13]
+    ref = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                   max_len=MAX_LEN)
+    first = int(np.asarray(ref)[0, len(prompt)])
+    req = Request(prompt=prompt, max_new_tokens=10, eos_id=first)
+    _, comps = _run_engine(model, params, [req])
+    assert len(comps) == 1
+    assert comps[0].finish_reason == "eos"
+    assert comps[0].tokens == [first]
+
+
+# -------------------------------------- checkpoint -> serve round trip
+
+def test_checkpoint_serve_round_trip(model_and_params, tmp_path, capsys):
+    """Satellite: save a tiny trained GPT state with CheckpointManager,
+    restore in serve.py (template-free), served greedy outputs match
+    direct generate() on the restored params."""
+    import optax
+
+    from apex_example_tpu import amp
+    from apex_example_tpu.data import lm_batch
+    from apex_example_tpu.engine import create_train_state, make_train_step
+    from apex_example_tpu.utils.checkpoint import (CheckpointManager,
+                                                   restore_params)
+    from apex_example_tpu.workloads import lm_loss
+
+    model, _ = model_and_params
+    V = model.vocab_size
+    policy, scaler = amp.initialize("O0")
+    toks = lm_batch(jnp.asarray(0, jnp.int32), batch_size=4, seq_len=16,
+                    vocab_size=V, seed=0)
+    batch = (toks[:, :-1], toks[:, 1:])
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.adam(1e-3), batch[0][:1], policy,
+                               scaler)
+    step_fn = jax.jit(make_train_step(model, optax.adam(1e-3), policy,
+                                      loss_fn=lm_loss,
+                                      compute_accuracy=False))
+    for _ in range(2):                       # "trained", cheaply
+        state, _metrics = step_fn(state, batch)
+    ckpt_dir = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(ckpt_dir)
+    mgr.save(state)
+    mgr.close()
+
+    restored = restore_params(ckpt_dir)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    argv = ["--arch", "gpt_tiny", "--checkpoint-dir", ckpt_dir,
+            "--requests", "4", "--slots", str(SLOTS), "--max-len",
+            str(MAX_LEN), "--prompt-len", "3:6", "--max-new", "4:8",
+            "--stagger", "2", "--seed", "9"]
+    comps, summary, rc = serve_mod.run_serve(
+        serve_mod.build_parser().parse_args(argv))
+    assert rc == 0 and len(comps) == 4
+    assert "checkpoint" in capsys.readouterr().out
+    for c in comps:
+        P = len(c.request.prompt)
+        n = len(c.tokens)
+        ref = generate(model, restored,
+                       jnp.asarray([c.request.prompt], jnp.int32),
+                       max_len=MAX_LEN)
+        np.testing.assert_array_equal(np.asarray(ref)[0, P:P + n],
+                                      np.asarray(c.tokens, np.int32))
+
+
+# -------------------------------------------------- serve.py CLI
+
+def test_serve_cli_smoke(tmp_path, capsys):
+    """Random-init smoke from the CLI: rc 0, JSONL lints, report runs."""
+    path = str(tmp_path / "cli.jsonl")
+    rc = serve_mod.main(["--requests", "6", "--slots", str(SLOTS),
+                         "--max-len", str(MAX_LEN), "--prompt-len", "3:8",
+                         "--max-new", "3:12", "--stagger", "3",
+                         "--metrics-jsonl", path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "6/6 completed" in out and "ttft_ms" in out
+    lint = _load_tool("metrics_lint")
+    code, errors = lint.lint(path)
+    assert code == 0, errors
+    records = obs.read_jsonl(path)
+    assert records[0]["record"] == "run_header"
+    assert records[0]["schema"] == obs_schema.SCHEMA_VERSION
+    assert records[-1]["record"] == "serve_summary"
+
+
+def test_serve_cli_steps_cap(tmp_path, capsys):
+    """A --steps cap that strands requests exits 1 and says so."""
+    rc = serve_mod.main(["--requests", "4", "--slots", str(SLOTS),
+                         "--max-len", str(MAX_LEN), "--prompt-len", "4",
+                         "--max-new", "8", "--steps", "3"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "unfinished" in captured.err
+
+
+def test_serve_cli_rejects_prompt_longer_than_cache():
+    with pytest.raises(SystemExit):
+        serve_mod.main(["--prompt-len", "40", "--max-len", "32"])
+
+
+# ------------------------------------------------------- schema v3
+
+def test_schema_v3_serving_records_validate():
+    req = {"record": "request_complete", "time": 1.0, "request_id": "r-1",
+           "prompt_tokens": 5, "output_tokens": 7, "ttft_ms": 12.5,
+           "tpot_ms": 1.5, "finish_reason": "length", "slot": 2,
+           "queue_wait_ms": 3.0, "e2e_ms": 25.0, "admitted_step": 4,
+           "finished_step": 11, "temperature": 0.0, "top_k": 0,
+           "run_id": "x"}
+    summ = {"record": "serve_summary", "time": 1.0, "requests": 8,
+            "output_tokens": 64, "tokens_per_sec": 100.0, "steps": 40,
+            "compute_steps": 39, "slots": 4, "max_len": 32,
+            "duration_s": 1.0, "occupancy": 0.6,
+            "ttft_ms": {"p50": 1.0, "p95": 2.0, "max": 2.0},
+            "tpot_ms": {"p50": 1.0, "p95": 2.0, "max": 2.0},
+            "queue_wait_ms": {"p50": 0.0, "p95": 1.0, "max": 1.0}}
+    header = {"record": "run_header", "schema": 3, "time": 0.0,
+              "run_id": "x", "num_devices": 1, "process_index": 0,
+              "platform": "cpu", "config": {}}
+    assert obs.validate_record(req) == []
+    assert obs.validate_record(summ) == []
+    assert obs_schema.validate_stream([header, req, summ]) == []
+    # malformed: missing required field / unknown field still rejected
+    assert obs.validate_record({"record": "request_complete"})
+    assert obs.validate_record(dict(summ, typo=1))
+
+
+def test_schema_v1_v2_streams_still_validate():
+    """v3 is a strict superset: pre-PR streams keep validating."""
+    v1 = [{"record": "run_header", "schema": 1, "time": 0.0, "run_id": "r",
+           "num_devices": 1, "process_index": 0, "platform": "cpu",
+           "config": {}},
+          {"record": "step", "step": 1, "epoch": 0, "loss": 1.0,
+           "scale": 1.0, "step_time_ms": 5.0, "items_per_sec": 10.0},
+          {"record": "run_summary", "steps": 1, "overflow_count": 0}]
+    assert obs_schema.validate_stream(v1) == []
+    v2 = [dict(v1[0], schema=2), v1[1],
+          {"record": "crash_dump", "time": 1.0, "reason": "signal:SIGTERM"},
+          {"record": "run_summary", "steps": 1, "overflow_count": 0,
+           "aborted": True, "abort_reason": "signal:SIGTERM"}]
+    assert obs_schema.validate_stream(v2) == []
+
+
+# ------------------------------------------------ queue + slot pool
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="empty prompt"):
+        Request(prompt=[], max_new_tokens=1)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        Request(prompt=[1], max_new_tokens=0)
+    with pytest.raises(ValueError, match="temperature"):
+        Request(prompt=[1], max_new_tokens=1, temperature=-1.0)
+    with pytest.raises(ValueError, match="top_k"):
+        Request(prompt=[1], max_new_tokens=1, top_k=-1)
+
+
+def test_queue_fifo_and_arrival_gating():
+    q = RequestQueue()
+    a = Request(prompt=[1], max_new_tokens=1, arrival_step=0)
+    b = Request(prompt=[2], max_new_tokens=1, arrival_step=5)
+    c = Request(prompt=[3], max_new_tokens=1)      # ungated, behind b
+    q.submit_all([a, b, c])
+    assert q.pop(0) is a
+    assert q.pop(3) is None        # b's gate holds the line (FIFO)
+    assert q.pending() == 2
+    assert q.pop(5) is b
+    assert q.pop(5) is c
+    assert q.pop(5) is None
+    assert not q.drained()
+    q.close()
+    assert q.drained()
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(a)
+
+
+def test_slot_pool_admit_evict(model_and_params):
+    model, _ = model_and_params
+    pool = SlotPool(model, num_slots=2, max_len=16)
+    r = lambda: Request(prompt=[1, 2, 3], max_new_tokens=4)
+    s0 = pool.admit(r(), step=0)
+    s1 = pool.admit(r(), step=0)
+    assert {s0, s1} == {0, 1} and pool.free_count == 0
+    with pytest.raises(RuntimeError, match="no free slot"):
+        pool.admit(r(), step=1)
+    pool.evict(s0)
+    assert pool.free_count == 1 and pool.live == [s1]
+    with pytest.raises(RuntimeError, match="already free"):
+        pool.evict(s0)
+    with pytest.raises(ValueError, match="prompt length"):
+        pool.admit(Request(prompt=list(range(16)), max_new_tokens=1),
+                   step=2)
+    # output budget clamps to the cache row
+    assert pool.max_new_for(Request(prompt=[1] * 10,
+                                    max_new_tokens=50)) == 6
+    with pytest.raises(ValueError, match="position table"):
+        SlotPool(model, num_slots=1, max_len=model.max_position + 1)
+
+
+def test_parse_range():
+    assert parse_range("8", "x") == (8, 8)
+    assert parse_range("4:12", "x") == (4, 12)
+    for bad in ("a", "4:2", "0:3", "1:2:3"):
+        with pytest.raises(ValueError):
+            parse_range(bad, "x")

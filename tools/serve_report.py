@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Summarize a serving JSONL stream (serve.py --metrics-jsonl): request
+and token totals, throughput, TTFT/TPOT/queue-wait percentiles, finish
+reasons, slot occupancy — recomputed from the per-request
+``request_complete`` records, with the stream's own ``serve_summary``
+shown for cross-checking.
+
+Thin client of the obs schema v3 (obs/schema.py):
+
+    python tools/serve_report.py serve.jsonl
+
+No jax import; works on any host with the file (the tier-1 jax-free
+guard in tests/test_diag.py runs it under a poisoned jax module).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Same no-jax file-path load as tools/telemetry_report.py.
+from metrics_lint import pct as _pct  # noqa: E402  (sibling import)
+from metrics_lint import validate_stream  # noqa: E402
+
+
+def _dist(out, name, vals_ms):
+    s = sorted(vals_ms)
+    print(f"{name:14s} p50 {_pct(s, 50):8.1f}  p95 {_pct(s, 95):8.1f}  "
+          f"max {s[-1]:8.1f}  (ms)", file=out)
+
+
+def report(path: str, out=sys.stdout) -> int:
+    records = []
+    with open(path) as fh:
+        for n, line in enumerate(fh):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                # Killed runs legitimately truncate the last line.
+                print(f"WARNING: line {n + 1}: not JSON, skipped",
+                      file=sys.stderr)
+    for e in validate_stream(records):
+        print(f"WARNING: {e}", file=sys.stderr)
+
+    header = next((r for r in records if r.get("record") == "run_header"),
+                  None)
+    summary = next((r for r in records
+                    if r.get("record") == "serve_summary"), None)
+    reqs = [r for r in records if r.get("record") == "request_complete"
+            and all(k in r for k in ("ttft_ms", "tpot_ms",
+                                     "output_tokens"))]
+
+    if header:
+        cfg = header.get("config", {})
+        print(f"run {header['run_id']}  platform={header['platform']}  "
+              f"arch={header.get('arch', cfg.get('arch', '?'))}  "
+              f"slots={cfg.get('slots', '?')}  "
+              f"max_len={cfg.get('max_len', '?')}", file=out)
+    if not reqs:
+        print("no request_complete records", file=out)
+        return 1
+
+    out_tokens = sum(r["output_tokens"] for r in reqs)
+    prompt_tokens = sum(r.get("prompt_tokens", 0) for r in reqs)
+    print(f"requests {len(reqs)}  prompt_tokens {prompt_tokens}  "
+          f"output_tokens {out_tokens}", file=out)
+    reasons = {}
+    for r in reqs:
+        reasons[r.get("finish_reason", "?")] = \
+            reasons.get(r.get("finish_reason", "?"), 0) + 1
+    print("finish reasons: " + ", ".join(
+        f"{k} x{v}" for k, v in sorted(reasons.items())), file=out)
+    _dist(out, "ttft_ms", [r["ttft_ms"] for r in reqs])
+    _dist(out, "tpot_ms", [r["tpot_ms"] for r in reqs])
+    waits = [r["queue_wait_ms"] for r in reqs if "queue_wait_ms" in r]
+    if waits:
+        _dist(out, "queue_wait_ms", waits)
+    rates = [r["output_tokens"] / (r["e2e_ms"] / 1e3)
+             for r in reqs if r.get("e2e_ms", 0) > 0]
+    if rates:
+        s = sorted(rates)
+        print(f"tokens_per_sec p50 {_pct(s, 50):6.1f}  max {s[-1]:6.1f}  "
+              "(per request)", file=out)
+    if summary:
+        print(f"serve_summary: {summary['requests']} request(s)  "
+              f"{summary['output_tokens']} token(s)  "
+              f"{summary['tokens_per_sec']} tok/s aggregate  "
+              f"occupancy {summary.get('occupancy', '?')}", file=out)
+        if summary.get("aborted"):
+            print(f"ABORTED RUN: {summary.get('abort_reason', '?')}",
+                  file=out)
+    elif any(r.get("record") == "run_header" for r in records):
+        print("stream ends without a serve_summary (run killed or still "
+              "in flight)", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="JSONL from serve.py --metrics-jsonl")
+    args = ap.parse_args(argv)
+    return report(args.path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
