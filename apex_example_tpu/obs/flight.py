@@ -139,6 +139,23 @@ class FlightRecorder:
         if at_exit:
             atexit.register(self._on_atexit)
 
+    def release_signal(self, sig) -> None:
+        """Hand ownership of ``sig`` back: restore the disposition that
+        preceded ``install()`` and forget the signal, so a later
+        ``close()`` cannot clobber whoever installs over it.  Used by the
+        preemption grace path (resilience/preemption.py) to take over
+        SIGTERM/SIGUSR1 — a preempted run must save and exit 75, not
+        crash-dump and die 143; the recorder keeps the excepthook/atexit/
+        faulthandler coverage for real crashes."""
+        prev = self._prev_signal.pop(sig, None)
+        if prev is None:
+            return
+        try:
+            if signal.getsignal(sig) == self._on_signal:
+                signal.signal(sig, prev)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+
     def close(self) -> None:
         """Clean-exit disarm: restore handlers, unregister atexit.  After
         this, no hook writes anything."""

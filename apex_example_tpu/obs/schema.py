@@ -43,16 +43,37 @@ v3 is again a strict superset: every v1/v2 stream validates unchanged
 (a serving stream carries a ``run_header`` but no ``run_summary`` —
 ``serve_summary`` is its closing record).
 
+Version 4 adds the resilience stratum (resilience/; the recover path):
+
+``preemption``  emitted by a ``--preempt-grace`` run that caught
+                SIGTERM/SIGUSR1, saved a final checkpoint at the next
+                step boundary and exited 75 (EX_TEMPFAIL) — the
+                graceful counterpart of ``crash_dump`` (the run summary
+                stays un-aborted).
+``restart``     emitted by the auto-resume supervisor
+                (tools/supervise.py) into its OWN stream when a child
+                exits restartably — attempt index, exit code, reason
+                (``preemption``/``crash``/``stall``), backoff, the
+                child's last step.
+``resume``      emitted by the supervisor when a launch attempt is
+                rewritten to ``--resume`` an existing checkpoint.
+
+plus ``restart_count``/``exit_code`` on ``run_summary`` (the
+supervisor's closing record).  v4 is once more a strict superset: every
+v1–v3 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
-means extending the tables here, nowhere else.
+means extending the tables here, nowhere else.  (The supervisor carries
+a hard-coded copy of SCHEMA_VERSION — resilience/supervisor.py is
+jax-free by contract and must not import the package.)
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 _NUM = (int, float)
 
@@ -128,6 +149,25 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "output_tokens": int,
         "tokens_per_sec": _NUM,
     },
+    # --- schema v4: resilience records (the recover path) ---
+    "preemption": {
+        "record": str,
+        "time": _NUM,
+        "signal": str,
+        "step": int,
+    },
+    "restart": {
+        "record": str,
+        "time": _NUM,
+        "attempt": int,
+        "exit_code": int,
+        "reason": str,
+    },
+    "resume": {
+        "record": str,
+        "time": _NUM,
+        "attempt": int,
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -154,6 +194,9 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         # v2: a crashed/killed run's summary is marked, not absent.
         "aborted": bool,
         "abort_reason": str,
+        # v4: the supervisor's closing record (tools/supervise.py).
+        "restart_count": int,
+        "exit_code": int,
     },
     "bench": {"vs_baseline": _NUM, "mfu_pct": _NUM, "time": _NUM,
               "config": dict},
@@ -207,6 +250,22 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "queue_wait_ms": dict,
         "aborted": bool,
         "abort_reason": str,
+    },
+    "preemption": {
+        "run_id": str,
+        "checkpoint_step": int,  # step of the grace-path final save
+        "saved": bool,           # False: no --checkpoint-dir to save to
+    },
+    "restart": {
+        "run_id": str,
+        "backoff_s": _NUM,
+        "last_step": int,        # tailed from the child's metrics JSONL
+        "checkpoint_step": int,  # latest checkpoint at restart time
+    },
+    "resume": {
+        "run_id": str,
+        "checkpoint_step": int,  # the step the attempt resumes from
+        "resume_dir": str,
     },
 }
 

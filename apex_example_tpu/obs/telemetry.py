@@ -193,6 +193,27 @@ class TelemetryEmitter:
             rec["spans"] = span_hists
         return rec
 
+    def preemption(self, signal_name: str, *, step: int,
+                   checkpoint_step: Optional[int] = None,
+                   saved: bool = False) -> Dict[str, Any]:
+        """The graceful-preemption record (schema v4; the resilience
+        grace path, resilience/preemption.py): written BEFORE the normal
+        close, so the stream reads header, steps..., preemption,
+        run_summary — and the summary stays un-aborted (a preempted run
+        is resumable, not broken)."""
+        rec: Dict[str, Any] = {
+            "record": "preemption",
+            "time": metrics_lib.now(),
+            "run_id": self.run_id,
+            "signal": str(signal_name),
+            "step": int(step),
+            "saved": bool(saved),
+        }
+        if checkpoint_step is not None:
+            rec["checkpoint_step"] = int(checkpoint_step)
+        self.sink.write(rec)
+        return rec
+
     def close(self) -> None:
         if self._closed:
             return

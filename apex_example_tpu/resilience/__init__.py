@@ -1,0 +1,34 @@
+"""apex_example_tpu.resilience — the fault-tolerance stratum.
+
+PR 2's diagnostics stratum (obs/flight.py) made the failure path
+*observable*; this package makes it *survivable*.  Production TPU fleets
+run on interruptible capacity, so preemption and restart are the steady
+state, not the exception — three pillars turn "observe the failure" into
+"absorb the failure":
+
+- :mod:`~apex_example_tpu.resilience.preemption`  SIGTERM/SIGUSR1 grace
+  path: the handler only sets a flag; the train loop notices it at the
+  next step boundary, saves a final checkpoint, emits a ``preemption``
+  record and exits ``EX_TEMPFAIL`` (75) — resumable, not broken.
+- :mod:`~apex_example_tpu.resilience.supervisor`  auto-resume supervisor
+  (pure stdlib, **jax-free by contract** — it must run on hosts where
+  jax is broken; ``tools/supervise.py`` is its CLI): runs train.py as a
+  child, restarts on preemption/crash with exponential backoff, rewrites
+  ``--resume`` each attempt, and emits ``restart``/``resume`` records.
+- :mod:`~apex_example_tpu.resilience.faults`  deterministic fault
+  injection (``--inject-fault kind@step``): crash / SIGTERM-self / hang /
+  grad-NaN at a chosen step, so the whole loop — fault → forensics →
+  graceful save → supervised restart → exact continuation — is testable
+  end-to-end in tier-1.
+
+``supervisor`` is importable here for in-package callers, but the CLI
+loads it by file path (the package ``__init__`` pulls jax).
+"""
+
+from apex_example_tpu.resilience.faults import FaultInjected, FaultPlan
+from apex_example_tpu.resilience.preemption import (EX_TEMPFAIL,
+                                                    PreemptionHandler)
+from apex_example_tpu.resilience.supervisor import Supervisor
+
+__all__ = ["EX_TEMPFAIL", "FaultInjected", "FaultPlan", "PreemptionHandler",
+           "Supervisor"]

@@ -1,0 +1,126 @@
+"""Deterministic fault injection (``--inject-fault kind@step``).
+
+The resilience loop — fault → forensics → graceful save → supervised
+restart → exact continuation — is only trustworthy if every stage is
+testable, and real faults don't arrive on cue.  A ``FaultPlan`` fires a
+chosen fault at an exact global step:
+
+``crash``    raise :class:`FaultInjected` (a RuntimeError) after the
+             step completes — exercises the flight recorder's
+             exception path (crash_dump + aborted summary) and the
+             supervisor's crash-restart backoff.
+``sigterm``  ``os.kill(self, SIGTERM)`` after the step completes — the
+             preemption drill: under ``--preempt-grace`` the loop
+             notices the flag at the next boundary and runs the grace
+             save; without it, the flight recorder's 143 path.
+``hang``     block in ``time.sleep`` after the step completes —
+             exercises the stall watchdog (``--stall-timeout``) and the
+             supervisor's ``--stall-kill``.
+``nan``      poison every *floating* leaf of the step's input batch with
+             NaN — grads go non-finite, exercising the overflow/
+             numerics provenance path (``--numerics-check``).  Requires
+             the batch to carry at least one float leaf (images, MLM
+             label weights); an int-only token batch is rejected at
+             fire time.
+
+Steps are 1-based **global** steps and fire exactly once, on equality —
+a resumed run whose restored step is already past the fault step never
+re-fires, which is precisely what makes "restart then run to completion"
+testable.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+KINDS = ("crash", "sigterm", "hang", "nan")
+
+# Long enough that a hung step is indistinguishable from a real wedge to
+# every consumer (watchdog, supervisor), bounded so an unsupervised run
+# still terminates eventually.
+HANG_SECONDS = 3600.0
+
+
+class FaultInjected(RuntimeError):
+    """The injected-crash exception ('crash' kind).  A RuntimeError
+    subclass so generic crash handling treats it as any other failure;
+    its own type so tests and log-readers can tell drill from disease."""
+
+
+class FaultPlan:
+    """One fault, one step, fires once."""
+
+    def __init__(self, kind: str, step: int, hang_s: float = HANG_SECONDS):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(expected one of {KINDS})")
+        if step < 1:
+            raise ValueError(f"fault step must be >= 1, got {step}")
+        self.kind = kind
+        self.step = int(step)
+        self.hang_s = hang_s
+        self.fired = False
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``kind@step`` — e.g. ``sigterm@12``."""
+        kind, sep, step_s = spec.partition("@")
+        if not sep or not kind or not step_s:
+            raise ValueError(f"--inject-fault {spec!r}: expected kind@step "
+                             f"(kinds: {', '.join(KINDS)})")
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(f"--inject-fault {spec!r}: step {step_s!r} is "
+                             "not an integer")
+        return cls(kind, step)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.kind}@{self.step})"
+
+    # ------------------------------------------------------------- fire
+
+    def maybe_poison(self, step: int, batch):
+        """'nan' kind, called with the 1-based global step the batch is
+        ABOUT to be consumed by: returns the batch with every floating
+        leaf replaced by NaN at the fault step, unchanged otherwise."""
+        if self.kind != "nan" or self.fired or step != self.step:
+            return batch
+        self.fired = True
+        import jax
+        import jax.numpy as jnp
+
+        poisoned = [False]
+
+        def poison(leaf):
+            x = jnp.asarray(leaf)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                poisoned[0] = True
+                return jnp.full_like(x, jnp.nan)
+            return leaf
+
+        batch = jax.tree_util.tree_map(poison, batch)
+        if not poisoned[0]:
+            raise FaultInjected(
+                f"nan fault at step {self.step}: the batch carries no "
+                "floating-point leaf to poison (int-only token batches "
+                "cannot carry NaN — use the image or MLM workloads)")
+        return batch
+
+    def maybe_fire(self, step: int) -> None:
+        """crash/sigterm/hang kinds, called with the 1-based global step
+        that JUST completed.  Fires after the step's telemetry record is
+        emitted, so forensics always hold the last good step."""
+        if self.kind == "nan" or self.fired or step != self.step:
+            return
+        self.fired = True
+        if self.kind == "crash":
+            raise FaultInjected(f"injected crash at step {self.step}")
+        if self.kind == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        # hang: one opaque block, like a wedged collective — the stall
+        # watchdog's stacks will point here.
+        time.sleep(self.hang_s)

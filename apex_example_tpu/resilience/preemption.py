@@ -1,0 +1,127 @@
+"""Preemption grace path: turn SIGTERM into a clean, resumable exit.
+
+Without this module a preempted run dies mid-step: the flight recorder
+(obs/flight.py) writes a ``crash_dump``, marks the summary
+``aborted: true`` and re-delivers the signal — exit status 143, forensics
+but no survival.  With ``--preempt-grace`` the handler here only SETS A
+FLAG; the training loop polls it at the next step boundary and runs the
+grace sequence itself, outside signal context:
+
+1. join any pending async orbax write, save a final checkpoint (with the
+   host-state sidecar, so resume is exact — utils/checkpoint.py);
+2. emit a ``preemption`` record (schema v4) through the telemetry sink —
+   NOT a crash_dump, and the run summary stays un-aborted;
+3. return ``EX_TEMPFAIL`` (75), the sysexits.h "temporary failure, retry"
+   status, so a supervisor (resilience/supervisor.py) knows the run is
+   resumable rather than broken.
+
+Coordination with the flight recorder: both want SIGTERM.  The handler
+takes ownership explicitly via ``FlightRecorder.release_signal`` — the
+recorder restores its saved previous disposition and forgets the signal,
+then this handler installs over that — so close order never matters and
+a real crash (exception, SIGSEGV, atexit) still reaches the recorder's
+hooks.  SIGUSR1 rides along for schedulers that send it as the
+preemption notice (SLURM ``--signal``, borg-style warning signals).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Optional, Tuple
+
+# sysexits.h EX_TEMPFAIL: "temporary failure; the user is invited to
+# retry".  os.EX_TEMPFAIL where the platform defines it — the literal is
+# the contract (the supervisor matches on 75, possibly on another host).
+EX_TEMPFAIL = getattr(os, "EX_TEMPFAIL", 75)
+
+DEFAULT_SIGNALS: Tuple[int, ...] = (signal.SIGTERM, signal.SIGUSR1)
+
+
+class PreemptionHandler:
+    """Flag-only signal handler for the graceful-preemption path.
+
+    Usage shape (what train.py's loops do)::
+
+        preempt = PreemptionHandler(recorder=recorder)   # recorder may be None
+        preempt.install()
+        for step ...:
+            ...train...
+            if preempt.preempted:
+                break                       # grace sequence runs here
+        preempt.close()                     # restore dispositions
+
+    The handler is async-signal-minimal: it records the signal name and a
+    timestamp, nothing else — no IO, no allocation-heavy work.  Repeat
+    deliveries while the flag is already set are ignored (cloud
+    preemption escalates to SIGKILL on its own schedule; a second SIGTERM
+    must not turn a grace save into a crash).
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = DEFAULT_SIGNALS,
+                 recorder=None):
+        self.signals = tuple(signals)
+        self.recorder = recorder
+        self._prev = {}
+        self._installed = False
+        self._closed = False
+        self._preempted = False
+        self.signal_name: Optional[str] = None
+        self.preempt_time: Optional[float] = None
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    # ------------------------------------------------------------ hooks
+
+    def install(self) -> None:
+        """Arm the grace handlers.  Signal handlers only install from the
+        main thread (CPython's constraint); off the main thread this is a
+        no-op and ``installed`` stays False."""
+        if self._installed or self._closed:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in self.signals:
+            if self.recorder is not None:
+                # Explicit handover: the recorder restores its saved
+                # previous disposition and forgets the signal, so its
+                # close() can no longer clobber ours.
+                self.recorder.release_signal(sig)
+            try:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):  # pragma: no cover
+                continue
+        self._installed = bool(self._prev)
+
+    def close(self) -> None:
+        """Restore the previous dispositions (the recorder's original
+        previous handler where a handover happened — not the recorder's,
+        which released ownership at install)."""
+        if self._closed:
+            return
+        self._closed = True
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev.clear()
+
+    # ----------------------------------------------------- hook target
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._preempted:
+            return
+        self._preempted = True
+        self.signal_name = signal.Signals(signum).name
+        self.preempt_time = time.time()

@@ -10,37 +10,111 @@ process 0 coordinates the write in multi-host settings.
 
 from __future__ import annotations
 
+import glob
+import json
 import os
-from typing import Optional
+from typing import Any, Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
 from apex_example_tpu.engine import TrainState
 
+# Host-state sidecar files live NEXT to the orbax step dirs (not inside
+# them — orbax owns the step dir's contents and garbage-collects it
+# whole).  One JSON file per retained step.
+_HOST_STATE_FMT = "host_state-{step}.json"
+_HOST_STATE_GLOB = "host_state-*.json"
+
 
 class CheckpointManager:
-    """Thin manager: save(state), restore(template) -> state, latest step."""
+    """Thin manager: save(state), restore(template) -> state, latest step.
+
+    Beyond the device pytree, a checkpoint can carry a **host-state
+    sidecar** (``host_state-<step>.json``): the loop position (epoch /
+    step-in-epoch / data index) and host PRNG state that live outside the
+    TrainState.  The device state alone resumes *a* run; the sidecar is
+    what makes resume *exact* — mid-epoch position preserved, the
+    synthetic data stream continued rather than the epoch restarted
+    (train.py's resume path consumes it; the resilience grace save
+    writes it).
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                  create=True))
 
     def save(self, state: TrainState, step: Optional[int] = None,
-             wait: bool = True) -> None:
+             wait: bool = True,
+             host_state: Optional[Dict[str, Any]] = None) -> None:
         """``wait=False`` returns as soon as the device arrays are snapshot
         and lets orbax's background thread do the serialization/IO — the
         async-checkpoint mode (train.py --async-checkpoint): training
         overlaps the write, at the cost of holding one extra copy of the
         state until it lands.  A later save (or close) joins the pending
-        write first, so checkpoints never interleave."""
+        write first, so checkpoints never interleave.
+
+        ``host_state`` (a JSON-serializable dict) is written synchronously
+        as the step's sidecar — it is host data and tiny, so it never
+        rides the async path (a sidecar must not outrun or trail the
+        arrays it describes by more than the orbax commit window)."""
         step = int(state.step) if step is None else step
         self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if host_state is not None:
+            self.save_host_state(step, host_state)
         if wait:
             self._mgr.wait_until_finished()
+
+    # -------------------------------------------------- host-state sidecar
+
+    def _host_state_path(self, step: int) -> str:
+        return os.path.join(self.directory, _HOST_STATE_FMT.format(step=step))
+
+    def save_host_state(self, step: int, host_state: Dict[str, Any]) -> None:
+        """Atomic write (tmp + rename: a preemption mid-write must not
+        leave a torn sidecar next to a good checkpoint), pruned to the
+        manager's retention window."""
+        path = self._host_state_path(int(step))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(host_state, fh)
+        os.replace(tmp, path)
+        kept = sorted(self.host_state_steps())
+        for old in kept[:-self.max_to_keep]:
+            try:
+                os.remove(self._host_state_path(old))
+            except OSError:  # pragma: no cover
+                pass
+
+    def host_state_steps(self):
+        steps = []
+        for path in glob.glob(os.path.join(self.directory,
+                                           _HOST_STATE_GLOB)):
+            stem = os.path.basename(path)[len("host_state-"):-len(".json")]
+            if stem.isdigit():
+                steps.append(int(stem))
+        return steps
+
+    def load_host_state(self, step: Optional[int] = None
+                        ) -> Optional[Dict[str, Any]]:
+        """Sidecar for ``step`` (default: the latest checkpoint's), or
+        None — pre-sidecar checkpoints stay restorable; the caller falls
+        back to deriving position from ``state.step``."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        path = self._host_state_path(int(step))
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):  # pragma: no cover
+            return None
 
     def wait_until_finished(self) -> None:
         """Join any pending async save."""

@@ -63,10 +63,15 @@ def test_psum_determinism_bitwise(devices8):
 
 
 def _spawn_trainer(ckpt, extra, env):
+    # bert_tiny, not resnet18: the kill/resume contract under test is
+    # arch-agnostic (checkpoint step continuity + AMP O2 state survival),
+    # and the tiny-LM step compiles several times faster — this test is
+    # two cold subprocess trainers, the suite's single largest cost.
     return subprocess.Popen(
-        [sys.executable, "train.py", "--arch", "resnet18", "--opt-level",
-         "O2", "--epochs", "3", "--steps-per-epoch", "3", "--batch-size",
-         "16", "--print-freq", "1", "--checkpoint-dir", ckpt] + extra,
+        [sys.executable, "train.py", "--arch", "bert_tiny", "--seq-len",
+         "16", "--opt", "adam", "--opt-level", "O2", "--epochs", "3",
+         "--steps-per-epoch", "3", "--batch-size", "8", "--print-freq",
+         "1", "--checkpoint-dir", ckpt] + extra,
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True, bufsize=1)
 

@@ -514,15 +514,27 @@ def test_c1_clean_run_with_diagnostics_armed(tmp_path, capsys):
     """Recorder + watchdog + numerics armed on a clean run: zero crash/
     stall records, an UN-aborted summary, per-step overflow_events in
     'always' mode (empty modules — nothing overflowed), hooks disarmed,
-    stdout meters intact."""
+    stdout meters intact.  Also the IMAGE-loop --save-every-steps wiring
+    (ISSUE 4): interval checkpoints + host-state sidecars ride this run
+    rather than paying a second resnet compile in test_resilience.py."""
     path = str(tmp_path / "clean.jsonl")
+    ck = str(tmp_path / "ck")
     prev_term = signal.getsignal(signal.SIGTERM)
     rc = train_mod.main(C1_DIAG_ARGS + [
         "--metrics-jsonl", path, "--flight-recorder",
-        "--stall-timeout", "600", "--numerics-check", "always"])
+        "--stall-timeout", "600", "--numerics-check", "always",
+        "--checkpoint-dir", ck, "--save-every-steps", "2"])
     assert rc == 0
-    assert "epoch 0 step 4/4" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "epoch 0 step 4/4" in out
+    assert "saved checkpoint at step 2" in out             # interval save
     assert signal.getsignal(signal.SIGTERM) == prev_term   # disarmed
+    from apex_example_tpu.utils.checkpoint import CheckpointManager
+    mgr = CheckpointManager(ck)
+    assert mgr.latest_step() == 4                 # epoch-end save, once
+    assert mgr.load_host_state(2)["step_in_epoch"] == 2
+    assert mgr.load_host_state(4)["data_index"] == 4
+    mgr.close()
     records = obs.read_jsonl(path)
     kinds = [r["record"] for r in records]
     assert "crash_dump" not in kinds and "stall" not in kinds
@@ -622,10 +634,12 @@ def test_thin_clients_run_without_jax(tmp_path):
     poisoned jax module sits first on PYTHONPATH, so any import of jax
     (direct or transitive) fails loudly."""
     clients = _thin_clients()
-    # the diagnostics/telemetry/serving clients must be in the set — if
-    # one grew a jax import, that IS the regression this test catches
+    # the diagnostics/telemetry/serving/resilience clients must be in the
+    # set — if one grew a jax import, that IS the regression this test
+    # catches.  supervise especially: the supervisor's whole job is to
+    # restart training on hosts where jax is broken (ISSUE 4).
     for required in ("metrics_lint", "telemetry_report", "fleet_report",
-                     "serve_report"):
+                     "serve_report", "supervise"):
         assert required in clients, f"{required} now imports jax"
 
     block = tmp_path / "block"
@@ -651,7 +665,12 @@ def test_thin_clients_run_without_jax(tmp_path):
     real_args = {"metrics_lint": [str(stream)],
                  "telemetry_report": [str(stream)],
                  "fleet_report": [str(stream)],
-                 "serve_report": [str(serve_stream)]}
+                 "serve_report": [str(serve_stream)],
+                 # a full supervise cycle (spawn child, wait, summarize)
+                 # with a trivial jax-free child — not just --help
+                 "supervise": ["--max-restarts", "0",
+                               "--metrics-jsonl", str(tmp_path / "sup.jsonl"),
+                               "--", sys.executable, "-c", "print('ok')"]}
     for tool in clients:
         argv = real_args.get(tool, ["--help"])
         r = subprocess.run(

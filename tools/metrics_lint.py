@@ -9,8 +9,13 @@ runs anywhere the repo is checked out:
     python tools/metrics_lint.py out.jsonl --require-summary
 
 Schema v2 streams (the diagnostics records: crash_dump / stall /
-overflow_event, aborted run summaries) validate alongside v1 streams —
-the schema tables are a strict superset.
+overflow_event, aborted run summaries), v3 streams (the serving
+records) and v4 streams (the resilience records: preemption / restart /
+resume, run summaries with restart_count) all validate alongside v1
+streams — each version's tables are a strict superset of the last.
+A gracefully preempted run (train.py --preempt-grace) DOES close with a
+run_summary, so --require-summary passes on it; only an actual abort
+exits 2.
 
 Exit status (the contract CI scripts key on):
   0   every line parses and validates, and the --require / --steps /

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Auto-resume supervisor CLI: keep a training run alive across
+preemptions and crashes.
+
+    python tools/supervise.py [flags] -- python train.py --arch ... \
+        --checkpoint-dir ck --preempt-grace --metrics-jsonl out.jsonl
+
+Everything after ``--`` is the child command, launched verbatim except:
+
+- ``--resume <checkpoint-dir>`` is inserted (or replaced) whenever the
+  checkpoint dir holds a step — attempt 0 included, so a re-launched
+  supervisor continues where its predecessor's child left off;
+- on restart attempts the child's ``--metrics-jsonl PATH`` becomes
+  ``PATH.attempt<K>``, preserving each attempt's stream intact.
+
+Child exit contract: 0 = done; 75 (EX_TEMPFAIL, train.py's
+``--preempt-grace`` path) = graceful preemption, restart promptly; any
+other status = crash, restart with exponential backoff.  Every restart
+consumes one unit of ``--max-restarts``.
+
+``--metrics-jsonl`` here gives the SUPERVISOR its own schema-v4 stream
+(``restart``/``resume`` records, ``run_summary`` with ``restart_count``
+— obs/schema.py); ``--checkpoint-dir``/child metrics default from the
+child's own flags.
+
+Thin client contract: **no jax import, direct or transitive** — the
+supervisor's one job is to restart training on hosts where training
+just died, including deaths caused by a broken jax install
+(tests/test_diag.py runs every tools/ thin client under a poisoned jax
+module).  resilience/supervisor.py is therefore loaded by file path:
+importing the package would pull jax via apex_example_tpu/__init__.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+
+def _load_supervisor():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "apex_example_tpu", "resilience",
+                        "supervisor.py")
+    spec = importlib.util.spec_from_file_location("apex_supervisor", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if "--" in argv:
+        split = argv.index("--")
+        sup_argv, child_argv = argv[:split], argv[split + 1:]
+    else:
+        sup_argv, child_argv = argv, []
+    ap = argparse.ArgumentParser(
+        description="auto-resume supervisor: tools/supervise.py [flags] "
+                    "-- <child command>")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="dir to watch for checkpoints and rewrite "
+                         "--resume to (default: the child's own "
+                         "--checkpoint-dir flag)")
+    ap.add_argument("--metrics-jsonl", default=None, metavar="PATH",
+                    help="the supervisor's OWN telemetry stream (schema "
+                         "v4 restart/resume records + run_summary with "
+                         "restart_count)")
+    ap.add_argument("--child-metrics", default=None, metavar="PATH",
+                    help="the child's metrics JSONL to tail for the last "
+                         "completed step (default: the child's own "
+                         "--metrics-jsonl flag)")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="restart budget; a flapping run eventually "
+                         "surfaces as a failure (default 3)")
+    ap.add_argument("--backoff", type=float, default=1.0, metavar="S",
+                    help="crash-restart backoff base: S * 2^k seconds "
+                         "(default 1.0)")
+    ap.add_argument("--backoff-max", type=float, default=60.0, metavar="S",
+                    help="crash-restart backoff ceiling (default 60)")
+    ap.add_argument("--preempt-delay", type=float, default=0.0, metavar="S",
+                    help="delay before restarting after a graceful "
+                         "preemption (exit 75; default 0 — the capacity "
+                         "is back when the scheduler restarts us)")
+    ap.add_argument("--stall-kill", type=float, default=0.0, metavar="S",
+                    help="SIGKILL a child whose metrics JSONL stops "
+                         "advancing for S seconds and restart it as a "
+                         "crash (0 disables; the deadline covers "
+                         "first-step compile — size it accordingly)")
+    args = ap.parse_args(sup_argv)
+    if not child_argv:
+        ap.error("no child command: tools/supervise.py [flags] -- "
+                 "python train.py ...")
+    sup_mod = _load_supervisor()
+    sup = sup_mod.Supervisor(
+        child_argv,
+        checkpoint_dir=args.checkpoint_dir,
+        metrics_jsonl=args.metrics_jsonl,
+        child_metrics=args.child_metrics,
+        max_restarts=args.max_restarts,
+        backoff_s=args.backoff,
+        backoff_max_s=args.backoff_max,
+        preempt_delay_s=args.preempt_delay,
+        stall_kill_s=args.stall_kill)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

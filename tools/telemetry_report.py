@@ -3,7 +3,11 @@
 throughput, compile estimate, overflow accounting, span histograms — and
 the failure path: aborted runs (a stream that ends without a
 run_summary, or one marked ``aborted: true``), overflow step indices,
-``crash_dump`` / ``stall`` diagnostics records when present.
+``crash_dump`` / ``stall`` diagnostics records when present — and the
+recover path (schema v4): graceful preemptions are reported as
+PREEMPTED (resumable), distinct from ABORTED (broken); supervisor
+streams surface their ``restart``/``resume`` records and the summary's
+``restart_count``.
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -52,6 +56,9 @@ def report(path: str, out=sys.stdout) -> int:
                    None)
     crashes = [r for r in records if r.get("record") == "crash_dump"]
     stalls = [r for r in records if r.get("record") == "stall"]
+    preemptions = [r for r in records if r.get("record") == "preemption"]
+    restarts = [r for r in records if r.get("record") == "restart"]
+    resumes = [r for r in records if r.get("record") == "resume"]
     overflow_events = [r for r in records
                        if r.get("record") == "overflow_event"]
     # Schema-invalid step records were warned about above; summarize only
@@ -71,14 +78,53 @@ def report(path: str, out=sys.stdout) -> int:
     # never write a summary by design — not aborts.
     is_train_stream = header is not None or any(
         r.get("record") == "step" for r in records)
+    is_supervisor_stream = (header or {}).get("platform") == "supervisor" \
+        or bool(restarts or resumes)
+    def print_preempted(p, truncated=False):
+        # A graceful preemption is NOT an abort: the run saved, exited
+        # 75 and is resumable — the distinction supervisors key on.
+        ck = p.get("checkpoint_step")
+        print(f"PREEMPTED RUN (graceful): {p.get('signal', '?')} at step "
+              f"{p.get('step', '?')}, "
+              + (f"checkpoint at step {ck}" if ck is not None
+                 else "nothing saved")
+              + " — resumable"
+              + (" (stream truncated before run_summary)" if truncated
+                 else ""), file=out)
+
     if summary is None:
-        if is_train_stream:
+        if is_supervisor_stream:
+            # Supervisors have no flight recorder; a truncated stream
+            # means the supervisor itself was killed mid-flight.
+            print("TRUNCATED SUPERVISOR STREAM: ends without a "
+                  "run_summary (supervisor killed?)", file=out)
+        elif preemptions:
+            # SIGKILL landed between the preemption record and the
+            # summary: the grace checkpoint DID land first (the record
+            # is written after the save), so the run is resumable.
+            print_preempted(preemptions[-1], truncated=True)
+        elif is_train_stream:
             print("ABORTED RUN: stream ends without a run_summary (killed "
                   "before the flight recorder could fire, or no "
                   "--flight-recorder)", file=out)
     elif summary.get("aborted"):
         reason = summary.get("abort_reason", "unknown reason")
         print(f"ABORTED RUN: {reason}", file=out)
+    elif preemptions:
+        print_preempted(preemptions[-1])
+    if summary is not None and summary.get("restart_count"):
+        print(f"restarts: {summary['restart_count']}"
+              + (f"  (final exit {summary['exit_code']})"
+                 if "exit_code" in summary else ""), file=out)
+    for r in restarts[:10]:
+        print(f"restart after attempt {r.get('attempt', '?')}: exit "
+              f"{r.get('exit_code', '?')} ({r.get('reason', '?')}), "
+              f"last step {r.get('last_step', '?')}, backoff "
+              f"{r.get('backoff_s', 0):.1f}s", file=out)
+    for r in resumes[:10]:
+        print(f"resume attempt {r.get('attempt', '?')}: from step "
+              f"{r.get('checkpoint_step', '?')} in "
+              f"{r.get('resume_dir', '?')}", file=out)
     for c in crashes:
         where = f" at step {c['step']}" if "step" in c else ""
         print(f"crash_dump{where}: {c.get('reason', '?')}", file=out)
@@ -90,6 +136,13 @@ def report(path: str, out=sys.stdout) -> int:
         print(f"stalls: {len(stalls)} (longest {worst:.0f}s without a "
               "step)", file=out)
     if not steps:
+        if is_supervisor_stream:
+            # Supervisor streams carry no step records by design — the
+            # child's stream(s) hold those.  A truncated one (no
+            # run_summary) is unhealthy regardless.
+            print("supervisor stream (step records live in the child's "
+                  "metrics JSONL)", file=out)
+            return 0 if summary is not None else 1
         print("no step records", file=out)
         return 1
 

@@ -48,6 +48,8 @@ from apex_example_tpu.parallel import (DDPConfig, LARC, is_main_process,
                                        maybe_initialize_distributed)
 from apex_example_tpu.obs import (TelemetryEmitter, TensorBoardAdapter,
                                   make_profiler_window, rank_print, span)
+from apex_example_tpu.resilience import (EX_TEMPFAIL, FaultPlan,
+                                         PreemptionHandler)
 from apex_example_tpu.utils import AverageMeter, Throughput
 from apex_example_tpu.utils.checkpoint import (CheckpointManager,
                                                restore_under_mesh)
@@ -178,6 +180,11 @@ def parse_args(argv=None):
     p.add_argument("--async-checkpoint", action="store_true",
                    help="don't block training on checkpoint IO (orbax "
                         "background write; joined before the next save)")
+    p.add_argument("--save-every-steps", type=int, default=0, metavar="N",
+                   help="also checkpoint every N optimizer steps (requires "
+                        "--checkpoint-dir; epoch boundaries still save) — "
+                        "bounds how stale the preemption grace path's "
+                        "'last checkpoint' can be on long epochs")
     p.add_argument("--remat", default="none",
                    choices=["none", "conv", "block"],
                    help="rematerialization for image archs: 'conv' saves "
@@ -229,6 +236,22 @@ def parse_args(argv=None):
                         "records naming the offending module(s) "
                         "('overflow': only on overflow steps; 'always': "
                         "every step; requires --metrics-jsonl)")
+    # resilience stratum (apex_example_tpu/resilience/; README
+    # "Resilience") — preemption grace, supervised auto-resume, fault
+    # drills.  tools/supervise.py is the restart supervisor.
+    p.add_argument("--preempt-grace", action="store_true",
+                   help="catch SIGTERM/SIGUSR1 and exit gracefully at the "
+                        "next step boundary: join pending checkpoint IO, "
+                        "save a final checkpoint (with --checkpoint-dir), "
+                        "emit a 'preemption' record (with --metrics-jsonl) "
+                        "and exit 75/EX_TEMPFAIL so a supervisor "
+                        "(tools/supervise.py) restarts the run instead of "
+                        "declaring it broken")
+    p.add_argument("--inject-fault", default="", metavar="KIND@STEP",
+                   help="deterministic fault drill at a 1-based global "
+                        "step: crash | sigterm | hang | nan "
+                        "(resilience/faults.py); a resumed run already "
+                        "past STEP never re-fires")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eval", action="store_true")
     p.add_argument("--eval-batches", type=int, default=10,
@@ -337,6 +360,109 @@ def close_telemetry(emitter, profwin, recorder=None, watchdog=None):
     obs.set_default_registry(None)
 
 
+def make_resilience(args, recorder):
+    """--preempt-grace handler + --inject-fault plan for a train loop.
+    Installed AFTER make_telemetry so the grace handler can take SIGTERM
+    ownership over from the flight recorder (release_signal handover —
+    a preempted run saves and exits 75 instead of crash-dumping 143);
+    the recorder keeps excepthook/atexit/faulthandler for real crashes."""
+    preempt = fault = None
+    if args.preempt_grace:
+        preempt = PreemptionHandler(recorder=recorder)
+        preempt.install()
+    if args.inject_fault:
+        fault = FaultPlan.parse(args.inject_fault)
+    return preempt, fault
+
+
+def host_loop_state(args, global_step):
+    """The host-state checkpoint sidecar (utils/checkpoint.py): loop
+    position + host PRNG state — everything resume needs that lives
+    outside the TrainState.  The synthetic data streams are index-driven
+    (data/__init__.py: batch_fn(global_step)), so ``data_index`` IS the
+    stream position; persisting it (with the python PRNG, for host-side
+    augmentation) makes mid-epoch resume continue the exact stream
+    instead of restarting the epoch."""
+    import random
+    rng_version, rng_state, rng_gauss = random.getstate()
+    return {
+        "step": int(global_step),
+        "data_index": int(global_step),
+        "steps_per_epoch": int(args.steps_per_epoch),
+        "epoch": int(global_step) // args.steps_per_epoch,
+        "step_in_epoch": int(global_step) % args.steps_per_epoch,
+        "seed": int(args.seed),
+        "python_random": [rng_version, list(rng_state), rng_gauss],
+    }
+
+
+def restore_loop_position(args, rmgr, global_step):
+    """(start_epoch, start_step_in_epoch) for a resumed run, restoring
+    the host PRNG from the sidecar when one exists.  Falls back to
+    deriving position from the restored step alone (pre-sidecar
+    checkpoints stay resumable — at epoch granularity both forms agree;
+    mid-epoch they also agree as long as --steps-per-epoch is
+    unchanged)."""
+    hs = rmgr.load_host_state(global_step)
+    start_epoch = global_step // args.steps_per_epoch
+    start_i = global_step % args.steps_per_epoch
+    if hs:
+        if hs.get("step") == global_step \
+                and hs.get("steps_per_epoch") == args.steps_per_epoch:
+            start_epoch = int(hs.get("epoch", start_epoch))
+            start_i = int(hs.get("step_in_epoch", start_i))
+        rng = hs.get("python_random")
+        if rng:
+            import random
+            random.setstate((rng[0], tuple(rng[1]), rng[2]))
+    return start_epoch, start_i
+
+
+def graceful_preempt_exit(args, mgr, state, preempt, emitter, global_step,
+                          last_saved=None):
+    """The preemption grace sequence (resilience/preemption.py docstring;
+    runs at a step boundary, NOT in signal context): join any pending
+    async orbax write, save a final checkpoint + host-state sidecar,
+    emit the schema-v4 ``preemption`` record, and hand back EX_TEMPFAIL
+    (75) so the supervisor restarts rather than buries the run.  The
+    caller's finally still runs close_telemetry — with no exception
+    unwinding, so the stream closes with a normal (un-aborted)
+    run_summary after the preemption record."""
+    if args.prof:
+        # The returns below skip the loops' post-try stop_trace — an
+        # unstopped trace is never finalized on disk.
+        jax.profiler.stop_trace()
+        rank_print("profile written to /tmp/apex_tpu_trace")
+    ckstep = None
+    if mgr is not None:
+        if is_main_process():
+            mgr.wait_until_finished()
+            if last_saved != int(state.step):
+                mgr.save(state, wait=True,
+                         host_state=host_loop_state(args, global_step))
+            else:
+                # This exact step is already on disk (a --save-every-steps
+                # boundary); just refresh its sidecar.
+                mgr.save_host_state(int(state.step),
+                                    host_loop_state(args, global_step))
+        # ckstep/saved describe the RUN, not this rank: rank 0 owns the
+        # write (state is replicated), so every rank's preemption record
+        # reports the same run-level outcome — fleet_report must not see
+        # contradictory saved flags for one run.
+        ckstep = int(state.step)
+        rank_print(f"preempted by {preempt.signal_name}: saved checkpoint "
+                   f"at step {ckstep}; exiting {EX_TEMPFAIL} (resumable)")
+    else:
+        rank_print(f"preempted by {preempt.signal_name}: no "
+                   f"--checkpoint-dir, nothing saved; exiting "
+                   f"{EX_TEMPFAIL}")
+    if emitter is not None:
+        emitter.preemption(preempt.signal_name, step=int(global_step),
+                           checkpoint_step=ckstep,
+                           saved=ckstep is not None)
+    return EX_TEMPFAIL
+
+
 def build_optimizer(args):
     lr = build_lr(args)
     # Under LARC, weight decay moves INTO the trust ratio (apex zeroes the
@@ -432,6 +558,19 @@ def main(argv=None):
     if args.stall_trace and args.stall_timeout <= 0:
         raise SystemExit("--stall-trace arms on a stall; it needs "
                          "--stall-timeout S")
+    if args.save_every_steps < 0:
+        raise SystemExit(f"--save-every-steps {args.save_every_steps} "
+                         "must be >= 0")
+    if args.save_every_steps and not args.checkpoint_dir:
+        raise SystemExit("--save-every-steps writes through "
+                         "--checkpoint-dir; add it")
+    if args.inject_fault:
+        # Early CLI gate only (uniform SystemExit before devices/model
+        # build); make_resilience re-parses to build each loop's plan.
+        try:
+            FaultPlan.parse(args.inject_fault)
+        except ValueError as e:
+            raise SystemExit(str(e))
     if args.numerics_check != "off" and (
             args.zero or args.pipeline_parallel > 1
             or args.context_parallel > 1 or args.moe_experts
@@ -548,7 +687,8 @@ def main(argv=None):
     writer = make_writer(args)
     tb = TensorBoardAdapter(writer)
     emitter, profwin, recorder, watchdog = make_telemetry(args)
-    start_epoch = 0
+    preempt, fault = make_resilience(args, recorder)
+    start_epoch = start_i = 0
     if args.resume:
         rmgr = CheckpointManager(args.resume)
         if n_dev > 1:
@@ -556,7 +696,8 @@ def main(argv=None):
                 rmgr, state, mesh, optimizer if args.zero else None)
         else:
             state = rmgr.restore(state)
-        start_epoch = int(state.step) // args.steps_per_epoch
+        start_epoch, start_i = restore_loop_position(args, rmgr,
+                                                     int(state.step))
         rank_print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
 
     if args.prof:
@@ -593,16 +734,24 @@ def main(argv=None):
         eval_batch_fn = batch_fn
 
     run_step = 0                    # run-relative step index (1-based in
-    try:                            # the loop; drives the profiler window)
+    last_saved = None               # the loop; drives the profiler window)
+    try:
         for epoch in range(start_epoch, args.epochs):
             losses, top1s = AverageMeter("loss"), AverageMeter("top1")
             thr = Throughput(warmup_steps=2)
-            for i in range(args.steps_per_epoch):
+            # Mid-epoch resume (host-state sidecar): the first resumed
+            # epoch continues at its saved position instead of rerunning
+            # the whole epoch — data indices stay continuous either way
+            # (batch_fn is index-driven), this keeps the STEP COUNT exact.
+            for i in range(start_i if epoch == start_epoch else 0,
+                           args.steps_per_epoch):
                 run_step += 1
                 if profwin is not None:
                     profwin.on_step_start(run_step)
                 with span("data"):
                     batch = batch_fn(global_step)
+                if fault is not None:
+                    batch = fault.maybe_poison(global_step + 1, batch)
                 t0 = time.perf_counter()
                 with span("step"):
                     state, metrics = step_fn(state, batch)
@@ -630,6 +779,23 @@ def main(argv=None):
                                 "train/top1": top1s.val,
                                 "train/img_per_sec": thr.rate},
                                global_step)
+                if args.save_every_steps and mgr is not None \
+                        and is_main_process() \
+                        and global_step % args.save_every_steps == 0:
+                    mgr.save(state, wait=not args.async_checkpoint,
+                             host_state=host_loop_state(args, global_step))
+                    last_saved = global_step
+                    rank_print(f"saved checkpoint at step {global_step}")
+                if fault is not None:
+                    # After the step's telemetry AND any interval save
+                    # landed: forensics hold the last good step, and a
+                    # crash@N drill with --save-every-steps N resumes
+                    # PAST the fault instead of crash-looping.
+                    fault.maybe_fire(global_step)
+                if preempt is not None and preempt.preempted:
+                    break               # grace sequence below the loops
+            if preempt is not None and preempt.preempted:
+                break
             if args.eval:
                 # Full validation loop (reference harness shape: N batches,
                 # top-1/top-5 meters, SURVEY.md §3.5) on a held-out index
@@ -647,12 +813,28 @@ def main(argv=None):
                       f"({args.eval_batches} batches)")
                 tb.scalars({"eval/loss": el.avg, "eval/top1": e1.avg,
                             "eval/top5": e5.avg}, global_step)
-            if mgr is not None and is_main_process():
+            if mgr is not None and is_main_process() \
+                    and last_saved != int(state.step):
                 # Reference: rank 0 writes the checkpoint (SURVEY.md §4.5);
                 # state is replicated so one host's copy is the full state.
-                mgr.save(state, wait=not args.async_checkpoint)
+                # (last_saved guard: a --save-every-steps boundary landing
+                # on the epoch end already wrote this exact step.)
+                mgr.save(state, wait=not args.async_checkpoint,
+                         host_state=host_loop_state(args, global_step))
+                last_saved = int(state.step)
                 rank_print(f"saved checkpoint at step {int(state.step)}")
+            if preempt is not None and preempt.preempted:
+                # Re-poll AFTER eval + the epoch-end save: a SIGTERM that
+                # lands during either must not cost one more training
+                # step of the scheduler's kill-escalation window.
+                break
+        if preempt is not None and preempt.preempted:
+            return graceful_preempt_exit(args, mgr, state, preempt,
+                                         emitter, global_step,
+                                         last_saved=last_saved)
     finally:
+        if preempt is not None:
+            preempt.close()
         close_telemetry(emitter, profwin, recorder, watchdog)
         if prefetcher is not None:
             prefetcher.close()
@@ -1307,20 +1489,25 @@ def _lm_main_impl(args, policy, scaler):
     writer = make_writer(args)
     tb = TensorBoardAdapter(writer)
     emitter, profwin, recorder, watchdog = make_telemetry(args)
-    start_epoch = 0
+    preempt, fault = make_resilience(args, recorder)
+    start_epoch = start_i = 0
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
-        # resume (matches the reference harness, which does not persist them).
+        # resume (matches the reference harness, which does not persist
+        # them); the host-state sidecar carries the loop position + host
+        # PRNG, and the index-driven token streams continue at
+        # batch_fn(global_step) — so BERT/GPT resume is exact mid-epoch.
+        rmgr = CheckpointManager(args.resume)
         if tp == 1 and pp == 1 and not args.moe_experts and n_dev > 1:
             # (tp/pp > 1 and MoE templates are already mesh-placed above;
             # DP and CP templates are not — CP state is replicated, so the
             # replicated template is the right restore target for it too.)
             state = restore_under_mesh(
-                CheckpointManager(args.resume), state, mesh,
-                optimizer if args.zero else None)
+                rmgr, state, mesh, optimizer if args.zero else None)
         else:
-            state = CheckpointManager(args.resume).restore(state)
-        start_epoch = int(state.step) // args.steps_per_epoch
+            state = rmgr.restore(state)
+        start_epoch, start_i = restore_loop_position(args, rmgr,
+                                                     int(state.step))
         rank_print(f"resumed from step {int(state.step)} (epoch {start_epoch})")
 
     if args.prof:
@@ -1365,16 +1552,21 @@ def _lm_main_impl(args, policy, scaler):
                                           jnp.asarray(w))
             return jnp.asarray(ids), jnp.asarray(labels)
     run_step = 0
+    last_saved = None
     try:
         for epoch in range(start_epoch, args.epochs):
             losses = AverageMeter("loss")
             thr = Throughput(warmup_steps=2)
-            for i in range(args.steps_per_epoch):
+            # Mid-epoch resume: see the image loop.
+            for i in range(start_i if epoch == start_epoch else 0,
+                           args.steps_per_epoch):
                 run_step += 1
                 if profwin is not None:
                     profwin.on_step_start(run_step)
                 with span("data"):
                     batch = batch_fn(global_step)
+                if fault is not None:
+                    batch = fault.maybe_poison(global_step + 1, batch)
                 t0 = time.perf_counter()
                 with span("step"):
                     if is_bert or is_gpt:
@@ -1404,6 +1596,20 @@ def _lm_main_impl(args, policy, scaler):
                     tb.scalars({"train/loss": losses.val,
                                 "train/tok_per_sec": thr.rate},
                                global_step)
+                if args.save_every_steps and mgr is not None \
+                        and is_main_process() \
+                        and global_step % args.save_every_steps == 0:
+                    mgr.save(state, wait=not args.async_checkpoint,
+                             host_state=host_loop_state(args, global_step))
+                    last_saved = global_step
+                    rank_print(f"saved checkpoint at step {global_step}")
+                if fault is not None:
+                    # See the image loop: after telemetry + interval save.
+                    fault.maybe_fire(global_step)
+                if preempt is not None and preempt.preempted:
+                    break
+            if preempt is not None and preempt.preempted:
+                break
             if eval_fn is not None:
                 # Held-out token streams at a disjoint index range (the
                 # image path's contract); TXL threads fresh eval mems.
@@ -1433,13 +1639,24 @@ def _lm_main_impl(args, policy, scaler):
                       f"({args.eval_batches} batches)")
                 tb.scalars({"eval/loss": el.avg,
                             f"eval/{metric[0]}": metric[1]}, global_step)
-            if mgr is not None and is_main_process():
-                mgr.save(state, wait=not args.async_checkpoint)
+            if mgr is not None and is_main_process() \
+                    and last_saved != int(state.step):
+                mgr.save(state, wait=not args.async_checkpoint,
+                         host_state=host_loop_state(args, global_step))
+                last_saved = int(state.step)
                 rank_print(f"saved checkpoint at step {int(state.step)}")
+            if preempt is not None and preempt.preempted:
+                break                # re-poll after eval: see image loop
+        if preempt is not None and preempt.preempted:
+            return graceful_preempt_exit(args, mgr, state, preempt,
+                                         emitter, global_step,
+                                         last_saved=last_saved)
     finally:
         # Join pending async checkpoint writes even when unwinding on an
         # exception — an announced save must exist on disk (main() gives
         # its image path the same protection).
+        if preempt is not None:
+            preempt.close()
         close_telemetry(emitter, profwin, recorder, watchdog)
         if prefetcher is not None:
             prefetcher.close()
