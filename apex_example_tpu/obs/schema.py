@@ -62,6 +62,27 @@ plus ``restart_count``/``exit_code`` on ``run_summary`` (the
 supervisor's closing record).  v4 is once more a strict superset: every
 v1–v3 stream validates unchanged.
 
+Version 5 adds the serving-resilience stratum (ISSUE 5: deadlines,
+admission control, drain, serve-path faults):
+
+``request_failed``  one per non-success request termination — status
+                    ``timeout`` (deadline expired, queued or mid-
+                    flight), ``cancelled``, or ``failed`` (slot-level
+                    exception / degenerate-token guard, with the
+                    traceback digest).
+``shed``            one per request rejected by admission control
+                    (``RequestQueue(max_pending=...)`` overflow).
+``serve_drain``     emitted by a SIGTERM/SIGUSR1'd serve.py that
+                    stopped admission, finished or deadline-evicted its
+                    in-flight slots, handed queued requests back for
+                    requeueing, and exited 75 (EX_TEMPFAIL) — the
+                    serving counterpart of ``preemption``.
+
+plus per-status counts (``completed``/``timed_out``/``shed``/
+``cancelled``/``failed``/``drained``) and an ``availability`` ratio on
+``serve_summary``.  v5 is once more a strict superset: every v1–v4
+stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -73,7 +94,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 _NUM = (int, float)
 
@@ -168,6 +189,24 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "time": _NUM,
         "attempt": int,
     },
+    # --- schema v5: serving-resilience records (serve.py / serve/) ---
+    "request_failed": {
+        "record": str,
+        "time": _NUM,
+        "request_id": str,
+        "status": str,          # timeout | cancelled | failed
+    },
+    "shed": {
+        "record": str,
+        "time": _NUM,
+        "request_id": str,
+        "reason": str,          # queue_full
+    },
+    "serve_drain": {
+        "record": str,
+        "time": _NUM,
+        "signal": str,
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -250,6 +289,14 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "queue_wait_ms": dict,
         "aborted": bool,
         "abort_reason": str,
+        # v5: per-status accounting ("requests" stays the terminal total)
+        "completed": int,       # status ok
+        "timed_out": int,       # deadline expired (queued or mid-flight)
+        "shed": int,            # rejected by admission control
+        "cancelled": int,
+        "failed": int,          # slot-level exception / token guard
+        "drained": int,         # requeued by a graceful drain
+        "availability": _NUM,   # ok / every status the server owned
     },
     "preemption": {
         "run_id": str,
@@ -266,6 +313,32 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "run_id": str,
         "checkpoint_step": int,  # the step the attempt resumes from
         "resume_dir": str,
+    },
+    "request_failed": {
+        "run_id": str,
+        "slot": int,             # only when the request was admitted
+        "admitted_step": int,
+        "failed_step": int,      # engine tick of the termination
+        "prompt_tokens": int,
+        "output_tokens": int,    # partial output kept at eviction
+        "queue_wait_ms": _NUM,
+        "e2e_ms": _NUM,
+        "error": str,            # traceback digest (status "failed")
+    },
+    "shed": {
+        "run_id": str,
+        "step": int,             # engine tick of the rejection
+        "pending": int,          # ARRIVED backlog after the shed (what
+        "max_pending": int,      #   the tripped bound actually counts)
+    },
+    "serve_drain": {
+        "run_id": str,
+        "step": int,             # tick the drain began
+        "in_flight": int,        # live slots at drain start
+        "completed": int,        # in-flight that finished during drain
+        "evicted": int,          # in-flight deadline-evicted/failed
+        "requeued": int,         # queued handed back (status "drained")
+        "requeued_ids": list,
     },
 }
 

@@ -19,16 +19,20 @@ state, not the exception — three pillars turn "observe the failure" into
   injection (``--inject-fault kind@step``): crash / SIGTERM-self / hang /
   grad-NaN at a chosen step, so the whole loop — fault → forensics →
   graceful save → supervised restart → exact continuation — is testable
-  end-to-end in tier-1.
+  end-to-end in tier-1.  The serve path (serve.py; ISSUE 5) accepts the
+  same kinds plus ``slot_fail`` (``SERVE_KINDS``) at engine-tick
+  granularity — sigterm drives the graceful drain, slot_fail the
+  slot-isolation path.
 
 ``supervisor`` is importable here for in-package callers, but the CLI
 loads it by file path (the package ``__init__`` pulls jax).
 """
 
-from apex_example_tpu.resilience.faults import FaultInjected, FaultPlan
+from apex_example_tpu.resilience.faults import (KINDS, SERVE_KINDS,
+                                                FaultInjected, FaultPlan)
 from apex_example_tpu.resilience.preemption import (EX_TEMPFAIL,
                                                     PreemptionHandler)
 from apex_example_tpu.resilience.supervisor import Supervisor
 
-__all__ = ["EX_TEMPFAIL", "FaultInjected", "FaultPlan", "PreemptionHandler",
-           "Supervisor"]
+__all__ = ["EX_TEMPFAIL", "FaultInjected", "FaultPlan", "KINDS",
+           "PreemptionHandler", "SERVE_KINDS", "Supervisor"]

@@ -21,12 +21,28 @@ chosen fault at an exact global step:
              numerics provenance path (``--numerics-check``).  Requires
              the batch to carry at least one float leaf (images, MLM
              label weights); an int-only token batch is rejected at
-             fire time.
+             fire time.  On the SERVE path (serve.py) the same kind
+             instead degenerates the tick's sampled tokens, exercising
+             the engine's NaN/degenerate-logits guard.
 
-Steps are 1-based **global** steps and fire exactly once, on equality —
-a resumed run whose restored step is already past the fault step never
-re-fires, which is precisely what makes "restart then run to completion"
-testable.
+Serve-only kind (``SERVE_KINDS``; serve.py accepts it, train.py keeps
+rejecting it):
+
+``slot_fail``  raise :class:`FaultInjected` inside ONE slot's harvest at
+               the chosen engine tick — exercises the serving engine's
+               failure isolation: exactly that slot's request fails
+               (``request_failed`` record), every other request is
+               token-identical to a fault-free run.
+
+Steps are 1-based **global** steps (engine ticks on the serve path) and
+fire exactly once — on equality for the training kinds (a resumed run
+whose restored step is already past the fault step never re-fires,
+which is precisely what makes "restart then run to completion"
+testable), and at the first tick ``>=`` the target for the
+caller-handled serve kinds (``due()``/``take()``: a slot-level drill
+landing on a tick that cannot express it — idle, or every slot still
+prefilling — defers rather than vanishing; the serve path has no
+resume, so late-firing never double-fires).
 """
 
 from __future__ import annotations
@@ -36,6 +52,9 @@ import signal
 import time
 
 KINDS = ("crash", "sigterm", "hang", "nan")
+# serve.py additionally accepts slot_fail (slot-level failure isolation);
+# train.py keeps validating against the training KINDS.
+SERVE_KINDS = KINDS + ("slot_fail",)
 
 # Long enough that a hung step is indistinguishable from a real wedge to
 # every consumer (watchdog, supervisor), bounded so an unsupervised run
@@ -50,12 +69,15 @@ class FaultInjected(RuntimeError):
 
 
 class FaultPlan:
-    """One fault, one step, fires once."""
+    """One fault, one step, fires once.  ``kinds`` is the accepted set —
+    training loops use the default ``KINDS``, serve.py passes
+    ``SERVE_KINDS`` (adds slot_fail)."""
 
-    def __init__(self, kind: str, step: int, hang_s: float = HANG_SECONDS):
-        if kind not in KINDS:
+    def __init__(self, kind: str, step: int, hang_s: float = HANG_SECONDS,
+                 kinds=KINDS):
+        if kind not in kinds:
             raise ValueError(f"unknown fault kind {kind!r} "
-                             f"(expected one of {KINDS})")
+                             f"(expected one of {kinds})")
         if step < 1:
             raise ValueError(f"fault step must be >= 1, got {step}")
         self.kind = kind
@@ -64,18 +86,18 @@ class FaultPlan:
         self.fired = False
 
     @classmethod
-    def parse(cls, spec: str) -> "FaultPlan":
+    def parse(cls, spec: str, kinds=KINDS) -> "FaultPlan":
         """``kind@step`` — e.g. ``sigterm@12``."""
         kind, sep, step_s = spec.partition("@")
         if not sep or not kind or not step_s:
             raise ValueError(f"--inject-fault {spec!r}: expected kind@step "
-                             f"(kinds: {', '.join(KINDS)})")
+                             f"(kinds: {', '.join(kinds)})")
         try:
             step = int(step_s)
         except ValueError:
             raise ValueError(f"--inject-fault {spec!r}: step {step_s!r} is "
                              "not an integer")
-        return cls(kind, step)
+        return cls(kind, step, kinds=kinds)
 
     def __repr__(self) -> str:
         return f"FaultPlan({self.kind}@{self.step})"
@@ -109,11 +131,26 @@ class FaultPlan:
                 "cannot carry NaN — use the image or MLM workloads)")
         return batch
 
+    def due(self, step: int) -> bool:
+        """Caller-handled kinds (the serve engine's ``nan`` token
+        degeneration and ``slot_fail`` isolation): armed and reached —
+        ``>=`` rather than ``==``, because a slot-level fault scheduled
+        on an idle or all-prefill tick must fire at the next tick that
+        CAN express it (the serve path has no resume, so late-firing
+        never double-fires).  The caller consumes it with take()."""
+        return not self.fired and step >= self.step
+
+    def take(self) -> None:
+        """Consume a due() fault — exactly-once is the caller's pairing
+        of due() and take()."""
+        self.fired = True
+
     def maybe_fire(self, step: int) -> None:
         """crash/sigterm/hang kinds, called with the 1-based global step
         that JUST completed.  Fires after the step's telemetry record is
         emitted, so forensics always hold the last good step."""
-        if self.kind == "nan" or self.fired or step != self.step:
+        if self.kind not in ("crash", "sigterm", "hang") or self.fired \
+                or step != self.step:
             return
         self.fired = True
         if self.kind == "crash":

@@ -37,12 +37,22 @@ The supervisor keeps its OWN telemetry stream (``metrics_jsonl``):
 checkpoint-resumed launch, a ``restart`` record per restart decision
 (exit code, reason, backoff, the child's last step tailed from its
 metrics JSONL), and a closing ``run_summary`` carrying ``restart_count``
-— schema v4 (obs/schema.py; hard-coded here to stay import-free).
+— schema v5 (obs/schema.py; hard-coded here to stay import-free).
 
 SIGTERM/SIGINT to the supervisor forward to the child and stop the
 restart loop: the child runs its own grace path, the supervisor exits
 with the child's status (75 if the child saved — a supervisor-of-
 supervisors can resume the whole tree).
+
+The contract is child-agnostic: serve.py's graceful drain exits the
+same 75, so the supervisor restarts a drained server promptly and a
+crashed one with backoff.  Serving children differ in two ways —
+``resume=False`` skips the ``--resume`` rewrite (serve.py has no resume
+concept), and ``drop_flags_on_restart=['--inject-fault']`` strips a
+one-shot drill from restart attempts (a served run restarts from tick
+0, so the exact-tick fault would otherwise re-fire every attempt).
+Metrics rotation and stall-kill work unchanged; a serve stream has no
+``step`` records, so ``last_step`` simply stays unreported.
 """
 
 from __future__ import annotations
@@ -59,7 +69,7 @@ from typing import Any, Dict, List, Optional
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) and
 # resilience/preemption.py (EX_TEMPFAIL) — this module must not import
 # either (jax-free contract).
-SCHEMA = 4
+SCHEMA = 5
 EX_TEMPFAIL = 75
 
 
@@ -138,6 +148,30 @@ def _get_flag(argv: List[str], flag: str) -> Optional[str]:
     return None
 
 
+def _strip_flag(argv: List[str], flag: str) -> List[str]:
+    """Return argv with every ``flag value`` / ``flag=value`` / bare
+    ``flag`` occurrence removed (used by ``drop_flags_on_restart`` —
+    e.g. a one-shot ``--inject-fault`` drill that must not re-fire on
+    the restarted attempt: a served run restarts from tick 0, so unlike
+    a resumed training run the exact-step match would fire again).  The
+    following token is only consumed when it is not itself a flag, so
+    stripping a store_true flag never swallows an unrelated argument."""
+    out: List[str] = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == flag:
+            i += 1
+            if i < len(argv) and not argv[i].startswith("-"):
+                i += 1                  # the flag's value
+        elif arg.startswith(flag + "="):
+            i += 1
+        else:
+            out.append(arg)
+            i += 1
+    return out
+
+
 class _Stream:
     """Minimal JSONL writer (the supervisor cannot use obs.JsonlSink —
     jax-free contract).  One file, truncated at first write, flushed per
@@ -182,11 +216,20 @@ class Supervisor:
                  backoff_max_s: float = 60.0,
                  preempt_delay_s: float = 0.0,
                  stall_kill_s: float = 0.0,
+                 resume: bool = True,
+                 drop_flags_on_restart: Optional[List[str]] = None,
                  sleep_fn=time.sleep,
                  log=print):
         if not child_argv:
             raise ValueError("supervisor needs a child command")
         self.child_argv = list(child_argv)
+        # resume=False: never rewrite --resume (children without a resume
+        # concept — serve.py restores params via its own flags and would
+        # reject an injected --resume).  drop_flags_on_restart: child
+        # flags stripped from every restart attempt's argv (one-shot
+        # fault drills).
+        self.resume = bool(resume)
+        self.drop_flags_on_restart = list(drop_flags_on_restart or [])
         self.checkpoint_dir = checkpoint_dir \
             or _get_flag(self.child_argv, "--checkpoint-dir")
         # An EXPLICIT --child-metrics always wins for tailing (the child
@@ -298,6 +341,11 @@ class Supervisor:
         # they match the .attempt<N> stream filenames after a supervisor
         # relaunch (offset > 0).
         n = attempt + self._attempt_offset
+        if n > 0:
+            for flag in self.drop_flags_on_restart:
+                argv = _strip_flag(argv, flag)
+        if not self.resume:
+            ckstep = None
         if ckstep is not None:
             argv = _set_flag(argv, "--resume", self.checkpoint_dir)
             self._stream.write({

@@ -7,19 +7,30 @@ advances every live request with ONE compiled decode step per tick
 trail TTFT/TPOT metrics derive from (``serve/queue.py``), and a
 deterministic synthetic load generator (``serve/loadgen.py``).
 
+The resilience layer (ISSUE 5) rides the same modules: per-request
+deadlines/TTL (queued-expire and mid-flight evict), bounded admission
+with deterministic load shedding (``RequestQueue(max_pending=...)``),
+cancellation, slot-level failure isolation with a degenerate-token
+guard, and graceful drain (``ServeEngine.drain``) — every request
+terminates in a first-class ``Completion(status=...)``.
+
 ``serve.py`` at the repo root is the CLI driver (checkpoint restore or
-random init, synthetic stream, schema-v3 JSONL serving records);
+random init, synthetic stream, schema-v5 JSONL serving records, SIGTERM
+drain-to-EX_TEMPFAIL, ``--inject-fault`` drills);
 ``tools/serve_report.py`` is the jax-free summary client.
 """
 
-from apex_example_tpu.serve.engine import (ServeEngine,
-                                           request_complete_record)
+from apex_example_tpu.serve.engine import (ServeEngine, SlotFailure,
+                                           request_complete_record,
+                                           request_failed_record)
 from apex_example_tpu.serve.loadgen import parse_range, synthetic_requests
-from apex_example_tpu.serve.queue import Completion, Request, RequestQueue
+from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
+                                          RequestQueue)
 from apex_example_tpu.serve.slots import Slot, SlotPool
 
 __all__ = [
-    "Completion", "Request", "RequestQueue", "ServeEngine", "Slot",
-    "SlotPool", "parse_range", "request_complete_record",
+    "Completion", "Request", "RequestQueue", "STATUSES", "ServeEngine",
+    "Slot", "SlotFailure", "SlotPool", "parse_range",
+    "request_complete_record", "request_failed_record",
     "synthetic_requests",
 ]

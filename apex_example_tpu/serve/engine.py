@@ -1,19 +1,39 @@
 """The continuous-batching scheduler loop.
 
-One engine tick = admit + step + harvest:
+One engine tick = expire + admit + step + harvest:
 
-1. **admit** — pop admissible requests from the queue into free slots
+1. **expire/shed** — stamp virtual arrivals, shed the backlog overflow
+   (bounded admission, ``RequestQueue(max_pending=...)``), expire queued
+   requests whose deadline passed without admission, and deadline-evict
+   decoding slots whose request ran out of time mid-flight.
+2. **admit** — pop admissible requests from the queue into free slots
    (serve/slots.py resets that row's cache indices; the request's prompt
    becomes the slot's token feed).
-2. **step** — ONE compiled decode program advances every live slot by
+3. **step** — ONE compiled decode program advances every live slot by
    one token.  Prefill and decode share the program exactly as in
    models/gpt.generate: a slot still inside its prompt feeds the next
    prompt token and discards the model's prediction; a slot past its
    prompt feeds its previously sampled token and keeps the new one.
    Because the cache indices are per-slot, requests admitted at
    different ticks coexist in one batch — continuous batching.
-3. **harvest** — detect EOS / length completions, evict their slots,
-   emit ``request_complete`` records (obs schema v3).
+4. **harvest** — detect EOS / length completions, evict their slots,
+   emit ``request_complete`` records; per-slot host work is exception-
+   contained, so a failure (or an injected ``slot_fail``) terminates
+   only that slot's request (``request_failed`` record with the
+   traceback digest) while the engine keeps ticking.  A NaN/degenerate-
+   logits guard on the sampled-token path fails the affected slots the
+   same way instead of feeding garbage back into the cache.
+
+Every request terminates in a first-class ``Completion(status=...)``
+(serve/queue.py: ok / timeout / shed / cancelled / failed / drained) —
+overload, deadlines, faults and drains resolve requests explicitly
+rather than silently dropping them.
+
+Graceful drain (``drain()``): stop admission, hand queued requests back
+with status "drained" (requeue-able on another replica), finish or
+deadline-evict the in-flight slots, and emit a ``serve_drain`` record —
+the serving counterpart of train.py's ``--preempt-grace`` path
+(serve.py wires it to SIGTERM/SIGUSR1 and exits ``EX_TEMPFAIL``).
 
 The per-tick host sync (fetching the sampled tokens) is the deliberate
 cost of host-side scheduling, mirroring the telemetry layer's stance on
@@ -28,6 +48,7 @@ from __future__ import annotations
 
 import functools
 import time
+import traceback
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -36,7 +57,9 @@ import numpy as np
 
 from apex_example_tpu.models.gpt import sample_tokens
 from apex_example_tpu.obs.metrics import nearest_rank
-from apex_example_tpu.serve.queue import Completion, Request, RequestQueue
+from apex_example_tpu.resilience.faults import FaultInjected
+from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
+                                          RequestQueue)
 from apex_example_tpu.serve.slots import SlotPool
 
 
@@ -55,32 +78,44 @@ def _pct_dict(vals_ms: List[float]) -> Dict[str, float]:
 def _slot_step(dec):
     """One compiled decode step for a slot-decode model clone (cached on
     the frozen module config, params as an argument — the same contract
-    as models/gpt._decode_loop)."""
+    as models/gpt._decode_loop).  Besides the sampled tokens it returns
+    a per-slot logits-finite mask: argmax/categorical over NaN logits
+    yield an IN-RANGE index, so a token-range check alone can never see
+    real NaN fallout — the finiteness of the logits themselves is the
+    signal, and computing it here fuses it into the decode program."""
 
     @jax.jit
     def step(params, cache, tok, rng, temperature, top_k):
         logits, mut = dec.apply({"params": params, "cache": cache}, tok,
                                 train=False, mutable=["cache"])
-        nxt = sample_tokens(rng, logits[:, -1], temperature, top_k)
-        return mut["cache"], nxt
+        last = logits[:, -1]
+        nxt = sample_tokens(rng, last, temperature, top_k)
+        finite = jnp.all(jnp.isfinite(last), axis=-1)
+        return mut["cache"], nxt, finite
 
     return step
 
 
+class SlotFailure(RuntimeError):
+    """Raised inside one slot's harvest when its sampled token is
+    degenerate (out-of-vocab / NaN-logits fallout) — contained to that
+    slot like any other per-slot exception."""
+
+
 def request_complete_record(comp: Completion,
                             run_id: Optional[str] = None) -> Dict[str, Any]:
-    """The schema-v3 ``request_complete`` record for one completion."""
+    """The schema-v3 ``request_complete`` record for one ok completion."""
     rec: Dict[str, Any] = {
         "record": "request_complete",
         "time": _now(),
         "request_id": comp.request.uid,
         "prompt_tokens": len(comp.request.prompt),
         "output_tokens": len(comp.tokens),
-        "ttft_ms": round(comp.ttft_s * 1e3, 3),
+        "ttft_ms": round((comp.ttft_s or 0.0) * 1e3, 3),
         "tpot_ms": round(comp.tpot_s * 1e3, 3),
         "finish_reason": comp.finish_reason,
         "slot": comp.slot,
-        "queue_wait_ms": round(comp.queue_wait_s * 1e3, 3),
+        "queue_wait_ms": round((comp.queue_wait_s or 0.0) * 1e3, 3),
         "e2e_ms": round(comp.e2e_s * 1e3, 3),
         "admitted_step": comp.admitted_step,
         "finished_step": comp.finished_step,
@@ -92,29 +127,64 @@ def request_complete_record(comp: Completion,
     return rec
 
 
+def request_failed_record(comp: Completion,
+                          run_id: Optional[str] = None) -> Dict[str, Any]:
+    """The schema-v5 ``request_failed`` record for a timeout / cancelled
+    / failed completion (drained requests ride the ``serve_drain``
+    record instead — they are requeued, not failed)."""
+    rec: Dict[str, Any] = {
+        "record": "request_failed",
+        "time": _now(),
+        "request_id": comp.request.uid,
+        "status": comp.status,
+        "prompt_tokens": len(comp.request.prompt),
+        "output_tokens": len(comp.tokens),
+        "failed_step": comp.finished_step,
+    }
+    if comp.slot >= 0:
+        rec["slot"] = comp.slot
+        rec["admitted_step"] = comp.admitted_step
+    if comp.queue_wait_s is not None:
+        rec["queue_wait_ms"] = round(comp.queue_wait_s * 1e3, 3)
+    rec["e2e_ms"] = round(comp.e2e_s * 1e3, 3)
+    if comp.error:
+        rec["error"] = comp.error
+    if run_id:
+        rec["run_id"] = run_id
+    return rec
+
+
 class ServeEngine:
     """Continuous-batching engine over a GPT-family model.
 
     ``model`` is the plain module, ``params`` its trained (or random)
     weights; the engine derives the slot-decode clone via its SlotPool.
     ``sink`` (an obs.JsonlSink), when given, receives one
-    ``request_complete`` per finished request; the caller writes the
-    run header and the final ``serve_summary`` (see serve.py).
+    ``request_complete`` / ``request_failed`` / ``shed`` record per
+    terminated request; the caller writes the run header and the final
+    ``serve_summary`` (see serve.py).  ``fault`` is an optional
+    resilience ``FaultPlan`` whose step is a 1-based engine tick
+    (``--inject-fault kind@tick``).
     """
 
     def __init__(self, model, params, *, num_slots: int = 4,
                  max_len: int = 128, rng=None,
                  queue: Optional[RequestQueue] = None,
-                 sink=None, run_id: Optional[str] = None):
+                 sink=None, run_id: Optional[str] = None,
+                 fault=None):
         self.pool = SlotPool(model, num_slots, max_len)
+        self.vocab_size = int(model.vocab_size)
         self.params = params
         self.queue = queue if queue is not None else RequestQueue()
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.sink = sink
         self.run_id = run_id
+        self.fault = fault
         self.step_count = 0
         self.compute_steps = 0
         self.completions: List[Completion] = []
+        self.counts: Dict[str, int] = {s: 0 for s in STATUSES}
+        self.draining = False
         self._step_fn = _slot_step(self.pool.dec)
         self._t0 = time.perf_counter()
         self._tokens_out = 0
@@ -125,6 +195,23 @@ class ServeEngine:
     def submit(self, request: Request) -> None:
         self.queue.submit(request)
 
+    def cancel(self, uid: str) -> bool:
+        """Cancel a request by uid: a queued one terminates immediately
+        (status "cancelled", never admitted); a decoding one is evicted
+        mid-flight with its partial tokens.  False if the uid is unknown
+        or already terminal.  Call from the engine thread (queued-side
+        cancellation alone is thread-safe via the queue's lock)."""
+        req = self.queue.cancel(uid)
+        if req is not None:
+            self._terminal_unadmitted(req, "cancelled")
+            return True
+        for i in self.pool.live:
+            slot = self.pool.slots[i]
+            if slot.request.uid == uid:
+                self._terminal_slot(i, "cancelled", time.perf_counter())
+                return True
+        return False
+
     # ------------------------------------------------------------ tick
 
     def step(self) -> bool:
@@ -132,15 +219,45 @@ class ServeEngine:
         slot was live); False is an idle tick (virtual time still
         advances, so ``arrival_step`` gates keep maturing)."""
         pool = self.pool
-        self.queue.mature(self.step_count)
-        while pool.free_count:
-            req = self.queue.pop(self.step_count)
-            if req is None:
-                break
-            pool.admit(req, self.step_count)
+        step = self.step_count
+        tick1 = step + 1            # 1-based, for --inject-fault kind@tick
+        now = time.perf_counter()
+        if not self.draining:
+            self.queue.mature(step)
+            # Expire BEFORE evaluating the bound: requests already dead
+            # in the queue must not count against max_pending and get a
+            # healthy arrival shed over capacity that frees this tick.
+            for req in self.queue.expire(step, now):
+                self._terminal_unadmitted(req, "timeout")
+            shed = self.queue.shed_overflow(step)
+            if shed:
+                # One arrived-backlog read for the whole batch of shed
+                # records, not one O(backlog) scan per victim.
+                pending = self.queue.arrived_pending(step)
+                for req in shed:
+                    self._terminal_unadmitted(req, "shed",
+                                              pending=pending)
+        # Mid-flight deadline eviction (drain included: "finish or
+        # deadline-evict" is the drain contract) — checked at the tick
+        # boundary, before the slot consumes another decode step.
+        for i in list(pool.live):
+            if pool.slots[i].request.expired(step, now):
+                self._terminal_slot(i, "timeout", now)
+        if not self.draining:
+            while pool.free_count:
+                req = self.queue.pop(step)
+                if req is None:
+                    break
+                pool.admit(req, step)
         live = pool.live
         if not live:
             self.step_count += 1
+            if self.fault is not None:
+                # Engine-level kinds are defined on TICKS, not decode
+                # steps — an idle tick must still fire crash/sigterm/
+                # hang, or a drill scheduled between arrival waves would
+                # be silently skipped (equality never matches again).
+                self.fault.maybe_fire(tick1)
             return False
 
         S = pool.num_slots
@@ -153,61 +270,178 @@ class ServeEngine:
             temps[i] = slot.request.temperature
             ks[i] = slot.request.top_k
         self.rng, key = jax.random.split(self.rng)
-        pool.cache, nxt = self._step_fn(
+        pool.cache, nxt, finite = self._step_fn(
             self.params, pool.cache, jnp.asarray(tok), key,
             jnp.asarray(temps), jnp.asarray(ks))
         nxt = np.asarray(nxt)          # the scheduler's host sync
+        finite = np.asarray(finite)
         now = time.perf_counter()
+
+        fault = self.fault
+        fail_slot = -1
+        if fault is not None:
+            if fault.kind == "nan" and fault.due(tick1):
+                # Degenerate-sampling drill: what NaN logits do to the
+                # sampled-token path, deterministically.  The guard below
+                # fails every affected slot instead of feeding the
+                # garbage token back into the cache.  Only consumed when
+                # some slot actually KEEPS this tick's token — on an
+                # all-prefill tick the outputs are discarded and the
+                # drill would be spent with zero effect, so it defers to
+                # the first tick that can express it (FaultPlan.due is
+                # >=, and the serve path has no resume to double-fire).
+                slots = pool.slots
+                if any(slots[i].cursor + 1 >= slots[i].n_prompt
+                       for i in live):
+                    fault.take()
+                    nxt = np.full_like(nxt, -1)
+            elif fault.kind == "slot_fail" and fault.due(tick1):
+                fault.take()
+                fail_slot = live[0]
 
         for i in live:
             slot = pool.slots[i]
-            slot.cursor += 1
-            if slot.prefilling:
-                continue               # prompt token fed; output discarded
-            out = int(nxt[i])
-            if slot.n_generated == 0:
-                slot.t_first_token = now
-            slot.tokens.append(out)
-            slot.n_generated += 1
-            self._tokens_out += 1
-            req = slot.request
             reason = None
-            if req.eos_id is not None and out == req.eos_id:
-                reason = "eos"
-            elif slot.n_generated >= pool.max_new_for(req):
-                reason = "length"
+            try:
+                if i == fail_slot:
+                    raise FaultInjected(
+                        f"injected slot_fail at tick {tick1} (slot {i})")
+                slot.cursor += 1
+                if slot.prefilling:
+                    continue           # prompt token fed; output discarded
+                out = int(nxt[i])
+                if not bool(finite[i]):
+                    raise SlotFailure(
+                        f"non-finite logits in slot {i} — NaN/Inf "
+                        "reached the sampled-token path (poisoned "
+                        "params or cache row)")
+                if not 0 <= out < self.vocab_size:
+                    raise SlotFailure(
+                        f"degenerate sampled token {out} (vocab "
+                        f"{self.vocab_size}) — poisoned sampling path")
+                if slot.n_generated == 0:
+                    slot.t_first_token = now
+                slot.tokens.append(out)
+                slot.n_generated += 1
+                self._tokens_out += 1
+                req = slot.request
+                if req.eos_id is not None and out == req.eos_id:
+                    reason = "eos"
+                elif slot.n_generated >= pool.max_new_for(req):
+                    reason = "length"
+            except Exception as e:   # noqa: BLE001 — slot-level isolation
+                # One request's failure must not take down the batch: the
+                # other slots' caches and host state are untouched, so
+                # their token streams continue bit-exact.
+                self._terminal_slot(i, "failed", now, error=e)
+                continue
+            # Terminal transitions run OUTSIDE the isolation try: a sink
+            # IO failure inside _finish is an ENGINE-level fault (it
+            # would hit every record), and catching it above would both
+            # mislabel it a slot failure and re-terminate an
+            # already-evicted slot.
             if reason is not None:
                 self._finish(i, reason, now)
         self.compute_steps += 1
         self._occupancy_sum += len(live)
         self.step_count += 1
+        if fault is not None:
+            # crash/sigterm/hang fire AFTER the tick's harvest (matching
+            # the training loops: forensics hold the last good tick).
+            fault.maybe_fire(tick1)
         return True
 
+    # ------------------------------------------------------- terminals
+
     def _finish(self, idx: int, reason: str, now: float) -> None:
+        self._evict_terminal(idx, reason, "ok", now)
+
+    def _terminal_slot(self, idx: int, status: str, now: float,
+                       error: Optional[BaseException] = None) -> None:
+        """Evict a live slot with a non-ok status (timeout / cancelled /
+        failed): partial tokens kept, ``request_failed`` emitted."""
+        self._evict_terminal(idx, status, status, now, error=error)
+
+    def _evict_terminal(self, idx: int, finish_reason: str, status: str,
+                        now: float,
+                        error: Optional[BaseException] = None) -> None:
+        """The one terminal sequence for an admitted request: build the
+        Completion from the slot, account it, evict, emit the record —
+        ok and non-ok paths share it so the accounting can never
+        desynchronize."""
         slot = self.pool.slots[idx]
+        digest = None
+        if error is not None:
+            tb = traceback.format_exception(type(error), error,
+                                            error.__traceback__)
+            digest = f"{type(error).__name__}: {error}"
+            tail = "".join(tb)[-2000:]
+            digest = f"{digest}\n{tail}" if tail else digest
         comp = Completion(
             request=slot.request,
             tokens=slot.tokens[slot.n_prompt:],
-            finish_reason=reason,
+            finish_reason=finish_reason,
             slot=idx,
             admitted_step=slot.admitted_step,
             finished_step=self.step_count,
             t_admitted=slot.t_admitted,
             t_first_token=slot.t_first_token,
-            t_finish=now)
+            t_finish=now,
+            status=status,
+            error=digest)
         self.completions.append(comp)
+        self.counts[status] += 1
         self.pool.evict(idx)
         if self.sink is not None:
-            self.sink.write(request_complete_record(comp, self.run_id))
+            record = request_complete_record if status == "ok" \
+                else request_failed_record
+            self.sink.write(record(comp, self.run_id))
+
+    def _terminal_unadmitted(self, req: Request, status: str,
+                             pending: Optional[int] = None) -> None:
+        """Terminate a never-admitted request: shed at arrival, expired
+        in the queue, cancelled while queued, or drained for requeueing
+        (the drain record carries the requeued ids; shed gets its own
+        record, with ``pending`` the tick's post-shed arrived backlog —
+        computed once by the caller; timeout/cancelled ride
+        ``request_failed``)."""
+        now = time.perf_counter()
+        comp = Completion(
+            request=req, tokens=[], finish_reason=status, slot=-1,
+            admitted_step=-1, finished_step=self.step_count,
+            t_admitted=None, t_first_token=None, t_finish=now,
+            status=status)
+        self.completions.append(comp)
+        self.counts[status] += 1
+        if self.sink is None:
+            return
+        if status == "shed":
+            rec: Dict[str, Any] = {
+                "record": "shed", "time": _now(), "request_id": req.uid,
+                "reason": "queue_full", "step": self.step_count,
+                "pending": pending if pending is not None
+                else self.queue.arrived_pending(self.step_count)}
+            if self.queue.max_pending is not None:
+                rec["max_pending"] = self.queue.max_pending
+            if self.run_id:
+                rec["run_id"] = self.run_id
+            self.sink.write(rec)
+        elif status in ("timeout", "cancelled", "failed"):
+            self.sink.write(request_failed_record(comp, self.run_id))
+        # "drained": accounted by the serve_drain record, not per-request.
 
     # ------------------------------------------------------------ loop
 
     def run(self, max_steps: Optional[int] = None,
-            idle_wait_s: float = 0.0) -> List[Completion]:
+            idle_wait_s: float = 0.0, stop=None) -> List[Completion]:
         """Drive ticks until the queue is drained and every slot is free
-        (or ``max_steps`` ticks).  ``idle_wait_s`` throttles idle spins
-        when a producer thread feeds the queue in wall-clock time."""
+        (or ``max_steps`` ticks, or ``stop()`` — a callable the caller
+        flips on SIGTERM to hand control to ``drain()``).
+        ``idle_wait_s`` throttles idle spins when a producer thread
+        feeds the queue in wall-clock time."""
         while max_steps is None or self.step_count < max_steps:
+            if stop is not None and stop():
+                break
             if self.queue.drained() and not self.pool.any_live():
                 break
             ran = self.step()
@@ -215,13 +449,58 @@ class ServeEngine:
                 time.sleep(idle_wait_s)
         return self.completions
 
+    # ----------------------------------------------------------- drain
+
+    def drain(self, signal_name: str = "SIGTERM") -> Dict[str, Any]:
+        """Graceful drain: stop admission, hand every still-queued
+        request back with status "drained" (requeue-able elsewhere),
+        then keep ticking until the in-flight slots finish or deadline-
+        evict.  Returns (and emits, with a sink) the ``serve_drain``
+        record; the caller then writes the normal, un-aborted
+        ``serve_summary`` and exits ``EX_TEMPFAIL``."""
+        self.draining = True
+        drain_step = self.step_count
+        before = dict(self.counts)
+        requeued = self.queue.drain()
+        for req in requeued:
+            self._terminal_unadmitted(req, "drained")
+        in_flight = len(self.pool.live)
+        # Bounded by construction: every live slot finishes within
+        # max_len ticks (length cap) — the slack covers prefill already
+        # under way.  A wedge here would be a bug, not load.
+        cap = self.step_count + self.pool.max_len + 2
+        while self.pool.any_live() and self.step_count < cap:
+            self.step()
+        rec: Dict[str, Any] = {
+            "record": "serve_drain",
+            "time": _now(),
+            "signal": str(signal_name),
+            "step": drain_step,
+            "in_flight": in_flight,
+            "completed": self.counts["ok"] - before["ok"],
+            "evicted": (self.counts["timeout"] - before["timeout"])
+            + (self.counts["failed"] - before["failed"]),
+            "requeued": len(requeued),
+            "requeued_ids": [r.uid for r in requeued],
+        }
+        if self.run_id:
+            rec["run_id"] = self.run_id
+        if self.sink is not None:
+            self.sink.write(rec)
+        return rec
+
     # --------------------------------------------------------- metrics
 
     def summary_record(self) -> Dict[str, Any]:
-        """The schema-v3 ``serve_summary`` for everything completed so
-        far (the caller writes it to the sink and closes)."""
+        """The ``serve_summary`` for everything terminated so far (the
+        caller writes it to the sink and closes).  Schema v5: per-status
+        counts + the availability ratio (ok / every terminal status the
+        server owned — drained requests are requeued elsewhere, so they
+        sit outside the denominator)."""
         duration = time.perf_counter() - self._t0
         comps = self.completions
+        ok = [c for c in comps if c.status == "ok"]
+        owned = len(comps) - self.counts["drained"]
         rec: Dict[str, Any] = {
             "record": "serve_summary",
             "time": _now(),
@@ -234,16 +513,24 @@ class ServeEngine:
             "slots": self.pool.num_slots,
             "max_len": self.pool.max_len,
             "duration_s": round(duration, 3),
+            "completed": self.counts["ok"],
+            "timed_out": self.counts["timeout"],
+            "shed": self.counts["shed"],
+            "cancelled": self.counts["cancelled"],
+            "failed": self.counts["failed"],
+            "drained": self.counts["drained"],
+            "availability": round(self.counts["ok"] / owned, 3)
+            if owned else 1.0,
         }
         if self.compute_steps:
             rec["occupancy"] = round(
                 self._occupancy_sum / (self.compute_steps
                                        * self.pool.num_slots), 3)
-        if comps:
-            rec["ttft_ms"] = _pct_dict([c.ttft_s * 1e3 for c in comps])
-            rec["tpot_ms"] = _pct_dict([c.tpot_s * 1e3 for c in comps])
+        if ok:
+            rec["ttft_ms"] = _pct_dict([c.ttft_s * 1e3 for c in ok])
+            rec["tpot_ms"] = _pct_dict([c.tpot_s * 1e3 for c in ok])
             rec["queue_wait_ms"] = _pct_dict(
-                [c.queue_wait_s * 1e3 for c in comps])
+                [c.queue_wait_s * 1e3 for c in ok])
         if self.run_id:
             rec["run_id"] = self.run_id
         return rec

@@ -10,6 +10,13 @@ Arrivals are expressed in VIRTUAL engine steps (``Request.arrival_step``)
 makes "staggered arrivals" deterministic regardless of host speed.  A
 wall-clock producer thread can instead submit these same requests late
 and leave ``arrival_step`` None.
+
+Overload is deterministic too: ``burst`` groups arrivals — requests
+land ``burst`` at a time every ``stagger`` ticks, so a burst sized past
+``num_slots + max_pending`` reproducibly exercises the shed path, and
+``deadline_steps`` gives every request a virtual-step deadline
+(``arrival + deadline_steps``) so the timeout path needs no wall-clock
+sleeps (ISSUE 5).
 """
 
 from __future__ import annotations
@@ -26,26 +33,40 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
                        max_new: Tuple[int, int] = (4, 16),
                        temperature: float = 0.0, top_k: int = 0,
                        eos_id: Optional[int] = None,
-                       stagger: int = 0) -> List[Request]:
+                       stagger: int = 0, burst: int = 1,
+                       deadline_steps: Optional[int] = None,
+                       deadline_s: Optional[float] = None) -> List[Request]:
     """``n`` requests with uniform prompt/output lengths in the given
-    inclusive ranges; request i arrives at virtual step ``i * stagger``
-    (stagger 0 = all at once)."""
+    inclusive ranges; request i arrives at virtual step
+    ``(i // burst) * stagger`` (stagger 0 = all at once; burst b = b
+    arrivals per wave — the deterministic overload mode).  With
+    ``deadline_steps`` each request must finish within that many engine
+    ticks of its arrival; ``deadline_s`` is the wall-clock TTL."""
     if n < 1:
         raise ValueError(f"need n >= 1 requests, got {n}")
     if prompt_len[0] < 1 or prompt_len[0] > prompt_len[1]:
         raise ValueError(f"bad prompt_len range {prompt_len}")
     if max_new[0] < 1 or max_new[0] > max_new[1]:
         raise ValueError(f"bad max_new range {max_new}")
+    if burst < 1:
+        raise ValueError(f"burst must be >= 1, got {burst}")
+    if deadline_steps is not None and deadline_steps < 1:
+        raise ValueError(f"deadline_steps must be >= 1, got "
+                         f"{deadline_steps}")
     rs = np.random.RandomState(seed)
     out = []
     for i in range(n):
         p = int(rs.randint(prompt_len[0], prompt_len[1] + 1))
         m = int(rs.randint(max_new[0], max_new[1] + 1))
         prompt = rs.randint(0, vocab_size, size=(p,)).tolist()
+        arrival = (i // burst) * stagger if stagger else None
         out.append(Request(prompt=prompt, max_new_tokens=m,
                            temperature=temperature, top_k=top_k,
                            eos_id=eos_id,
-                           arrival_step=i * stagger if stagger else None))
+                           arrival_step=arrival,
+                           deadline_step=(arrival or 0) + deadline_steps
+                           if deadline_steps is not None else None,
+                           deadline_s=deadline_s))
     return out
 
 

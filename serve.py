@@ -13,14 +13,30 @@ deterministic synthetic request stream with staggered arrivals.
     python serve.py --arch gpt_tiny --checkpoint-dir ckpts \\
         --temperature 0.8 --top-k 40 --metrics-jsonl serve.jsonl
 
-    # then summarize latency percentiles (jax-free):
+    # overload drill: bursts past the slot count + queue bound shed
+    # deterministically, tight virtual deadlines exercise timeouts
+    python serve.py --requests 24 --slots 2 --max-pending 4 --burst 12 \\
+        --deadline-steps 40 --metrics-jsonl serve.jsonl
+
+    # then summarize per-status accounting + latency (jax-free):
     python tools/serve_report.py serve.jsonl
 
-With --metrics-jsonl the run emits schema-v3 records through the obs
-sink: a run_header, one ``request_complete`` per finished request
-(TTFT/TPOT/queue-wait/slot provenance) and a closing ``serve_summary``
-(throughput, latency percentiles, slot occupancy).  The stream passes
-tools/metrics_lint.py like every other obs stream.
+Resilience (README "Serving resilience"; ISSUE 5): SIGTERM/SIGUSR1
+triggers a graceful drain — admission stops, queued requests are handed
+back with status "drained" (requeue-able on another replica), in-flight
+slots finish or deadline-evict, a ``serve_drain`` record plus the
+normal un-aborted ``serve_summary`` close the stream, and the process
+exits 75 (EX_TEMPFAIL) so a supervisor (tools/supervise.py --no-resume)
+restarts it.  ``--inject-fault {crash,sigterm,hang,nan,slot_fail}@tick``
+makes every failure path deterministic; ``--flight-recorder`` keeps
+crash forensics for the paths that ARE crashes.
+
+With --metrics-jsonl the run emits schema-v5 records through the obs
+sink: a run_header, one ``request_complete`` / ``request_failed`` /
+``shed`` per terminated request, an optional ``serve_drain``, and a
+closing ``serve_summary`` (throughput, latency percentiles, per-status
+counts, availability).  The stream passes tools/metrics_lint.py like
+every other obs stream.
 """
 
 from __future__ import annotations
@@ -60,24 +76,62 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stagger", type=int, default=2,
                    help="virtual engine steps between request arrivals "
                         "(0 = all arrive at once)")
+    p.add_argument("--burst", type=int, default=1,
+                   help="arrivals per wave: B requests land together "
+                        "every --stagger ticks (deterministic overload "
+                        "mode; 1 = the classic one-by-one stagger)")
+    p.add_argument("--max-pending", type=int, default=None,
+                   help="admission control: bound on the arrived request "
+                        "backlog; overflow is shed deterministically "
+                        "(default: unbounded)")
+    p.add_argument("--shed-policy", default="newest",
+                   choices=["newest", "oldest"],
+                   help="which side of the backlog to shed on overflow "
+                        "(newest = reject incoming, the default)")
+    p.add_argument("--deadline-steps", type=int, default=None,
+                   help="per-request deadline in engine ticks after "
+                        "arrival (deterministic; expires queued requests "
+                        "without admitting and evicts decoding slots "
+                        "mid-flight)")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="per-request wall-clock TTL from arrival")
     p.add_argument("--steps", type=int, default=0,
                    help="engine tick cap (0 = run until drained)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--metrics-jsonl", default=None,
-                   help="emit schema-v3 serving records to this JSONL")
+                   help="emit schema-v5 serving records to this JSONL")
+    p.add_argument("--inject-fault", default="", metavar="KIND@TICK",
+                   help="deterministic serve-path fault drill at a "
+                        "1-based engine tick: crash | sigterm | hang | "
+                        "nan | slot_fail (resilience/faults.py; sigterm "
+                        "exercises the drain path, slot_fail the "
+                        "slot-isolation path)")
+    p.add_argument("--flight-recorder", action="store_true",
+                   help="arm crash forensics (obs/flight.py): abnormal "
+                        "exits write a crash_dump + aborted summary to "
+                        "the metrics stream; SIGTERM stays with the "
+                        "drain handler (release_signal handover)")
+    p.add_argument("--no-drain", action="store_true",
+                   help="do not catch SIGTERM/SIGUSR1 for graceful "
+                        "drain (signals then kill the process as before)")
     return p
 
 
 def run_serve(args):
-    """Build, restore, drive.  Returns (completions, summary_record, rc)
-    — split from main() so tests can assert on the served tokens."""
+    """Build, restore, drive — and drain gracefully on SIGTERM/SIGUSR1.
+    Returns (completions, summary_record, rc) — split from main() so
+    tests can assert on the served tokens; rc is 75 (EX_TEMPFAIL) after
+    a drain so a supervisor restarts rather than buries the server."""
     import jax
     import jax.numpy as jnp
 
     from apex_example_tpu import obs
     from apex_example_tpu.models.gpt import gpt_base, gpt_tiny
-    from apex_example_tpu.serve import (ServeEngine, parse_range,
-                                        synthetic_requests)
+    from apex_example_tpu.resilience import (EX_TEMPFAIL, FaultPlan,
+                                             PreemptionHandler)
+    from apex_example_tpu.resilience.faults import SERVE_KINDS
+    from apex_example_tpu.serve import (RequestQueue, ServeEngine,
+                                        parse_range, synthetic_requests)
     from apex_example_tpu.utils.checkpoint import restore_params
 
     model = {"gpt_tiny": gpt_tiny, "gpt_base": gpt_base}[args.arch]()
@@ -89,6 +143,17 @@ def run_serve(args):
     if prompt_len[1] >= max_len:
         raise SystemExit(f"--prompt-len max {prompt_len[1]} must be < "
                          f"--max-len {max_len}")
+    if args.flight_recorder and not args.metrics_jsonl:
+        # Same guard as train.py: forensics need a stream to land in —
+        # a silently-disarmed recorder is worse than an error.
+        raise SystemExit("--flight-recorder requires --metrics-jsonl "
+                         "(the crash_dump rides the metrics stream)")
+    fault = None
+    if args.inject_fault:
+        try:
+            fault = FaultPlan.parse(args.inject_fault, kinds=SERVE_KINDS)
+        except ValueError as e:
+            raise SystemExit(str(e))
 
     if args.checkpoint_dir:
         params = restore_params(args.checkpoint_dir, args.checkpoint_step)
@@ -99,7 +164,7 @@ def run_serve(args):
             jnp.zeros((1, 4), jnp.int32))["params"]
         source = "random init (smoke mode)"
 
-    emitter = sink = None
+    emitter = sink = recorder = None
     run_id = None
     if args.metrics_jsonl:
         sink = obs.JsonlSink(args.metrics_jsonl)
@@ -107,41 +172,96 @@ def run_serve(args):
         emitter.run_header(config=vars(args), argv=sys.argv,
                            arch=args.arch)
         run_id = emitter.run_id
+        if args.flight_recorder:
+            recorder = obs.FlightRecorder(emitter, config=vars(args))
+            recorder.install()
+
+    # The drain grace path (README "Serving resilience"): the handler
+    # only sets a flag; the engine loop notices it at the next tick
+    # boundary and run_serve runs the drain itself, outside signal
+    # context — the same flag-and-handover shape as train.py's
+    # --preempt-grace (the recorder releases SIGTERM/SIGUSR1 to us and
+    # keeps excepthook/atexit for real crashes).
+    preempt = None
+    if not args.no_drain:
+        preempt = PreemptionHandler(recorder=recorder)
+        preempt.install()
 
     requests = synthetic_requests(
         args.requests, vocab_size=model.vocab_size, seed=args.seed,
         prompt_len=prompt_len, max_new=max_new,
         temperature=args.temperature, top_k=args.top_k,
-        eos_id=args.eos_id, stagger=args.stagger)
+        eos_id=args.eos_id, stagger=args.stagger, burst=args.burst,
+        deadline_steps=args.deadline_steps, deadline_s=args.deadline_s)
+    queue = RequestQueue(max_pending=args.max_pending,
+                         shed_policy=args.shed_policy)
     engine = ServeEngine(model, params, num_slots=args.slots,
                          max_len=max_len,
                          rng=jax.random.PRNGKey(args.seed),
-                         sink=sink, run_id=run_id)
+                         queue=queue, sink=sink, run_id=run_id,
+                         fault=fault)
     engine.queue.submit_all(requests)
     engine.queue.close()
 
     print(f"serve: {args.requests} request(s)  arch={args.arch}  "
           f"slots={args.slots}  max_len={max_len}  params from {source}")
-    completions = engine.run(max_steps=args.steps or None)
-    summary = engine.summary_record()
-    if sink is not None:
-        sink.write(summary)
-        sink.close()
+    rc = 0
+    try:
+        completions = engine.run(
+            max_steps=args.steps or None,
+            stop=(lambda: preempt.preempted) if preempt else None)
+        if preempt is not None and preempt.preempted:
+            drain = engine.drain(preempt.signal_name)
+            completions = engine.completions
+            print(f"drain ({drain['signal']}): admission stopped at tick "
+                  f"{drain['step']}  in_flight={drain['in_flight']}  "
+                  f"completed={drain['completed']}  "
+                  f"evicted={drain['evicted']}  "
+                  f"requeued={drain['requeued']}; exiting {EX_TEMPFAIL} "
+                  f"(resumable)")
+            rc = EX_TEMPFAIL
+        summary = engine.summary_record()
+        if sink is not None:
+            sink.write(summary)
+    finally:
+        # Mirror train.close_telemetry: called while an exception is
+        # unwinding (sys.exc_info live inside a finally — the crash
+        # fault's path), route through the flight recorder (crash_dump +
+        # aborted summary) before disarming; a drained/finished run is
+        # not a crash and closes clean.
+        exc = sys.exc_info()
+        if recorder is not None and exc[0] is not None \
+                and not issubclass(exc[0], SystemExit):
+            recorder.crash_dump(f"exception:{exc[0].__name__}",
+                                exc_info=exc)
+        if recorder is not None:
+            recorder.close()
+        if preempt is not None:
+            preempt.close()
+        if sink is not None:
+            sink.close()
 
-    rc = 0 if len(completions) == len(requests) else 1
-    print(f"done: {len(completions)}/{args.requests} completed  "
+    counts = engine.counts
+    stranded = args.requests - len(completions)
+    print(f"done: {counts['ok']}/{args.requests} completed  "
           f"out_tokens={summary['output_tokens']}  "
           f"tok/s={summary['tokens_per_sec']}  "
           f"steps={summary['steps']}  "
           f"occupancy={summary.get('occupancy', 0.0)}")
+    nonsuccess = {k: v for k, v in counts.items() if k != "ok" and v}
+    if nonsuccess:
+        print("statuses: " + "  ".join(f"{k}={v}" for k, v in
+                                       sorted(nonsuccess.items()))
+              + f"  availability={summary['availability']}")
     for name in ("ttft_ms", "tpot_ms", "queue_wait_ms"):
         d = summary.get(name)
         if d:
             print(f"{name:14s} p50 {d['p50']:.1f}  p95 {d['p95']:.1f}  "
                   f"max {d['max']:.1f}")
-    if rc:
-        print(f"WARNING: {len(requests) - len(completions)} request(s) "
-              f"unfinished at the --steps cap", file=sys.stderr)
+    if rc == 0 and stranded:
+        rc = 1
+        print(f"WARNING: {stranded} request(s) unfinished at the --steps "
+              f"cap", file=sys.stderr)
     return completions, summary, rc
 
 

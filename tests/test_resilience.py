@@ -170,6 +170,24 @@ def test_fault_plan_sigterm_and_hang_mechanisms(monkeypatch):
     assert naps == [123.0]
 
 
+def test_fault_plan_serve_kinds():
+    """slot_fail is a SERVE-only kind: serve.py's parse accepts it,
+    train.py's default parse keeps rejecting it; due()/take() is the
+    caller-handled one-shot (nan token degeneration, slot_fail) — >=
+    semantics, because a slot-level fault scheduled on a tick that
+    cannot express it must fire at the next one that can."""
+    from apex_example_tpu.resilience.faults import SERVE_KINDS
+    fp = FaultPlan.parse("slot_fail@4", kinds=SERVE_KINDS)
+    assert (fp.kind, fp.step) == ("slot_fail", 4)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("slot_fail@4")            # training kinds
+    assert not fp.due(3)
+    assert fp.due(4) and fp.due(5)                # >= until consumed
+    fp.take()
+    assert not fp.due(5)                          # consumed: once only
+    fp.maybe_fire(4)                              # not its mechanism: noop
+
+
 def test_fault_plan_nan_poisons_only_float_leaves():
     fp = FaultPlan("nan", 3)
     batch = (jnp.ones((2, 2)), jnp.zeros((2,), jnp.int32))
@@ -401,6 +419,43 @@ sys.exit(75)
     assert sup._metrics_path(1) == str(tmp_path / "external.jsonl")
 
 
+def test_supervisor_no_resume_and_drop_flags(tmp_path):
+    """Serving-child generalization: resume=False never injects
+    --resume even with a checkpoint present, and drop_flags_on_restart
+    strips a one-shot drill flag from restart attempts (attempt 0 keeps
+    it — the drill must fire once)."""
+    sup_mod = _load_supervisor()
+    assert sup_mod._strip_flag(
+        ["a", "--inject-fault", "sigterm@4", "b"], "--inject-fault") \
+        == ["a", "b"]
+    assert sup_mod._strip_flag(
+        ["a", "--inject-fault=crash@2"], "--inject-fault") == ["a"]
+    assert sup_mod._strip_flag(["a"], "--inject-fault") == ["a"]
+    # a store_true flag must not swallow the following argument
+    assert sup_mod._strip_flag(
+        ["--no-drain", "--metrics-jsonl", "out.jsonl"], "--no-drain") \
+        == ["--metrics-jsonl", "out.jsonl"]
+    assert sup_mod._strip_flag(["x", "--no-drain"], "--no-drain") == ["x"]
+    (tmp_path / "ck" / "5").mkdir(parents=True)
+    sup = sup_mod.Supervisor(
+        ["child", "--inject-fault", "sigterm@4"],
+        checkpoint_dir=str(tmp_path / "ck"),
+        resume=False, drop_flags_on_restart=["--inject-fault"],
+        log=lambda *a: None)
+    sup._attempt_offset = 0
+    argv0 = sup._launch_argv(0)
+    argv1 = sup._launch_argv(1)
+    assert "--resume" not in argv0 and "--resume" not in argv1
+    assert "--inject-fault" in argv0                 # attempt 0: fires
+    assert "--inject-fault" not in argv1             # restarts: stripped
+    # default resume path still rewrites (the training contract)
+    sup2 = sup_mod.Supervisor(["child"],
+                              checkpoint_dir=str(tmp_path / "ck"),
+                              log=lambda *a: None)
+    sup2._attempt_offset = 0
+    assert "--resume" in sup2._launch_argv(0)
+
+
 # ------------------------------------------------- CLI flag guards
 
 def test_resilience_cli_guards():
@@ -543,3 +598,70 @@ def test_supervised_sigterm_e2e(tmp_path, baseline, capsys):
     rep = capsys.readouterr().out
     assert "PREEMPTED RUN (graceful): SIGTERM at step 3" in rep
     assert "restarts: 1" in rep
+
+
+@pytest.mark.resilience
+def test_supervised_serve_drain_e2e(tmp_path):
+    """The serving acceptance bar, end-to-end (ISSUE 5): a SIGTERM'd
+    serve.py subprocess admits no new requests, resolves every in-flight
+    request, emits serve_drain + an un-aborted serve_summary and exits
+    75 (EX_TEMPFAIL); tools/supervise.py treats that as prompt-restart
+    (--no-resume, --drop-flag-on-restart stripping the one-shot drill),
+    rotates the serve metrics stream, and the restarted attempt serves
+    to completion."""
+    child_metrics = str(tmp_path / "serve.jsonl")
+    sup_path = str(tmp_path / "sup.jsonl")
+    child = [sys.executable, os.path.join(REPO, "serve.py"),
+             "--requests", "6", "--slots", "2", "--max-len", "16",
+             "--prompt-len", "3:5", "--max-new", "3:6", "--stagger", "2",
+             "--seed", "7", "--metrics-jsonl", child_metrics,
+             "--inject-fault", "sigterm@4"]
+    supervise = _load_tool("supervise")
+    rc = supervise.main(["--metrics-jsonl", sup_path,
+                         "--max-restarts", "2", "--backoff", "0.1",
+                         "--no-resume",
+                         "--drop-flag-on-restart=--inject-fault",
+                         "--"] + child)
+    assert rc == 0
+
+    sup_recs = obs.read_jsonl(sup_path)
+    assert obs_schema.validate_stream(sup_recs) == []
+    # no checkpoints, no resumes — just one drain-restart
+    assert [r["record"] for r in sup_recs] == \
+        ["run_header", "restart", "run_summary"]
+    restart = sup_recs[1]
+    assert restart["exit_code"] == EX_TEMPFAIL == 75   # the wire contract
+    assert restart["reason"] == "preemption"
+    assert sup_recs[-1]["restart_count"] == 1
+    assert sup_recs[-1]["exit_code"] == 0
+
+    att0 = obs.read_jsonl(child_metrics)               # the drained attempt
+    assert obs_schema.validate_stream(att0) == []
+    kinds0 = [r["record"] for r in att0]
+    assert "crash_dump" not in kinds0                  # grace, not crash
+    drain = next(r for r in att0 if r["record"] == "serve_drain")
+    assert drain["signal"] == "SIGTERM"
+    assert drain["in_flight"] == drain["completed"] + drain["evicted"]
+    assert drain["requeued"] >= 1
+    summ0 = att0[-1]
+    assert summ0["record"] == "serve_summary"
+    assert "aborted" not in summ0                      # resumable != broken
+    assert summ0["drained"] == drain["requeued"]
+    # every request resolved with an explicit status, none admitted
+    # after the drain began
+    assert summ0["requests"] == 6
+    assert summ0["completed"] + summ0["timed_out"] + summ0["drained"] == 6
+    assert all(r.get("admitted_step", -1) <= drain["step"]
+               for r in att0 if r["record"] == "request_complete")
+
+    att1 = obs.read_jsonl(child_metrics + ".attempt1")  # rotated stream
+    assert obs_schema.validate_stream(att1) == []
+    kinds1 = [r["record"] for r in att1]
+    assert "serve_drain" not in kinds1                 # drill was stripped
+    summ1 = att1[-1]
+    assert summ1["record"] == "serve_summary"
+    assert summ1["completed"] == 6 and summ1["availability"] == 1.0
+
+    lint = _load_tool("metrics_lint")
+    assert lint.lint(child_metrics)[0] == 0
+    assert lint.lint(child_metrics + ".attempt1")[0] == 0

@@ -1,4 +1,5 @@
-"""Continuous-batching inference subsystem (serve/, serve.py; ISSUE 3):
+"""Continuous-batching inference subsystem (serve/, serve.py; ISSUE 3)
+and its resilience layer (ISSUE 5):
 
 - the tier-1 acceptance smoke: 8 staggered mixed-length requests through
   a 4-slot engine — greedy outputs token-identical to one-shot
@@ -8,8 +9,15 @@
   greedy),
 - checkpoint -> serve round trip (CheckpointManager save, template-free
   restore in serve.py, served == generate() on the restored params),
-- schema v3 records + v1/v2 back-compat,
-- queue/slot-pool unit coverage and the serve.py CLI surface.
+- request lifecycle hardening: deadlines (queued expiry + mid-flight
+  evict), bounded admission with deterministic shedding, cancellation,
+- failure isolation: slot_fail fails exactly one request with every
+  other greedy output token-identical to the fault-free run; the
+  degenerate-token guard on the nan fault,
+- graceful drain: run_serve + sigterm@tick => serve_drain record,
+  un-aborted serve_summary with per-status counts, exit EX_TEMPFAIL,
+- schema v3/v5 records + v1-v4 back-compat,
+- queue/slot-pool/loadgen unit coverage and the serve.py CLI surface.
 
 All engine tests share one slot geometry (SLOTS=4, MAX_LEN=32) and one
 generate() max_len so the compiled decode programs are built once per
@@ -31,6 +39,8 @@ import serve as serve_mod
 from apex_example_tpu import obs
 from apex_example_tpu.models.gpt import generate, gpt_tiny
 from apex_example_tpu.obs import schema as obs_schema
+from apex_example_tpu.resilience import EX_TEMPFAIL, FaultPlan
+from apex_example_tpu.resilience.faults import SERVE_KINDS
 from apex_example_tpu.serve import (Request, RequestQueue, ServeEngine,
                                     SlotPool, parse_range,
                                     synthetic_requests)
@@ -366,3 +376,515 @@ def test_parse_range():
     for bad in ("a", "4:2", "0:3", "1:2:3"):
         with pytest.raises(ValueError):
             parse_range(bad, "x")
+
+
+# ==================== serving resilience (ISSUE 5) ====================
+
+def _run_engine_res(model, params, requests, queue=None, fault=None,
+                    sink=None, run_id=None, max_steps=2000):
+    """Engine helper for the resilience tests — same shared slot
+    geometry as _run_engine so the decode program compiles once."""
+    eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                      rng=jax.random.PRNGKey(0), queue=queue, sink=sink,
+                      run_id=run_id, fault=fault)
+    eng.queue.submit_all(requests)
+    eng.queue.close()
+    eng.run(max_steps=max_steps)
+    return eng
+
+
+def _by_order(engine):
+    """Completions in submission order (uids are a monotonic counter
+    within one process, so sorting aligns two runs' streams)."""
+    return sorted(engine.completions, key=lambda c: c.request.uid)
+
+
+# ------------------------------------------------ deadlines / timeout
+
+def test_deadline_expires_queued_request_without_admitting(
+        model_and_params):
+    """A queued request whose deadline passes before a slot frees up
+    terminates with status "timeout", slot -1, never admitted — the
+    hogs are untouched."""
+    model, params = model_and_params
+    hogs = [Request(prompt=[1 + i, 2, 3], max_new_tokens=20)
+            for i in range(SLOTS)]
+    late = Request(prompt=[5, 6], max_new_tokens=4, deadline_step=5)
+    eng = _run_engine_res(model, params, hogs + [late])
+    assert eng.counts == {"ok": SLOTS, "timeout": 1, "shed": 0,
+                          "cancelled": 0, "failed": 0, "drained": 0}
+    comp = next(c for c in eng.completions if c.request is late)
+    assert comp.status == "timeout" and comp.finish_reason == "timeout"
+    assert comp.slot == -1 and comp.admitted_step == -1
+    assert comp.tokens == [] and comp.ttft_s is None
+
+
+def test_deadline_evicts_decoding_slot_midflight(model_and_params,
+                                                 tmp_path):
+    """A decoding request hitting its deadline is evicted mid-flight:
+    partial tokens kept, request_failed emitted, stream lints."""
+    model, params = model_and_params
+    path = str(tmp_path / "t.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    emitter = obs.TelemetryEmitter(sink)
+    emitter.run_header(config={}, arch="gpt_tiny")
+    req = Request(prompt=[1, 2, 3], max_new_tokens=20, deadline_step=6)
+    eng = _run_engine_res(model, params, [req], sink=sink,
+                          run_id=emitter.run_id)
+    sink.write(eng.summary_record())
+    sink.close()
+    comp = eng.completions[0]
+    assert comp.status == "timeout" and comp.slot == 0
+    # 3 prefill ticks then decode: fewer tokens than asked, more than 0
+    assert 0 < len(comp.tokens) < 20
+    recs = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(recs) == []
+    failed = next(r for r in recs if r["record"] == "request_failed")
+    assert failed["status"] == "timeout"
+    assert failed["output_tokens"] == len(comp.tokens)
+    assert failed["slot"] == 0
+    summary = recs[-1]
+    assert summary["timed_out"] == 1 and summary["completed"] == 0
+    assert summary["availability"] == 0.0
+    lint = _load_tool("metrics_lint")
+    assert lint.lint(path)[0] == 0
+
+
+# ------------------------------------------- admission control / shed
+
+def test_bounded_queue_sheds_newest_deterministically(model_and_params,
+                                                      tmp_path):
+    """A burst past max_pending sheds the newest arrivals (reject-newest
+    default), deterministically: same uids shed on every run, shed
+    records emitted, availability reflects the loss."""
+    model, params = model_and_params
+    path = str(tmp_path / "s.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    emitter = obs.TelemetryEmitter(sink)
+    emitter.run_header(config={}, arch="gpt_tiny")
+    mk = lambda: synthetic_requests(
+        10, vocab_size=model.vocab_size, seed=4, prompt_len=(3, 5),
+        max_new=(3, 5), stagger=0)
+    reqs = mk()
+    eng = _run_engine_res(model, params, reqs,
+                          queue=RequestQueue(max_pending=4), sink=sink,
+                          run_id=emitter.run_id)
+    sink.write(eng.summary_record())
+    sink.close()
+    assert eng.counts["shed"] == 6 and eng.counts["ok"] == 4
+    shed_uids = [c.request.uid for c in eng.completions
+                 if c.status == "shed"]
+    # reject-NEWEST: the last 6 submitted are the ones shed
+    assert shed_uids == [r.uid for r in reqs[4:]]
+    # deterministic: a rerun sheds the same submission indices
+    reqs2 = mk()
+    eng2 = _run_engine_res(model, params, reqs2,
+                           queue=RequestQueue(max_pending=4))
+    assert [c.request.uid for c in eng2.completions
+            if c.status == "shed"] == [r.uid for r in reqs2[4:]]
+    assert eng2.counts == eng.counts
+    recs = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(recs) == []
+    shed_recs = [r for r in recs if r["record"] == "shed"]
+    assert len(shed_recs) == 6
+    assert all(r["reason"] == "queue_full" and r["max_pending"] == 4
+               for r in shed_recs)
+    summary = recs[-1]
+    assert summary["shed"] == 6 and summary["completed"] == 4
+    assert summary["availability"] == 0.4
+
+
+def test_shed_record_pending_is_arrived_backlog(model_and_params,
+                                                tmp_path):
+    """A shed record's ``pending`` counts the ARRIVED backlog (what the
+    bound actually limits), not the whole deque — future-gated waves
+    must not make admission control look broken (pending > bound)."""
+    model, params = model_and_params
+    wave1 = [Request(prompt=[i + 1, 2, 3], max_new_tokens=3)
+             for i in range(6)]
+    wave2 = [Request(prompt=[i + 1, 3, 4], max_new_tokens=3,
+                     arrival_step=100) for i in range(8)]
+    path = str(tmp_path / "p.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    eng = _run_engine_res(model, params, wave1 + wave2,
+                          queue=RequestQueue(max_pending=2), sink=sink)
+    sink.close()
+    shed_recs = [r for r in obs.read_jsonl(path) if r["record"] == "shed"]
+    assert shed_recs
+    assert all(r["pending"] <= r["max_pending"] == 2 for r in shed_recs)
+
+
+def test_sink_failure_is_engine_level_not_slot_mislabel(model_and_params):
+    """A sink whose write() raises inside _finish must surface as an
+    ENGINE-level error (it would hit every record), not be caught by
+    the slot-isolation try — which would re-terminate the already-
+    evicted slot and mislabel an IO fault as a request failure."""
+    model, params = model_and_params
+
+    class BrokenSink:
+        def write(self, rec):
+            raise OSError("disk full")
+
+    eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                      rng=jax.random.PRNGKey(0), sink=BrokenSink())
+    eng.queue.submit(Request(prompt=[1, 2, 3], max_new_tokens=2))
+    eng.queue.close()
+    with pytest.raises(OSError, match="disk full"):
+        eng.run()
+    # the completion itself was recorded exactly once, slot freed
+    assert eng.counts["ok"] == 1 and eng.counts["failed"] == 0
+    assert len(eng.completions) == 1
+    assert eng.pool.free_count == SLOTS
+
+
+def test_expired_queued_requests_free_capacity_before_shed(
+        model_and_params):
+    """Expiry runs before the bound check: a backlog of already-dead
+    requests must not get a healthy arrival shed over capacity that
+    frees this very tick."""
+    model, params = model_and_params
+    # hogs arrive in bound-respecting waves of 2 and fill every slot
+    hogs = [Request(prompt=[i + 1, 2, 3], max_new_tokens=12,
+                    arrival_step=i // 2) for i in range(SLOTS)]
+    # two queued requests whose deadline passes at tick 5...
+    dead = [Request(prompt=[7, 8], max_new_tokens=2, arrival_step=2,
+                    deadline_step=5) for _ in range(2)]
+    # ...and a healthy arrival AT tick 5, into a bound of 2: the old
+    # shed-before-expire order counted the dead pair and shed it
+    fresh = Request(prompt=[9, 9, 9], max_new_tokens=2, arrival_step=5)
+    eng = _run_engine_res(model, params, hogs + dead + [fresh],
+                          queue=RequestQueue(max_pending=2))
+    st = {c.request.uid: c.status for c in eng.completions}
+    assert st[fresh.uid] == "ok"                  # NOT shed
+    assert all(st[d.uid] == "timeout" for d in dead)
+    assert eng.counts["shed"] == 0
+
+
+def test_shed_policy_oldest_drops_head(model_and_params):
+    model, params = model_and_params
+    reqs = [Request(prompt=[i + 1, 2, 3], max_new_tokens=3)
+            for i in range(6)]
+    eng = _run_engine_res(model, params, reqs,
+                          queue=RequestQueue(max_pending=2,
+                                             shed_policy="oldest"))
+    shed_uids = {c.request.uid for c in eng.completions
+                 if c.status == "shed"}
+    assert shed_uids == {r.uid for r in reqs[:4]}   # head dropped
+
+
+# ------------------------------------------------------- cancellation
+
+def test_cancel_queued_and_inflight(model_and_params):
+    model, params = model_and_params
+    a = Request(prompt=[1, 2, 3], max_new_tokens=8)
+    hogs = [Request(prompt=[2 + i, 3, 4], max_new_tokens=8)
+            for i in range(SLOTS - 1)]
+    b = Request(prompt=[9, 9], max_new_tokens=8, arrival_step=30)
+    eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                      rng=jax.random.PRNGKey(0))
+    eng.queue.submit_all([a] + hogs + [b])
+    eng.queue.close()
+    eng.step()
+    eng.step()
+    assert eng.cancel(b.uid)            # still queued (gated): immediate
+    assert eng.cancel(a.uid)            # decoding: evicted mid-flight
+    assert not eng.cancel(a.uid)        # already terminal
+    assert not eng.cancel("req-unknown")
+    eng.run()
+    assert eng.counts["cancelled"] == 2 and eng.counts["ok"] == len(hogs)
+    ca = next(c for c in eng.completions if c.request is a)
+    cb = next(c for c in eng.completions if c.request is b)
+    assert ca.slot >= 0 and cb.slot == -1
+    assert ca.status == cb.status == "cancelled"
+
+
+# ------------------------------------------------- failure isolation
+
+def test_slot_fail_isolates_one_request(model_and_params, tmp_path):
+    """The acceptance bar: slot_fail@tick fails exactly one request
+    (request_failed with the injected traceback digest) while every
+    other request's greedy output is token-identical to the fault-free
+    run — the engine keeps ticking."""
+    model, params = model_and_params
+    mk = lambda: synthetic_requests(
+        6, vocab_size=model.vocab_size, seed=5, prompt_len=(3, 6),
+        max_new=(4, 8), stagger=2)
+    ref = _run_engine_res(model, params, mk())
+    assert ref.counts["ok"] == 6
+    path = str(tmp_path / "f.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    emitter = obs.TelemetryEmitter(sink)
+    emitter.run_header(config={}, arch="gpt_tiny")
+    eng = _run_engine_res(model, params, mk(),
+                          fault=FaultPlan("slot_fail", 6,
+                                          kinds=SERVE_KINDS),
+                          sink=sink, run_id=emitter.run_id)
+    sink.write(eng.summary_record())
+    sink.close()
+    assert eng.counts["failed"] == 1 and eng.counts["ok"] == 5
+    for c_ref, c in zip(_by_order(ref), _by_order(eng)):
+        assert len(c_ref.request.prompt) == len(c.request.prompt)
+        if c.status == "ok":
+            assert c.tokens == c_ref.tokens, c.request.uid
+    failed = next(c for c in eng.completions if c.status == "failed")
+    assert "injected slot_fail at tick 6" in failed.error
+    recs = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(recs) == []
+    frec = next(r for r in recs if r["record"] == "request_failed")
+    assert frec["status"] == "failed"
+    assert frec["request_id"] == failed.request.uid
+    assert "FaultInjected" in frec["error"]
+    summary = recs[-1]
+    assert summary["failed"] == 1 and summary["completed"] == 5
+    assert summary["availability"] == round(5 / 6, 3)
+
+
+def test_fault_on_idle_tick_still_fires(model_and_params):
+    """A drill scheduled in an idle gap between arrival waves must not
+    be silently skipped: engine-level kinds fire on the idle tick
+    itself, slot-level kinds defer to the next tick that can express
+    them (FaultPlan.due is >=)."""
+    model, params = model_and_params
+    # wave 1 (ticks 0..~6), idle gap, wave 2 arrives at tick 20
+    reqs = [Request(prompt=[1, 2, 3], max_new_tokens=3),
+            Request(prompt=[4, 5, 6], max_new_tokens=3, arrival_step=20)]
+    fault = FaultPlan("slot_fail", 12, kinds=SERVE_KINDS)  # idle tick
+    eng = _run_engine_res(model, params, reqs, fault=fault)
+    assert fault.fired
+    assert eng.counts["failed"] == 1 and eng.counts["ok"] == 1
+    failed = next(c for c in eng.completions if c.status == "failed")
+    assert failed.request is reqs[1]              # fired on wave 2
+
+
+def test_nan_fault_defers_past_all_prefill_ticks(model_and_params):
+    """nan@1 lands while every slot is still prefilling (outputs
+    discarded) — the drill must not be consumed with zero effect; it
+    defers to the first token-keeping tick and fails that slot."""
+    model, params = model_and_params
+    req = Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=4)
+    fault = FaultPlan("nan", 1, kinds=SERVE_KINDS)
+    eng = _run_engine_res(model, params, [req], fault=fault)
+    assert fault.fired
+    assert eng.counts["failed"] == 1 and eng.counts["ok"] == 0
+    failed = eng.completions[0]
+    assert "degenerate sampled token" in failed.error
+    assert failed.tokens == []                    # first kept token poisoned
+
+
+def test_real_nan_params_trip_nonfinite_logits_guard(model_and_params):
+    """Not just the drill: actually-poisoned params produce NaN logits,
+    and argmax over NaN yields an IN-RANGE token — the per-slot finite
+    mask (computed inside the compiled step) must catch it, fail the
+    slot, and never feed the garbage token onward as status ok."""
+    model, params = model_and_params
+    bad = jax.tree_util.tree_map(lambda x: jnp.full_like(x, jnp.nan),
+                                 params)
+    eng = _run_engine_res(model, bad,
+                          [Request(prompt=[1, 2, 3], max_new_tokens=4)])
+    assert eng.counts == {"ok": 0, "timeout": 0, "shed": 0,
+                          "cancelled": 0, "failed": 1, "drained": 0}
+    comp = eng.completions[0]
+    assert comp.status == "failed" and comp.tokens == []
+    assert "non-finite logits" in comp.error
+
+
+def test_nan_fault_trips_degenerate_token_guard(model_and_params):
+    """The nan serve fault degenerates the tick's sampled tokens; the
+    guard fails the affected slots instead of feeding garbage into the
+    cache, and later arrivals still complete."""
+    model, params = model_and_params
+    reqs = synthetic_requests(6, vocab_size=model.vocab_size, seed=5,
+                              prompt_len=(3, 6), max_new=(4, 8),
+                              stagger=4)
+    eng = _run_engine_res(model, params, reqs,
+                          fault=FaultPlan("nan", 6, kinds=SERVE_KINDS))
+    assert eng.counts["failed"] >= 1
+    assert eng.counts["ok"] + eng.counts["failed"] == 6
+    assert eng.counts["ok"] >= 1                  # engine kept serving
+    for c in eng.completions:
+        if c.status == "failed":
+            assert "degenerate sampled token" in c.error
+            # failed during decode of tick 6 (1-based)
+            assert c.finished_step == 5
+
+
+# --------------------------------------------------- graceful drain
+
+def test_sigterm_drain_graceful_exit(model_and_params, tmp_path, capsys):
+    """run_serve + sigterm@tick: admission stops, in-flight requests
+    resolve, queued ones are requeued (status drained), the stream
+    closes serve_drain -> un-aborted serve_summary, rc == EX_TEMPFAIL,
+    and serve_report renders the drain."""
+    path = str(tmp_path / "drain.jsonl")
+    argv = ["--requests", "8", "--slots", str(SLOTS), "--max-len",
+            str(MAX_LEN), "--prompt-len", "3:6", "--max-new", "6:10",
+            "--stagger", "3", "--seed", "3", "--metrics-jsonl", path,
+            "--inject-fault", "sigterm@6"]
+    comps, summary, rc = serve_mod.run_serve(
+        serve_mod.build_parser().parse_args(argv))
+    assert rc == EX_TEMPFAIL == 75
+    assert len(comps) == 8                        # every request terminal
+    recs = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(recs) == []
+    drain = next(r for r in recs if r["record"] == "serve_drain")
+    assert drain["signal"] == "SIGTERM"
+    assert drain["requeued"] == len(drain["requeued_ids"]) > 0
+    assert drain["in_flight"] == drain["completed"] + drain["evicted"]
+    # no admission after the drain began
+    assert all(c.admitted_step <= drain["step"] for c in comps
+               if c.admitted_step >= 0)
+    assert {c.status for c in comps} <= {"ok", "timeout", "drained"}
+    last = recs[-1]
+    assert last["record"] == "serve_summary" and "aborted" not in last
+    assert last["drained"] == drain["requeued"]
+    assert last["completed"] + last["timed_out"] + last["drained"] == 8
+    out = capsys.readouterr().out
+    assert "drain (SIGTERM)" in out and "exiting 75" in out
+    lint = _load_tool("metrics_lint")
+    assert lint.lint(path)[0] == 0
+    report = _load_tool("serve_report")
+    assert report.main([path]) == 0
+    rep = capsys.readouterr().out
+    assert "DRAIN: SIGTERM" in rep
+    assert "drained x" in rep
+
+
+def test_serve_cli_overload_shed_and_deadlines(tmp_path, capsys):
+    """CLI overload drill: burst past slots+bound sheds, tight virtual
+    deadlines time out — all deterministic, availability reported."""
+    path = str(tmp_path / "over.jsonl")
+    rc = serve_mod.main(["--requests", "12", "--slots", str(SLOTS),
+                         "--max-len", str(MAX_LEN), "--prompt-len", "3:5",
+                         "--max-new", "3:6", "--stagger", "0",
+                         "--burst", "12", "--max-pending", "5",
+                         "--deadline-steps", "25",
+                         "--metrics-jsonl", path])
+    assert rc == 0                        # resolved != stranded
+    out = capsys.readouterr().out
+    assert "shed=" in out and "availability=" in out
+    recs = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(recs) == []
+    summary = recs[-1]
+    # the bound is evaluated at arrival, before the tick's admissions:
+    # a 12-burst against max_pending 5 sheds 7 on the spot
+    assert summary["shed"] == 12 - 5
+    assert summary["completed"] + summary["timed_out"] \
+        + summary["shed"] == 12
+    assert 0 < summary["availability"] < 1
+
+
+def test_serve_cli_rejects_bad_fault():
+    with pytest.raises(SystemExit):
+        serve_mod.main(["--inject-fault", "bogus@3"])
+    with pytest.raises(SystemExit):
+        serve_mod.main(["--inject-fault", "slot_fail"])
+    with pytest.raises(SystemExit, match="flight-recorder"):
+        serve_mod.main(["--flight-recorder"])     # needs --metrics-jsonl
+
+
+# ------------------------------------------------------- schema v5
+
+def test_schema_v5_serving_resilience_records_validate():
+    failed = {"record": "request_failed", "time": 1.0, "request_id": "r-1",
+              "status": "timeout", "slot": 2, "admitted_step": 3,
+              "failed_step": 9, "prompt_tokens": 4, "output_tokens": 2,
+              "queue_wait_ms": 1.0, "e2e_ms": 20.0, "error": "x",
+              "run_id": "x"}
+    shed = {"record": "shed", "time": 1.0, "request_id": "r-2",
+            "reason": "queue_full", "step": 4, "pending": 5,
+            "max_pending": 4, "run_id": "x"}
+    drain = {"record": "serve_drain", "time": 1.0, "signal": "SIGTERM",
+             "step": 12, "in_flight": 2, "completed": 1, "evicted": 1,
+             "requeued": 3, "requeued_ids": ["a", "b", "c"],
+             "run_id": "x"}
+    summ = {"record": "serve_summary", "time": 1.0, "requests": 8,
+            "output_tokens": 64, "tokens_per_sec": 100.0,
+            "completed": 4, "timed_out": 1, "shed": 2, "cancelled": 0,
+            "failed": 1, "drained": 0, "availability": 0.5}
+    header = {"record": "run_header", "schema": 5, "time": 0.0,
+              "run_id": "x", "num_devices": 1, "process_index": 0,
+              "platform": "cpu", "config": {}}
+    for rec in (failed, shed, drain, summ):
+        assert obs.validate_record(rec) == [], rec["record"]
+    assert obs_schema.validate_stream(
+        [header, failed, shed, drain, summ]) == []
+    # malformed still rejected
+    assert obs.validate_record({"record": "request_failed", "time": 1.0})
+    assert obs.validate_record(dict(shed, typo=1))
+    assert obs.validate_record(dict(drain, signal=7))
+
+
+def test_schema_v1_v4_streams_still_validate():
+    """v5 is a strict superset: pre-PR streams keep validating."""
+    header = {"record": "run_header", "schema": 1, "time": 0.0,
+              "run_id": "r", "num_devices": 1, "process_index": 0,
+              "platform": "cpu", "config": {}}
+    step = {"record": "step", "step": 1, "epoch": 0, "loss": 1.0,
+            "scale": 1.0, "step_time_ms": 5.0, "items_per_sec": 10.0}
+    v1 = [header, step,
+          {"record": "run_summary", "steps": 1, "overflow_count": 0}]
+    v2 = [dict(header, schema=2), step,
+          {"record": "crash_dump", "time": 1.0, "reason": "signal:SIGTERM"},
+          {"record": "run_summary", "steps": 1, "overflow_count": 0,
+           "aborted": True, "abort_reason": "signal:SIGTERM"}]
+    v3 = [dict(header, schema=3),
+          {"record": "request_complete", "time": 1.0, "request_id": "r-0",
+           "prompt_tokens": 4, "output_tokens": 6, "ttft_ms": 10.0,
+           "tpot_ms": 1.5, "finish_reason": "length"},
+          {"record": "serve_summary", "time": 2.0, "requests": 1,
+           "output_tokens": 6, "tokens_per_sec": 50.0}]
+    v4 = [dict(header, schema=4), step,
+          {"record": "preemption", "time": 1.0, "signal": "SIGTERM",
+           "step": 1, "saved": True, "checkpoint_step": 1},
+          {"record": "run_summary", "steps": 1, "overflow_count": 0}]
+    for stream in (v1, v2, v3, v4):
+        assert obs_schema.validate_stream(stream) == []
+
+
+# --------------------------------------- queue / loadgen resilience
+
+def test_queue_bounds_and_deadline_validation():
+    with pytest.raises(ValueError, match="max_pending"):
+        RequestQueue(max_pending=0)
+    with pytest.raises(ValueError, match="shed_policy"):
+        RequestQueue(shed_policy="bogus")
+    with pytest.raises(ValueError, match="deadline_s"):
+        Request(prompt=[1], max_new_tokens=1, deadline_s=0.0)
+    with pytest.raises(ValueError, match="deadline_step"):
+        Request(prompt=[1], max_new_tokens=1, deadline_step=0)
+
+
+def test_queue_expire_shed_drain_cancel():
+    q = RequestQueue(max_pending=2)
+    a = Request(prompt=[1], max_new_tokens=1)
+    b = Request(prompt=[2], max_new_tokens=1, deadline_step=3)
+    c = Request(prompt=[3], max_new_tokens=1)
+    d = Request(prompt=[4], max_new_tokens=1, arrival_step=50)
+    q.submit_all([a, b, c, d])
+    # bound counts ARRIVED requests only: a, b, c arrived; d is future
+    shed = q.shed_overflow(0)
+    assert shed == [c]                       # reject-newest
+    assert q.expire(0, 0.0) == []
+    assert q.expire(3, 0.0) == [b]           # deadline_step hit
+    assert q.cancel(a.uid) is a
+    assert q.cancel(a.uid) is None
+    assert q.pending() == 1                  # d, still gated
+    left = q.drain()
+    assert left == [d] and q.closed
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(a)
+
+
+def test_loadgen_burst_and_deadlines():
+    reqs = synthetic_requests(6, vocab_size=100, seed=0, stagger=4,
+                              burst=3, deadline_steps=10)
+    assert [r.arrival_step for r in reqs] == [0, 0, 0, 4, 4, 4]
+    assert [r.deadline_step for r in reqs] == [10, 10, 10, 14, 14, 14]
+    reqs = synthetic_requests(2, vocab_size=100, seed=0, stagger=0,
+                              deadline_s=1.5)
+    assert all(r.arrival_step is None and r.deadline_s == 1.5
+               and r.deadline_step is None for r in reqs)
+    with pytest.raises(ValueError, match="burst"):
+        synthetic_requests(2, vocab_size=100, burst=0)
+    with pytest.raises(ValueError, match="deadline_steps"):
+        synthetic_requests(2, vocab_size=100, deadline_steps=0)

@@ -10,9 +10,11 @@ runs anywhere the repo is checked out:
 
 Schema v2 streams (the diagnostics records: crash_dump / stall /
 overflow_event, aborted run summaries), v3 streams (the serving
-records) and v4 streams (the resilience records: preemption / restart /
-resume, run summaries with restart_count) all validate alongside v1
-streams — each version's tables are a strict superset of the last.
+records), v4 streams (the resilience records: preemption / restart /
+resume, run summaries with restart_count) and v5 streams (the serving-
+resilience records: request_failed / shed / serve_drain, serve
+summaries with per-status counts + availability) all validate alongside
+v1 streams — each version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort
 exits 2.

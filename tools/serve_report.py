@@ -5,7 +5,13 @@ reasons, slot occupancy — recomputed from the per-request
 ``request_complete`` records, with the stream's own ``serve_summary``
 shown for cross-checking.
 
-Thin client of the obs schema v3 (obs/schema.py):
+Schema v5 adds the resilience stratum: per-status accounting (ok /
+timeout / shed / cancelled / failed / drained from ``request_failed`` /
+``shed`` / ``serve_drain`` records), an availability line, and drain
+rendering — a drained stream shows what the server finished, evicted
+and handed back before exiting 75.
+
+Thin client of the obs schema (obs/schema.py):
 
     python tools/serve_report.py serve.jsonl
 
@@ -55,6 +61,9 @@ def report(path: str, out=sys.stdout) -> int:
     reqs = [r for r in records if r.get("record") == "request_complete"
             and all(k in r for k in ("ttft_ms", "tpot_ms",
                                      "output_tokens"))]
+    failed = [r for r in records if r.get("record") == "request_failed"]
+    shed = [r for r in records if r.get("record") == "shed"]
+    drains = [r for r in records if r.get("record") == "serve_drain"]
 
     if header:
         cfg = header.get("config", {})
@@ -62,36 +71,65 @@ def report(path: str, out=sys.stdout) -> int:
               f"arch={header.get('arch', cfg.get('arch', '?'))}  "
               f"slots={cfg.get('slots', '?')}  "
               f"max_len={cfg.get('max_len', '?')}", file=out)
-    if not reqs:
-        print("no request_complete records", file=out)
+    if not reqs and not failed and not shed and not drains:
+        print("no request records", file=out)
         return 1
+
+    # Per-status accounting: ok from request_complete, the rest from the
+    # failure-path records (drained counts ride serve_drain — a drained
+    # request is requeued, not failed, so it has no per-request record).
+    statuses = {"ok": len(reqs)}
+    for r in failed:
+        s = r.get("status", "failed")
+        statuses[s] = statuses.get(s, 0) + 1
+    if shed:
+        statuses["shed"] = len(shed)
+    requeued = sum(r.get("requeued", 0) for r in drains)
+    if requeued:
+        statuses["drained"] = requeued
+    print("status: " + ", ".join(f"{k} x{v}" for k, v in
+                                 sorted(statuses.items())), file=out)
+    owned = sum(v for k, v in statuses.items() if k != "drained")
+    if owned and len(statuses) > 1:
+        print(f"availability {statuses.get('ok', 0) / owned:.3f}  "
+              f"(ok / every status the server owned; drained requests "
+              f"are requeued elsewhere)", file=out)
 
     out_tokens = sum(r["output_tokens"] for r in reqs)
     prompt_tokens = sum(r.get("prompt_tokens", 0) for r in reqs)
     print(f"requests {len(reqs)}  prompt_tokens {prompt_tokens}  "
           f"output_tokens {out_tokens}", file=out)
-    reasons = {}
-    for r in reqs:
-        reasons[r.get("finish_reason", "?")] = \
-            reasons.get(r.get("finish_reason", "?"), 0) + 1
-    print("finish reasons: " + ", ".join(
-        f"{k} x{v}" for k, v in sorted(reasons.items())), file=out)
-    _dist(out, "ttft_ms", [r["ttft_ms"] for r in reqs])
-    _dist(out, "tpot_ms", [r["tpot_ms"] for r in reqs])
-    waits = [r["queue_wait_ms"] for r in reqs if "queue_wait_ms" in r]
-    if waits:
-        _dist(out, "queue_wait_ms", waits)
-    rates = [r["output_tokens"] / (r["e2e_ms"] / 1e3)
-             for r in reqs if r.get("e2e_ms", 0) > 0]
-    if rates:
-        s = sorted(rates)
-        print(f"tokens_per_sec p50 {_pct(s, 50):6.1f}  max {s[-1]:6.1f}  "
-              "(per request)", file=out)
+    if reqs:
+        reasons = {}
+        for r in reqs:
+            reasons[r.get("finish_reason", "?")] = \
+                reasons.get(r.get("finish_reason", "?"), 0) + 1
+        print("finish reasons: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(reasons.items())), file=out)
+        _dist(out, "ttft_ms", [r["ttft_ms"] for r in reqs])
+        _dist(out, "tpot_ms", [r["tpot_ms"] for r in reqs])
+        waits = [r["queue_wait_ms"] for r in reqs if "queue_wait_ms" in r]
+        if waits:
+            _dist(out, "queue_wait_ms", waits)
+        rates = [r["output_tokens"] / (r["e2e_ms"] / 1e3)
+                 for r in reqs if r.get("e2e_ms", 0) > 0]
+        if rates:
+            s = sorted(rates)
+            print(f"tokens_per_sec p50 {_pct(s, 50):6.1f}  max "
+                  f"{s[-1]:6.1f}  (per request)", file=out)
+    for d in drains:
+        print(f"DRAIN: {d.get('signal', '?')} at step {d.get('step', '?')}"
+              f" — in_flight {d.get('in_flight', '?')}, completed "
+              f"{d.get('completed', '?')}, evicted {d.get('evicted', '?')}"
+              f", requeued {d.get('requeued', '?')}", file=out)
     if summary:
         print(f"serve_summary: {summary['requests']} request(s)  "
               f"{summary['output_tokens']} token(s)  "
               f"{summary['tokens_per_sec']} tok/s aggregate  "
               f"occupancy {summary.get('occupancy', '?')}", file=out)
+        if "availability" in summary:
+            print(f"serve_summary availability: "
+                  f"{summary['availability']}", file=out)
         if summary.get("aborted"):
             print(f"ABORTED RUN: {summary.get('abort_reason', '?')}",
                   file=out)

@@ -1,24 +1,34 @@
 #!/usr/bin/env python
-"""Auto-resume supervisor CLI: keep a training run alive across
-preemptions and crashes.
+"""Auto-resume supervisor CLI: keep a training run — or a serving
+process — alive across preemptions, drains and crashes.
 
     python tools/supervise.py [flags] -- python train.py --arch ... \
         --checkpoint-dir ck --preempt-grace --metrics-jsonl out.jsonl
+
+    # over serve.py: drain-exit 75 restarts promptly, no --resume rewrite
+    python tools/supervise.py --no-resume \
+        --drop-flag-on-restart=--inject-fault \
+        -- python serve.py --requests 32 --metrics-jsonl serve.jsonl
 
 Everything after ``--`` is the child command, launched verbatim except:
 
 - ``--resume <checkpoint-dir>`` is inserted (or replaced) whenever the
   checkpoint dir holds a step — attempt 0 included, so a re-launched
-  supervisor continues where its predecessor's child left off;
+  supervisor continues where its predecessor's child left off
+  (``--no-resume`` disables this for children like serve.py that have
+  no resume flag);
 - on restart attempts the child's ``--metrics-jsonl PATH`` becomes
-  ``PATH.attempt<K>``, preserving each attempt's stream intact.
+  ``PATH.attempt<K>``, preserving each attempt's stream intact;
+- ``--drop-flag-on-restart FLAG`` (repeatable) strips ``FLAG`` and its
+  value from restart attempts — one-shot ``--inject-fault`` drills must
+  not re-fire on a child that restarts from tick 0.
 
-Child exit contract: 0 = done; 75 (EX_TEMPFAIL, train.py's
-``--preempt-grace`` path) = graceful preemption, restart promptly; any
-other status = crash, restart with exponential backoff.  Every restart
-consumes one unit of ``--max-restarts``.
+Child exit contract: 0 = done; 75 (EX_TEMPFAIL — train.py's
+``--preempt-grace`` path and serve.py's SIGTERM drain alike) = graceful,
+restart promptly; any other status = crash, restart with exponential
+backoff.  Every restart consumes one unit of ``--max-restarts``.
 
-``--metrics-jsonl`` here gives the SUPERVISOR its own schema-v4 stream
+``--metrics-jsonl`` here gives the SUPERVISOR its own schema-v5 stream
 (``restart``/``resume`` records, ``run_summary`` with ``restart_count``
 — obs/schema.py); ``--checkpoint-dir``/child metrics default from the
 child's own flags.
@@ -65,7 +75,7 @@ def main(argv=None) -> int:
                          "--checkpoint-dir flag)")
     ap.add_argument("--metrics-jsonl", default=None, metavar="PATH",
                     help="the supervisor's OWN telemetry stream (schema "
-                         "v4 restart/resume records + run_summary with "
+                         "v5 restart/resume records + run_summary with "
                          "restart_count)")
     ap.add_argument("--child-metrics", default=None, metavar="PATH",
                     help="the child's metrics JSONL to tail for the last "
@@ -88,6 +98,17 @@ def main(argv=None) -> int:
                          "advancing for S seconds and restart it as a "
                          "crash (0 disables; the deadline covers "
                          "first-step compile — size it accordingly)")
+    ap.add_argument("--no-resume", action="store_true",
+                    help="never rewrite --resume into the child argv "
+                         "(serving children restore params via their own "
+                         "flags and have no resume concept)")
+    ap.add_argument("--drop-flag-on-restart", action="append", default=[],
+                    metavar="FLAG",
+                    help="strip FLAG (and its value) from restart "
+                         "attempts' argv; repeatable, use the = form for "
+                         "flag-shaped values (--drop-flag-on-restart="
+                         "--inject-fault) — e.g. a one-shot drill that "
+                         "must not re-fire")
     args = ap.parse_args(sup_argv)
     if not child_argv:
         ap.error("no child command: tools/supervise.py [flags] -- "
@@ -102,7 +123,9 @@ def main(argv=None) -> int:
         backoff_s=args.backoff,
         backoff_max_s=args.backoff_max,
         preempt_delay_s=args.preempt_delay,
-        stall_kill_s=args.stall_kill)
+        stall_kill_s=args.stall_kill,
+        resume=not args.no_resume,
+        drop_flags_on_restart=args.drop_flag_on_restart)
     return sup.run()
 
 
