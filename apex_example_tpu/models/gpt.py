@@ -297,6 +297,15 @@ def generate(model: GPTForCausalLM, params, prompt: jnp.ndarray,
     if rng is None:
         rng = jax.random.PRNGKey(0)          # carried but unused (greedy)
     run = _decode_loop(dec, max_len)
+    # Cost observability (obs/costmodel.py, --cost-model): with a
+    # default instance installed the loop compiles through the AOT path
+    # and the compilation is harvested; instrument() caches per
+    # (name, fn), so repeated generate() calls at one config keep
+    # reusing ONE compiled program — identity when no instance is set.
+    # Lazy import: generate() is also used from contexts that never
+    # touch the obs package.
+    from apex_example_tpu.obs import costmodel as _costmodel
+    run = _costmodel.instrument("gpt_decode_loop", run)
     args = (params, tokens, cache, rng, jnp.asarray(P, jnp.int32),
             jnp.asarray(float(temperature), jnp.float32),
             jnp.asarray(int(top_k), jnp.int32))

@@ -30,10 +30,22 @@ The diagnostics stratum (the failure path, schema v2):
   module non-finite counts fused into the engine's finite-check pass,
   surfaced as ``overflow_event`` records naming the offending module(s).
 
+The cost stratum (what XLA compiled, schema v6):
+
+- :mod:`~apex_example_tpu.obs.costmodel` compiled-graph cost
+  observability — jitted step functions re-routed through the AOT path
+  so every compilation yields a ``compile_event`` (wall time, lowering
+  hash, recompile ordinal) and a ``cost_model`` record (harvested
+  flops/bytes/memory + roofline verdict).  ``--cost-model`` on
+  train.py / bench.py / serve.py; ``tools/cost_report.py`` is the
+  jax-free report.
+
 The JSONL schema itself lives in :mod:`~apex_example_tpu.obs.schema`
 (pure stdlib — tools can validate without importing jax).
 """
 
+from apex_example_tpu.obs import costmodel
+from apex_example_tpu.obs.costmodel import CostModel
 from apex_example_tpu.obs.flight import FlightRecorder, format_thread_stacks
 from apex_example_tpu.obs.logging import get_logger, rank_print
 from apex_example_tpu.obs.metrics import (Counter, Gauge, Histogram,
@@ -53,7 +65,8 @@ from apex_example_tpu.obs.telemetry import TelemetryEmitter, \
 from apex_example_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
-    "Counter", "DEFAULT_TRACE_DIR", "FlightRecorder", "Gauge", "Histogram",
+    "CostModel", "Counter", "DEFAULT_TRACE_DIR", "FlightRecorder", "Gauge",
+    "Histogram",
     "JsonlSink", "MetricsRegistry", "NumericsMonitor", "PHASES",
     "ProfilerWindow", "SCHEMA_VERSION", "StallWatchdog", "TelemetryEmitter",
     "TensorBoardAdapter", "current_span", "device_memory_stats",

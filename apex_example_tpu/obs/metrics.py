@@ -103,8 +103,11 @@ class Histogram:
     def summary(self) -> Dict[str, float]:
         if not self.count:
             return {"count": 0}
-        return {"count": self.count, "mean": self.mean, "min": self.min,
-                "max": self.max, "p50": self.percentile(50),
+        # sum is exact over the full run (like count/min/max — not the
+        # bounded sample): compile-time TOTALS ride it into the run
+        # summary, separately from the per-step time distribution.
+        return {"count": self.count, "mean": self.mean, "sum": self.sum,
+                "min": self.min, "max": self.max, "p50": self.percentile(50),
                 "p95": self.percentile(95)}
 
 

@@ -83,6 +83,33 @@ plus per-status counts (``completed``/``timed_out``/``shed``/
 ``serve_summary``.  v5 is once more a strict superset: every v1–v4
 stream validates unchanged.
 
+Version 6 adds the compiled-graph cost stratum (obs/costmodel.py;
+``--cost-model`` on train.py / bench.py / serve.py):
+
+``compile_event``  one per XLA compilation of an instrumented function
+                   — lower/compile wall time, the lowering hash (the
+                   compile-cache identity), and the per-name compile
+                   ordinal ``n_compiles`` the recompile-regression
+                   guard counts (a healthy run compiles each function
+                   exactly once).
+``cost_model``     the harvested ``cost_analysis()`` /
+                   ``memory_analysis()`` for one compiled executable —
+                   flops, HBM bytes accessed, transcendentals, buffer
+                   sizes — plus the analytic roofline position
+                   (arithmetic intensity, compute vs HBM time at the
+                   peak constants, the binding-side verdict, MFU
+                   ceiling).  Fields a backend omits are ``null``, not
+                   absent (the CPU rig reports no generated-code size;
+                   some backends omit whole analyses).
+
+plus measured compile totals (``compile_ms_total``/``compile_events``)
+on ``run_summary`` and the paged-KV waste baseline on
+``serve_summary`` (``kv_bytes_reserved``/``kv_bytes_live``/
+``slot_occupancy``/``kv_waste_pct``).  v6 is once more a strict
+superset: every v1–v5 stream validates unchanged.
+``tools/cost_report.py`` is the jax-free thin client that joins
+``cost_model`` records against measured step times.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -94,9 +121,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 _NUM = (int, float)
+# v6 cost fields degrade to null where a backend omits the analysis —
+# the record still lands, consumers see an explicit null, and a typo'd
+# field name is still rejected (unknown fields stay errors).
+_NUM_OR_NULL = (int, float, type(None))
 
 # record type -> {field: allowed python types}; None in OPTIONAL means any.
 REQUIRED: Dict[str, Dict[str, Any]] = {
@@ -207,6 +238,18 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "time": _NUM,
         "signal": str,
     },
+    # --- schema v6: compiled-graph cost records (obs/costmodel.py) ---
+    "compile_event": {
+        "record": str,
+        "time": _NUM,
+        "name": str,            # the instrumented function's name
+        "compile_ms": _NUM,
+    },
+    "cost_model": {
+        "record": str,
+        "time": _NUM,
+        "name": str,
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -236,6 +279,11 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         # v4: the supervisor's closing record (tools/supervise.py).
         "restart_count": int,
         "exit_code": int,
+        # v6: measured compile totals (obs/costmodel.py) — the
+        # first-vs-steady compile_est_ms above becomes a cross-check,
+        # not the only source.
+        "compile_events": int,
+        "compile_ms_total": _NUM,
     },
     "bench": {"vs_baseline": _NUM, "mfu_pct": _NUM, "time": _NUM,
               "config": dict},
@@ -297,6 +345,13 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "failed": int,          # slot-level exception / token guard
         "drained": int,         # requeued by a graceful drain
         "availability": _NUM,   # ok / every status the server owned
+        # v6: the paged-KV waste baseline (ROADMAP item 2) — the dense
+        # [SLOTS, max_len] pages' reserved bytes vs what live requests
+        # actually fill, per compute tick.
+        "kv_bytes_reserved": int,   # full page allocation (constant)
+        "kv_bytes_live": dict,      # per-tick filled-bytes histogram
+        "slot_occupancy": dict,     # per-tick live-slot histogram
+        "kv_waste_pct": _NUM,       # 100 * (1 - mean live / reserved)
     },
     "preemption": {
         "run_id": str,
@@ -339,6 +394,36 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "evicted": int,          # in-flight deadline-evicted/failed
         "requeued": int,         # queued handed back (status "drained")
         "requeued_ids": list,
+    },
+    "compile_event": {
+        "run_id": str,
+        "lower_ms": _NUM,        # trace+lower wall time (compile_ms is
+        "n_compiles": int,       #   the XLA backend compile alone)
+        "lowering_hash": str,    # StableHLO digest: the compile-cache
+        "platform": str,         #   identity recompile forensics join on
+    },
+    "cost_model": {
+        "run_id": str,
+        "lowering_hash": str,          # joins to its compile_event
+        # cost_analysis(); null where the backend omits the analysis
+        "flops": _NUM_OR_NULL,
+        "bytes_accessed": _NUM_OR_NULL,
+        "transcendentals": _NUM_OR_NULL,
+        # memory_analysis(); null where omitted (CPU backend)
+        "argument_bytes": _NUM_OR_NULL,
+        "output_bytes": _NUM_OR_NULL,
+        "temp_bytes": _NUM_OR_NULL,
+        "generated_code_bytes": _NUM_OR_NULL,
+        # the roofline position at the peak constants below
+        "peak_flops": _NUM,
+        "hbm_gbps": _NUM,
+        "arithmetic_intensity": _NUM,  # flops / bytes_accessed
+        "ridge_flops_per_byte": _NUM,  # peak_flops / (hbm_gbps * 1e9)
+        "compute_ms": _NUM,            # flops / peak_flops
+        "hbm_ms": _NUM,                # bytes_accessed / bandwidth
+        "analytic_min_ms": _NUM,       # max(compute_ms, hbm_ms)
+        "roofline": str,               # compute-bound | hbm-bound
+        "mfu_ceiling_pct": _NUM,       # MFU the intensity admits
     },
 }
 

@@ -185,12 +185,20 @@ class TelemetryEmitter:
                 rec["compile_est_ms"] = round(max(first - steady, 0.0), 3)
             wall_s = time.perf_counter() - self._t_run0
             rec["items_per_sec"] = round(self._items / max(wall_s, 1e-9), 1)
+        snap = self.registry.snapshot()
         span_hists = {
             name: summ
-            for name, summ in self.registry.snapshot().items()
+            for name, summ in snap.items()
             if name.startswith("span.") and isinstance(summ, dict)}
         if span_hists:
             rec["spans"] = span_hists
+        # Measured compile totals (obs/costmodel.py feeds the histogram
+        # under --cost-model): the first-vs-steady compile_est_ms above
+        # stays as a cross-check, but consumers should prefer these.
+        compile_hist = snap.get("compile_time_ms")
+        if isinstance(compile_hist, dict) and compile_hist.get("count"):
+            rec["compile_events"] = int(compile_hist["count"])
+            rec["compile_ms_total"] = round(compile_hist["sum"], 3)
         return rec
 
     def preemption(self, signal_name: str, *, step: int,

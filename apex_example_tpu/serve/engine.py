@@ -56,7 +56,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_example_tpu.models.gpt import sample_tokens
-from apex_example_tpu.obs.metrics import nearest_rank
+from apex_example_tpu.obs import costmodel as costmodel_lib
+from apex_example_tpu.obs.metrics import Histogram, nearest_rank
 from apex_example_tpu.resilience.faults import FaultInjected
 from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
                                           RequestQueue)
@@ -171,7 +172,7 @@ class ServeEngine:
                  max_len: int = 128, rng=None,
                  queue: Optional[RequestQueue] = None,
                  sink=None, run_id: Optional[str] = None,
-                 fault=None):
+                 fault=None, registry=None):
         self.pool = SlotPool(model, num_slots, max_len)
         self.vocab_size = int(model.vocab_size)
         self.params = params
@@ -180,15 +181,27 @@ class ServeEngine:
         self.sink = sink
         self.run_id = run_id
         self.fault = fault
+        self.registry = registry
         self.step_count = 0
         self.compute_steps = 0
         self.completions: List[Completion] = []
         self.counts: Dict[str, int] = {s: 0 for s in STATUSES}
         self.draining = False
-        self._step_fn = _slot_step(self.pool.dec)
+        # --cost-model (obs/costmodel.py): when a default instance is
+        # installed, the decode step compiles through the AOT path and
+        # that ONE compilation lands as compile_event + cost_model
+        # records — the batch geometry is static, so a second
+        # compile_event for this name is a recompile regression.
+        self._step_fn = costmodel_lib.instrument(
+            "serve_decode_step", _slot_step(self.pool.dec))
         self._t0 = time.perf_counter()
         self._tokens_out = 0
         self._occupancy_sum = 0
+        # Per-compute-tick gauges (schema v6 serve_summary): live slots
+        # and live-vs-reserved KV bytes — the dense-page waste baseline
+        # the paged-KV refactor (ROADMAP item 2) needs.
+        self._occ_hist = Histogram("serve.slots_live")
+        self._kv_hist = Histogram("serve.kv_bytes_live")
 
     # ---------------------------------------------------------- intake
 
@@ -344,6 +357,16 @@ class ServeEngine:
                 self._finish(i, reason, now)
         self.compute_steps += 1
         self._occupancy_sum += len(live)
+        # Gauge the tick AFTER harvest: what is RESIDENT at the tick
+        # boundary (finished slots' pages just went stale — exactly the
+        # reuse a paged allocator would reclaim).
+        live_slots = len(self.pool.live)
+        kv_live = self.pool.kv_bytes_live()
+        self._occ_hist.observe(live_slots)
+        self._kv_hist.observe(kv_live)
+        if self.registry is not None:
+            self.registry.gauge("serve.slots_live").set(live_slots)
+            self.registry.gauge("serve.kv_bytes_live").set(kv_live)
         self.step_count += 1
         if fault is not None:
             # crash/sigterm/hang fire AFTER the tick's harvest (matching
@@ -526,6 +549,17 @@ class ServeEngine:
             rec["occupancy"] = round(
                 self._occupancy_sum / (self.compute_steps
                                        * self.pool.num_slots), 3)
+        # The paged-KV waste baseline (schema v6): dense pages pinned
+        # for the run vs what live requests actually filled per tick.
+        reserved = self.pool.kv_bytes_reserved()
+        rec["kv_bytes_reserved"] = reserved
+        if self.compute_steps:
+            kv = self._kv_hist.summary()
+            rec["slot_occupancy"] = self._occ_hist.summary()
+            rec["kv_bytes_live"] = kv
+            if reserved:
+                rec["kv_waste_pct"] = round(
+                    100.0 * (1.0 - kv["mean"] / reserved), 2)
         if ok:
             rec["ttft_ms"] = _pct_dict([c.ttft_s * 1e3 for c in ok])
             rec["tpot_ms"] = _pct_dict([c.tpot_s * 1e3 for c in ok])

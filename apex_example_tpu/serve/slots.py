@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from apex_example_tpu.serve.queue import Request
 
 _INDEX_LEAVES = ("cache_index", "cache_position")
+_PAGE_LEAVES = ("cached_key", "cached_value")
 
 
 def _leaf_name(path) -> str:
@@ -104,6 +105,7 @@ class SlotPool:
             lambda t: jnp.zeros(t.shape, t.dtype), shapes)
         self.slots: List[Optional[Slot]] = [None] * num_slots
         self._free: List[int] = list(range(num_slots))[::-1]  # pop() = slot 0 first
+        self._kv_reserved: Optional[int] = None
 
     # ------------------------------------------------------------ state
 
@@ -152,3 +154,34 @@ class SlotPool:
         total sequence fits the cache row."""
         return min(request.max_new_tokens,
                    self.max_len - len(request.prompt))
+
+    # ---------------------------------------------------- KV accounting
+
+    def kv_bytes_reserved(self) -> int:
+        """HBM bytes the dense KV pages pin for the engine's lifetime:
+        every ``cached_key``/``cached_value`` leaf is a full
+        [SLOTS, max_len, H, D] allocation regardless of what lives in
+        it — the waste baseline a paged-KV refactor (ROADMAP item 2)
+        gets scored against."""
+        if self._kv_reserved is None:       # geometry is fixed; compute once
+            total = 0
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    self.cache)[0]:
+                if _leaf_name(path) in _PAGE_LEAVES:
+                    total += leaf.size * leaf.dtype.itemsize
+            self._kv_reserved = total
+        return self._kv_reserved
+
+    def kv_bytes_per_token(self) -> int:
+        """Bytes one cached token occupies across every layer's K and V
+        page (``kv_bytes_reserved / (SLOTS * max_len)``) — multiply by a
+        slot's fill level for its live footprint."""
+        return self.kv_bytes_reserved() // (self.num_slots * self.max_len)
+
+    def kv_bytes_live(self) -> int:
+        """Bytes actually filled by the live slots (each slot's fed-token
+        count times the per-token cost).  reserved - live = the HBM the
+        dense layout wastes right now."""
+        per_token = self.kv_bytes_per_token()
+        return sum(s.cursor for s in self.slots if s is not None) \
+            * per_token

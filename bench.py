@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from apex_example_tpu.obs import (FlightRecorder, JsonlSink, StallWatchdog,
                                   rank_print, span)
+from apex_example_tpu.obs import costmodel as obs_costmodel
 from apex_example_tpu.obs import metrics as obs_metrics
 from apex_example_tpu.utils.flops import (model_train_flops_per_token,
                                           mfu_pct,
@@ -142,7 +143,9 @@ def bench_image_single(args, *, arch: str, opt_level: str, image_size: int,
         remat=getattr(args, "remat", "none"))
     batch = jax.tree_util.tree_map(
         lambda x: jax.device_put(x, jax.devices()[0]), batch)
-    step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
+    step = obs_costmodel.instrument(f"bench_{args.config}_step",
+                       jax.jit(make_train_step(model, opt, policy),
+                               donate_argnums=(0,)))
 
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch)
@@ -170,7 +173,8 @@ def bench_c3(args):
     model, opt, batch, state = _image_setup(
         policy, scaler, arch="resnet50", batch_size=global_bs,
         image_size=args.image_size, num_classes=1000, syncbn=True)
-    step = make_sharded_train_step(mesh, model, opt, policy)
+    step = obs_costmodel.instrument("bench_c3_step",
+                       make_sharded_train_step(mesh, model, opt, policy))
 
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch)
@@ -209,9 +213,11 @@ def bench_c4(args):
     batch = (ids, (labels, w))
     state = create_train_state(jax.random.PRNGKey(0), model, opt, ids[:1],
                                policy, scaler, train_kwargs={})
-    step = jax.jit(make_train_step(model, opt, policy, loss_fn=mlm_loss,
-                                   compute_accuracy=False),
-                   donate_argnums=(0,))
+    step = obs_costmodel.instrument("bench_c4_step",
+                       jax.jit(make_train_step(model, opt, policy,
+                                               loss_fn=mlm_loss,
+                                               compute_accuracy=False),
+                               donate_argnums=(0,)))
 
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch)
@@ -251,9 +257,11 @@ def bench_gpt(args):
     state = create_train_state(jax.random.PRNGKey(0), model, opt,
                                batch[0][:1], policy, scaler,
                                train_kwargs={})
-    step = jax.jit(make_train_step(model, opt, policy, loss_fn=lm_loss,
-                                   compute_accuracy=False),
-                   donate_argnums=(0,))
+    step = obs_costmodel.instrument("bench_gpt_step",
+                       jax.jit(make_train_step(model, opt, policy,
+                                               loss_fn=lm_loss,
+                                               compute_accuracy=False),
+                               donate_argnums=(0,)))
 
     for _ in range(max(args.warmup, 1)):
         state, metrics = step(state, batch)
@@ -289,8 +297,9 @@ def bench_c5(args):
                                batch[0][:1], policy, scaler,
                                train_kwargs={})
     mems = model.init_mems(bs)
-    raw = jax.jit(make_txl_train_step(model, opt, policy),
-                  donate_argnums=(0, 1))
+    raw = obs_costmodel.instrument("bench_c5_step",
+                      jax.jit(make_txl_train_step(model, opt, policy),
+                              donate_argnums=(0, 1)))
     # adapt (state, mems) into the chain_rate (state, batch) shape
     def step(carry, batch):
         state, mems = carry
@@ -327,7 +336,9 @@ def bench_hostpipe(args):
     model, opt, batch, state = _image_setup(
         policy, scaler, arch="resnet50", batch_size=args.batch_size,
         image_size=args.image_size, num_classes=1000)
-    step = jax.jit(make_train_step(model, opt, policy), donate_argnums=(0,))
+    step = obs_costmodel.instrument("bench_hostpipe_step",
+                       jax.jit(make_train_step(model, opt, policy),
+                               donate_argnums=(0,)))
 
     dev_batch = jax.tree_util.tree_map(
         lambda x: jax.device_put(x, jax.devices()[0]), batch)
@@ -425,6 +436,12 @@ def main():
                     help="also write each measurement as a schema-valid "
                          "'bench' JSONL record (obs/schema.py; "
                          "tools/metrics_lint.py validates)")
+    ap.add_argument("--cost-model", action="store_true",
+                    help="with --metrics-jsonl: AOT-compile the "
+                         "measurement step and emit schema-v6 "
+                         "compile_event + cost_model records (XLA flops/"
+                         "HBM bytes + roofline verdict — the analytic "
+                         "twin of the measured MFU; obs/costmodel.py)")
     ap.add_argument("--flight-recorder", action="store_true",
                     help="with --metrics-jsonl: emit a 'crash_dump' "
                          "record on crash/SIGTERM (obs/flight.py)")
@@ -436,10 +453,15 @@ def main():
     args = ap.parse_args()
     global _SINK, _WATCHDOG
     recorder = None
-    if (args.flight_recorder or args.stall_timeout > 0) \
-            and not args.metrics_jsonl:
-        raise SystemExit("--flight-recorder/--stall-timeout write to the "
-                         "telemetry sink; add --metrics-jsonl PATH")
+    if (args.flight_recorder or args.stall_timeout > 0
+            or args.cost_model) and not args.metrics_jsonl:
+        raise SystemExit("--flight-recorder/--stall-timeout/--cost-model "
+                         "write to the telemetry sink; add "
+                         "--metrics-jsonl PATH")
+    # Clear any instance a previous in-process run leaked before the
+    # measurement bodies instrument their steps (train.make_telemetry
+    # hygiene).
+    obs_costmodel.set_default(None)
     if args.metrics_jsonl:
         _SINK = JsonlSink(args.metrics_jsonl)
         if args.flight_recorder:
@@ -449,6 +471,9 @@ def main():
             _WATCHDOG = StallWatchdog(_SINK,
                                       deadline_s=args.stall_timeout)
             _WATCHDOG.start()
+        if args.cost_model:
+            obs_costmodel.set_default(
+                obs_costmodel.CostModel(sink=_SINK))
     _tunnel_watchdog(args.watchdog_timeout)
 
     defaults = {          # (batch_size, image_size, seq_len)
@@ -500,6 +525,7 @@ def main():
                 recorder.crash_dump(f"exception:{exc[0].__name__}",
                                     exc_info=exc)
             recorder.close()
+        obs_costmodel.set_default(None)
         if _SINK is not None:
             _SINK.close()
 

@@ -99,7 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="engine tick cap (0 = run until drained)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--metrics-jsonl", default=None,
-                   help="emit schema-v5 serving records to this JSONL")
+                   help="emit schema-valid serving records to this JSONL")
+    p.add_argument("--cost-model", action="store_true",
+                   help="with --metrics-jsonl: AOT-compile the slot "
+                        "decode step and emit schema-v6 compile_event + "
+                        "cost_model records (per-tick decode flops/HBM "
+                        "bytes + roofline verdict; obs/costmodel.py — "
+                        "the decode program still compiles exactly once)")
     p.add_argument("--inject-fault", default="", metavar="KIND@TICK",
                    help="deterministic serve-path fault drill at a "
                         "1-based engine tick: crash | sigterm | hang | "
@@ -148,6 +154,10 @@ def run_serve(args):
         # a silently-disarmed recorder is worse than an error.
         raise SystemExit("--flight-recorder requires --metrics-jsonl "
                          "(the crash_dump rides the metrics stream)")
+    if args.cost_model and not args.metrics_jsonl:
+        raise SystemExit("--cost-model requires --metrics-jsonl (the "
+                         "compile_event/cost_model records ride the "
+                         "metrics stream)")
     fault = None
     if args.inject_fault:
         try:
@@ -166,6 +176,9 @@ def run_serve(args):
 
     emitter = sink = recorder = None
     run_id = None
+    # Clear any instance a previous in-process run leaked before this
+    # run builds its engine (same hygiene as train.make_telemetry).
+    obs.costmodel.set_default(None)
     if args.metrics_jsonl:
         sink = obs.JsonlSink(args.metrics_jsonl)
         emitter = obs.TelemetryEmitter(sink)
@@ -175,6 +188,12 @@ def run_serve(args):
         if args.flight_recorder:
             recorder = obs.FlightRecorder(emitter, config=vars(args))
             recorder.install()
+        if args.cost_model:
+            # Process-default instance: the engine's decode step (and
+            # any generate() call) picks it up without plumbing; the
+            # finally below clears it.
+            obs.costmodel.set_default(obs.CostModel(
+                sink=sink, registry=emitter.registry, run_id=run_id))
 
     # The drain grace path (README "Serving resilience"): the handler
     # only sets a flag; the engine loop notices it at the next tick
@@ -199,7 +218,8 @@ def run_serve(args):
                          max_len=max_len,
                          rng=jax.random.PRNGKey(args.seed),
                          queue=queue, sink=sink, run_id=run_id,
-                         fault=fault)
+                         fault=fault,
+                         registry=emitter.registry if emitter else None)
     engine.queue.submit_all(requests)
     engine.queue.close()
 
@@ -238,6 +258,7 @@ def run_serve(args):
             recorder.close()
         if preempt is not None:
             preempt.close()
+        obs.costmodel.set_default(None)
         if sink is not None:
             sink.close()
 

@@ -46,3 +46,23 @@ def devices8():
     if len(devs) < 8:
         pytest.skip("need 8 logical devices")
     return devs[:8]
+
+
+@pytest.fixture
+def compile_events():
+    """Recompile-regression guard (ISSUE 7): a callable mapping a
+    telemetry JSONL path (or already-parsed records) to the per-function
+    ``compile_event`` counts via obs.costmodel.compile_counts — tier-1
+    tests assert every instrumented function's count is exactly 1, so a
+    silent recompile regression (which would multiply compile time into
+    the 870 s suite budget) fails loudly."""
+    from apex_example_tpu.obs import costmodel
+    from apex_example_tpu.obs.metrics import read_jsonl
+
+    def counts(path_or_records):
+        records = path_or_records
+        if isinstance(path_or_records, str):
+            records = read_jsonl(path_or_records)
+        return costmodel.compile_counts(records)
+
+    return counts

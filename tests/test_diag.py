@@ -639,7 +639,7 @@ def test_thin_clients_run_without_jax(tmp_path):
     # catches.  supervise especially: the supervisor's whole job is to
     # restart training on hosts where jax is broken (ISSUE 4).
     for required in ("metrics_lint", "telemetry_report", "fleet_report",
-                     "serve_report", "supervise"):
+                     "serve_report", "supervise", "cost_report"):
         assert required in clients, f"{required} now imports jax"
 
     block = tmp_path / "block"
@@ -660,12 +660,32 @@ def test_thin_clients_run_without_jax(tmp_path):
          "queue_wait_ms": 2.0, "e2e_ms": 20.0},
         {"record": "serve_summary", "time": 2.0, "requests": 1,
          "output_tokens": 6, "tokens_per_sec": 50.0}])
+    cost_stream = tmp_path / "cost.jsonl"
+    _write_stream(str(cost_stream), [
+        _header(), _step(1, ms=3000.0), _step(2, ms=12.0), _step(3, ms=13.0),
+        {"record": "compile_event", "time": 0.5, "name": "train_step",
+         "compile_ms": 2900.0, "lower_ms": 500.0, "n_compiles": 1,
+         "lowering_hash": "sha256:ab", "platform": "cpu"},
+        {"record": "cost_model", "time": 0.5, "name": "train_step",
+         "flops": 8e7, "bytes_accessed": 2.7e7, "transcendentals": 1e5,
+         "argument_bytes": 1, "output_bytes": 2, "temp_bytes": 3,
+         "generated_code_bytes": None, "peak_flops": 197e12,
+         "hbm_gbps": 375.0, "arithmetic_intensity": 2.9,
+         "ridge_flops_per_byte": 525.3, "compute_ms": 0.0004,
+         "hbm_ms": 0.073, "analytic_min_ms": 0.073,
+         "roofline": "hbm-bound", "mfu_ceiling_pct": 0.55,
+         "lowering_hash": "sha256:ab"},
+        {"record": "run_summary", "steps": 3, "overflow_count": 0,
+         "compile_events": 1, "compile_ms_total": 2900.0}])
     env = dict(os.environ)
     env["PYTHONPATH"] = str(block) + os.pathsep + env.get("PYTHONPATH", "")
     real_args = {"metrics_lint": [str(stream)],
                  "telemetry_report": [str(stream)],
                  "fleet_report": [str(stream)],
                  "serve_report": [str(serve_stream)],
+                 # a full roofline join (cost_model x measured steps),
+                 # not just --help
+                 "cost_report": [str(cost_stream)],
                  # a full supervise cycle (spawn child, wait, summarize)
                  # with a trivial jax-free child — not just --help
                  "supervise": ["--max-restarts", "0",

@@ -378,6 +378,69 @@ def test_parse_range():
             parse_range(bad, "x")
 
 
+# ============= cost observability + KV gauges (ISSUE 7) =============
+
+def test_cost_model_decode_compiles_once_and_kv_gauges(
+        model_and_params, tmp_path, compile_events):
+    """The serving half of the ISSUE 7 recompile guard + the paged-KV
+    waste baseline: a --cost-model engine run compiles the decode step
+    EXACTLY once (static batch geometry — a second compile_event is the
+    regression), and the serve_summary carries the v6 occupancy/KV
+    gauges (live vs reserved page bytes per compute tick).  Rides the
+    session's SLOTS=4/MAX_LEN=32 decode geometry."""
+    from apex_example_tpu.obs import costmodel
+    model, params = model_and_params
+    path = str(tmp_path / "cm_serve.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    emitter = obs.TelemetryEmitter(sink)
+    emitter.run_header(config={"slots": SLOTS, "max_len": MAX_LEN},
+                       arch="gpt_tiny")
+    costmodel.set_default(obs.CostModel(
+        sink=sink, registry=emitter.registry, run_id=emitter.run_id))
+    try:
+        reqs = synthetic_requests(6, vocab_size=model.vocab_size, seed=5,
+                                  prompt_len=(3, 6), max_new=(3, 6),
+                                  stagger=2)
+        eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                          rng=jax.random.PRNGKey(0), sink=sink,
+                          run_id=emitter.run_id,
+                          registry=emitter.registry)
+        eng.queue.submit_all(reqs)
+        eng.queue.close()
+        comps = eng.run(max_steps=2000)
+    finally:
+        costmodel.set_default(None)
+    sink.write(eng.summary_record())
+    sink.close()
+    assert len(comps) == 6
+
+    records = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(records) == []
+    # recompile guard: one engine, one decode program, one compilation
+    assert compile_events(records) == {"serve_decode_step": 1}
+    cm = next(r for r in records if r["record"] == "cost_model")
+    assert cm["name"] == "serve_decode_step"
+    assert cm["flops"] > 0 and cm["bytes_accessed"] > 0
+
+    # KV accounting: per-token cost is layers x (K+V) x hidden x 4B
+    per_token = 2 * model.num_layers * model.hidden_size * 4
+    assert eng.pool.kv_bytes_per_token() == per_token
+    reserved = SLOTS * MAX_LEN * per_token
+    summary = records[-1]
+    assert summary["record"] == "serve_summary"
+    assert summary["kv_bytes_reserved"] == reserved
+    kv = summary["kv_bytes_live"]
+    assert 0 < kv["max"] <= reserved
+    assert kv["max"] % per_token == 0         # whole cached tokens
+    occ = summary["slot_occupancy"]
+    assert 0 < occ["max"] <= SLOTS
+    assert 0 <= summary["kv_waste_pct"] <= 100
+    # per-tick registry gauges saw the run (last tick: pool drained)
+    snap = emitter.registry.snapshot()
+    assert snap["serve.slots_live"] == 0
+    assert snap["serve.kv_bytes_live"] == 0
+
+
 # ==================== serving resilience (ISSUE 5) ====================
 
 def _run_engine_res(model, params, requests, queue=None, fault=None,

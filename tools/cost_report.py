@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Roofline report over a --cost-model telemetry stream (schema v6).
+
+Joins the ``cost_model`` records (what XLA compiled: flops, HBM bytes,
+arithmetic intensity, the analytic step-time floor at the peak
+constants) against the MEASURED ``step_time_ms`` distribution from the
+same stream, and tallies ``compile_event`` records per function — the
+decision-grade table the parallelism auto-planner (ROADMAP item 4) and
+any img/s-gap analysis start from:
+
+    python train.py ... --metrics-jsonl run.jsonl --cost-model
+    python tools/cost_report.py run.jsonl
+
+Per instrumented function the table shows the program cost (GFLOP, MB
+accessed, arithmetic intensity), which roofline side binds it at the
+record's peak constants, the analytic minimum step time, and — where
+the stream carries a measured twin — the measured time, the
+measured/analytic gap, and achieved MFU:
+
+- ``train_step`` joins the ``step`` records' steady-state
+  ``step_time_ms`` (median of steps after the first; the first is
+  trace+compile+execute),
+- ``serve_decode_step`` joins ``serve_summary``'s
+  ``duration_s / compute_steps`` mean tick time.
+
+Recompiles (more than one ``compile_event`` for one name) are listed
+explicitly; ``--fail-on-recompile`` turns them into exit 1 so CI can
+gate on the compile-once contract.
+
+Thin client of the obs JSONL schema: NO jax import, same file-path
+schema load as tools/metrics_lint.py — runs on any host with the file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from metrics_lint import pct as _pct  # noqa: E402  (sibling import)
+from metrics_lint import validate_stream  # noqa: E402
+
+
+def _read(path: str) -> List[Dict[str, Any]]:
+    records = []
+    with open(path) as fh:
+        for n, line in enumerate(fh):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"WARNING: line {n + 1}: not JSON, skipped",
+                      file=sys.stderr)
+    return records
+
+
+def _fmt(value, spec: str, missing: str = "-") -> str:
+    return format(value, spec) if value is not None else missing
+
+
+def measured_ms(name: str, records: List[Dict[str, Any]]
+                ) -> Optional[float]:
+    """The measured wall-time twin of one instrumented function, where
+    the stream carries one (see module docstring for the join rules)."""
+    if name == "train_step":
+        times = [r["step_time_ms"] for r in records
+                 if r.get("record") == "step" and "step_time_ms" in r]
+        steady = sorted(times[1:])       # first step = compile + execute
+        if steady:
+            return _pct(steady, 50)
+    if name == "serve_decode_step":
+        summary = next((r for r in records
+                        if r.get("record") == "serve_summary"), None)
+        if summary and summary.get("compute_steps") \
+                and summary.get("duration_s") is not None:
+            # The AOT compile runs inside the engine loop, so the
+            # summary's wall-clock contains it; subtract this
+            # function's recorded lower+compile time or a short run's
+            # mean tick is dominated by the one-off compile.
+            compile_ms = sum(
+                r.get("compile_ms", 0.0) + r.get("lower_ms", 0.0)
+                for r in records
+                if r.get("record") == "compile_event"
+                and r.get("name") == name)
+            total_ms = summary["duration_s"] * 1e3 - compile_ms
+            if total_ms > 0:
+                return total_ms / summary["compute_steps"]
+    return None
+
+
+def report(path: str, out=sys.stdout, fail_on_recompile: bool = False) -> int:
+    records = _read(path)
+    for e in validate_stream(records):
+        print(f"WARNING: {e}", file=sys.stderr)
+
+    costs: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("record") == "cost_model" and "name" in r:
+            costs[r["name"]] = r             # last per name wins
+    compiles: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        if r.get("record") == "compile_event" and "name" in r:
+            compiles.setdefault(r["name"], []).append(r)
+
+    if not costs and not compiles:
+        print("no cost_model/compile_event records (run with "
+              "--cost-model and --metrics-jsonl)", file=out)
+        return 1
+
+    head = (f"{'function':<20} {'GFLOP':>9} {'MB':>9} {'AI':>7} "
+            f"{'roofline':<13} {'min_ms':>9} {'meas_ms':>9} {'gap':>7} "
+            f"{'mfu%':>6}")
+    print(head, file=out)
+    print("-" * len(head), file=out)
+    for name in sorted(costs):
+        c = costs[name]
+        flops = c.get("flops")
+        nbytes = c.get("bytes_accessed")
+        min_ms = c.get("analytic_min_ms")
+        meas = measured_ms(name, records)
+        gap = mfu = None
+        if meas and min_ms:
+            gap = meas / min_ms
+        if meas and flops and c.get("peak_flops"):
+            mfu = 100.0 * flops / (meas / 1e3) / c["peak_flops"]
+        print(f"{name:<20} "
+              f"{_fmt(flops and flops / 1e9, '9.3f'):>9} "
+              f"{_fmt(nbytes and nbytes / 1e6, '9.2f'):>9} "
+              f"{_fmt(c.get('arithmetic_intensity'), '7.1f'):>7} "
+              f"{c.get('roofline', '-'):<13} "
+              f"{_fmt(min_ms, '9.4f'):>9} "
+              f"{_fmt(meas, '9.3f'):>9} "
+              f"{_fmt(gap, '6.1f') + 'x' if gap else '-':>7} "
+              f"{_fmt(mfu, '6.3f'):>6}", file=out)
+
+    print("", file=out)
+    total_ms = sum(e.get("compile_ms", 0.0)
+                   for evs in compiles.values() for e in evs)
+    n_events = sum(len(evs) for evs in compiles.values())
+    print(f"compiles: {n_events} event(s), {total_ms:.0f} ms total",
+          file=out)
+    recompiled = {n: evs for n, evs in compiles.items() if len(evs) > 1}
+    for name, evs in sorted(recompiled.items()):
+        hashes = {e.get("lowering_hash", "?") for e in evs}
+        print(f"RECOMPILE {name}: {len(evs)} compilations "
+              f"({len(hashes)} distinct program(s))", file=out)
+    if not recompiled and compiles:
+        print("no recompiles: every instrumented function compiled once",
+              file=out)
+    if recompiled and fail_on_recompile:
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="JSONL file a --cost-model run wrote")
+    ap.add_argument("--fail-on-recompile", action="store_true",
+                    help="exit 1 when any function compiled more than "
+                         "once (the CI gate on the compile-once "
+                         "contract)")
+    args = ap.parse_args(argv)
+    return report(args.path, fail_on_recompile=args.fail_on_recompile)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

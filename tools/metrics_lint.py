@@ -11,10 +11,13 @@ runs anywhere the repo is checked out:
 Schema v2 streams (the diagnostics records: crash_dump / stall /
 overflow_event, aborted run summaries), v3 streams (the serving
 records), v4 streams (the resilience records: preemption / restart /
-resume, run summaries with restart_count) and v5 streams (the serving-
+resume, run summaries with restart_count), v5 streams (the serving-
 resilience records: request_failed / shed / serve_drain, serve
-summaries with per-status counts + availability) all validate alongside
-v1 streams — each version's tables are a strict superset of the last.
+summaries with per-status counts + availability) and v6 streams (the
+cost records: compile_event / cost_model from --cost-model runs, run
+summaries with measured compile totals, serve summaries with the
+KV-occupancy gauges) all validate alongside v1 streams — each
+version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort
 exits 2.

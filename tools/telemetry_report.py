@@ -7,7 +7,11 @@ run_summary, or one marked ``aborted: true``), overflow step indices,
 recover path (schema v4): graceful preemptions are reported as
 PREEMPTED (resumable), distinct from ABORTED (broken); supervisor
 streams surface their ``restart``/``resume`` records and the summary's
-``restart_count``.
+``restart_count`` — and the cost stratum (schema v6): COMPILE lines per
+``compile_event`` (recompiles flagged), COST lines per ``cost_model``
+record, and measured compile totals replacing the first-vs-steady
+estimate when a ``--cost-model`` run recorded them
+(tools/cost_report.py renders the full roofline join).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -61,6 +65,9 @@ def report(path: str, out=sys.stdout) -> int:
     resumes = [r for r in records if r.get("record") == "resume"]
     overflow_events = [r for r in records
                        if r.get("record") == "overflow_event"]
+    compile_events = [r for r in records
+                      if r.get("record") == "compile_event"]
+    cost_models = [r for r in records if r.get("record") == "cost_model"]
     # Schema-invalid step records were warned about above; summarize only
     # the ones carrying the contract fields rather than crashing.
     steps = [r for r in records if r.get("record") == "step"
@@ -176,8 +183,45 @@ def report(path: str, out=sys.stdout) -> int:
         s = sorted(norms)
         print(f"grad_norm     p50 {_pct(s, 50):.3g}  max {s[-1]:.3g}",
               file=out)
+    for ev in compile_events[:10]:
+        tag = ""
+        if ev.get("n_compiles", 1) > 1:
+            tag = f"  RECOMPILE #{ev['n_compiles']}"
+        print(f"COMPILE {ev.get('name', '?')}  "
+              f"{ev.get('compile_ms', 0):.0f} ms compile "
+              f"+ {ev.get('lower_ms', 0):.0f} ms lower{tag}", file=out)
+    if len(compile_events) > 10:
+        print(f"... {len(compile_events) - 10} more compile_event "
+              "record(s)", file=out)
+    for c in cost_models[:10]:
+        flops = c.get("flops")
+        nbytes = c.get("bytes_accessed")
+        # `is not None` throughout: 0 is a legitimate XLA count (a
+        # data-movement-only program); null means the backend omitted
+        # the analysis — the two must not render the same.
+        print(f"COST {c.get('name', '?')}  "
+              + (f"{flops / 1e9:.3f} GFLOP  " if flops is not None
+                 else "flops n/a  ")
+              + (f"{nbytes / 1e6:.1f} MB  " if nbytes is not None
+                 else "bytes n/a  ")
+              + (f"AI {c['arithmetic_intensity']:.1f}  "
+                 if "arithmetic_intensity" in c else "")
+              + c.get("roofline", ""), file=out)
+    if len(cost_models) > 10:
+        print(f"... {len(cost_models) - 10} more cost_model "
+              "record(s)", file=out)
     if summary:
-        if "compile_est_ms" in summary:
+        # Measured compile time (schema v6, --cost-model) supersedes
+        # the first-vs-steady estimate; the estimate stays as the
+        # cross-check when both exist.
+        if "compile_ms_total" in summary:
+            print(f"compile       {summary['compile_ms_total']:.0f} ms "
+                  f"measured over {summary.get('compile_events', 0)} "
+                  "compilation(s)"
+                  + (f"  (first-vs-steady estimate "
+                     f"{summary['compile_est_ms']:.0f} ms)"
+                     if "compile_est_ms" in summary else ""), file=out)
+        elif "compile_est_ms" in summary:
             print(f"compile est   {summary['compile_est_ms']:.0f} ms "
                   f"(first {summary['first_step_ms']:.0f} ms vs steady "
                   f"{summary['steady_step_ms']:.0f} ms)", file=out)

@@ -209,6 +209,14 @@ def parse_args(argv=None):
                    help="capture a jax profiler trace for exactly run-"
                         "relative steps N..M (1-based, inclusive) instead "
                         "of --prof's whole-run dump")
+    p.add_argument("--cost-model", action="store_true",
+                   help="with --metrics-jsonl: compile the step/eval "
+                        "functions through the AOT path and emit one "
+                        "schema-v6 'compile_event' (compile wall time, "
+                        "lowering hash) + 'cost_model' (XLA flops/HBM "
+                        "bytes/memory + roofline verdict) record per "
+                        "compilation (obs/costmodel.py; zero extra "
+                        "compiles — tools/cost_report.py reports)")
     # diagnostics stratum (obs/flight.py, obs/watchdog.py, obs/numerics.py;
     # README "Diagnostics") — all write to the --metrics-jsonl sink
     p.add_argument("--flight-recorder", action="store_true",
@@ -310,6 +318,11 @@ def make_telemetry(args):
     Also binds the span registry so host spans ("data"/"step") aggregate
     into the run_summary."""
     emitter = recorder = watchdog = None
+    # Clear any cost-model instance a previous in-process run leaked
+    # (e.g. it died between telemetry setup and its finally): this
+    # run's instrument() sites run after us, so a stale default must
+    # not write records into the old run's stream.
+    obs.costmodel.set_default(None)
     if args.metrics_jsonl:
         registry = obs.MetricsRegistry()
         obs.set_default_registry(registry)
@@ -318,6 +331,13 @@ def make_telemetry(args):
         emitter = TelemetryEmitter(sink, registry=registry)
         emitter.run_header(config=vars(args), argv=sys.argv[1:],
                            arch=args.arch)
+        if args.cost_model:
+            # Installed as the process default so the loops' single
+            # instrument() call sites stay no-ops when the flag is off;
+            # close_telemetry clears it (a programmatic caller must not
+            # inherit the instance).
+            obs.costmodel.set_default(obs.CostModel(
+                sink=sink, registry=registry, run_id=emitter.run_id))
         if args.flight_recorder:
             recorder = obs.FlightRecorder(emitter, config=vars(args),
                                           keep=args.flight_recorder_keep)
@@ -358,6 +378,7 @@ def close_telemetry(emitter, profwin, recorder=None, watchdog=None):
     if emitter is not None:
         emitter.close()
     obs.set_default_registry(None)
+    obs.costmodel.set_default(None)
 
 
 def make_resilience(args, recorder):
@@ -555,6 +576,10 @@ def main(argv=None):
         raise SystemExit("--flight-recorder/--stall-timeout/"
                          "--numerics-check write to the telemetry sink; "
                          "add --metrics-jsonl PATH")
+    if args.cost_model and not args.metrics_jsonl:
+        raise SystemExit("--cost-model emits compile_event/cost_model "
+                         "records to the telemetry sink; add "
+                         "--metrics-jsonl PATH")
     if args.stall_trace and args.stall_timeout <= 0:
         raise SystemExit("--stall-trace arms on a stall; it needs "
                          "--stall-timeout S")
@@ -688,6 +713,11 @@ def main(argv=None):
     tb = TensorBoardAdapter(writer)
     emitter, profwin, recorder, watchdog = make_telemetry(args)
     preempt, fault = make_resilience(args, recorder)
+    # --cost-model: re-route the step through the AOT path so its one
+    # compilation is harvested (compile_event + cost_model records); a
+    # no-op identity without the flag (obs/costmodel.instrument).
+    step_fn = obs.costmodel.instrument("train_step", step_fn)
+    eval_fn = obs.costmodel.instrument("eval_step", eval_fn)
     start_epoch = start_i = 0
     if args.resume:
         rmgr = CheckpointManager(args.resume)
@@ -1490,6 +1520,11 @@ def _lm_main_impl(args, policy, scaler):
     tb = TensorBoardAdapter(writer)
     emitter, profwin, recorder, watchdog = make_telemetry(args)
     preempt, fault = make_resilience(args, recorder)
+    # --cost-model hookup: see the image loop.  One call site covers
+    # every LM step builder above (single-device, DDP shard_map, GSPMD
+    # TP/ZeRO, CP, MoE, PP, TXL) — they all end in a jitted callable.
+    step_fn = obs.costmodel.instrument("train_step", step_fn)
+    eval_fn = obs.costmodel.instrument("eval_step", eval_fn)
     start_epoch = start_i = 0
     if args.resume:
         # TXL mems are transient per-segment activations and restart cold on
