@@ -117,16 +117,23 @@ class BertSelfAttention(nn.Module):
     # takes ONE token, writes its k/v at the running index, and attends
     # against the filled prefix.  models/gpt.generate drives it.
     decode: bool = False
-    # Slot-indexed decode (with decode=True): cache_index is PER ROW
-    # ([B] instead of a shared scalar) — each batch row is an independent
-    # request slot with its own fill level, so one compiled decode step
-    # advances requests that arrived at different times.  k/v land via a
-    # per-row scatter and the live-prefix mask is per-row.  The
-    # continuous-batching engine (serve/slots.py) owns slot lifecycle.
+    # Block-paged slot decode (with decode=True): instead of a dense
+    # [B, max_len, H, D] page per row, K/V live in one shared arena of
+    # shape [kv_num_blocks, kv_block_size, H, D] per layer.  Each batch
+    # row is an independent request slot whose logical sequence is
+    # scattered across arena blocks named by a per-slot block table —
+    # the ``paged`` call argument carries the table plus per-slot fill
+    # levels, new-token counts and copy-on-write pairs, all host-owned
+    # (serve/slots.py is the allocator; there is no device-side index
+    # state).  One compiled step advances every live slot by up to
+    # kv_block_size tokens (chunked prefill) or one token (decode) —
+    # the geometry is static, so the program compiles exactly once.
     slot_decode: bool = False
+    kv_num_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
-    def __call__(self, x, mask_bias):
+    def __call__(self, x, mask_bias, paged=None):
         d = self.hidden_size
         h = self.num_heads
         hd = d // h
@@ -171,20 +178,88 @@ class BertSelfAttention(nn.Module):
         if self.decode:
             from jax import lax as _lax
             cache_ready = self.has_variable("cache", "cached_key")
-            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape,
-                               k.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape,
-                               v.dtype)
             if self.slot_decode:
-                # per-slot fill levels: row b's next write lands at
-                # index[b]; reset to 0 on admit (serve/slots.py) without
-                # touching the k/v pages — the live mask hides stale rows.
-                ci = self.variable("cache", "cache_index",
-                                   lambda: jnp.zeros((k.shape[0],),
-                                                     jnp.int32))
+                # Block-paged arena: one [NB, BS, H, D] K and V buffer
+                # per layer, shared by every slot through per-slot block
+                # tables.  Allocation/refcounts/COW policy are host-side
+                # (serve/slots.py); the compiled step only executes the
+                # table the host hands it.
+                NB, BS = self.kv_num_blocks, self.kv_block_size
+                if NB < 1 or BS < 1:
+                    raise ValueError(
+                        "slot_decode is block-paged: clone the model "
+                        "with kv_num_blocks/kv_block_size >= 1 "
+                        f"(got {NB}/{BS})")
+                ck = self.variable("cache", "cached_key", jnp.zeros,
+                                   (NB, BS, h, hd), k.dtype)
+                cv = self.variable("cache", "cached_value", jnp.zeros,
+                                   (NB, BS, h, hd), v.dtype)
             else:
+                ck = self.variable("cache", "cached_key", jnp.zeros,
+                                   k.shape, k.dtype)
+                cv = self.variable("cache", "cached_value", jnp.zeros,
+                                   v.shape, v.dtype)
                 ci = self.variable("cache", "cache_index",
                                    lambda: jnp.zeros((), jnp.int32))
+            if cache_ready and self.slot_decode:
+                if paged is None:
+                    raise ValueError(
+                        "paged slot decode needs the host state: pass "
+                        "paged={'block_table', 'fill', 'n_new', "
+                        "'cow_src', 'cow_dst'} (serve/engine.py builds "
+                        "it each tick)")
+                NB, BS = self.kv_num_blocks, self.kv_block_size
+                S, C = x.shape[0], x.shape[1]
+                table = paged["block_table"]          # [S, max_blocks]
+                fill = paged["fill"]                  # [S] tokens cached
+                n_new = paged["n_new"]                # [S] fed this tick
+                # 1. Copy-on-write: slots whose next write lands in a
+                # shared (immutable) block copy it first — dst -1 means
+                # no COW this tick and the scatter drops out of range.
+                src = jnp.clip(paged["cow_src"], 0, NB - 1)
+                dst = jnp.where(paged["cow_dst"] >= 0, paged["cow_dst"],
+                                NB)
+                ck.value = ck.value.at[dst].set(ck.value[src],
+                                                mode="drop")
+                cv.value = cv.value.at[dst].set(cv.value[src],
+                                                mode="drop")
+                # 2. Scatter this tick's K/V through the block table:
+                # token j of slot s lands at logical position fill[s]+j,
+                # physical arena row table[s, pos//BS]*BS + pos%BS.
+                # Lanes past n_new[s] scatter out of range and drop —
+                # the host only maps exclusively-owned blocks for the
+                # write span, so no two slots write one block.
+                pos = fill[:, None] + jnp.arange(C)[None, :]
+                blk = jnp.take_along_axis(
+                    table, jnp.clip(pos // BS, 0, table.shape[1] - 1),
+                    axis=1)
+                flat = blk * BS + pos % BS
+                valid = jnp.arange(C)[None, :] < n_new[:, None]
+                flat = jnp.where(valid, flat, NB * BS).reshape(-1)
+                ck.value = ck.value.reshape(NB * BS, h, hd).at[flat].set(
+                    k.reshape(S * C, h, hd),
+                    mode="drop").reshape(NB, BS, h, hd)
+                cv.value = cv.value.reshape(NB * BS, h, hd).at[flat].set(
+                    v.reshape(S * C, h, hd),
+                    mode="drop").reshape(NB, BS, h, hd)
+                # 3. Gather each slot's logical K/V view back out of the
+                # arena ([S, max_blocks*BS, H, D], logical order) and
+                # attend under the per-slot causal live mask: query j
+                # (position fill+j) sees keys at positions <= fill+j —
+                # unwritten/stale arena rows sit beyond it and garbage
+                # lanes of dead slots are discarded by the host.
+                tbl = jnp.clip(table, 0, NB - 1)
+                keys = ck.value[tbl].reshape(S, -1, h, hd)
+                vals = cv.value[tbl].reshape(S, -1, h, hd)
+                L = keys.shape[1]
+                live = jnp.arange(L)[None, None, :] <= pos[:, :, None]
+                # head_spec: under TP the arena shards over heads
+                # ('model') exactly like training attention.
+                ctx = _softmax_attention(q, head_spec(keys),
+                                         head_spec(vals),
+                                         self.softmax_dtype, self.dtype,
+                                         bool_mask=live[:, None])
+                return dense_out(ctx.reshape(*x.shape[:-1], d))
             if cache_ready:      # per-token decode step (cache exists)
                 if x.shape[1] != 1:
                     raise ValueError("decode takes ONE token per call "
@@ -192,24 +267,14 @@ class BertSelfAttention(nn.Module):
                                      "[B, max_len] shape is for cache "
                                      "allocation at init only")
                 idx = ci.value
-                if self.slot_decode:
-                    rows = jnp.arange(k.shape[0])
-                    ck.value = ck.value.at[rows, idx].set(k[:, 0])
-                    cv.value = cv.value.at[rows, idx].set(v[:, 0])
-                    ci.value = idx + 1
-                    # per-row live prefix: slot b attends keys <= idx[b]
-                    live = (jnp.arange(ck.value.shape[1])[None, :]
-                            <= idx[:, None])
-                    mask = live[:, None, None, :]
-                else:
-                    ck.value = _lax.dynamic_update_slice(ck.value, k,
-                                                         (0, idx, 0, 0))
-                    cv.value = _lax.dynamic_update_slice(cv.value, v,
-                                                         (0, idx, 0, 0))
-                    ci.value = idx + 1
-                    # keys beyond the running index are unwritten slots
-                    live = jnp.arange(ck.value.shape[1]) <= idx
-                    mask = live[None, None, None]
+                ck.value = _lax.dynamic_update_slice(ck.value, k,
+                                                     (0, idx, 0, 0))
+                cv.value = _lax.dynamic_update_slice(cv.value, v,
+                                                     (0, idx, 0, 0))
+                ci.value = idx + 1
+                # keys beyond the running index are unwritten slots
+                live = jnp.arange(ck.value.shape[1]) <= idx
+                mask = live[None, None, None]
                 # head_spec: under TP the cache shards over heads ('model')
                 # exactly like training attention — the constraint keeps
                 # GSPMD from gathering the [B, max_len, h, hd] cache.
@@ -308,9 +373,11 @@ class BertLayer(nn.Module):
     cp_mode: str = "ring"
     decode: bool = False
     slot_decode: bool = False
+    kv_num_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
-    def __call__(self, x, mask_bias):
+    def __call__(self, x, mask_bias, paged=None):
         # LN I/O dtype per the op classification (O1: fp32; O2/O3: half
         # I/O).  The Pallas kernel computes its statistics in fp32
         # regardless, so half I/O loses no precision in the moments — the
@@ -327,7 +394,10 @@ class BertLayer(nn.Module):
                                  cp_mode=self.cp_mode,
                                  decode=self.decode,
                                  slot_decode=self.slot_decode,
-                                 name="attention")(x, mask_bias)
+                                 kv_num_blocks=self.kv_num_blocks,
+                                 kv_block_size=self.kv_block_size,
+                                 name="attention")(x, mask_bias,
+                                                   paged=paged)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(
             (x + attn).astype(ln_io))
         x = x.astype(self.dtype)

@@ -63,17 +63,22 @@ class GPTForCausalLM(nn.Module):
     # a [B, max_len] dummy to allocate per-layer caches, then apply one
     # token at a time with mutable=["cache"].
     decode: bool = False
-    # Slot-indexed decode (with decode=True): every cache index —
-    # cache_position here, cache_index in each attention layer — is PER
-    # ROW ([B] instead of a shared scalar), so each batch row is an
-    # independent request slot with its own position/fill level.  This is
-    # the substrate the continuous-batching engine (serve/) schedules on:
-    # one compiled step advances all live slots regardless of when each
-    # request arrived.
+    # Block-paged slot decode (with decode=True): K/V live in one
+    # [kv_num_blocks, kv_block_size, H, D] arena per layer, addressed
+    # through per-slot block tables, and there is NO device-side index
+    # state at all — the host (serve/slots.py BlockPool) owns fill
+    # levels, allocation, refcounts and copy-on-write, and passes the
+    # per-tick state in through the ``paged`` call argument.  Each batch
+    # row is an independent request slot fed up to kv_block_size tokens
+    # per step (chunked prefill) or one (decode); the geometry is
+    # static, so one compiled step serves every slot mix.  This is the
+    # substrate the continuous-batching engine (serve/) schedules on.
     slot_decode: bool = False
+    kv_num_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True):
+    def __call__(self, input_ids, train: bool = True, paged=None):
         del train  # no dropout in the pretraining benchmark path
         if self.moe_experts and self.sequence_parallel:
             # (TP composes: the expert block replaces the FFN; Megatron
@@ -114,21 +119,26 @@ class GPTForCausalLM(nn.Module):
                              "it requires decode=True")
         x = word_emb(input_ids)
         pos = jnp.arange(L)[None, :]
-        if self.decode:
+        if self.decode and self.slot_decode:
+            # Paged slot decode: positions come from the HOST's per-slot
+            # fill levels (paged["fill"]), not a device counter — the
+            # block pool is the single source of truth for how far each
+            # slot has filled.  paged is None only on the init trace
+            # (cache allocation), where plain arange positions serve the
+            # [B, max_len] dummy.  The clip keeps garbage lanes of dead
+            # slots inside the position table; real lanes never bind
+            # (fill + j <= max_len - 1 <= max_position - 1).
+            if paged is not None:
+                pos = jnp.clip(paged["fill"][:, None] + pos,
+                               0, self.max_position - 1)
+        elif self.decode:
             # position = running cache index (checked BEFORE .variable
             # creates it: at allocation time the dummy covers 0..L-1)
             cache_ready = self.has_variable("cache", "cache_position")
-            if self.slot_decode:
-                pi = self.variable("cache", "cache_position",
-                                   lambda: jnp.zeros((b,), jnp.int32))
-            else:
-                pi = self.variable("cache", "cache_position",
-                                   lambda: jnp.zeros((), jnp.int32))
+            pi = self.variable("cache", "cache_position",
+                               lambda: jnp.zeros((), jnp.int32))
             if cache_ready:      # per-token decode step
-                # slot mode: per-row positions (each slot is its own
-                # request, mid-prefill or mid-decode independently)
-                pos = pos + (pi.value[:, None] if self.slot_decode
-                             else pi.value)
+                pos = pos + pi.value
                 pi.value = pi.value + L
         if self.context_parallel:
             from jax import lax as _lax
@@ -169,7 +179,9 @@ class GPTForCausalLM(nn.Module):
                           causal=True, cp_mode=self.cp_mode,
                           decode=self.decode,
                           slot_decode=self.slot_decode,
-                          name=f"layer_{i}")(x, None)
+                          kv_num_blocks=self.kv_num_blocks,
+                          kv_block_size=self.kv_block_size,
+                          name=f"layer_{i}")(x, None, paged=paged)
             if self.moe_experts:
                 x, aux = x
                 aux_total = aux_total + aux

@@ -110,6 +110,29 @@ superset: every v1–v5 stream validates unchanged.
 ``tools/cost_report.py`` is the jax-free thin client that joins
 ``cost_model`` records against measured step times.
 
+Version 7 adds the block-paged KV stratum (serve/slots.py; ISSUE 8) —
+no new record types, new ``serve_summary`` fields:
+
+``block_size``/``blocks_total``  the arena geometry (tokens per block,
+                                 blocks per layer arena),
+``blocks_live``                  per-tick histogram of arena blocks
+                                 physically held by live slots,
+``kv_bytes_committed``           per-tick histogram of admission-
+                                 committed bytes (held + worst-case
+                                 reserved blocks),
+``prefix_hit_rate``              shared prompt tokens / total prompt
+                                 tokens over every admission,
+``cow_copies``                   copy-on-write block copies performed,
+``rejected``                     requests terminated at admission as
+                                 unservable (zero output budget) —
+                                 ``request_failed`` gains the matching
+                                 ``rejected`` status.
+
+``kv_waste_pct`` becomes block-accurate (held-block bytes vs logically
+live bytes; the dense layout's fixed full-page reservation measured
+~92% on the smoke workload, the paged layout <= 40%).  v7 is once more
+a strict superset: every v1–v6 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -121,7 +144,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -225,7 +248,7 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "record": str,
         "time": _NUM,
         "request_id": str,
-        "status": str,          # timeout | cancelled | failed
+        "status": str,          # timeout | cancelled | failed | rejected
     },
     "shed": {
         "record": str,
@@ -345,13 +368,21 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "failed": int,          # slot-level exception / token guard
         "drained": int,         # requeued by a graceful drain
         "availability": _NUM,   # ok / every status the server owned
-        # v6: the paged-KV waste baseline (ROADMAP item 2) — the dense
-        # [SLOTS, max_len] pages' reserved bytes vs what live requests
-        # actually fill, per compute tick.
-        "kv_bytes_reserved": int,   # full page allocation (constant)
+        # v6: KV occupancy — arena-lifetime reserved bytes vs what live
+        # requests actually fill, per compute tick.
+        "kv_bytes_reserved": int,   # full arena allocation (constant)
         "kv_bytes_live": dict,      # per-tick filled-bytes histogram
         "slot_occupancy": dict,     # per-tick live-slot histogram
-        "kv_waste_pct": _NUM,       # 100 * (1 - mean live / reserved)
+        "kv_waste_pct": _NUM,       # v7: 100 * (1 - live / held-block
+                                    #   bytes), block-accurate
+        # v7: the block-paged KV stratum (serve/slots.py; ISSUE 8)
+        "block_size": int,          # tokens per arena block
+        "blocks_total": int,        # blocks per layer arena
+        "blocks_live": dict,        # per-tick held-blocks histogram
+        "kv_bytes_committed": dict,  # per-tick held+reserved bytes
+        "prefix_hit_rate": _NUM,    # shared / total prompt tokens
+        "cow_copies": int,          # copy-on-write block copies
+        "rejected": int,            # unservable, rejected at admission
     },
     "preemption": {
         "run_id": str,

@@ -69,7 +69,7 @@ from typing import Any, Dict, List, Optional
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) and
 # resilience/preemption.py (EX_TEMPFAIL) — this module must not import
 # either (jax-free contract).
-SCHEMA = 6
+SCHEMA = 7
 EX_TEMPFAIL = 75
 
 

@@ -1,11 +1,13 @@
 """apex_example_tpu.serve — continuous-batching inference.
 
-The serving counterpart of the training engine: a slot pool over one
-shared per-layer KV cache (``serve/slots.py``), a scheduler loop that
-advances every live request with ONE compiled decode step per tick
-(``serve/engine.py``), a thread-safe request queue with the timestamp
-trail TTFT/TPOT metrics derive from (``serve/queue.py``), and a
-deterministic synthetic load generator (``serve/loadgen.py``).
+The serving counterpart of the training engine: a BLOCK-PAGED KV cache
+— per-layer arenas + free-list allocator + per-slot block tables with
+copy-on-write prefix sharing (``serve/slots.py``) — a scheduler loop
+that advances every live request with ONE compiled decode step per
+tick, chunked prefill included (``serve/engine.py``), a thread-safe
+request queue with the timestamp trail TTFT/TPOT metrics derive from
+(``serve/queue.py``), and a deterministic synthetic load generator
+with a shared-system-prompt mode (``serve/loadgen.py``).
 
 The resilience layer (ISSUE 5) rides the same modules: per-request
 deadlines/TTL (queued-expire and mid-flight evict), bounded admission
@@ -26,11 +28,11 @@ from apex_example_tpu.serve.engine import (ServeEngine, SlotFailure,
 from apex_example_tpu.serve.loadgen import parse_range, synthetic_requests
 from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
                                           RequestQueue)
-from apex_example_tpu.serve.slots import Slot, SlotPool
+from apex_example_tpu.serve.slots import BlockAllocator, BlockPool, Slot
 
 __all__ = [
-    "Completion", "Request", "RequestQueue", "STATUSES", "ServeEngine",
-    "Slot", "SlotFailure", "SlotPool", "parse_range",
-    "request_complete_record", "request_failed_record",
+    "BlockAllocator", "BlockPool", "Completion", "Request",
+    "RequestQueue", "STATUSES", "ServeEngine", "Slot", "SlotFailure",
+    "parse_range", "request_complete_record", "request_failed_record",
     "synthetic_requests",
 ]

@@ -6,16 +6,28 @@ One engine tick = expire + admit + step + harvest:
    (bounded admission, ``RequestQueue(max_pending=...)``), expire queued
    requests whose deadline passed without admission, and deadline-evict
    decoding slots whose request ran out of time mid-flight.
-2. **admit** — pop admissible requests from the queue into free slots
-   (serve/slots.py resets that row's cache indices; the request's prompt
-   becomes the slot's token feed).
-3. **step** — ONE compiled decode program advances every live slot by
-   one token.  Prefill and decode share the program exactly as in
-   models/gpt.generate: a slot still inside its prompt feeds the next
-   prompt token and discards the model's prediction; a slot past its
-   prompt feeds its previously sampled token and keeps the new one.
-   Because the cache indices are per-slot, requests admitted at
-   different ticks coexist in one batch — continuous batching.
+2. **admit** — pop admissible requests from the queue into free slots,
+   gated by the BLOCK budget as well as the slot count: admission
+   reserves a request's worst-case KV-block need (after prefix
+   sharing, serve/slots.py), so out-of-blocks resolves here —
+   deterministic head-of-line queueing (the popped head goes back to
+   the queue front) — never as a stuck decoding slot.  A request the
+   engine could NEVER serve (zero output budget: its prompt fills the
+   cache; or a block need beyond the whole arena) terminates
+   first-class with status "rejected" instead of occupying a slot to
+   emit nothing.
+3. **step** — ONE compiled decode program advances every live slot:
+   a slot still inside its prompt feeds up to ``block_size`` prompt
+   tokens (CHUNKED PREFILL — long prompts no longer take one tick per
+   token) and discards every prediction except the one after its final
+   prompt token; a slot past its prompt feeds its previously sampled
+   token and keeps the new one.  Prefill chunks and decode steps ride
+   the same program in the same batch (per-slot ``n_new`` lane
+   counts), so requests admitted at different ticks coexist — and the
+   K/V they cache live in block-paged arenas addressed through
+   per-slot block tables (copy-on-write prefix sharing included)
+   rather than dense per-slot pages.  The geometry is static; the
+   program compiles exactly once.
 4. **harvest** — detect EOS / length completions, evict their slots,
    emit ``request_complete`` records; per-slot host work is exception-
    contained, so a failure (or an injected ``slot_fail``) terminates
@@ -61,7 +73,7 @@ from apex_example_tpu.obs.metrics import Histogram, nearest_rank
 from apex_example_tpu.resilience.faults import FaultInjected
 from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
                                           RequestQueue)
-from apex_example_tpu.serve.slots import SlotPool
+from apex_example_tpu.serve.slots import BlockPool
 
 
 def _now() -> float:
@@ -77,19 +89,32 @@ def _pct_dict(vals_ms: List[float]) -> Dict[str, float]:
 
 @functools.lru_cache(maxsize=8)
 def _slot_step(dec):
-    """One compiled decode step for a slot-decode model clone (cached on
-    the frozen module config, params as an argument — the same contract
-    as models/gpt._decode_loop).  Besides the sampled tokens it returns
-    a per-slot logits-finite mask: argmax/categorical over NaN logits
-    yield an IN-RANGE index, so a token-range check alone can never see
-    real NaN fallout — the finiteness of the logits themselves is the
-    signal, and computing it here fuses it into the decode program."""
+    """One compiled decode step for a PAGED slot-decode model clone
+    (cached on the frozen module config — block geometry included —
+    with params as an argument, the same contract as
+    models/gpt._decode_loop).  ``tok`` is [SLOTS, C] with C =
+    kv_block_size: a prefill chunk for slots inside their prompt, one
+    token (lane 0) for decoding slots; ``n_new`` says how many lanes
+    are real per slot, and sampling reads the logits AFTER each slot's
+    last real token.  COW copies, the block-table K/V scatter and the
+    gathered-attention live mask all run inside this one program
+    (models/bert.py).  Besides the sampled tokens it returns a per-slot
+    logits-finite mask: argmax/categorical over NaN logits yield an
+    IN-RANGE index, so a token-range check alone can never see real NaN
+    fallout — the finiteness of the logits themselves is the signal,
+    and computing it here fuses it into the decode program."""
 
     @jax.jit
-    def step(params, cache, tok, rng, temperature, top_k):
+    def step(params, cache, tok, block_table, fill, n_new, cow_src,
+             cow_dst, rng, temperature, top_k):
+        paged = {"block_table": block_table, "fill": fill, "n_new": n_new,
+                 "cow_src": cow_src, "cow_dst": cow_dst}
         logits, mut = dec.apply({"params": params, "cache": cache}, tok,
-                                train=False, mutable=["cache"])
-        last = logits[:, -1]
+                                train=False, paged=paged,
+                                mutable=["cache"])
+        idx = jnp.clip(n_new - 1, 0, tok.shape[1] - 1)
+        last = jnp.take_along_axis(logits, idx[:, None, None],
+                                   axis=1)[:, 0]
         nxt = sample_tokens(rng, last, temperature, top_k)
         finite = jnp.all(jnp.isfinite(last), axis=-1)
         return mut["cache"], nxt, finite
@@ -159,7 +184,9 @@ class ServeEngine:
     """Continuous-batching engine over a GPT-family model.
 
     ``model`` is the plain module, ``params`` its trained (or random)
-    weights; the engine derives the slot-decode clone via its SlotPool.
+    weights; the engine derives the paged slot-decode clone via its
+    BlockPool (``block_size`` sets both the arena granularity and the
+    chunked-prefill width; ``num_blocks`` defaults to dense capacity).
     ``sink`` (an obs.JsonlSink), when given, receives one
     ``request_complete`` / ``request_failed`` / ``shed`` record per
     terminated request; the caller writes the run header and the final
@@ -169,11 +196,14 @@ class ServeEngine:
     """
 
     def __init__(self, model, params, *, num_slots: int = 4,
-                 max_len: int = 128, rng=None,
+                 max_len: int = 128, block_size: int = 8,
+                 num_blocks: Optional[int] = None, rng=None,
                  queue: Optional[RequestQueue] = None,
                  sink=None, run_id: Optional[str] = None,
                  fault=None, registry=None):
-        self.pool = SlotPool(model, num_slots, max_len)
+        self.pool = BlockPool(model, num_slots, max_len,
+                              block_size=block_size,
+                              num_blocks=num_blocks)
         self.vocab_size = int(model.vocab_size)
         self.params = params
         self.queue = queue if queue is not None else RequestQueue()
@@ -197,11 +227,14 @@ class ServeEngine:
         self._t0 = time.perf_counter()
         self._tokens_out = 0
         self._occupancy_sum = 0
-        # Per-compute-tick gauges (schema v6 serve_summary): live slots
-        # and live-vs-reserved KV bytes — the dense-page waste baseline
-        # the paged-KV refactor (ROADMAP item 2) needs.
+        # Per-compute-tick gauges (schema v6/v7 serve_summary): live
+        # slots, logical KV bytes, physically-held arena blocks and
+        # admission-committed bytes — block-accurate occupancy (the
+        # dense-page layout these replace measured ~92% kv_waste_pct).
         self._occ_hist = Histogram("serve.slots_live")
         self._kv_hist = Histogram("serve.kv_bytes_live")
+        self._blk_hist = Histogram("serve.blocks_live")
+        self._committed_hist = Histogram("serve.kv_bytes_committed")
 
     # ---------------------------------------------------------- intake
 
@@ -261,6 +294,23 @@ class ServeEngine:
                 req = self.queue.pop(step)
                 if req is None:
                     break
+                if not pool.fits(req):
+                    # The satellite bugfix (ISSUE 8): a request whose
+                    # prompt fills the cache (max_new_for == 0) — or
+                    # whose worst-case block need exceeds the whole
+                    # arena — used to occupy a slot and terminate with
+                    # ZERO generated tokens.  It can never be served
+                    # here; reject it first-class at admission.
+                    self._terminal_unadmitted(req, "rejected")
+                    continue
+                if not pool.can_admit(req):
+                    # Out of KV blocks: deterministic head-of-line
+                    # queueing — the head waits at the queue front
+                    # until evictions free its worst-case budget (FIFO
+                    # preserved; bounded, since every live slot
+                    # finishes within max_len ticks).
+                    self.queue.push_front(req)
+                    break
                 pool.admit(req, step)
         live = pool.live
         if not live:
@@ -273,18 +323,34 @@ class ServeEngine:
                 self.fault.maybe_fire(tick1)
             return False
 
-        S = pool.num_slots
-        tok = np.zeros((S, 1), np.int32)
+        S, C = pool.num_slots, pool.block_size
+        tok = np.zeros((S, C), np.int32)
+        fill = np.zeros((S,), np.int32)
+        n_new = np.zeros((S,), np.int32)
+        cow_src = np.full((S,), -1, np.int32)
+        cow_dst = np.full((S,), -1, np.int32)
         temps = np.zeros((S,), np.float32)
         ks = np.zeros((S,), np.int32)
         for i in live:
             slot = pool.slots[i]
-            tok[i, 0] = slot.next_token()
+            # Chunked prefill: up to one block of prompt tokens per
+            # tick; decode feeds the single previously-sampled token.
+            n = min(C, slot.n_prompt - slot.cursor) if slot.prefilling \
+                else 1
+            tok[i, :n] = slot.tokens[slot.cursor:slot.cursor + n]
+            fill[i] = slot.cursor
+            n_new[i] = n
+            # Map/COW the blocks this slot writes this tick (draws from
+            # the budget reserved at admission, so it cannot OOM).
+            cow_src[i], cow_dst[i] = pool.stage_writes(i, n)
             temps[i] = slot.request.temperature
             ks[i] = slot.request.top_k
         self.rng, key = jax.random.split(self.rng)
         pool.cache, nxt, finite = self._step_fn(
-            self.params, pool.cache, jnp.asarray(tok), key,
+            self.params, pool.cache, jnp.asarray(tok),
+            jnp.asarray(pool.table), jnp.asarray(fill),
+            jnp.asarray(n_new), jnp.asarray(cow_src),
+            jnp.asarray(cow_dst), key,
             jnp.asarray(temps), jnp.asarray(ks))
         nxt = np.asarray(nxt)          # the scheduler's host sync
         finite = np.asarray(finite)
@@ -298,14 +364,15 @@ class ServeEngine:
                 # sampled-token path, deterministically.  The guard below
                 # fails every affected slot instead of feeding the
                 # garbage token back into the cache.  Only consumed when
-                # some slot actually KEEPS this tick's token — on an
-                # all-prefill tick the outputs are discarded and the
-                # drill would be spent with zero effect, so it defers to
-                # the first tick that can express it (FaultPlan.due is
-                # >=, and the serve path has no resume to double-fire).
+                # some slot actually KEEPS this tick's token — a slot
+                # still short of its prompt end after this tick's chunk
+                # discards the output, and the drill would be spent with
+                # zero effect, so it defers to the first tick that can
+                # express it (FaultPlan.due is >=, and the serve path
+                # has no resume to double-fire).
                 slots = pool.slots
-                if any(slots[i].cursor + 1 >= slots[i].n_prompt
-                       for i in live):
+                if any(slots[i].cursor + int(n_new[i])
+                       >= slots[i].n_prompt for i in live):
                     fault.take()
                     nxt = np.full_like(nxt, -1)
             elif fault.kind == "slot_fail" and fault.due(tick1):
@@ -319,9 +386,9 @@ class ServeEngine:
                 if i == fail_slot:
                     raise FaultInjected(
                         f"injected slot_fail at tick {tick1} (slot {i})")
-                slot.cursor += 1
+                pool.commit_writes(i, int(n_new[i]))
                 if slot.prefilling:
-                    continue           # prompt token fed; output discarded
+                    continue           # prompt chunk fed; output discarded
                 out = int(nxt[i])
                 if not bool(finite[i]):
                     raise SlotFailure(
@@ -358,15 +425,21 @@ class ServeEngine:
         self.compute_steps += 1
         self._occupancy_sum += len(live)
         # Gauge the tick AFTER harvest: what is RESIDENT at the tick
-        # boundary (finished slots' pages just went stale — exactly the
-        # reuse a paged allocator would reclaim).
+        # boundary (a finished slot's blocks were just unref'd — the
+        # reclamation the dense layout could never express).
         live_slots = len(self.pool.live)
         kv_live = self.pool.kv_bytes_live()
+        blocks_live = self.pool.blocks_live()
+        per_block = self.pool.block_size * self.pool.kv_bytes_per_token()
         self._occ_hist.observe(live_slots)
         self._kv_hist.observe(kv_live)
+        self._blk_hist.observe(blocks_live)
+        self._committed_hist.observe(
+            self.pool.blocks_committed() * per_block)
         if self.registry is not None:
             self.registry.gauge("serve.slots_live").set(live_slots)
             self.registry.gauge("serve.kv_bytes_live").set(kv_live)
+            self.registry.gauge("serve.blocks_live").set(blocks_live)
         self.step_count += 1
         if fault is not None:
             # crash/sigterm/hang fire AFTER the tick's harvest (matching
@@ -423,11 +496,11 @@ class ServeEngine:
     def _terminal_unadmitted(self, req: Request, status: str,
                              pending: Optional[int] = None) -> None:
         """Terminate a never-admitted request: shed at arrival, expired
-        in the queue, cancelled while queued, or drained for requeueing
-        (the drain record carries the requeued ids; shed gets its own
-        record, with ``pending`` the tick's post-shed arrived backlog —
-        computed once by the caller; timeout/cancelled ride
-        ``request_failed``)."""
+        in the queue, cancelled while queued, rejected as unservable at
+        admission, or drained for requeueing (the drain record carries
+        the requeued ids; shed gets its own record, with ``pending`` the
+        tick's post-shed arrived backlog — computed once by the caller;
+        timeout/cancelled/rejected ride ``request_failed``)."""
         now = time.perf_counter()
         comp = Completion(
             request=req, tokens=[], finish_reason=status, slot=-1,
@@ -449,7 +522,7 @@ class ServeEngine:
             if self.run_id:
                 rec["run_id"] = self.run_id
             self.sink.write(rec)
-        elif status in ("timeout", "cancelled", "failed"):
+        elif status in ("timeout", "cancelled", "failed", "rejected"):
             self.sink.write(request_failed_record(comp, self.run_id))
         # "drained": accounted by the serve_drain record, not per-request.
 
@@ -516,14 +589,20 @@ class ServeEngine:
 
     def summary_record(self) -> Dict[str, Any]:
         """The ``serve_summary`` for everything terminated so far (the
-        caller writes it to the sink and closes).  Schema v5: per-status
-        counts + the availability ratio (ok / every terminal status the
-        server owned — drained requests are requeued elsewhere, so they
-        sit outside the denominator)."""
+        caller writes it to the sink and closes).  Schema v5 added
+        per-status counts + the availability ratio (ok / every terminal
+        status the server owned — drained requests are requeued
+        elsewhere, so they sit outside the denominator); v7 adds the
+        block-pool gauges (blocks_live / kv_bytes_committed /
+        prefix_hit_rate / cow_copies) and makes ``kv_waste_pct``
+        block-accurate: held-block bytes minus logically-live bytes,
+        per compute tick — the dense layout's fixed full-page
+        reservation measured ~92% here."""
         duration = time.perf_counter() - self._t0
         comps = self.completions
         ok = [c for c in comps if c.status == "ok"]
         owned = len(comps) - self.counts["drained"]
+        pool = self.pool
         rec: Dict[str, Any] = {
             "record": "serve_summary",
             "time": _now(),
@@ -533,8 +612,10 @@ class ServeEngine:
                                     1),
             "steps": self.step_count,
             "compute_steps": self.compute_steps,
-            "slots": self.pool.num_slots,
-            "max_len": self.pool.max_len,
+            "slots": pool.num_slots,
+            "max_len": pool.max_len,
+            "block_size": pool.block_size,
+            "blocks_total": pool.num_blocks,
             "duration_s": round(duration, 3),
             "completed": self.counts["ok"],
             "timed_out": self.counts["timeout"],
@@ -542,24 +623,36 @@ class ServeEngine:
             "cancelled": self.counts["cancelled"],
             "failed": self.counts["failed"],
             "drained": self.counts["drained"],
+            "rejected": self.counts["rejected"],
+            "prefix_hit_rate": round(pool.prefix_hit_rate(), 4),
+            "cow_copies": pool.cow_copies,
             "availability": round(self.counts["ok"] / owned, 3)
             if owned else 1.0,
         }
         if self.compute_steps:
             rec["occupancy"] = round(
                 self._occupancy_sum / (self.compute_steps
-                                       * self.pool.num_slots), 3)
-        # The paged-KV waste baseline (schema v6): dense pages pinned
-        # for the run vs what live requests actually filled per tick.
-        reserved = self.pool.kv_bytes_reserved()
+                                       * pool.num_slots), 3)
+        # Arena-lifetime reservation (constant) + the per-tick block
+        # gauges.  kv_waste_pct compares what the held blocks could
+        # store against what live slots logically filled — the
+        # block-rounding + reuse-lag overhead of the paged layout
+        # (clamped at 0: heavy sharing counts shared tokens once
+        # physically but once PER SLOT logically).
+        reserved = pool.kv_bytes_reserved()
         rec["kv_bytes_reserved"] = reserved
         if self.compute_steps:
             kv = self._kv_hist.summary()
+            blk = self._blk_hist.summary()
             rec["slot_occupancy"] = self._occ_hist.summary()
             rec["kv_bytes_live"] = kv
-            if reserved:
+            rec["blocks_live"] = blk
+            rec["kv_bytes_committed"] = self._committed_hist.summary()
+            held = blk["mean"] * pool.block_size \
+                * pool.kv_bytes_per_token()
+            if held:
                 rec["kv_waste_pct"] = round(
-                    100.0 * (1.0 - kv["mean"] / reserved), 2)
+                    max(0.0, 100.0 * (1.0 - kv["mean"] / held)), 2)
         if ok:
             rec["ttft_ms"] = _pct_dict([c.ttft_s * 1e3 for c in ok])
             rec["tpot_ms"] = _pct_dict([c.tpot_s * 1e3 for c in ok])

@@ -35,13 +35,21 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
                        eos_id: Optional[int] = None,
                        stagger: int = 0, burst: int = 1,
                        deadline_steps: Optional[int] = None,
-                       deadline_s: Optional[float] = None) -> List[Request]:
+                       deadline_s: Optional[float] = None,
+                       shared_prefix: int = 0) -> List[Request]:
     """``n`` requests with uniform prompt/output lengths in the given
     inclusive ranges; request i arrives at virtual step
     ``(i // burst) * stagger`` (stagger 0 = all at once; burst b = b
     arrivals per wave — the deterministic overload mode).  With
     ``deadline_steps`` each request must finish within that many engine
-    ticks of its arrival; ``deadline_s`` is the wall-clock TTL."""
+    ticks of its arrival; ``deadline_s`` is the wall-clock TTL.
+
+    ``shared_prefix`` > 0 prepends one common N-token "system prompt"
+    (drawn once from the same RandomState) to every request's sampled
+    prompt — the workload mode that makes the paged KV cache's
+    copy-on-write prefix sharing measurable: the common blocks are
+    computed once and refcounted across requests (ISSUE 8;
+    ``prompt_len`` still sizes only the per-request sampled part)."""
     if n < 1:
         raise ValueError(f"need n >= 1 requests, got {n}")
     if prompt_len[0] < 1 or prompt_len[0] > prompt_len[1]:
@@ -53,12 +61,17 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
     if deadline_steps is not None and deadline_steps < 1:
         raise ValueError(f"deadline_steps must be >= 1, got "
                          f"{deadline_steps}")
+    if shared_prefix < 0:
+        raise ValueError(f"shared_prefix must be >= 0, got "
+                         f"{shared_prefix}")
     rs = np.random.RandomState(seed)
+    prefix = rs.randint(0, vocab_size, size=(shared_prefix,)).tolist() \
+        if shared_prefix else []
     out = []
     for i in range(n):
         p = int(rs.randint(prompt_len[0], prompt_len[1] + 1))
         m = int(rs.randint(max_new[0], max_new[1] + 1))
-        prompt = rs.randint(0, vocab_size, size=(p,)).tolist()
+        prompt = prefix + rs.randint(0, vocab_size, size=(p,)).tolist()
         arrival = (i // burst) * stagger if stagger else None
         out.append(Request(prompt=prompt, max_new_tokens=m,
                            temperature=temperature, top_k=top_k,

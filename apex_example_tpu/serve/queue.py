@@ -34,8 +34,9 @@ the ``shed`` records and Completions.
 
 Every request terminates in a first-class :class:`Completion` whose
 ``status`` is one of ``ok`` / ``timeout`` / ``shed`` / ``cancelled`` /
-``failed`` / ``drained`` — the serving stack never loses a request
-silently (ISSUE 5).
+``failed`` / ``drained`` / ``rejected`` — the serving stack never loses
+a request silently (ISSUE 5; ``rejected`` is the admission-time verdict
+for requests the engine could never serve, ISSUE 8).
 """
 
 from __future__ import annotations
@@ -51,8 +52,13 @@ _uid = itertools.count()
 
 # Terminal request statuses (Completion.status).  "ok" is the only
 # success; "drained" means the request was never admitted before a
-# graceful drain and was handed back for requeueing on another replica.
-STATUSES = ("ok", "timeout", "shed", "cancelled", "failed", "drained")
+# graceful drain and was handed back for requeueing on another replica;
+# "rejected" means admission determined the request can NEVER be served
+# by this engine (prompt fills the whole cache so the output budget is
+# zero, or the worst-case block need exceeds the arena) — terminated
+# first-class at admission instead of occupying a slot to emit nothing.
+STATUSES = ("ok", "timeout", "shed", "cancelled", "failed", "drained",
+            "rejected")
 
 
 def _next_uid() -> str:
@@ -284,6 +290,15 @@ class RequestQueue:
             if head.arrival_step is not None and head.arrival_step > step:
                 return None
             return self._q.popleft()
+
+    def push_front(self, request: Request) -> None:
+        """Hand a popped request back to the HEAD of the queue — the
+        engine's deterministic out-of-blocks queueing (head-of-line:
+        FIFO order is preserved while the head waits for KV blocks).
+        Allowed on a closed queue: this is the engine returning work it
+        already owns, not a new submission."""
+        with self._lock:
+            self._q.appendleft(request)
 
     def pending(self) -> int:
         with self._lock:

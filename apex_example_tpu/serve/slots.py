@@ -1,33 +1,60 @@
-"""Slot pool: a fixed-size request→row mapping over the shared KV cache.
+"""Block-paged KV cache: arena + free-list allocator + per-slot tables.
 
-The cache the pool owns is the model's own decode cache (flax 'cache'
-collection under ``decode=True, slot_decode=True``): per layer,
-``cached_key``/``cached_value`` pages of shape [SLOTS, max_len, H, D]
-plus per-slot fill indices ([SLOTS] ``cache_index`` per layer and the
-top-level [SLOTS] ``cache_position``).  A request is admitted by
-resetting ONE row's indices to zero — the k/v pages are left untouched
-(stale keys beyond the fill index are masked out by the per-slot live
-mask inside attention, models/bert.py), so admit/evict costs O(1) index
-writes, not an O(max_len·H·D) page clear.
+The dense layout this replaces pinned a [SLOTS, max_len, H, D] page per
+slot, so HBM cost scaled with ``max_len`` regardless of request length
+(PR 6's gauges measured ~92% ``kv_waste_pct`` on the smoke workload).
+Here every layer owns ONE shared arena of shape
+``[num_blocks, block_size, H, D]`` and a request maps only the blocks
+its sequence actually touches, through a per-slot block table
+(``[SLOTS, max_blocks]`` int32) the attention layers gather through
+inside the one compiled decode step (models/bert.py).  Geometry stays
+static — table CONTENTS are data, so the program still compiles exactly
+once.
 
-The pool is host-side bookkeeping plus that one jitted index-reset; the
-scheduler loop that feeds tokens through the slots lives in
-serve/engine.py.
+Host-side policy (this module, no jax in the allocator):
+
+- **Free-list allocation** with per-block refcounts.  Admission
+  reserves a request's worst-case block count up front
+  (``ceil((prompt + max_new) / block_size)`` minus fully-shared
+  blocks), so a decoding slot can never hit out-of-blocks mid-flight —
+  OOM resolves deterministically at admission (queueing/shed in the
+  engine), never as a stuck slot.
+- **Prefix sharing** (copy-on-write): full blocks are registered in a
+  chain-keyed index (each key hashes the block's tokens AND its whole
+  prefix — KV content depends on every preceding token, so per-block
+  content alone can never key it).  A new request maps the longest
+  indexed chain covering its prompt, including a partial overlap into
+  the last matched block; blocks mapped by several slots (or cached in
+  the index) are immutable, and the first write into one triggers a
+  block copy inside the compiled step (``cow_*`` pairs).  Zero-ref
+  indexed blocks linger as a reusable cache (LRU-evicted under
+  pressure), so a recurring system prompt keeps its KV across
+  non-overlapping requests.
+- **Chunked prefill**: the engine feeds up to ``block_size`` prompt
+  tokens per tick through the same compiled step (serve/engine.py);
+  this module's ``stage_writes``/``commit_writes`` bracket each tick's
+  span with allocation/COW before and full-block registration after.
+
+Shared prefixes always stop one token short of the full prompt: the
+first generated token is sampled from the logits AFTER the last prompt
+token, and sharing that position's KV would skip the forward pass that
+produces those logits.
 """
 
 from __future__ import annotations
 
-import functools
+import math
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_example_tpu.serve.queue import Request
 
-_INDEX_LEAVES = ("cache_index", "cache_position")
 _PAGE_LEAVES = ("cached_key", "cached_value")
 
 
@@ -36,15 +63,169 @@ def _leaf_name(path) -> str:
     return getattr(last, "key", getattr(last, "name", str(last)))
 
 
-@jax.jit
-def _reset_slot_indices(cache, slot):
-    """Zero every per-slot index leaf at row ``slot`` (traced, so one
-    compiled program serves every slot id)."""
-    def reset(path, leaf):
-        if _leaf_name(path) in _INDEX_LEAVES:
-            return leaf.at[slot].set(0)
-        return leaf
-    return jax.tree_util.tree_map_with_path(reset, cache)
+@dataclass
+class BlockNode:
+    """One indexed (full, immutable) block: its chain key encodes the
+    block's tokens and, through ``parent``, every token before it."""
+
+    bid: int
+    key: Tuple
+    parent: Optional[Tuple]
+    tokens: Tuple[int, ...]
+
+
+class BlockAllocator:
+    """Free-list + refcount + prefix-index bookkeeping for one arena.
+
+    Pure host code (no jax): the engine calls it between compiled
+    steps.  Determinism contract: allocation order, LRU eviction order
+    and prefix-match tie-breaks depend only on the call sequence, so a
+    rerun of the same request stream allocates identically.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.refcount = [0] * num_blocks
+        self._immutable = [False] * num_blocks
+        self._free: List[int] = list(range(num_blocks))[::-1]  # pop()=0 first
+        # Zero-ref indexed blocks, LRU order (oldest first): reusable
+        # prefix cache, evicted only when the free list runs dry.
+        self._reusable: "OrderedDict[int, BlockNode]" = OrderedDict()
+        self._index: Dict[Tuple, BlockNode] = {}
+        self._children: Dict[Optional[Tuple], List[BlockNode]] = {}
+        self._nodes: Dict[int, BlockNode] = {}   # bid -> node (indexed only)
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def blocks_in_use(self) -> int:
+        """Blocks currently mapped by at least one slot."""
+        return self.num_blocks - len(self._free) - len(self._reusable)
+
+    def available(self, revive: Tuple[int, ...] = ()) -> int:
+        """Blocks an admission could still draw from: the free list plus
+        the evictable reusable cache, minus any of ``revive`` that sit
+        in that cache (mapping a cached shared block removes it from the
+        evictable pool, so it must not be double-counted)."""
+        revived = sum(1 for b in set(revive) if b in self._reusable)
+        return len(self._free) + len(self._reusable) - revived
+
+    def immutable(self, bid: int) -> bool:
+        return self._immutable[bid]
+
+    # -------------------------------------------------------- lifecycle
+
+    def alloc(self) -> int:
+        """One fresh mutable block (refcount 1).  Draws the free list
+        first, then evicts the least-recently-freed reusable block
+        (deregistering its index entry).  Raising here means the
+        caller's reservation accounting is broken — admission must have
+        checked ``available()``."""
+        if self._free:
+            bid = self._free.pop()
+        elif self._reusable:
+            bid, node = self._reusable.popitem(last=False)
+            self._deregister(node)
+        else:
+            raise RuntimeError(
+                "out of KV blocks — admission reserves worst-case block "
+                "budgets, so this is an allocator accounting bug")
+        self.refcount[bid] = 1
+        self._immutable[bid] = False
+        return bid
+
+    def ref(self, bid: int) -> None:
+        """Map an already-cached block into one more slot (prefix
+        sharing); revives it out of the reusable pool if parked there."""
+        self.refcount[bid] += 1
+        self._reusable.pop(bid, None)
+
+    def unref(self, bid: int) -> None:
+        """Drop one mapping.  At zero refs an indexed block parks in the
+        reusable cache (its KV stays valid for future prefix hits); an
+        unindexed one returns to the free list."""
+        if self.refcount[bid] < 1:
+            raise RuntimeError(f"unref of free block {bid}")
+        self.refcount[bid] -= 1
+        if self.refcount[bid] == 0:
+            node = self._nodes.get(bid)
+            if node is not None:
+                self._reusable[bid] = node
+            else:
+                self._free.append(bid)
+
+    def _deregister(self, node: BlockNode) -> None:
+        del self._index[node.key]
+        self._children[node.parent].remove(node)
+        if not self._children[node.parent]:
+            del self._children[node.parent]
+        del self._nodes[node.bid]
+
+    # ----------------------------------------------------- prefix index
+
+    def register_full(self, parent: Optional[Tuple],
+                      tokens: Tuple[int, ...], bid: int) -> Tuple:
+        """Index a block that just filled (immutable from here on: any
+        later write COWs).  A duplicate chain — two slots computed the
+        same content in parallel — keeps the first index entry; the
+        duplicate block stays unindexed and frees normally."""
+        if len(tokens) != self.block_size:
+            raise ValueError(f"register_full wants exactly "
+                             f"{self.block_size} tokens, got {len(tokens)}")
+        key = (parent, tokens)
+        self._immutable[bid] = True
+        if key not in self._index:
+            node = BlockNode(bid, key, parent, tokens)
+            self._index[key] = node
+            self._children.setdefault(parent, []).append(node)
+            self._nodes[bid] = node
+        return key
+
+    def match_prefix(self, prompt) -> Tuple[int, List[int], List[Tuple]]:
+        """Longest cached prefix of ``prompt``: ``(shared_len, block
+        ids, chain keys)``.  Walks exact full-block chain matches, then
+        tries a partial overlap into one more indexed block (the COW
+        case: the block is mapped read-only for its first few positions
+        and copied at the first divergent write).  Read-only — the
+        caller refs the returned blocks on admission.  Always capped at
+        ``len(prompt) - 1`` so the last prompt token is re-fed (its
+        forward pass produces the first sampled token's logits)."""
+        BS = self.block_size
+        bids: List[int] = []
+        keys: List[Tuple] = []
+        parent: Optional[Tuple] = None
+        shared = 0
+        for b in range(len(prompt) // BS):
+            key = (parent, tuple(prompt[b * BS:(b + 1) * BS]))
+            node = self._index.get(key)
+            if node is None:
+                break
+            bids.append(node.bid)
+            keys.append(key)
+            parent = key
+            shared += BS
+        # Partial overlap into one more child block: first registered
+        # child with the longest common prefix wins (deterministic).
+        rest = tuple(prompt[shared:shared + BS])
+        best, best_j = None, 0
+        for node in self._children.get(parent, []):
+            j = 0
+            while j < len(rest) and node.tokens[j] == rest[j]:
+                j += 1
+            if j > best_j:
+                best, best_j = node, j
+        if best is not None:
+            bids.append(best.bid)
+            keys.append(best.key)
+            shared += best_j
+        shared = min(shared, len(prompt) - 1)
+        n_mapped = math.ceil(shared / BS) if shared else 0
+        return shared, bids[:n_mapped], keys[:n_mapped]
 
 
 @dataclass
@@ -52,10 +233,14 @@ class Slot:
     """Host-side state of one live request in a slot.
 
     ``tokens`` is the full sequence (prompt + generated so far);
-    ``cursor`` counts tokens already fed to the model.  Invariant during
-    decode: ``len(tokens) == cursor + 1`` (the newest element is the next
-    token to feed); during prefill ``cursor < n_prompt`` and generated
-    output is still being discarded.
+    ``cursor`` counts tokens whose KV is in the arena — fed through the
+    model OR covered by a shared prefix.  During decode
+    ``len(tokens) == cursor + 1`` (the newest element is the next token
+    to feed); during prefill ``cursor < n_prompt``.
+
+    ``block_keys`` parallels the slot's mapped blocks: the chain key
+    for registered (full, immutable) blocks, None for a mutable block
+    still filling (registered by ``commit_writes`` when it fills).
     """
 
     request: Request
@@ -65,6 +250,10 @@ class Slot:
     cursor: int = 0
     n_generated: int = 0
     t_first_token: Optional[float] = None
+    shared_len: int = 0
+    n_mapped: int = 0
+    reserved: int = 0
+    block_keys: List[Optional[Tuple]] = field(default_factory=list)
 
     @property
     def n_prompt(self) -> int:
@@ -74,19 +263,21 @@ class Slot:
     def prefilling(self) -> bool:
         return self.cursor < self.n_prompt
 
-    def next_token(self) -> int:
-        return self.tokens[self.cursor]
 
-
-class SlotPool:
-    """``num_slots`` rows over one shared decode cache.
+class BlockPool:
+    """``num_slots`` request slots over one block-paged KV arena.
 
     ``model`` is the plain (training) GPT module; the pool derives the
-    slot-decode clone and allocates the cache via an abstract init trace
-    (no real forward runs), exactly like models/gpt.generate.
+    paged slot-decode clone and allocates the per-layer arenas via an
+    abstract init trace (no real forward runs), exactly like
+    models/gpt.generate.  ``num_blocks`` defaults to the dense
+    layout's capacity (``num_slots * ceil(max_len / block_size)``), so
+    the default arena reserves the same HBM the old [SLOTS, max_len]
+    pages did — the win is that admission now shares and packs it.
     """
 
-    def __init__(self, model, num_slots: int, max_len: int):
+    def __init__(self, model, num_slots: int, max_len: int,
+                 block_size: int = 8, num_blocks: Optional[int] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
@@ -94,18 +285,33 @@ class SlotPool:
         if model.max_position < max_len:
             raise ValueError(f"max_len {max_len} exceeds the model's "
                              f"position table ({model.max_position})")
-        self.dec = model.clone(decode=True, slot_decode=True,
-                               fused_attention=False)
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.max_blocks = math.ceil(max_len / block_size)
+        if num_blocks is None:
+            num_blocks = num_slots * self.max_blocks
         self.num_slots = num_slots
         self.max_len = max_len
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.dec = model.clone(decode=True, slot_decode=True,
+                               fused_attention=False,
+                               kv_num_blocks=num_blocks,
+                               kv_block_size=block_size)
         shapes = jax.eval_shape(
             self.dec.init, jax.random.PRNGKey(0),
             jnp.zeros((num_slots, max_len), jnp.int32))["cache"]
         self.cache = jax.tree_util.tree_map(
             lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+        self.alloc = BlockAllocator(num_blocks, block_size)
+        self.table = np.zeros((num_slots, self.max_blocks), np.int32)
         self.slots: List[Optional[Slot]] = [None] * num_slots
-        self._free: List[int] = list(range(num_slots))[::-1]  # pop() = slot 0 first
+        self._free: List[int] = list(range(num_slots))[::-1]  # pop()=slot 0
+        self._reserved_total = 0
         self._kv_reserved: Optional[int] = None
+        self.cow_copies = 0
+        self._shared_tokens = 0
+        self._prompt_tokens = 0
 
     # ------------------------------------------------------------ state
 
@@ -120,11 +326,49 @@ class SlotPool:
     def any_live(self) -> bool:
         return len(self._free) < self.num_slots
 
+    # ---------------------------------------------------- block budgets
+
+    def max_new_for(self, request: Request) -> int:
+        """Effective output budget: the request's ask, clamped so the
+        total sequence fits a slot's logical capacity."""
+        return min(request.max_new_tokens,
+                   self.max_len - len(request.prompt))
+
+    def blocks_needed(self, request: Request,
+                      shared_len: int = 0) -> int:
+        """Worst-case blocks this request will ALLOCATE over its
+        lifetime: blocks covering the clamped total sequence, minus
+        fully-shared blocks (never written — a partially-overlapped
+        shared block still costs its COW copy, so it is not
+        subtracted)."""
+        total = len(request.prompt) + self.max_new_for(request)
+        return math.ceil(total / self.block_size) \
+            - shared_len // self.block_size
+
+    def fits(self, request: Request) -> bool:
+        """Could this request EVER be admitted?  (Worst case, no
+        sharing.)  False means admission must reject it outright —
+        queueing would deadlock."""
+        return self.max_new_for(request) >= 1 \
+            and self.blocks_needed(request) <= self.num_blocks
+
+    def can_admit(self, request: Request) -> bool:
+        """Slot free AND the worst-case block budget (after prefix
+        sharing) is coverable by unreserved blocks right now."""
+        if not self._free:
+            return False
+        shared, bids, _ = self.alloc.match_prefix(request.prompt)
+        need = self.blocks_needed(request, shared)
+        return self.alloc.available(tuple(bids)) \
+            - self._reserved_total >= need
+
     # -------------------------------------------------------- lifecycle
 
     def admit(self, request: Request, step: int) -> int:
-        """Insert ``request`` into a free slot: reset that row's cache
-        indices and seed the host state.  Returns the slot id."""
+        """Insert ``request`` into a free slot: map its shared prefix
+        blocks (refcounted), reserve its worst-case allocation budget
+        and seed the host state.  Returns the slot id.  The engine must
+        gate on ``fits``/``can_admit`` first."""
         if not self._free:
             raise RuntimeError("no free slot (admission must check "
                                "free_count first)")
@@ -132,37 +376,105 @@ class SlotPool:
         if n_prompt >= self.max_len:
             raise ValueError(
                 f"{request.uid}: prompt length {n_prompt} must be < "
-                f"cache max_len {self.max_len}")
+                f"cache max_len {self.max_len} (admission should have "
+                "rejected this request)")
+        shared, bids, keys = self.alloc.match_prefix(request.prompt)
+        need = self.blocks_needed(request, shared)
         idx = self._free.pop()
-        self.cache = _reset_slot_indices(self.cache,
-                                         jnp.asarray(idx, jnp.int32))
+        for b in bids:
+            self.alloc.ref(b)
+        self.table[idx, :] = 0
+        self.table[idx, :len(bids)] = bids
         self.slots[idx] = Slot(request=request, admitted_step=step,
                                t_admitted=time.perf_counter(),
-                               tokens=[int(t) for t in request.prompt])
+                               tokens=[int(t) for t in request.prompt],
+                               cursor=shared, shared_len=shared,
+                               n_mapped=len(bids), reserved=need,
+                               block_keys=list(keys))
+        self._reserved_total += need
+        self._shared_tokens += shared
+        self._prompt_tokens += n_prompt
         return idx
 
     def evict(self, idx: int) -> None:
-        """Free a slot (finished or cancelled).  The cache row keeps its
-        stale contents; the next admit resets the indices."""
-        if self.slots[idx] is None:
+        """Free a slot (finished, failed or cancelled): unref its
+        mapped blocks (full indexed ones park in the reusable prefix
+        cache) and release the unspent reservation."""
+        slot = self.slots[idx]
+        if slot is None:
             raise RuntimeError(f"slot {idx} is already free")
+        for b in range(slot.n_mapped):
+            self.alloc.unref(int(self.table[idx, b]))
+        self._reserved_total -= slot.reserved
+        self.table[idx, :] = 0
         self.slots[idx] = None
         self._free.append(idx)
 
-    def max_new_for(self, request: Request) -> int:
-        """Effective output budget: the request's ask, clamped so the
-        total sequence fits the cache row."""
-        return min(request.max_new_tokens,
-                   self.max_len - len(request.prompt))
+    def _alloc_for(self, slot: Slot) -> int:
+        if slot.reserved < 1:
+            raise RuntimeError(
+                f"{slot.request.uid}: write past the reserved block "
+                "budget — blocks_needed accounting bug")
+        bid = self.alloc.alloc()
+        slot.reserved -= 1
+        self._reserved_total -= 1
+        return bid
+
+    def stage_writes(self, idx: int, n_new: int) -> Tuple[int, int]:
+        """Pre-step: make every block covering write positions
+        ``[cursor, cursor + n_new)`` mapped and mutable for slot
+        ``idx``.  Returns the tick's COW pair ``(src, dst)`` —
+        ``(-1, -1)`` when no shared block is written this tick.  At
+        most one COW per slot per tick: only the first written block
+        can be shared (later blocks in the span are freshly
+        allocated)."""
+        slot = self.slots[idx]
+        cow = (-1, -1)
+        start, end = slot.cursor, slot.cursor + n_new
+        BS = self.block_size
+        for b in range(start // BS, (end - 1) // BS + 1):
+            if b < slot.n_mapped:
+                bid = int(self.table[idx, b])
+                if self.alloc.immutable(bid):
+                    # First divergent write into a shared/cached block:
+                    # copy-on-write inside the compiled step.
+                    new = self._alloc_for(slot)
+                    cow = (bid, new)
+                    self.alloc.unref(bid)
+                    self.table[idx, b] = new
+                    slot.block_keys[b] = None     # content diverges
+                    self.cow_copies += 1
+            else:
+                if b != slot.n_mapped:
+                    raise RuntimeError("non-contiguous block mapping")
+                self.table[idx, b] = self._alloc_for(slot)
+                slot.block_keys.append(None)
+                slot.n_mapped += 1
+        return cow
+
+    def commit_writes(self, idx: int, n_new: int) -> None:
+        """Post-step: advance the slot's fill cursor and register every
+        block that just became full in the prefix index (it turns
+        immutable; its chain key hashes the whole token prefix)."""
+        slot = self.slots[idx]
+        slot.cursor += n_new
+        BS = self.block_size
+        for b in range(slot.n_mapped):
+            if slot.block_keys[b] is None and (b + 1) * BS <= slot.cursor:
+                parent = slot.block_keys[b - 1] if b else None
+                toks = tuple(slot.tokens[b * BS:(b + 1) * BS])
+                slot.block_keys[b] = self.alloc.register_full(
+                    parent, toks, int(self.table[idx, b]))
 
     # ---------------------------------------------------- KV accounting
 
     def kv_bytes_reserved(self) -> int:
-        """HBM bytes the dense KV pages pin for the engine's lifetime:
-        every ``cached_key``/``cached_value`` leaf is a full
-        [SLOTS, max_len, H, D] allocation regardless of what lives in
-        it — the waste baseline a paged-KV refactor (ROADMAP item 2)
-        gets scored against."""
+        """HBM bytes the arenas pin for the engine's lifetime: every
+        ``cached_key``/``cached_value`` leaf is a full
+        [num_blocks, block_size, H, D] allocation.  The default
+        ``num_blocks`` makes this equal to the dense layout's
+        reservation — the paged win shows up in the per-tick committed/
+        live gauges, not here."""
         if self._kv_reserved is None:       # geometry is fixed; compute once
             total = 0
             for path, leaf in jax.tree_util.tree_flatten_with_path(
@@ -174,14 +486,31 @@ class SlotPool:
 
     def kv_bytes_per_token(self) -> int:
         """Bytes one cached token occupies across every layer's K and V
-        page (``kv_bytes_reserved / (SLOTS * max_len)``) — multiply by a
-        slot's fill level for its live footprint."""
-        return self.kv_bytes_reserved() // (self.num_slots * self.max_len)
+        arena (``kv_bytes_reserved / (num_blocks * block_size)``)."""
+        return self.kv_bytes_reserved() \
+            // (self.num_blocks * self.block_size)
 
     def kv_bytes_live(self) -> int:
-        """Bytes actually filled by the live slots (each slot's fed-token
-        count times the per-token cost).  reserved - live = the HBM the
-        dense layout wastes right now."""
+        """Bytes of KV the live slots logically hold (per-slot fill
+        level times the per-token cost; a shared block's tokens count
+        once per sharer — this is the demand gauge, ``blocks_in_use``
+        the physical one)."""
         per_token = self.kv_bytes_per_token()
         return sum(s.cursor for s in self.slots if s is not None) \
             * per_token
+
+    def blocks_live(self) -> int:
+        """Arena blocks physically held by live slots right now."""
+        return self.alloc.blocks_in_use
+
+    def blocks_committed(self) -> int:
+        """Blocks admission has committed: physically held plus
+        reserved-but-unallocated worst-case budget."""
+        return self.alloc.blocks_in_use + self._reserved_total
+
+    def prefix_hit_rate(self) -> float:
+        """Shared prompt tokens / total prompt tokens over every
+        admission so far (0.0 before any admission)."""
+        if not self._prompt_tokens:
+            return 0.0
+        return self._shared_tokens / self._prompt_tokens

@@ -18,8 +18,21 @@ deterministic synthetic request stream with staggered arrivals.
     python serve.py --requests 24 --slots 2 --max-pending 4 --burst 12 \\
         --deadline-steps 40 --metrics-jsonl serve.jsonl
 
+    # shared-system-prompt workload: prefix sharing packs the common
+    # 16 tokens into refcounted blocks (COW on divergence)
+    python serve.py --requests 16 --shared-prefix 16 \\
+        --metrics-jsonl serve.jsonl
+
     # then summarize per-status accounting + latency (jax-free):
     python tools/serve_report.py serve.jsonl
+
+The KV cache is BLOCK-PAGED (ISSUE 8; README "Paged KV cache"):
+per-layer arenas of --num-blocks x --block-size token blocks, per-slot
+block tables gathered inside the one compiled decode step, chunked
+prefill (up to --block-size prompt tokens per tick), and admission by
+worst-case block budget — out-of-blocks resolves as deterministic
+head-of-line queueing, and a request that could never be served (its
+prompt fills the cache) terminates with status "rejected" at admission.
 
 Resilience (README "Serving resilience"; ISSUE 5): SIGTERM/SIGUSR1
 triggers a graceful drain — admission stops, queued requests are handed
@@ -60,10 +73,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-len", type=int, default=None,
                    help="per-slot cache length (default: the model's "
                         "position table, capped at 128 for gpt_tiny)")
+    p.add_argument("--block-size", type=int, default=8,
+                   help="KV arena block granularity in tokens: chunked "
+                        "prefill feeds up to this many prompt tokens "
+                        "per tick, and prefix sharing/allocation happen "
+                        "per block (serve/slots.py)")
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="KV arena size in blocks per layer (default: "
+                        "slots * ceil(max_len / block_size) — the dense "
+                        "layout's capacity; admission reserves each "
+                        "request's worst-case block budget against it)")
     p.add_argument("--requests", type=int, default=16,
                    help="synthetic request count")
     p.add_argument("--prompt-len", default="4:12",
                    help="prompt length, N or MIN:MAX tokens")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="prepend one common N-token system prompt to "
+                        "every request (drawn once per seed) — the "
+                        "prefix-sharing workload: shared KV blocks are "
+                        "computed once and refcounted, measurable in "
+                        "serve_summary's prefix_hit_rate/cow_copies")
     p.add_argument("--max-new", default="4:16",
                    help="output budget, N or MIN:MAX tokens")
     p.add_argument("--temperature", type=float, default=0.0,
@@ -146,9 +175,19 @@ def run_serve(args):
         max_len = min(model.max_position, 128)
     prompt_len = parse_range(args.prompt_len, "prompt-len")
     max_new = parse_range(args.max_new, "max-new")
-    if prompt_len[1] >= max_len:
-        raise SystemExit(f"--prompt-len max {prompt_len[1]} must be < "
-                         f"--max-len {max_len}")
+    if args.shared_prefix < 0:
+        raise SystemExit(f"--shared-prefix must be >= 0, got "
+                         f"{args.shared_prefix}")
+    if prompt_len[1] + args.shared_prefix >= max_len:
+        raise SystemExit(f"--prompt-len max {prompt_len[1]} plus "
+                         f"--shared-prefix {args.shared_prefix} must be "
+                         f"< --max-len {max_len}")
+    if args.block_size < 1:
+        raise SystemExit(f"--block-size must be >= 1, got "
+                         f"{args.block_size}")
+    if args.num_blocks is not None and args.num_blocks < 1:
+        raise SystemExit(f"--num-blocks must be >= 1, got "
+                         f"{args.num_blocks}")
     if args.flight_recorder and not args.metrics_jsonl:
         # Same guard as train.py: forensics need a stream to land in —
         # a silently-disarmed recorder is worse than an error.
@@ -211,11 +250,13 @@ def run_serve(args):
         prompt_len=prompt_len, max_new=max_new,
         temperature=args.temperature, top_k=args.top_k,
         eos_id=args.eos_id, stagger=args.stagger, burst=args.burst,
-        deadline_steps=args.deadline_steps, deadline_s=args.deadline_s)
+        deadline_steps=args.deadline_steps, deadline_s=args.deadline_s,
+        shared_prefix=args.shared_prefix)
     queue = RequestQueue(max_pending=args.max_pending,
                          shed_policy=args.shed_policy)
     engine = ServeEngine(model, params, num_slots=args.slots,
-                         max_len=max_len,
+                         max_len=max_len, block_size=args.block_size,
+                         num_blocks=args.num_blocks,
                          rng=jax.random.PRNGKey(args.seed),
                          queue=queue, sink=sink, run_id=run_id,
                          fault=fault,
@@ -223,8 +264,11 @@ def run_serve(args):
     engine.queue.submit_all(requests)
     engine.queue.close()
 
+    pool = engine.pool
     print(f"serve: {args.requests} request(s)  arch={args.arch}  "
-          f"slots={args.slots}  max_len={max_len}  params from {source}")
+          f"slots={args.slots}  max_len={max_len}  "
+          f"blocks={pool.num_blocks}x{pool.block_size}  "
+          f"params from {source}")
     rc = 0
     try:
         completions = engine.run(

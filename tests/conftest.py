@@ -55,7 +55,15 @@ def compile_events():
     ``compile_event`` counts via obs.costmodel.compile_counts — tier-1
     tests assert every instrumented function's count is exactly 1, so a
     silent recompile regression (which would multiply compile time into
-    the 870 s suite budget) fails loudly."""
+    the 870 s suite budget) fails loudly.
+
+    ``counts.gate(path)`` additionally runs the CI gate itself —
+    ``tools/cost_report.py PATH --fail-on-recompile`` — over the stream
+    (ISSUE 8: the serve path rides the same gate as the train path), so
+    the tests police the exact command CI scripts key on, not just the
+    underlying counter."""
+    import importlib.util
+
     from apex_example_tpu.obs import costmodel
     from apex_example_tpu.obs.metrics import read_jsonl
 
@@ -65,4 +73,13 @@ def compile_events():
             records = read_jsonl(path_or_records)
         return costmodel.compile_counts(records)
 
+    def gate(path):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "cost_report", os.path.join(repo, "tools", "cost_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main([path, "--fail-on-recompile"])
+
+    counts.gate = gate
     return counts

@@ -19,9 +19,12 @@ and its resilience layer (ISSUE 5):
 - schema v3/v5 records + v1-v4 back-compat,
 - queue/slot-pool/loadgen unit coverage and the serve.py CLI surface.
 
-All engine tests share one slot geometry (SLOTS=4, MAX_LEN=32) and one
-generate() max_len so the compiled decode programs are built once per
-session — the suite rides tier-1 and must stay cheap.
+All engine tests share one slot geometry (SLOTS=4, MAX_LEN=32, the
+default 8-token blocks) and one generate() max_len so the compiled
+decode programs are built once per session — the suite rides tier-1 and
+must stay cheap.  The KV cache is block-paged as of ISSUE 8
+(tests/test_paged_kv.py holds the allocator/prefix-sharing/chunked-
+prefill coverage; this file keeps the serving + resilience contract).
 """
 
 import importlib.util
@@ -41,8 +44,8 @@ from apex_example_tpu.models.gpt import generate, gpt_tiny
 from apex_example_tpu.obs import schema as obs_schema
 from apex_example_tpu.resilience import EX_TEMPFAIL, FaultPlan
 from apex_example_tpu.resilience.faults import SERVE_KINDS
-from apex_example_tpu.serve import (Request, RequestQueue, ServeEngine,
-                                    SlotPool, parse_range,
+from apex_example_tpu.serve import (BlockPool, Request, RequestQueue,
+                                    ServeEngine, parse_range,
                                     synthetic_requests)
 
 pytestmark = pytest.mark.serve
@@ -145,6 +148,15 @@ def test_continuous_batching_smoke(model_and_params, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "ttft_ms" in out and "tpot_ms" in out
     assert "finish reasons: length x8" in out
+    assert "kv blocks:" in out                   # v7 block line rendered
+
+    # (d) the ISSUE 8 acceptance bar: block-accurate kv_waste_pct on
+    # THIS smoke workload drops from the dense layout's ~92% to <= 40%
+    # (blocks are allocated as sequences grow and freed at completion,
+    # so held-block bytes track live bytes to within block rounding).
+    assert summary["kv_waste_pct"] <= 40.0
+    assert summary["blocks_total"] == SLOTS * (MAX_LEN // 8)
+    assert 0 < summary["blocks_live"]["max"] <= summary["blocks_total"]
 
 
 # ------------------------------------------------- per-slot sampling
@@ -270,6 +282,13 @@ def test_serve_cli_steps_cap(tmp_path, capsys):
 def test_serve_cli_rejects_prompt_longer_than_cache():
     with pytest.raises(SystemExit):
         serve_mod.main(["--prompt-len", "40", "--max-len", "32"])
+    with pytest.raises(SystemExit, match="shared-prefix"):
+        serve_mod.main(["--prompt-len", "3:8", "--max-len", "32",
+                        "--shared-prefix", "30"])
+    with pytest.raises(SystemExit, match="block-size"):
+        serve_mod.main(["--block-size", "0"])
+    with pytest.raises(SystemExit, match="num-blocks"):
+        serve_mod.main(["--num-blocks", "0"])
 
 
 # ------------------------------------------------------- schema v3
@@ -347,9 +366,10 @@ def test_queue_fifo_and_arrival_gating():
         q.submit(a)
 
 
-def test_slot_pool_admit_evict(model_and_params):
+def test_block_pool_admit_evict(model_and_params):
     model, _ = model_and_params
-    pool = SlotPool(model, num_slots=2, max_len=16)
+    pool = BlockPool(model, num_slots=2, max_len=16, block_size=8)
+    assert pool.num_blocks == 4                  # dense-capacity default
     r = lambda: Request(prompt=[1, 2, 3], max_new_tokens=4)
     s0 = pool.admit(r(), step=0)
     s1 = pool.admit(r(), step=0)
@@ -363,11 +383,11 @@ def test_slot_pool_admit_evict(model_and_params):
     with pytest.raises(ValueError, match="prompt length"):
         pool.admit(Request(prompt=list(range(16)), max_new_tokens=1),
                    step=2)
-    # output budget clamps to the cache row
+    # output budget clamps to the slot's logical capacity
     assert pool.max_new_for(Request(prompt=[1] * 10,
                                     max_new_tokens=50)) == 6
     with pytest.raises(ValueError, match="position table"):
-        SlotPool(model, num_slots=1, max_len=model.max_position + 1)
+        BlockPool(model, num_slots=1, max_len=model.max_position + 1)
 
 
 def test_parse_range():
@@ -382,11 +402,13 @@ def test_parse_range():
 
 def test_cost_model_decode_compiles_once_and_kv_gauges(
         model_and_params, tmp_path, compile_events):
-    """The serving half of the ISSUE 7 recompile guard + the paged-KV
-    waste baseline: a --cost-model engine run compiles the decode step
-    EXACTLY once (static batch geometry — a second compile_event is the
-    regression), and the serve_summary carries the v6 occupancy/KV
-    gauges (live vs reserved page bytes per compute tick).  Rides the
+    """The serving half of the ISSUE 7 recompile guard, on the PAGED
+    decode step (ISSUE 8): block tables, fill levels, COW pairs and
+    chunk widths are all DATA, so the program still compiles exactly
+    once per geometry (a second compile_event is the regression — and
+    ``compile_events.gate`` runs the actual cost_report
+    --fail-on-recompile CI command over the stream).  Also checks the
+    serve_summary KV gauges, v6 + the v7 block stratum.  Rides the
     session's SLOTS=4/MAX_LEN=32 decode geometry."""
     from apex_example_tpu.obs import costmodel
     model, params = model_and_params
@@ -416,13 +438,16 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
 
     records = obs.read_jsonl(path)
     assert obs_schema.validate_stream(records) == []
-    # recompile guard: one engine, one decode program, one compilation
+    # recompile guard: one engine, one decode program, one compilation —
+    # asserted on the counter AND through the CI gate command itself
     assert compile_events(records) == {"serve_decode_step": 1}
+    assert compile_events.gate(path) == 0
     cm = next(r for r in records if r["record"] == "cost_model")
     assert cm["name"] == "serve_decode_step"
     assert cm["flops"] > 0 and cm["bytes_accessed"] > 0
 
-    # KV accounting: per-token cost is layers x (K+V) x hidden x 4B
+    # KV accounting: per-token cost is layers x (K+V) x hidden x 4B;
+    # the default arena reserves exactly the dense layout's capacity
     per_token = 2 * model.num_layers * model.hidden_size * 4
     assert eng.pool.kv_bytes_per_token() == per_token
     reserved = SLOTS * MAX_LEN * per_token
@@ -435,10 +460,21 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
     occ = summary["slot_occupancy"]
     assert 0 < occ["max"] <= SLOTS
     assert 0 <= summary["kv_waste_pct"] <= 100
+    # v7 block stratum: held blocks never exceed the arena, committed
+    # bytes cover what admission reserved, and this no-shared-prefix
+    # workload neither hits the prefix index nor copies a block
+    blk = summary["blocks_live"]
+    assert 0 < blk["max"] <= summary["blocks_total"] == SLOTS * MAX_LEN // 8
+    assert summary["block_size"] == 8
+    assert summary["kv_bytes_committed"]["max"] <= reserved
+    assert summary["kv_bytes_committed"]["min"] >= kv["min"]
+    assert summary["prefix_hit_rate"] == 0.0
+    assert summary["cow_copies"] == 0 and summary["rejected"] == 0
     # per-tick registry gauges saw the run (last tick: pool drained)
     snap = emitter.registry.snapshot()
     assert snap["serve.slots_live"] == 0
     assert snap["serve.kv_bytes_live"] == 0
+    assert snap["serve.blocks_live"] == 0
 
 
 # ==================== serving resilience (ISSUE 5) ====================
@@ -475,7 +511,8 @@ def test_deadline_expires_queued_request_without_admitting(
     late = Request(prompt=[5, 6], max_new_tokens=4, deadline_step=5)
     eng = _run_engine_res(model, params, hogs + [late])
     assert eng.counts == {"ok": SLOTS, "timeout": 1, "shed": 0,
-                          "cancelled": 0, "failed": 0, "drained": 0}
+                          "cancelled": 0, "failed": 0, "drained": 0,
+                          "rejected": 0}
     comp = next(c for c in eng.completions if c.request is late)
     assert comp.status == "timeout" and comp.finish_reason == "timeout"
     assert comp.slot == -1 and comp.admitted_step == -1
@@ -498,7 +535,8 @@ def test_deadline_evicts_decoding_slot_midflight(model_and_params,
     sink.close()
     comp = eng.completions[0]
     assert comp.status == "timeout" and comp.slot == 0
-    # 3 prefill ticks then decode: fewer tokens than asked, more than 0
+    # one chunked-prefill tick then decode: fewer tokens than asked,
+    # more than 0 by the deadline
     assert 0 < len(comp.tokens) < 20
     recs = obs.read_jsonl(path)
     assert obs_schema.validate_stream(recs) == []
@@ -719,10 +757,12 @@ def test_fault_on_idle_tick_still_fires(model_and_params):
     assert failed.request is reqs[1]              # fired on wave 2
 
 
-def test_nan_fault_defers_past_all_prefill_ticks(model_and_params):
-    """nan@1 lands while every slot is still prefilling (outputs
-    discarded) — the drill must not be consumed with zero effect; it
-    defers to the first token-keeping tick and fails that slot."""
+def test_nan_fault_fires_on_first_token_keeping_tick(model_and_params):
+    """The nan drill is only consumed on a tick some slot KEEPS a
+    token.  Under chunked prefill a 5-token prompt completes inside
+    tick 1's chunk, so nan@1 fires immediately and poisons the first
+    kept token; a drill landing on a tick whose chunks all stop short
+    of their prompt end still defers (FaultPlan.due is >=)."""
     model, params = model_and_params
     req = Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=4)
     fault = FaultPlan("nan", 1, kinds=SERVE_KINDS)
@@ -732,6 +772,16 @@ def test_nan_fault_defers_past_all_prefill_ticks(model_and_params):
     failed = eng.completions[0]
     assert "degenerate sampled token" in failed.error
     assert failed.tokens == []                    # first kept token poisoned
+    # the defer path proper: a 20-token prompt needs ticks 1-3 of pure
+    # prefill (block chunks of 8), so nan@1 must wait for tick 3's
+    # prompt-crossing chunk instead of burning on a discarded output
+    req2 = Request(prompt=list(range(1, 21)), max_new_tokens=4)
+    fault2 = FaultPlan("nan", 1, kinds=SERVE_KINDS)
+    eng2 = _run_engine_res(model, params, [req2], fault=fault2)
+    assert fault2.fired
+    failed2 = eng2.completions[0]
+    assert failed2.status == "failed" and failed2.tokens == []
+    assert failed2.finished_step == 2             # tick 3, 0-based step 2
 
 
 def test_real_nan_params_trip_nonfinite_logits_guard(model_and_params):
@@ -745,7 +795,8 @@ def test_real_nan_params_trip_nonfinite_logits_guard(model_and_params):
     eng = _run_engine_res(model, bad,
                           [Request(prompt=[1, 2, 3], max_new_tokens=4)])
     assert eng.counts == {"ok": 0, "timeout": 0, "shed": 0,
-                          "cancelled": 0, "failed": 1, "drained": 0}
+                          "cancelled": 0, "failed": 1, "drained": 0,
+                          "rejected": 0}
     comp = eng.completions[0]
     assert comp.status == "failed" and comp.tokens == []
     assert "non-finite logits" in comp.error

@@ -13,11 +13,15 @@ overflow_event, aborted run summaries), v3 streams (the serving
 records), v4 streams (the resilience records: preemption / restart /
 resume, run summaries with restart_count), v5 streams (the serving-
 resilience records: request_failed / shed / serve_drain, serve
-summaries with per-status counts + availability) and v6 streams (the
+summaries with per-status counts + availability), v6 streams (the
 cost records: compile_event / cost_model from --cost-model runs, run
 summaries with measured compile totals, serve summaries with the
-KV-occupancy gauges) all validate alongside v1 streams — each
-version's tables are a strict superset of the last.
+KV-occupancy gauges) and v7 streams (the block-paged KV stratum:
+serve summaries with block_size / blocks_total / blocks_live /
+kv_bytes_committed / prefix_hit_rate / cow_copies / rejected, the
+block-accurate kv_waste_pct, request_failed status "rejected") all
+validate alongside v1 streams — each version's tables are a strict
+superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort
 exits 2.

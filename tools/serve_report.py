@@ -6,10 +6,14 @@ reasons, slot occupancy — recomputed from the per-request
 shown for cross-checking.
 
 Schema v5 adds the resilience stratum: per-status accounting (ok /
-timeout / shed / cancelled / failed / drained from ``request_failed`` /
-``shed`` / ``serve_drain`` records), an availability line, and drain
-rendering — a drained stream shows what the server finished, evicted
-and handed back before exiting 75.
+timeout / shed / cancelled / failed / drained / rejected from
+``request_failed`` / ``shed`` / ``serve_drain`` records), an
+availability line, and drain rendering — a drained stream shows what
+the server finished, evicted and handed back before exiting 75.
+
+Schema v7 adds the block-paged KV line: block utilization (mean/max
+held blocks vs the arena), block-accurate ``kv_waste_pct``, the
+prefix-sharing hit rate and copy-on-write copy count.
 
 Thin client of the obs schema (obs/schema.py):
 
@@ -127,6 +131,20 @@ def report(path: str, out=sys.stdout) -> int:
               f"{summary['output_tokens']} token(s)  "
               f"{summary['tokens_per_sec']} tok/s aggregate  "
               f"occupancy {summary.get('occupancy', '?')}", file=out)
+        if "blocks_total" in summary:
+            blk = summary.get("blocks_live") or {}
+            total = summary["blocks_total"]
+            mean = blk.get("mean", 0.0)
+            util = 100.0 * mean / total if total else 0.0
+            print(f"kv blocks: mean {mean:.1f} / max "
+                  f"{blk.get('max', 0):.0f} of {total} "
+                  f"x{summary.get('block_size', '?')} tokens "
+                  f"({util:.1f}% util)  waste "
+                  f"{summary.get('kv_waste_pct', '?')}%  "
+                  f"prefix_hit_rate "
+                  f"{summary.get('prefix_hit_rate', '?')}  "
+                  f"cow_copies {summary.get('cow_copies', '?')}",
+                  file=out)
         if "availability" in summary:
             print(f"serve_summary availability: "
                   f"{summary['availability']}", file=out)
