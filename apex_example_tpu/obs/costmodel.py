@@ -72,6 +72,12 @@ from apex_example_tpu.utils.flops import V5E_BF16_PEAK_FLOPS
 # --measured-bw default).  Spec sheet HBM bw for v5e is 819 GB/s.
 MEASURED_HBM_GBPS = 375.0
 
+# Retention cap for the per-function StableHLO text kept for the
+# recompile-cause diff: past this size graftlint's diff_lowerings
+# refuses to diff anyway (its MAX_DIFF_CHARS), so holding multi-MB
+# serve-step lowerings in a long-lived process would buy nothing.
+_MAX_HLO_RETAIN_CHARS = 2_000_000
+
 # CompiledMemoryStats attribute -> cost_model field.
 _MEMORY_FIELDS = (
     ("argument_size_in_bytes", "argument_bytes"),
@@ -118,6 +124,13 @@ def _first_computation(analysis) -> Dict[str, float]:
     return dict(analysis) if analysis else {}
 
 
+def text_hash(text: str) -> str:
+    """The lowering-hash formula over already-extracted StableHLO text
+    (one place, shared with the instrumented AOT path that also keeps
+    the text for the recompile-cause diff)."""
+    return "sha256:" + hashlib.sha256(text.encode()).hexdigest()[:32]
+
+
 def lowering_hash(lowered) -> Optional[str]:
     """Stable identity of the lowered program (StableHLO text digest):
     two compilations with the same hash compiled the same program — the
@@ -126,7 +139,7 @@ def lowering_hash(lowered) -> Optional[str]:
         text = lowered.as_text()
     except Exception:
         return None
-    return "sha256:" + hashlib.sha256(text.encode()).hexdigest()[:32]
+    return text_hash(text)
 
 
 def compile_counts(records) -> Dict[str, int]:
@@ -160,6 +173,14 @@ class CostModel:
         self._counts: Dict[str, int] = {}
         self._wrapped: Dict[Tuple[str, int], "InstrumentedFn"] = {}
         self.events: List[Dict[str, Any]] = []
+        # Last StableHLO text PER NAME (not per wrapper: re-instrumenting
+        # a name with a fresh fn object shares the per-name compile
+        # count, so it must share the diff baseline too — the second
+        # compile of a name always gets its recompile_cause).  Texts
+        # past the retention cap are dropped; the name is remembered so
+        # oversized recompiles still get an honest (diff-less) cause.
+        self._last_hlo: Dict[str, str] = {}
+        self._hlo_dropped: Dict[str, bool] = {}
 
     # ------------------------------------------------------- wrapping
 
@@ -180,6 +201,29 @@ class CostModel:
     def compile_counts(self) -> Dict[str, int]:
         return dict(self._counts)
 
+    def recompile_cause(self, name: str,
+                        text: Optional[str]) -> Optional[str]:
+        """Diff ``name``'s new lowering text against the retained
+        previous one (None on the first compile of a name), then roll
+        the retention forward."""
+        if text is None:
+            return None
+        prev = self._last_hlo.get(name)
+        cause = None
+        if prev is not None:
+            cause = _recompile_cause(prev, text)
+        elif self._hlo_dropped.get(name):
+            cause = ("previous lowering exceeded the retention cap "
+                     f"({_MAX_HLO_RETAIN_CHARS} chars) — no diff; "
+                     "compare lowering_hash values instead")
+        if len(text) > _MAX_HLO_RETAIN_CHARS:
+            self._last_hlo.pop(name, None)
+            self._hlo_dropped[name] = True
+        else:
+            self._last_hlo[name] = text
+            self._hlo_dropped[name] = False
+        return cause
+
     # ------------------------------------------------------- emission
 
     def _write(self, rec: Dict[str, Any]) -> None:
@@ -188,7 +232,8 @@ class CostModel:
             self.sink.write(rec)
 
     def on_compile(self, name: str, *, compile_ms: float, lower_ms: float,
-                   lhash: Optional[str]) -> None:
+                   lhash: Optional[str],
+                   recompile_cause: Optional[str] = None) -> None:
         self._counts[name] = self._counts.get(name, 0) + 1
         rec: Dict[str, Any] = {
             "record": "compile_event",
@@ -201,6 +246,11 @@ class CostModel:
         }
         if lhash:
             rec["lowering_hash"] = lhash
+        if recompile_cause:
+            # schema v8: the recompile-regression gate's diagnosis — the
+            # first structurally divergent op between this lowering and
+            # the previous one for the same name (graftlint's HLO diff).
+            rec["recompile_cause"] = recompile_cause
         if self.run_id:
             rec["run_id"] = self.run_id
         if self.registry is not None:
@@ -370,11 +420,39 @@ class InstrumentedFn:
                 "compile_event/cost_model records for it",
                 self.name, type(e).__name__, e)
             return None
-        lhash = lowering_hash(lowered)
+        text: Optional[str] = None
+        try:
+            text = lowered.as_text()
+        except Exception:
+            pass
+        lhash = text_hash(text) if text is not None else None
+        # Per-NAME diff baseline on the CostModel: the compile ordinal
+        # is per name, so the diagnosis must be too.
+        cause = self._cm.recompile_cause(self.name, text)
         self._cm.on_compile(self.name, compile_ms=(t2 - t1) * 1e3,
-                            lower_ms=(t1 - t0) * 1e3, lhash=lhash)
+                            lower_ms=(t1 - t0) * 1e3, lhash=lhash,
+                            recompile_cause=cause)
         self._cm.on_cost(self.name, compiled, lhash)
         return compiled
+
+
+def _recompile_cause(prev_text: str, new_text: str) -> Optional[str]:
+    """Name the first divergent op between two lowerings of one step
+    (the graftlint HLO diff, jax-free text analysis).  Degrades to None
+    when the linter package is not importable — the tally still lands,
+    only the diagnosis is lost."""
+    try:
+        from tools.graftlint.hlo import diff_lowerings
+    except Exception:
+        return None
+    try:
+        diff = diff_lowerings(prev_text, new_text)
+    except Exception:  # pragma: no cover — diagnosis must never crash
+        return None
+    if diff is None:
+        return ("lowerings structurally identical — this recompile is "
+                "a dispatch-cache miss, not a program change")
+    return str(diff["summary"])
 
 
 # ------------------------------------------------------ default instance

@@ -161,14 +161,14 @@ class JsonlSink:
         self.path = path
         self.all_ranks = all_ranks
         self._rank = rank
-        self._fh = None
-        self._opened = False
+        self._fh = None                         # guarded-by: _lock
+        self._opened = False                    # guarded-by: _lock
         # Reentrant: the flight recorder's signal handler may interrupt the
         # main thread inside write() and write its crash_dump from the same
         # thread; the watchdog thread contends cross-thread.  Records stay
         # intact either way because each lands as ONE fh.write() call.
         self._lock = threading.RLock()
-        self.records_written = 0
+        self.records_written = 0                # guarded-by: _lock
 
     def _resolve_rank(self) -> int:
         if self._rank is None:

@@ -133,6 +133,21 @@ live bytes; the dense layout's fixed full-page reservation measured
 ~92% on the smoke workload, the paged layout <= 40%).  v7 is once more
 a strict superset: every v1–v6 stream validates unchanged.
 
+Version 8 adds the static-analysis stratum's one record field
+(tools/graftlint; ISSUE 9) — no new record types:
+
+``recompile_cause``  on ``compile_event``, set from the second compile
+                     of one name onward: the first structurally
+                     divergent op between this lowering and the one it
+                     replaced (graftlint's jax-free StableHLO diff), or
+                     an explicit note that the programs are identical
+                     (a dispatch-cache miss, not a graph change).  The
+                     ``cost_report --fail-on-recompile`` gate prints it,
+                     turning the recompile tally into a diagnosis.
+
+v8 is once more a strict superset: every v1–v7 stream validates
+unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -144,7 +159,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -432,6 +447,10 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "n_compiles": int,       #   the XLA backend compile alone)
         "lowering_hash": str,    # StableHLO digest: the compile-cache
         "platform": str,         #   identity recompile forensics join on
+        # v8: the recompile-cause diff (graftlint HLO stratum) — only on
+        # n_compiles >= 2 events: the first divergent op vs the previous
+        # lowering of the same name.
+        "recompile_cause": str,
     },
     "cost_model": {
         "run_id": str,

@@ -60,12 +60,12 @@ class StallWatchdog:
             else min(max(self.deadline_s / 4.0, 0.01), 1.0)
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._last = clock()
-        self._last_step = 0
-        self._fired = False
-        self._tracing = False
-        self._trace_used = False
-        self.stall_count = 0
+        self._last = clock()                    # guarded-by: _lock
+        self._last_step = 0                     # guarded-by: _lock
+        self._fired = False                     # guarded-by: _lock
+        self._tracing = False                   # guarded-by: _lock
+        self._trace_used = False                # watchdog thread only
+        self.stall_count = 0                    # guarded-by: _lock
         self._thread = threading.Thread(target=self._run,
                                         name="apex-stall-watchdog",
                                         daemon=True)
@@ -104,11 +104,16 @@ class StallWatchdog:
                 fire = gap >= self.deadline_s and not self._fired
                 if fire:
                     self._fired = True
+                    # Count under the SAME lock hold as the fire
+                    # decision: the watchdog thread writes this while
+                    # the main thread polls it, and the unguarded
+                    # increment was graftlint's first lock-discipline
+                    # true positive (ISSUE 9).
+                    self.stall_count += 1
             if fire:
                 self._emit_stall(gap, step)
 
     def _emit_stall(self, gap: float, step: int) -> None:
-        self.stall_count += 1
         rec = {"record": "stall",
                "time": metrics_lib.now(),
                "seconds_since_step": round(gap, 3),

@@ -3,7 +3,8 @@
 Pure stdlib ON PURPOSE — the supervisor's job is to restart training on
 hosts where training just died, including deaths caused by a broken jax
 install, so it must not import jax (or anything that transitively does;
-``tests/test_diag.py`` enforces this with a poisoned ``jax`` module).
+graftlint's static ``jax-free`` rule proves this over the whole import
+closure — tools/graftlint/imports.py, ISSUE 9).
 ``tools/supervise.py`` is the CLI; it loads this file by path so even
 the package ``__init__`` (which pulls jax) is never imported.
 
@@ -69,7 +70,7 @@ from typing import Any, Dict, List, Optional
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) and
 # resilience/preemption.py (EX_TEMPFAIL) — this module must not import
 # either (jax-free contract).
-SCHEMA = 7
+SCHEMA = 8
 EX_TEMPFAIL = 75
 
 

@@ -201,14 +201,14 @@ class RequestQueue:
                              f"got {shed_policy!r}")
         self.max_pending = max_pending
         self.shed_policy = shed_policy
-        self._q: deque = deque()
+        self._q: deque = deque()                # guarded-by: _lock
         self._lock = threading.Lock()
-        self._closed = False
+        self._closed = False                    # guarded-by: _lock
         # Sticky: set once any deadline-carrying request is submitted,
         # so the per-tick expire() scan is skipped entirely on the
         # (default) deadline-free path — a 20k-request backlog must not
         # pay an O(n) no-op scan under the lock every engine tick.
-        self._has_deadlines = False
+        self._has_deadlines = False             # guarded-by: _lock
 
     def submit(self, request: Request) -> None:
         with self._lock:
@@ -262,9 +262,15 @@ class RequestQueue:
         """Arrived-but-unadmitted requests whose deadline has passed at
         tick ``step`` — removed and returned so the engine can terminate
         them with status "timeout" without ever admitting them."""
-        if not self._has_deadlines:
-            return []
         with self._lock:
+            # Sticky-flag fast path INSIDE the lock: the flag is set by
+            # producer threads (submit) and read here by the engine —
+            # graftlint's lock-discipline rule caught the original
+            # unguarded read (ISSUE 9).  The O(n) scan is still skipped
+            # on the deadline-free path; the uncontended acquire is the
+            # whole cost.
+            if not self._has_deadlines:
+                return []
             dead = [r for r in self._q
                     if r.arrived(step) and r.expired(step, now)]
             if dead:

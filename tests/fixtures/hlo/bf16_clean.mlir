@@ -1,0 +1,21 @@
+// graftlint HLO fixture (ISSUE 9): a bf16-clean two-matmul forward.
+// Recorded shape: jax.jit(mlp).lower(...) .as_text() for a toy
+// [8,16] @ [16,32] @ [32,8] MLP under an AMP-O2 (bf16 compute)
+// policy — params arrive f32 (master weights) and are converted DOWN
+// to bf16 before every dot; only the loss-side convert goes back up.
+// The upcast-leak rule must stay QUIET here; bf16_f32_leak.mlir is the
+// same program with the second matmul leaked to f32, and the
+// recompile-cause diff between the two names that dot_general.
+module @jit_mlp attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<16x32xf32>, %arg1: tensor<32x8xf32>, %arg2: tensor<8x16xbf16>) -> (tensor<8x8xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.convert %arg0 : (tensor<16x32xf32>) -> tensor<16x32xbf16>
+    %1 = stablehlo.dot_general %arg2, %0, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x16xbf16>, tensor<16x32xbf16>) -> tensor<8x32xbf16>
+    %cst = stablehlo.constant dense<0.000000e+00> : tensor<bf16>
+    %2 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<bf16>) -> tensor<8x32xbf16>
+    %3 = stablehlo.maximum %1, %2 : tensor<8x32xbf16>
+    %4 = stablehlo.convert %arg1 : (tensor<32x8xf32>) -> tensor<32x8xbf16>
+    %5 = stablehlo.dot_general %3, %4, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x32xbf16>, tensor<32x8xbf16>) -> tensor<8x8xbf16>
+    %6 = stablehlo.convert %5 : (tensor<8x8xbf16>) -> tensor<8x8xf32>
+    return %6 : tensor<8x8xf32>
+  }
+}

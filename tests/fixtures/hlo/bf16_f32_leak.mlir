@@ -1,0 +1,19 @@
+// graftlint HLO fixture (ISSUE 9): the SEEDED f32 leak.
+// Identical program to bf16_clean.mlir except the second matmul: the
+// relu output is converted UP to f32 and the dot_general runs wide —
+// the exact signature of an AMP policy miss (an op class left out of
+// the cast tables, or an fp32 residual joining the MXU path).  The
+// upcast-leak rule must FIRE on the f32 dot_general, and
+// diff_lowerings(clean, leak) must name it (first divergent op).
+module @jit_mlp attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<16x32xf32>, %arg1: tensor<32x8xf32>, %arg2: tensor<8x16xbf16>) -> (tensor<8x8xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.convert %arg0 : (tensor<16x32xf32>) -> tensor<16x32xbf16>
+    %1 = stablehlo.dot_general %arg2, %0, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x16xbf16>, tensor<16x32xbf16>) -> tensor<8x32xbf16>
+    %cst = stablehlo.constant dense<0.000000e+00> : tensor<bf16>
+    %2 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<bf16>) -> tensor<8x32xbf16>
+    %3 = stablehlo.maximum %1, %2 : tensor<8x32xbf16>
+    %4 = stablehlo.convert %3 : (tensor<8x32xbf16>) -> tensor<8x32xf32>
+    %5 = stablehlo.dot_general %4, %arg1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x32xf32>, tensor<32x8xf32>) -> tensor<8x8xf32>
+    return %5 : tensor<8x8xf32>
+  }
+}

@@ -5,8 +5,8 @@
   records, the train step compiles EXACTLY once per process (the
   recompile-regression guard protecting the suite budget), the
   run_summary carries measured compile totals, and tools/cost_report.py
-  renders a roofline table from the stream (jax-free — the poisoned-jax
-  guard in test_diag.py covers the import side),
+  renders a roofline table from the stream (jax-free — graftlint's
+  static jax-free rule covers the import side),
 - the two models policing each other: XLA's cost_analysis() flops vs
   the utils/flops.py analytic 6N model for tiny GPT (compiled, riding
   the smoke run's one compile) and bert_tiny (lowered only — no new
@@ -163,8 +163,8 @@ def test_summary_measured_compile_replaces_estimate(gpt_cost_run, capsys):
 
 def test_cost_report_renders_roofline_table(gpt_cost_run, capsys):
     """tools/cost_report.py joins cost_model vs measured step times into
-    the roofline table (jax-free import is guarded by test_diag's
-    poisoned-jax test; here we check the rendering contract)."""
+    the roofline table (jax-free import is proven by graftlint's static
+    rule; here we check the rendering contract)."""
     report = _load_tool("cost_report")
     assert report.main([gpt_cost_run]) == 0
     out = capsys.readouterr().out
@@ -193,35 +193,85 @@ def test_cost_report_flags_recompiles(tmp_path, capsys):
 
 # ------------------------------------ the models police each other
 
-def test_flops_cross_check_bert_lowered_no_compile():
-    """bert_tiny's cross-check stops at LOWERING (hlo cost analysis on
-    the unoptimized module — no backend compile, so the suite pays
-    tracing only): same [1.0, 2.0] contract band as the compiled GPT
-    check (measured ratio ~1.16)."""
+BERT_BS, BERT_SEQ = 8, 16
+
+
+@pytest.fixture(scope="module")
+def bert_o0_lowered():
+    """ONE lowered (never compiled) bert_tiny O0 train step per module:
+    the flops cross-check and the live upcast-leak smoke share the
+    single trace, so the suite pays tracing once and compiling never."""
     from apex_example_tpu import amp
     from apex_example_tpu.data import mlm_batch
     from apex_example_tpu.engine import create_train_state, make_train_step
     from apex_example_tpu.models.bert import bert_tiny
     from apex_example_tpu.optim import FusedLAMB
+
     from apex_example_tpu.workloads import mlm_loss
 
     policy, scaler = amp.initialize("O0")
     model = bert_tiny()
     opt = FusedLAMB(lr=1e-3)
-    bs, seq = 8, 16
     V = model.vocab_size
-    ids, labels, w = mlm_batch(jnp.asarray(0), batch_size=bs, seq_len=seq,
-                               vocab_size=V, mask_token_id=V - 1, seed=0)
+    ids, labels, w = mlm_batch(jnp.asarray(0), batch_size=BERT_BS,
+                               seq_len=BERT_SEQ, vocab_size=V,
+                               mask_token_id=V - 1, seed=0)
     batch = (ids, (labels, w))
     state = create_train_state(jax.random.PRNGKey(0), model, opt, ids[:1],
                                policy, scaler, train_kwargs={})
     step = jax.jit(make_train_step(model, opt, policy, loss_fn=mlm_loss,
                                    compute_accuracy=False))
-    lowered = step.lower(state, batch)
+    return model, step.lower(state, batch)
+
+
+def test_flops_cross_check_bert_lowered_no_compile(bert_o0_lowered):
+    """bert_tiny's cross-check stops at LOWERING (hlo cost analysis on
+    the unoptimized module — no backend compile, so the suite pays
+    tracing only): same [1.0, 2.0] contract band as the compiled GPT
+    check (measured ratio ~1.16)."""
+    model, lowered = bert_o0_lowered
     cost = costmodel._first_computation(lowered.cost_analysis())
-    analytic = model_train_flops_per_token(model, seq) * bs * seq
+    analytic = model_train_flops_per_token(model, BERT_SEQ) \
+        * BERT_BS * BERT_SEQ
     ratio = cost["flops"] / analytic
     assert 1.0 <= ratio <= 2.0, (cost["flops"], analytic, ratio)
+
+
+@pytest.mark.lint
+def test_upcast_leak_rule_live_smoke_bert_amp_o2(bert_o0_lowered):
+    """The live HLO smoke (ISSUE 9): graftlint's upcast-leak rule over
+    REAL lowerings, not just the checked-in fixtures.
+
+    (a) bert_tiny under AMP O2 (bf16 compute, fp32 masters), forward
+    lowered only — abstract params via eval_shape, no init, no backend
+    compile: every one of its dot_generals must run bf16, so the rule
+    stays QUIET on the policy the program claims.
+    (b) the module's shared O0 train-step lowering is an f32 program:
+    linted against a CLAIMED bf16 policy it must fire on the wide dots
+    — the live seeded leak, at zero extra trace cost."""
+    from apex_example_tpu import amp
+    from apex_example_tpu.models.bert import bert_tiny
+    from tools.graftlint.hlo import host_transfer, ops, upcast_leak
+
+    policy, _ = amp.initialize("O2")
+    md = amp.module_dtypes(policy)
+    model = bert_tiny(dtype=md.compute, param_dtype=md.param,
+                      ln_dtype=md.ln_io, softmax_dtype=md.softmax)
+    ids = jnp.zeros((BERT_BS, BERT_SEQ), jnp.int32)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0), ids,
+                            train=False)
+    fwd = jax.jit(lambda p, x: model.apply(p, x, train=False))
+    text = fwd.lower(params, ids).as_text()
+    dots = [op for _, op, _ in ops(text) if op == "dot_general"]
+    assert len(dots) >= 10                  # a real program, not a stub
+    assert upcast_leak(text, "bf16") == []  # O2 compute is leak-free
+    assert host_transfer(text) == []        # pure device computation
+
+    _, lowered = bert_o0_lowered
+    leaks = upcast_leak(lowered.as_text(), "bf16")
+    assert leaks, "an all-f32 program must trip a claimed-bf16 policy"
+    assert all(f.rule == "hlo-upcast-leak" for f in leaks)
+    assert any("dot_general" in f.message for f in leaks)
 
 
 def test_bytes_cross_check_byte_accounting_chain():
@@ -280,10 +330,33 @@ def test_recompile_detection_and_registry(tmp_path):
     assert [e["n_compiles"] for e in events] == [1, 2]
     # distinct programs => distinct lowering hashes
     assert events[0]["lowering_hash"] != events[1]["lowering_hash"]
+    # schema v8: the SECOND compile carries the recompile-cause diff
+    # (graftlint's HLO stratum names the first divergent op) — the
+    # first compile of a name never does.
+    assert "recompile_cause" not in events[0]
+    assert "first divergent op" in events[1]["recompile_cause"]
     snap = registry.snapshot()
     assert snap["compiles"] == 2
     assert snap["compile_time_ms"]["count"] == 2
     assert snap["compile_time_ms"]["sum"] > 0
+
+
+def test_recompile_cause_survives_reinstrumentation_same_name():
+    """The diff baseline is per NAME on the CostModel, not per wrapper:
+    re-instrumenting a name with a fresh fn object (generate() rebuilding
+    a closure) shares the compile count, so its first compile is the
+    name's SECOND — and must carry the recompile_cause diagnosis
+    (review regression)."""
+    cm = obs.CostModel()
+    f1 = cm.instrument("loop", jax.jit(lambda x: x + 1))
+    f1(jnp.ones((4,)))
+    f2 = cm.instrument("loop", jax.jit(lambda x: x * 3))   # fresh fn
+    assert f2 is not f1
+    f2(jnp.ones((4,)))
+    assert cm.compile_counts == {"loop": 2}
+    events = [e for e in cm.events if e["record"] == "compile_event"]
+    assert "recompile_cause" not in events[0]
+    assert "first divergent op" in events[1]["recompile_cause"]
 
 
 def test_weak_type_mismatch_never_escapes_typeerror():

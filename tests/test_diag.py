@@ -8,14 +8,15 @@ obs/numerics.py, tools/fleet_report.py; ISSUE 2):
 - overflow provenance: NaN-injection naming the poisoned module,
 - fleet_report straggler / overflow-divergence detection,
 - metrics_lint --require-summary exit codes, telemetry_report abort
-  summaries, and the jax-free import guard for every tools/ thin client.
+  summaries.  (The jax-free guard for the tools/ thin clients is now
+  STATIC: graftlint's import-graph rule, tests/test_graftlint.py —
+  ISSUE 9 retired the per-tool poisoned-jax subprocess loop here.)
 
 Subprocess tests carry the ``diag`` marker (pytest.ini) so the crash-path
 suite is selectable with ``-m diag``; everything here rides tier-1.
 """
 
 import importlib.util
-import io
 import json
 import os
 import signal
@@ -612,89 +613,17 @@ def test_sigterm_mid_flight_yields_crash_dump(tmp_path):
 
 
 # ---------------------------------------- jax-free tools guard (diag)
-
-def _thin_clients():
-    """Every tools/*.py that does not import jax — the thin-client set
-    the guard applies to (new jax-free tools join automatically)."""
-    tools_dir = os.path.join(REPO, "tools")
-    out = []
-    for name in sorted(os.listdir(tools_dir)):
-        if not name.endswith(".py"):
-            continue
-        with open(os.path.join(tools_dir, name)) as fh:
-            src = fh.read()
-        if "import jax" not in src:
-            out.append(name[:-3])
-    return out
-
-
-@pytest.mark.diag
-def test_thin_clients_run_without_jax(tmp_path):
-    """The JSONL thin clients must run on hosts WITHOUT jax installed: a
-    poisoned jax module sits first on PYTHONPATH, so any import of jax
-    (direct or transitive) fails loudly."""
-    clients = _thin_clients()
-    # the diagnostics/telemetry/serving/resilience clients must be in the
-    # set — if one grew a jax import, that IS the regression this test
-    # catches.  supervise especially: the supervisor's whole job is to
-    # restart training on hosts where jax is broken (ISSUE 4).
-    for required in ("metrics_lint", "telemetry_report", "fleet_report",
-                     "serve_report", "supervise", "cost_report"):
-        assert required in clients, f"{required} now imports jax"
-
-    block = tmp_path / "block"
-    block.mkdir()
-    (block / "jax.py").write_text(
-        "raise ImportError('jax is blocked: tools/ thin clients must run "
-        "without jax installed')\n")
-    stream = tmp_path / "s.jsonl"
-    _write_stream(str(stream), [_header(), _step(1),
-                                {"record": "run_summary", "steps": 1,
-                                 "overflow_count": 0}])
-    serve_stream = tmp_path / "serve.jsonl"
-    _write_stream(str(serve_stream), [
-        _header(),
-        {"record": "request_complete", "time": 1.0, "request_id": "r-0",
-         "prompt_tokens": 4, "output_tokens": 6, "ttft_ms": 10.0,
-         "tpot_ms": 1.5, "finish_reason": "length", "slot": 0,
-         "queue_wait_ms": 2.0, "e2e_ms": 20.0},
-        {"record": "serve_summary", "time": 2.0, "requests": 1,
-         "output_tokens": 6, "tokens_per_sec": 50.0}])
-    cost_stream = tmp_path / "cost.jsonl"
-    _write_stream(str(cost_stream), [
-        _header(), _step(1, ms=3000.0), _step(2, ms=12.0), _step(3, ms=13.0),
-        {"record": "compile_event", "time": 0.5, "name": "train_step",
-         "compile_ms": 2900.0, "lower_ms": 500.0, "n_compiles": 1,
-         "lowering_hash": "sha256:ab", "platform": "cpu"},
-        {"record": "cost_model", "time": 0.5, "name": "train_step",
-         "flops": 8e7, "bytes_accessed": 2.7e7, "transcendentals": 1e5,
-         "argument_bytes": 1, "output_bytes": 2, "temp_bytes": 3,
-         "generated_code_bytes": None, "peak_flops": 197e12,
-         "hbm_gbps": 375.0, "arithmetic_intensity": 2.9,
-         "ridge_flops_per_byte": 525.3, "compute_ms": 0.0004,
-         "hbm_ms": 0.073, "analytic_min_ms": 0.073,
-         "roofline": "hbm-bound", "mfu_ceiling_pct": 0.55,
-         "lowering_hash": "sha256:ab"},
-        {"record": "run_summary", "steps": 3, "overflow_count": 0,
-         "compile_events": 1, "compile_ms_total": 2900.0}])
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(block) + os.pathsep + env.get("PYTHONPATH", "")
-    real_args = {"metrics_lint": [str(stream)],
-                 "telemetry_report": [str(stream)],
-                 "fleet_report": [str(stream)],
-                 "serve_report": [str(serve_stream)],
-                 # a full roofline join (cost_model x measured steps),
-                 # not just --help
-                 "cost_report": [str(cost_stream)],
-                 # a full supervise cycle (spawn child, wait, summarize)
-                 # with a trivial jax-free child — not just --help
-                 "supervise": ["--max-restarts", "0",
-                               "--metrics-jsonl", str(tmp_path / "sup.jsonl"),
-                               "--", sys.executable, "-c", "print('ok')"]}
-    for tool in clients:
-        argv = real_args.get(tool, ["--help"])
-        r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", f"{tool}.py")]
-            + argv, env=env, cwd=str(tmp_path), capture_output=True,
-            text=True, timeout=60)
-        assert r.returncode == 0, (tool, r.stdout[-500:], r.stderr[-1000:])
+#
+# RETIRED (ISSUE 9): the runtime poisoned-jax guard — one subprocess
+# per tools/ thin client with a broken ``jax`` module first on
+# PYTHONPATH — is replaced by graftlint's static ``jax-free`` rule: an
+# exhaustive transitive import-graph proof over the WHOLE tools/
+# directory plus resilience/supervisor.py and obs/schema.py, covering
+# every import edge rather than the code paths the smoke arguments
+# happened to execute, at AST-parse cost instead of ~20 s of
+# interpreter startups.  See tools/graftlint/imports.py and
+# tests/test_graftlint.py::test_jax_free_contract_covers_the_retired_
+# runtime_guard_set (which pins the same required-client list the
+# runtime guard asserted).  The tools' behavior (real args, real
+# streams) remains covered by their own in-process tests here and in
+# test_obs/test_costmodel/test_serve/test_resilience.

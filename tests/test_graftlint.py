@@ -1,0 +1,541 @@
+"""graftlint — the two-stratum static analysis pass (ISSUE 9).
+
+- positive AND negative fixture snippets for every source rule (each
+  rule must both fire and stay quiet),
+- the two recorded StableHLO fixtures (bf16-clean vs seeded f32 leak)
+  driving the HLO rules and the recompile-cause diff,
+- baseline / suppression mechanics and the CLI exit-code contract,
+- the acceptance gates: the repo itself is lint-clean at HEAD
+  (``--fail-on-new`` with the checked-in EMPTY baseline exits 0), the
+  jax-free contract set covers every thin client the retired runtime
+  poisoned-jax guard used to spawn subprocesses for, and
+  ``tools/ci_gate.py`` bundles graftlint + the recompile gate into one
+  passing command.
+
+Everything here is jax-free (the tool's own contract): no jax import,
+no subprocesses, no compiles — the whole module is AST/text analysis
+and must stay in the low single-digit seconds.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from tools import graftlint
+from tools.graftlint import hostsync, imports, locks, schema_rules
+from tools.graftlint import hlo as hlo_rules
+from tools.graftlint.base import (apply_baseline, load_baseline,
+                                  tree_from_sources, write_baseline)
+from tools.graftlint.cli import main as graftlint_main
+from tools.graftlint.cli import run_source_lint
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HLO_DIR = os.path.join(REPO, "tests", "fixtures", "hlo")
+CLEAN_MLIR = os.path.join(HLO_DIR, "bf16_clean.mlir")
+LEAK_MLIR = os.path.join(HLO_DIR, "bf16_f32_leak.mlir")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------- jax-free (rule a)
+
+_MINI_SCHEMA = 'REQUIRED = {"step": {"record": str}}\nOPTIONAL = {}\n'
+
+
+def test_jax_free_rule_fires_on_transitive_reach():
+    tree = tree_from_sources({
+        "tools/thin.py": "import helper\n",
+        "tools/helper.py": "from flax import linen\n",   # flax => jax
+    })
+    fs = imports.check(tree)
+    assert _rules(fs) == ["jax-free"]
+    # BOTH are violations: helper reaches flax directly (a flax import
+    # does not opt a tool out — only a direct jax/jaxlib import does),
+    # and thin reaches it transitively with the chain spelled out.
+    msgs = [f.message for f in fs]
+    assert any("tools/helper.py -> flax" in m for m in msgs)
+    assert any("tools/thin.py -> tools/helper.py -> flax" in m
+               for m in msgs)
+
+
+def test_jax_free_rule_package_init_counts_as_an_edge():
+    """Importing a submodule executes its package __init__: a clean
+    submodule under a jax-carrying __init__ still violates."""
+    tree = tree_from_sources({
+        "tools/thin.py": "from pkg.sub import helper\n",
+        "pkg/__init__.py": "import jax\n",
+        "pkg/sub/__init__.py": "",
+        "pkg/sub/helper.py": "import os\n",
+    })
+    fs = imports.check(tree)
+    assert len(fs) == 1 and "pkg/__init__.py" in fs[0].message
+
+
+def test_jax_free_rule_follows_relative_import_init_edges():
+    """A RELATIVE import executes the importing package's own __init__
+    chain: a jax import hiding in a subpackage __init__.py must be
+    reachable from a sibling module's ``from . import x`` (review
+    regression on the first cut of this rule)."""
+    tree = tree_from_sources({
+        "tools/pkg/__init__.py": "import jax\n",
+        "tools/pkg/mod.py": "from . import helper\n",
+        "tools/pkg/helper.py": "import os\n",
+    })
+    fs = imports.check(tree)
+    assert len(fs) == 1
+    assert "tools/pkg/mod.py -> tools/pkg/__init__.py -> jax" \
+        in fs[0].message
+
+
+def test_jax_free_rule_quiet_on_stdlib_and_guarded_imports():
+    tree = tree_from_sources({
+        "tools/thin.py": ("import json, os, sys\n"
+                          "try:\n    import jax\n"
+                          "except ImportError:\n    jax = None\n"),
+        "tools/jaxy.py": "import jax\n",     # direct import: opted OUT
+    })
+    assert imports.check(tree) == []
+
+
+def test_jax_free_rule_fallback_import_in_handler_is_a_hard_edge():
+    """Only the try-BODY import is runtime-guarded; the fallback import
+    in the except handler executes precisely on the jax-less host
+    (review regression: `except ImportError: import flax...` must not
+    be treated as soft)."""
+    tree = tree_from_sources({"tools/thin.py": """
+try:
+    import ujson as json
+except ImportError:
+    import flax.serialization as json
+"""})
+    fs = imports.check(tree)
+    assert len(fs) == 1 and "flax" in fs[0].message
+
+
+def test_jax_free_contract_covers_the_retired_runtime_guard_set():
+    """The static check replaces test_diag's poisoned-jax subprocess
+    loop: every thin client that loop spawned must be in the verified
+    contract set — a tool growing a direct jax import silently leaves
+    the contract, which IS the regression this assertion catches."""
+    tree = graftlint.load_tree()
+    contract = set(imports.contract_modules(tree))
+    for required in ("tools/metrics_lint.py", "tools/telemetry_report.py",
+                     "tools/fleet_report.py", "tools/serve_report.py",
+                     "tools/supervise.py", "tools/cost_report.py",
+                     "tools/ci_gate.py",
+                     "apex_example_tpu/resilience/supervisor.py",
+                     "apex_example_tpu/obs/schema.py"):
+        assert required in contract, f"{required} left the jax-free set"
+    # and graftlint must eat its own dogfood
+    assert "tools/graftlint/cli.py" in contract
+
+
+# ------------------------------------------- host-sync-in-step (rule b)
+
+def test_host_sync_fires_on_fetches_of_traced_values():
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA,
+                              "pkg/step.py": """
+import jax
+import numpy as np
+
+@jax.jit
+def step(state, batch):
+    loss = state.loss + batch.mean()
+    host = float(loss)
+    per_elem = loss.item()
+    arr = np.asarray(batch)
+    return host, per_elem, arr
+"""})
+    fs = hostsync.check(tree)
+    assert len(fs) == 3
+    assert all(f.rule == "host-sync-in-step" for f in fs)
+    assert {f.line for f in fs} == {8, 9, 10}
+
+
+def test_host_sync_quiet_on_static_metadata_and_closure_config():
+    """Negative space: shape/dtype metadata and factory closure config
+    are host-side statics — float()/bool() on them is fine (the
+    bert_pipeline ``with_aux=bool(moe)`` shape)."""
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA,
+                              "pkg/ok.py": """
+import jax
+
+def make_train_step(model, moe, lr):
+    def step(state, batch):
+        width = int(batch.shape[-1])
+        cfg = bool(moe)
+        rate = float(lr)
+        return state.apply(batch, width, cfg, rate)
+    return jax.jit(step)
+"""})
+    assert hostsync.check(tree) == []
+
+
+def test_host_sync_sees_factory_inner_functions():
+    """Functions defined inside a make_*step factory run under trace
+    even without a local jit call."""
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA,
+                              "pkg/factory.py": """
+def make_gpt_step(model):
+    def step(state, batch):
+        return int(state.loss)
+    return step
+"""})
+    fs = hostsync.check(tree)
+    assert len(fs) == 1 and fs[0].line == 4
+
+
+def test_jit_in_loop_fires_and_module_level_stays_quiet():
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA,
+                              "pkg/loop.py": """
+import jax
+
+eval_fn = jax.jit(lambda p, b: p + b)      # once per import: fine
+
+def serve(ticks):
+    for t in ticks:
+        def body(x):
+            return x + 1
+        f = jax.jit(body)                  # fresh hash per tick
+        g = jax.jit(lambda v: v * 2)       # fresh hash per tick
+        f(t); g(t)
+"""})
+    fs = hostsync.check(tree)
+    assert _rules(fs) == ["jit-in-loop"]
+    assert {f.line for f in fs} == {10, 11}
+
+
+# ----------------------------------------------- lock-discipline (c)
+
+_LOCKED = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []            # guarded-by: _lock
+        self.closed = False         # guarded-by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items), self.closed
+"""
+
+
+def test_lock_discipline_quiet_when_every_touch_holds_the_lock():
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA, "pkg/box.py": _LOCKED})
+    assert locks.check(tree) == []
+
+
+def test_lock_discipline_fires_on_unguarded_touch_and_cross_class():
+    src = _LOCKED + """
+    def size(self):
+        return len(self._items)     # unguarded read
+
+def poke(box):
+    return box._items               # cross-class access
+"""
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA, "pkg/box.py": src})
+    fs = locks.check(tree)
+    assert len(fs) == 2
+    assert "Box.size touches self._items" in fs[0].message
+    assert "outside its class" in fs[1].message
+
+
+def test_lock_discipline_cross_class_needs_the_class_name_in_file():
+    """A bare private-attr name collision in an unrelated file must not
+    fire: the cross-class check requires the declaring class to be
+    referenced by name in the accessing file (review precision fix)."""
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": _MINI_SCHEMA,
+        "pkg/box.py": _LOCKED,
+        "pkg/other.py": """
+def close_channel(chan):
+    return chan._items          # unrelated object, declaring class absent
+"""})
+    assert locks.check(tree) == []
+
+
+def test_lock_discipline_ignore_pragma_and_init_exemption():
+    src = _LOCKED + """
+    def fast_size(self):
+        return len(self._items)     # graftlint: ignore[lock-discipline]
+"""
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA, "pkg/box.py": src})
+    assert locks.check(tree) == []   # __init__ stores + pragma both quiet
+
+
+# ---------------------------------------------- schema-emission (d)
+
+_SCHEMA_SRC = """
+REQUIRED = {
+    "step": {"record": str, "loss": float},
+    "run_summary": {"record": str, "steps": int},
+}
+OPTIONAL = {
+    "step": {"lr": float, "grad_norm": float},
+    "run_summary": {"aborted": bool},
+}
+"""
+
+
+def test_schema_emission_quiet_on_valid_emitters():
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": _SCHEMA_SRC,
+        "pkg/emit.py": """
+def emit(sink, values):
+    rec = {"record": "step", "loss": 0.5}
+    for key in ("lr", "grad_norm"):
+        if key in values:
+            rec[key] = values[key]
+    sink.write(rec)
+    sink.write({"record": "run_summary", "steps": 3, "aborted": True})
+"""})
+    assert schema_rules.check(tree) == []
+
+
+def test_schema_emission_fires_on_drift():
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": _SCHEMA_SRC,
+        "pkg/emit.py": """
+def emit(sink):
+    rec = {"record": "step", "loss": 0.5}
+    rec["undeclared"] = 1            # new field without a schema bump
+    sink.write(rec)
+    sink.write({"record": "run_summary"})          # missing required
+    sink.write({"record": "mystery", "x": 1})      # unknown type
+"""})
+    msgs = [f.message for f in schema_rules.check(tree)]
+    assert len(msgs) == 3
+    assert any("undeclared" in m and "bump the schema" in m for m in msgs)
+    assert any("never sets required field 'steps'" in m for m in msgs)
+    assert any("unknown record type 'mystery'" in m for m in msgs)
+
+
+def test_schema_emission_variable_rebinding_does_not_cross_contaminate():
+    """Two records sharing one variable name in a function: field
+    assignments after the rebinding belong to the SECOND record only
+    (review regression — the fold is scoped to the binding's live
+    range)."""
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": _SCHEMA_SRC,
+        "pkg/emit.py": """
+def emit(sink):
+    rec = {"record": "step", "loss": 0.5}
+    sink.write(rec)
+    rec = {"record": "run_summary", "steps": 2}
+    rec["aborted"] = True       # must not leak into the 'step' record
+    sink.write(rec)
+"""})
+    assert schema_rules.check(tree) == []
+
+
+def test_schema_emission_dynamic_builders_skip_missing_check_only():
+    """A ``**``-built record (bench.py shape) can't be proven complete
+    statically — but its literal keys are still checked."""
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": _SCHEMA_SRC,
+        "pkg/emit.py": """
+def emit(sink, extra):
+    sink.write({"record": "step", "bogus": 1, **extra})
+"""})
+    msgs = [f.message for f in schema_rules.check(tree)]
+    assert len(msgs) == 1 and "bogus" in msgs[0]
+
+
+# -------------------------------------------------- HLO stratum rules
+
+@pytest.fixture(scope="module")
+def clean_text():
+    with open(CLEAN_MLIR) as fh:
+        return fh.read()
+
+
+@pytest.fixture(scope="module")
+def leak_text():
+    with open(LEAK_MLIR) as fh:
+        return fh.read()
+
+
+def test_upcast_leak_fixture_pair(clean_text, leak_text):
+    assert hlo_rules.upcast_leak(clean_text, "bf16") == []
+    fs = hlo_rules.upcast_leak(leak_text, "bf16")
+    assert len(fs) == 1
+    assert fs[0].rule == "hlo-upcast-leak"
+    assert "dot_general" in fs[0].message and "f32" in fs[0].message
+    # under an f32 policy the same program is legal
+    assert hlo_rules.upcast_leak(leak_text, "f32") == []
+
+
+def test_host_transfer_rule(clean_text):
+    assert hlo_rules.host_transfer(clean_text) == []
+    poisoned = clean_text.replace(
+        "return %6 : tensor<8x8xf32>",
+        '%7 = "stablehlo.outfeed"(%6, %tok) : (tensor<8x8xf32>, '
+        "!stablehlo.token) -> !stablehlo.token\n    "
+        "return %6 : tensor<8x8xf32>")
+    fs = hlo_rules.host_transfer(poisoned)
+    assert len(fs) == 1 and "outfeed" in fs[0].message
+    # custom_call @Sharding only fires when unsharded is expected
+    sharded = clean_text.replace(
+        "%3 = stablehlo.maximum %1, %2 : tensor<8x32xbf16>",
+        "%3 = stablehlo.custom_call @Sharding(%1) : "
+        "(tensor<8x32xbf16>) -> tensor<8x32xbf16>")
+    assert hlo_rules.host_transfer(sharded, allow_sharding=True) == []
+    fs = hlo_rules.host_transfer(sharded, allow_sharding=False)
+    assert len(fs) == 1 and "@Sharding" in fs[0].message
+
+
+def test_recompile_cause_diff_names_divergent_op(clean_text, leak_text):
+    diff = hlo_rules.diff_lowerings(clean_text, leak_text)
+    assert diff is not None
+    # the first structural divergence is the upcast convert feeding the
+    # wide dot — naming it IS the diagnosis
+    assert diff["op"] == "convert"
+    assert "first divergent op: convert" in diff["summary"]
+    # identical programs (modulo SSA numbering + comments) diff to None
+    renumbered = clean_text.replace("%5", "%55").replace("%6", "%66") \
+        .replace("// graftlint", "// renamed")
+    assert hlo_rules.diff_lowerings(clean_text, renumbered) is None
+
+
+def test_hlo_cli_exit_codes(capsys):
+    assert graftlint_main(["--hlo", CLEAN_MLIR]) == 0
+    assert graftlint_main(["--hlo", LEAK_MLIR]) == 1
+    assert graftlint_main(["--hlo", LEAK_MLIR, "--policy", "f32"]) == 0
+    assert graftlint_main(["--hlo-diff", CLEAN_MLIR, LEAK_MLIR]) == 1
+    assert graftlint_main(["--hlo-diff", CLEAN_MLIR, CLEAN_MLIR]) == 0
+    out = capsys.readouterr().out
+    assert "hlo-upcast-leak" in out
+    assert "first divergent op" in out
+
+
+# ------------------------------------------ baseline + CLI mechanics
+
+def test_baseline_roundtrip_and_fail_on_new(tmp_path):
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA,
+                              "pkg/bad.py": """
+import jax
+
+@jax.jit
+def step(state):
+    return float(state.loss)
+"""})
+    findings = []
+    for rule in (hostsync.check,):
+        findings += rule(tree)
+    assert len(findings) == 1
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, findings)
+    baseline = load_baseline(path)
+    assert len(baseline) == 1 and baseline[0].startswith(
+        "host-sync-in-step::pkg/bad.py::")
+    # identity is line-free: the same finding on a shifted line matches
+    apply_baseline(findings, baseline)
+    assert all(f.baselined for f in findings)
+
+
+def test_repo_is_lint_clean_at_head(capsys):
+    """The acceptance bar: the checked-in baseline is EMPTY and the
+    whole source stratum exits 0 — every violation the rules found when
+    they landed (the watchdog stall-counter race, the RequestQueue
+    deadline fast-path read) was fixed in this PR."""
+    baseline_path = os.path.join(REPO, "tools", "graftlint",
+                                 "baseline.json")
+    assert load_baseline(baseline_path) == []      # shipped empty
+    assert graftlint_main(["--fail-on-new"]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+
+def test_repo_json_output_parses(capsys):
+    assert graftlint_main(["--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"] == [] and data["failed"] is False
+
+
+def test_run_source_lint_reports_parse_errors():
+    tree = tree_from_sources({"apex_example_tpu/obs/schema.py":
+                              _MINI_SCHEMA,
+                              "pkg/broken.py": "def broken(:\n"})
+    fs = run_source_lint(tree)
+    assert [f.rule for f in fs] == ["parse-error"]
+
+
+# ------------------------------------------------- ci_gate (satellite)
+
+def test_ci_gate_bundles_both_gates(tmp_path, capsys):
+    """One CI command: graftlint --fail-on-new + cost_report
+    --fail-on-recompile.  A recompiling stream must fail the bundle and
+    surface the schema-v8 recompile_cause diagnosis."""
+    ci_gate = _load_tool("ci_gate")
+    clean = tmp_path / "clean.jsonl"
+    clean.write_text(json.dumps(
+        {"record": "compile_event", "time": 1.0, "name": "train_step",
+         "compile_ms": 10.0, "n_compiles": 1}) + "\n")
+    assert ci_gate.main(["--stream", str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "graftlint --fail-on-new: PASS" in out
+    assert "ci_gate: PASS" in out
+
+    recompiled = tmp_path / "re.jsonl"
+    with open(recompiled, "w") as fh:
+        for n in (1, 2):
+            rec = {"record": "compile_event", "time": float(n),
+                   "name": "train_step", "compile_ms": 10.0,
+                   "n_compiles": n}
+            if n == 2:
+                rec["recompile_cause"] = "first divergent op: convert"
+            fh.write(json.dumps(rec) + "\n")
+    assert ci_gate.main(["--stream", str(recompiled)]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out
+    assert "first divergent op: convert" in out    # diagnosis rendered
+
+    assert ci_gate.main(["--stream", str(tmp_path / "missing.jsonl")]) \
+        == 2
+    # usage errors stay 2 end-to-end (not collapsed into gate-failure 1)
+    assert ci_gate.main(["--baseline",
+                         str(tmp_path / "no_such_baseline.json")]) == 2
+
+
+def test_schema_v8_recompile_cause_validates():
+    """Thin-client schema check without importing the package: load
+    obs/schema.py by file path (the metrics_lint pattern)."""
+    spec = importlib.util.spec_from_file_location(
+        "schema_under_test",
+        os.path.join(REPO, "apex_example_tpu", "obs", "schema.py"))
+    schema = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(schema)
+    assert schema.SCHEMA_VERSION == 8
+    rec = {"record": "compile_event", "time": 1.0, "name": "f",
+           "compile_ms": 5.0, "n_compiles": 2,
+           "recompile_cause": "first divergent op: convert"}
+    assert schema.validate_record(rec) == []
+    assert schema.validate_record({**rec, "recompile_cause": 3})

@@ -1,0 +1,3 @@
+# Package marker so `python -m tools.graftlint` resolves.  Deliberately
+# empty: tools/*.py scripts are standalone CLIs (many are jax-free thin
+# clients loaded by file path) and must not gain import-time behavior.

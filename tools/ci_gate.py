@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""ci_gate: the repo's static gates behind ONE command.
+
+    python tools/ci_gate.py                         # graftlint only
+    python tools/ci_gate.py --stream run.jsonl      # + recompile gate
+    python tools/ci_gate.py --stream a.jsonl --stream b.jsonl
+
+Gates:
+
+1. **graftlint --fail-on-new** (tools/graftlint): the two-stratum
+   static analysis — jax-free import contracts, host-sync-in-step,
+   lock discipline, schema-emission consistency — against the checked-
+   in baseline (empty at HEAD).
+2. **cost_report --fail-on-recompile** (per ``--stream``): the compile-
+   once contract over recorded ``--cost-model`` telemetry, with the
+   schema-v8 ``recompile_cause`` diagnosis printed when a stream
+   carries one.
+
+Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
+errors (unreadable stream, bad baseline).  Thin-client contract: NO
+jax import, direct or transitive — this must run on the bare CI host
+(graftlint's own jax-free rule checks this file too).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)                     # sibling tools imports
+sys.path.insert(0, os.path.dirname(_HERE))    # `tools.graftlint` package
+
+from tools.graftlint.cli import main as graftlint_main  # noqa: E402
+
+
+def _load_cost_report():
+    spec = importlib.util.spec_from_file_location(
+        "cost_report", os.path.join(_HERE, "cost_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="one command for every static CI gate")
+    ap.add_argument("--stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a --cost-model telemetry stream to run the "
+                         "recompile gate over (repeatable)")
+    ap.add_argument("--baseline", default=None,
+                    help="graftlint baseline override")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict graftlint's reported findings")
+    args = ap.parse_args(argv)
+
+    worst = 0
+    lint_argv = ["--fail-on-new"] + args.paths
+    if args.baseline:
+        lint_argv += ["--baseline", args.baseline]
+    rc = graftlint_main(lint_argv)
+    print(f"ci_gate: graftlint --fail-on-new: "
+          f"{'PASS' if rc == 0 else 'FAIL'}")
+    worst = max(worst, rc)
+
+    if args.stream:
+        cost_report = _load_cost_report()
+        for stream in args.stream:
+            if not os.path.isfile(stream):
+                print(f"ci_gate: no such stream: {stream}",
+                      file=sys.stderr)
+                return 2
+            rc = cost_report.main([stream, "--fail-on-recompile"])
+            print(f"ci_gate: cost_report --fail-on-recompile "
+                  f"{stream}: {'PASS' if rc == 0 else 'FAIL'}")
+            worst = max(worst, rc)
+
+    print(f"ci_gate: {'PASS' if worst == 0 else 'FAIL'}")
+    return worst                 # 1 = gate failure, 2 = usage error
+
+
+if __name__ == "__main__":
+    sys.exit(main())

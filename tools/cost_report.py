@@ -149,6 +149,12 @@ def report(path: str, out=sys.stdout, fail_on_recompile: bool = False) -> int:
         hashes = {e.get("lowering_hash", "?") for e in evs}
         print(f"RECOMPILE {name}: {len(evs)} compilations "
               f"({len(hashes)} distinct program(s))", file=out)
+        for ev in evs:
+            # schema v8: the recompile-cause diff (graftlint HLO
+            # stratum) — the tally becomes a diagnosis.
+            if ev.get("recompile_cause"):
+                print(f"  cause (compile #{ev.get('n_compiles', '?')}): "
+                      f"{ev['recompile_cause']}", file=out)
     if not recompiled and compiles:
         print("no recompiles: every instrumented function compiled once",
               file=out)

@@ -1,0 +1,31 @@
+"""graftlint — the repo-custom two-stratum static analysis pass.
+
+**Source stratum** (pure ``ast``, never imports the code it checks):
+
+- ``jax-free`` — static transitive import-graph proof that the thin
+  clients (tools/*), the auto-resume supervisor and the telemetry
+  schema never reach jax (imports.py);
+- ``host-sync-in-step`` / ``jit-in-loop`` — device fetches inside
+  traced step functions; fresh-hash jit of lambdas/local defs in loops
+  (hostsync.py);
+- ``lock-discipline`` — ``# guarded-by: _lock`` attributes touched
+  outside ``with self._lock`` (locks.py);
+- ``schema-emission`` — every emitted record's field set checked
+  against obs/schema.py, so a new field cannot ship without a schema
+  bump (schema_rules.py).
+
+**HLO stratum** (StableHLO text, hlo.py): ``hlo-upcast-leak``,
+``hlo-host-transfer``, and the recompile-cause diff that names the
+first divergent op between two lowerings of one step.
+
+CLI: ``python -m tools.graftlint [--fail-on-new] [--json] [paths…]``
+(cli.py); ``tools/ci_gate.py`` bundles it with the cost_report
+recompile gate into one CI command.  Pure stdlib throughout — the
+linter runs wherever the checkout does, jax installed or not.
+"""
+
+from .base import (Finding, Tree, load_tree,  # noqa: F401
+                   tree_from_sources)
+from .cli import main, run_source_lint  # noqa: F401
+from .hlo import (diff_lowerings, host_transfer,  # noqa: F401
+                  lint_hlo_text, upcast_leak)

@@ -1,0 +1,205 @@
+"""graftlint CLI: the two-stratum static gate.
+
+    # source stratum over the checkout (the CI gate):
+    python -m tools.graftlint --fail-on-new
+
+    # human inspection, baseline management:
+    python -m tools.graftlint [paths...] [--json]
+    python -m tools.graftlint --write-baseline
+
+    # HLO stratum over a saved lowering:
+    python -m tools.graftlint --hlo step.mlir --policy bf16
+    python -m tools.graftlint --hlo-diff first.mlir second.mlir
+
+Exit codes: 0 clean (under ``--fail-on-new``: no finding outside the
+baseline), 1 findings (or a structural divergence for ``--hlo-diff``),
+2 usage / unreadable input.  ``--json`` emits one machine-readable
+object for either stratum.
+
+The default baseline is ``tools/graftlint/baseline.json`` — checked in,
+line-free keys, and EMPTY at HEAD: every violation the rules found when
+they landed was fixed in the same PR (ISSUE 9).  ``--write-baseline``
+regenerates it; the only legitimate reason for it to grow is importing
+a violation wholesale from an upstream merge, and then it should shrink
+again in the next PR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import hlo as hlo_rules
+from . import hostsync, imports, locks, schema_rules
+from .base import (Finding, Tree, apply_baseline, load_baseline,
+                   load_tree, repo_root, write_baseline)
+
+SOURCE_RULES = (imports.check, hostsync.check, locks.check,
+                schema_rules.check)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "tools", "graftlint",
+                        "baseline.json")
+
+
+def run_source_lint(tree: Optional[Tree] = None) -> List[Finding]:
+    """Every source-stratum rule over a loaded tree (the whole checkout
+    by default).  Parse failures surface as findings, and the broken
+    files are skipped by the rules rather than crashing them."""
+    tree = tree if tree is not None else load_tree()
+    findings: List[Finding] = list(tree.parse_findings())
+    for rule in SOURCE_RULES:
+        findings.extend(rule(tree))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def _filter_paths(findings: List[Finding],
+                  paths: List[str]) -> List[Finding]:
+    if not paths:
+        return findings
+    root = repo_root()
+    rel = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        rel.append(os.path.relpath(ap, root).replace(os.sep, "/")
+                   if ap.startswith(root) else p.replace(os.sep, "/"))
+    return [f for f in findings
+            if any(f.path == r or f.path.startswith(r.rstrip("/") + "/")
+                   for r in rel)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="repo-custom two-stratum static analysis "
+                    "(source AST rules + lowered-HLO lint)")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict REPORTED findings to these files/"
+                         "directories (rules still see the whole tree)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: tools/graftlint/"
+                         "baseline.json when present)")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit 0 when every finding is in the baseline "
+                         "(the CI gate semantics)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--hlo", metavar="FILE",
+                    help="lint one StableHLO text file instead of the "
+                         "source tree")
+    ap.add_argument("--policy", default="bf16",
+                    choices=sorted(hlo_rules.WIDE) + ["none"],
+                    help="--hlo: the AMP compute dtype the program "
+                         "should honor (default bf16; 'none' skips the "
+                         "upcast rule)")
+    ap.add_argument("--allow-host-transfer", action="store_true",
+                    help="--hlo: skip the host-transfer rule")
+    ap.add_argument("--expect-unsharded", action="store_true",
+                    help="--hlo: additionally flag custom_call "
+                         "@Sharding (single-device step programs)")
+    ap.add_argument("--hlo-diff", nargs=2, metavar=("A", "B"),
+                    help="name the first divergent op between two "
+                         "lowerings of the same step (exit 1 when they "
+                         "diverge)")
+    args = ap.parse_args(argv)
+
+    if args.hlo_diff:
+        return _run_hlo_diff(args)
+    if args.hlo:
+        return _run_hlo(args)
+    return _run_source(args)
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+    except OSError as e:
+        print(f"graftlint: cannot read {path}: {e}", file=sys.stderr)
+        return None
+
+
+def _run_hlo(args) -> int:
+    text = _read(args.hlo)
+    if text is None:
+        return 2
+    findings = hlo_rules.lint_hlo_text(
+        text, path=args.hlo,
+        compute_dtype=None if args.policy == "none" else args.policy,
+        expect_no_host_transfer=not args.allow_host_transfer,
+        allow_sharding=not args.expect_unsharded)
+    return _report(args, findings)
+
+
+def _run_hlo_diff(args) -> int:
+    a, b = (_read(p) for p in args.hlo_diff)
+    if a is None or b is None:
+        return 2
+    diff = hlo_rules.diff_lowerings(a, b)
+    if args.json:
+        print(json.dumps({"identical": diff is None, "diff": diff}))
+    elif diff is None:
+        print("lowerings are structurally identical (a recompile of "
+              "this pair is a cache failure, not a program change)")
+    else:
+        print(diff["summary"])
+    return 0 if diff is None else 1
+
+
+def _run_source(args) -> int:
+    findings = run_source_lint()
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        # Always write the WHOLE tree's findings: writing a
+        # path-filtered subset would silently drop every baselined
+        # violation outside the filter and fail the next CI run.
+        write_baseline(baseline_path, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+    findings = _filter_paths(findings, args.paths)
+    baseline: List[str] = []
+    if args.baseline or os.path.isfile(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftlint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    apply_baseline(findings, baseline)
+
+    new = [f for f in findings if not f.baselined]
+    failing = new if args.fail_on_new else findings
+    return _report(args, findings, failing)
+
+
+def _report(args, findings: List[Finding],
+            failing: Optional[List[Finding]] = None) -> int:
+    if failing is None:
+        failing = findings
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_json() for f in findings],
+            "new": sum(1 for f in findings if not f.baselined),
+            "baselined": sum(1 for f in findings if f.baselined),
+            "failed": bool(failing)}))
+    else:
+        for f in findings:
+            print(f.render())
+        n_base = sum(1 for f in findings if f.baselined)
+        tail = f" ({n_base} baselined)" if n_base else ""
+        print(f"graftlint: {len(findings)} finding(s){tail}")
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

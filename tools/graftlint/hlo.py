@@ -1,0 +1,195 @@
+"""The lowered-HLO stratum: lint what XLA was actually asked to compile.
+
+Source rules see what we WROTE; the StableHLO text ``obs/costmodel.py``
+already produces per instrumented step function (``lowered.as_text()``)
+shows what the tracer actually BUILT — dtype promotion, sharding
+custom-calls and host transfers all appear here first, before any
+runtime cost is paid ("Operator Fusion in XLA: Analysis and
+Evaluation" motivates reading fusion/dtype structure off the compiled
+graph; PAPERS.md).  Everything in this module is TEXT analysis — no
+jax import, so the rules run over checked-in fixture lowerings and
+over live ``--cost-model`` captures alike.
+
+Rules:
+
+- **upcast-leak** — wide-dtype (f32/f64) ``dot_general`` /
+  ``convolution`` ops in a program whose AMP policy says compute runs
+  in bf16/f16.  One leaked convert on an activation path silently
+  doubles the MXU and HBM cost of every downstream matmul; the f32 op
+  in the lowering is the first observable symptom.
+- **host-transfer-in-step** — ``infeed`` / ``outfeed`` / ``send`` /
+  ``recv`` (and optionally ``custom_call @Sharding``) inside a step
+  program that is expected to be a pure device computation: a host
+  round-trip per step caps throughput at PCIe/ICI latency.
+- **recompile-cause diff** — given two lowerings of the SAME step name
+  (``compile_counts`` > 1), name the first structurally divergent op.
+  This turns the ``--fail-on-recompile`` tally into a diagnosis:
+  obs/costmodel.py calls :func:`diff_lowerings` when it sees a repeat
+  compile and ships the result as ``recompile_cause`` on the second
+  ``compile_event`` record (schema v8).
+"""
+
+from __future__ import annotations
+
+import difflib
+import re
+from typing import Dict, List, Optional
+
+from .base import Finding
+
+RULE_UPCAST = "hlo-upcast-leak"
+RULE_HOST = "hlo-host-transfer"
+
+# `%3 = stablehlo.dot_general %1, %2 ...` and the generic
+# `%3 = "stablehlo.dot_general"(%1, %2) ...` form.
+_OP = re.compile(r'=\s*"?(?:stablehlo|mhlo|chlo)\.([A-Za-z_][\w]*)"?')
+_TENSOR_DTYPE = re.compile(r"tensor<(?:[0-9x?*\[\],]+x)?"
+                           r"([a-z][a-z0-9]*)(?:[,>])")
+_CUSTOM_TARGET = re.compile(r'custom_call\s*@(\w+)'
+                            r'|call_target_name\s*=\s*"(\w+)"')
+_SSA = re.compile(r"%[\w#.]+")
+_LOC = re.compile(r"\s*loc\(.*?\)\s*$")
+
+HEAVY_OPS = {"dot_general", "dot", "convolution", "conv"}
+HOST_OPS = {"infeed", "outfeed", "send", "recv"}
+WIDE = {"bf16": {"f32", "f64"}, "f16": {"f32", "f64"},
+        "f32": {"f64"}}
+
+
+def ops(text: str):
+    """(lineno, opname, line) for every HLO op line."""
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _OP.search(line)
+        if m:
+            yield i, m.group(1), line
+
+
+def line_dtypes(line: str) -> List[str]:
+    return _TENSOR_DTYPE.findall(line)
+
+
+def upcast_leak(text: str, compute_dtype: str = "bf16",
+                path: str = "<hlo>") -> List[Finding]:
+    """Wide heavy ops in a reduced-precision program.  ``compute_dtype``
+    is the AMP policy's MXU dtype (O1/O2 => bf16 on this repo)."""
+    wide = WIDE.get(compute_dtype)
+    if wide is None:
+        raise ValueError(f"unknown compute dtype {compute_dtype!r} "
+                         f"(expected one of {sorted(WIDE)})")
+    findings: List[Finding] = []
+    for lineno, opname, line in ops(text):
+        if opname not in HEAVY_OPS:
+            continue
+        hit = sorted(set(line_dtypes(line)) & wide)
+        if hit:
+            findings.append(Finding(
+                RULE_UPCAST, path, lineno,
+                f"{opname} runs in {'/'.join(hit)} inside a "
+                f"{compute_dtype} policy region — an upcast leaked "
+                "into the MXU path"))
+    return findings
+
+
+def host_transfer(text: str, path: str = "<hlo>",
+                  allow_sharding: bool = True) -> List[Finding]:
+    """Host-transfer ops in a program expected to stay on device.
+    ``allow_sharding=False`` additionally flags ``custom_call
+    @Sharding`` — a single-device step program has no business carrying
+    partitioning annotations (they mean a sharded value escaped into
+    the step's trace)."""
+    findings: List[Finding] = []
+    for lineno, opname, line in ops(text):
+        if opname in HOST_OPS:
+            findings.append(Finding(
+                RULE_HOST, path, lineno,
+                f"{opname} inside the step program — a host transfer "
+                "per step caps throughput at interconnect latency"))
+        elif opname == "custom_call" and not allow_sharding:
+            m = _CUSTOM_TARGET.search(line)
+            target = (m.group(1) or m.group(2)) if m else None
+            if target == "Sharding":
+                findings.append(Finding(
+                    RULE_HOST, path, lineno,
+                    "custom_call @Sharding inside a step expected to "
+                    "be unsharded — a partitioned value leaked into "
+                    "this trace"))
+    return findings
+
+
+# ------------------------------------------------- recompile-cause diff
+
+# Diffing two multi-MB serve-step lowerings line-by-line is quadratic
+# in the worst case; past this size the tally alone has to do.
+MAX_DIFF_CHARS = 2_000_000
+
+
+def _normalize(text: str) -> List[str]:
+    """Strip the noise that differs between two compiles of the SAME
+    program (SSA value numbering, location info, indentation) so the
+    diff surfaces structural divergence only."""
+    out = []
+    for line in text.splitlines():
+        line = _LOC.sub("", line.strip())
+        if not line or line.startswith("//"):   # MLIR comments are noise
+            continue
+        out.append(_SSA.sub("%_", line))
+    return out
+
+
+def diff_lowerings(a: str, b: str) -> Optional[Dict[str, object]]:
+    """First structurally divergent op between two lowerings.
+
+    Returns None when the programs are structurally identical (a
+    recompile with an identical program is a CACHE failure, not a graph
+    change — also worth knowing).  Otherwise a dict with the divergent
+    op name, both normalized lines (empty string for pure
+    insertion/deletion) and their 0-based indices in the normalized
+    listings.
+    """
+    if len(a) > MAX_DIFF_CHARS or len(b) > MAX_DIFF_CHARS:
+        return {"op": None, "a": "", "b": "",
+                "index_a": -1, "index_b": -1,
+                "summary": "lowerings too large to diff "
+                           f"(> {MAX_DIFF_CHARS} chars)"}
+    na, nb = _normalize(a), _normalize(b)
+    matcher = difflib.SequenceMatcher(a=na, b=nb, autojunk=False)
+    for tag, i1, i2, j1, j2 in matcher.get_opcodes():
+        if tag == "equal":
+            continue
+        line_a = na[i1] if i1 < i2 else ""
+        line_b = nb[j1] if j1 < j2 else ""
+        probe = line_b or line_a
+        m = _OP.search(probe)
+        op = m.group(1) if m else _first_word(probe)
+        summary = f"first divergent op: {op or '?'}"
+        if line_a and line_b:
+            summary += f" ({_clip(line_a)} vs {_clip(line_b)})"
+        elif line_b:
+            summary += f" (only in recompile: {_clip(line_b)})"
+        else:
+            summary += f" (dropped in recompile: {_clip(line_a)})"
+        return {"op": op, "a": line_a, "b": line_b,
+                "index_a": i1, "index_b": j1, "summary": summary}
+    return None
+
+
+def _first_word(line: str) -> Optional[str]:
+    m = re.search(r"[A-Za-z_][\w.]*", line)
+    return m.group(0) if m else None
+
+
+def _clip(line: str, n: int = 120) -> str:
+    return line if len(line) <= n else line[: n - 3] + "..."
+
+
+def lint_hlo_text(text: str, path: str = "<hlo>",
+                  compute_dtype: Optional[str] = "bf16",
+                  expect_no_host_transfer: bool = True,
+                  allow_sharding: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    if compute_dtype:
+        findings += upcast_leak(text, compute_dtype, path)
+    if expect_no_host_transfer:
+        findings += host_transfer(text, path,
+                                  allow_sharding=allow_sharding)
+    return findings

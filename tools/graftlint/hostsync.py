@@ -1,0 +1,304 @@
+"""host-sync-in-step: device fetches and fresh-hash jits in traced code.
+
+Two failure classes the runtime can't flag:
+
+- **Host sync inside a traced function.**  ``float(x)`` / ``int(x)`` /
+  ``bool(x)`` / ``x.item()`` / ``np.asarray(x)`` / ``jax.device_get(x)``
+  applied to a traced value inside a jitted function either raises a
+  ``TracerConversionError`` at trace time (the lucky case) or — via
+  ``io_callback``-style wrappers and numpy fallbacks — silently forces
+  a device round-trip per step.  The engine's ONE deliberate host sync
+  (fetching sampled tokens in serve/engine.py) happens OUTSIDE the
+  compiled step by design; nothing inside a step function may sync.
+
+- **Fresh-hash jit.**  ``jax.jit(lambda ...)`` (or of a local ``def``)
+  executed INSIDE A LOOP builds a new callable — hence a new dispatch
+  cache key — per iteration: every call silently recompiles.  The
+  repo's sanctioned shapes are factory functions called once per run
+  (``make_*_step`` returning ``jax.jit(step)``) and lru-cached builders
+  (``serve/engine._slot_step``); both jit a given function object once.
+
+Step contexts recognized (per module, static):
+
+1. functions decorated with ``jit`` / ``jax.jit`` / ``pjit`` /
+   ``functools.partial(jax.jit, ...)``;
+2. named functions passed to a ``jit(...)`` call anywhere in the module;
+3. functions (and lambdas) defined inside — or passed as arguments to —
+   a ``make_*step`` factory: the step/loss callables those factories
+   close over run inside the traced program.
+
+Static-shape escapes: an argument that touches ``.shape`` / ``.ndim``
+/ ``.size`` / ``.dtype`` / ``len(...)`` is host-static metadata, not a
+traced value, and stays quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set, Tuple
+
+from .base import Finding, SourceFile, Tree, dotted_name
+
+RULE_SYNC = "host-sync-in-step"
+RULE_JIT = "jit-in-loop"
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit"}
+_FACTORY = re.compile(r"^make_\w*step\w*$")
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize",
+                 "num_devices", "block_size"}
+_FETCHERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "onp.asarray", "jax.device_get", "device_get"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression denote jit (possibly partial(jit, ...))?"""
+    name = dotted_name(node)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+        # decorator form ``@jax.jit`` with kwargs: jax.jit(static_...)
+        if fname in _JIT_NAMES:
+            return True
+    return False
+
+
+def _jitted_arg_names(tree: ast.AST) -> Set[str]:
+    """Names of functions passed to a jit(...) call in this module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+                elif isinstance(arg, ast.Call):
+                    # jax.jit(make_train_step(...)): the factory's inner
+                    # defs are contexts via the factory-name rule.
+                    pass
+    return out
+
+
+def _step_contexts(sf: SourceFile) -> List[ast.AST]:
+    """Function/lambda nodes whose bodies execute under trace."""
+    tree = sf.tree
+    contexts: List[ast.AST] = []
+    jitted_names = _jitted_arg_names(tree)
+    from .base import walk_with_parents
+    for node, ancestors in walk_with_parents(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                contexts.append(node)
+                continue
+            if node.name in jitted_names:
+                contexts.append(node)
+                continue
+            if any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and _FACTORY.match(a.name) for a in ancestors):
+                contexts.append(node)
+                continue
+        if isinstance(node, ast.Lambda):
+            if any(isinstance(a, ast.Call)
+                   and _factory_call(a) and node in a.args + [
+                       kw.value for kw in a.keywords]
+                   for a in ancestors[-2:]):
+                contexts.append(node)
+    return contexts
+
+
+def _factory_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    return bool(_FACTORY.match(name.split(".")[-1]))
+
+
+def _mentions_static(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id in ("len", "range"):
+            return True
+    return False
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return []
+
+
+def _tainted_names(ctx: ast.AST) -> Set[str]:
+    """Names that (transitively) derive from the step function's own
+    parameters — the traced values.  Closure config (``bool(moe)`` in a
+    factory) never taints: a factory's flags are host-side statics, and
+    flagging them would bury the real syncs in noise."""
+    args = ctx.args
+    taint: Set[str] = set()
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        taint.add(a.arg)
+    taint.discard("self")
+    body = ctx.body if isinstance(ctx.body, list) else [ctx.body]
+    for _ in range(4):                    # cheap fixpoint
+        grew = False
+        for stmt in body:
+            for node in ast.walk(stmt):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    targets, value = [node.target], node.iter
+                if value is None:
+                    continue
+                if any(isinstance(s, ast.Name) and s.id in taint
+                       for s in ast.walk(value)):
+                    for t in targets:
+                        for name in _target_names(t):
+                            if name not in taint:
+                                taint.add(name)
+                                grew = True
+        if not grew:
+            break
+    return taint
+
+
+def _is_tainted(node: ast.AST, taint: Set[str]) -> bool:
+    return any(isinstance(s, ast.Name) and s.id in taint
+               for s in ast.walk(node))
+
+
+def _walk_skipping(node: ast.AST, skip_ids: Set[int]):
+    """ast.walk, but do not descend into nested nodes in ``skip_ids``
+    (nested step contexts check their own bodies — double-reporting one
+    sync under two context names would double the noise)."""
+    for child in ast.iter_child_nodes(node):
+        if id(child) in skip_ids:
+            continue
+        yield child
+        yield from _walk_skipping(child, skip_ids)
+
+
+def _check_context(sf: SourceFile, ctx: ast.AST,
+                   findings: List[Finding],
+                   skip_ids: Set[int] = frozenset()) -> None:
+    body = ctx.body if isinstance(ctx.body, list) else [ctx.body]
+    name = getattr(ctx, "name", "<lambda>")
+    taint = _tainted_names(ctx)
+    for stmt in body:
+        for node in _walk_skipping_or_self(stmt, skip_ids):
+            # float()/int()/bool() on a traced value
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") \
+                    and len(node.args) == 1 and not node.keywords:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) or _mentions_static(arg) \
+                        or not _is_tainted(arg, taint):
+                    continue
+                _emit(sf, findings, node.lineno,
+                      f"{node.func.id}() on a traced value inside step "
+                      f"function '{name}' forces a host sync (or a "
+                      "TracerConversionError)")
+            # .item()
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args \
+                    and _is_tainted(node.func.value, taint):
+                _emit(sf, findings, node.lineno,
+                      f".item() inside step function '{name}' is a "
+                      "per-element device fetch")
+            # np.asarray / device_get
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname in _FETCHERS and node.args \
+                        and _is_tainted(node.args[0], taint):
+                    _emit(sf, findings, node.lineno,
+                          f"{fname}() inside step function '{name}' "
+                          "materializes a device array on the host")
+
+
+def _walk_skipping_or_self(node: ast.AST, skip_ids: Set[int]):
+    yield node
+    yield from _walk_skipping(node, skip_ids)
+
+
+def _emit(sf: SourceFile, findings: List[Finding], line: int,
+          message: str) -> None:
+    if not sf.suppressed(RULE_SYNC, line):
+        findings.append(Finding(RULE_SYNC, sf.path, line, message))
+
+
+def _check_jit_in_loop(sf: SourceFile, findings: List[Finding]) -> None:
+    from .base import walk_with_parents
+    local_defs_by_scope = {}
+    for node, ancestors in walk_with_parents(sf.tree):
+        if isinstance(node, ast.FunctionDef) and any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a in ancestors):
+            scope = next(a for a in reversed(ancestors)
+                         if isinstance(a, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)))
+            local_defs_by_scope.setdefault(id(scope), set()).add(node.name)
+    for node, ancestors in walk_with_parents(sf.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_expr(node.func)
+                and node.args):
+            continue
+        in_loop = any(isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+                      for a in ancestors)
+        if not in_loop:
+            continue
+        arg = node.args[0]
+        fresh: Optional[str] = None
+        if isinstance(arg, ast.Lambda):
+            fresh = "a lambda"
+        elif isinstance(arg, ast.Name):
+            scope = next((a for a in reversed(ancestors)
+                          if isinstance(a, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))), None)
+            if scope is not None and arg.id in \
+                    local_defs_by_scope.get(id(scope), ()):
+                fresh = f"local def '{arg.id}'"
+        if fresh and not sf.suppressed(RULE_JIT, node.lineno):
+            findings.append(Finding(
+                RULE_JIT, sf.path, node.lineno,
+                f"jit({fresh}) inside a loop builds a fresh callable "
+                "per iteration — every call silently recompiles "
+                "(fresh dispatch-cache hash)"))
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, sf in sorted(tree.files.items()):
+        if sf.tree is None:
+            continue
+        seen: Set[Tuple[int, int]] = set()
+        contexts = []
+        for ctx in _step_contexts(sf):
+            key = (ctx.lineno, ctx.col_offset)
+            if key in seen:          # decorated AND name-jitted
+                continue
+            seen.add(key)
+            contexts.append(ctx)
+        ctx_ids = {id(c) for c in contexts}
+        for ctx in contexts:
+            _check_context(sf, ctx, findings,
+                           skip_ids=ctx_ids - {id(ctx)})
+        _check_jit_in_loop(sf, findings)
+    return findings

@@ -1,0 +1,248 @@
+"""jax-free-by-contract: a static, exhaustive transitive import check.
+
+The repo's thin clients (tools/metrics_lint.py, tools/supervise.py, …),
+the auto-resume supervisor and the telemetry schema are jax-free BY
+CONTRACT: they must run on hosts where jax is broken or absent — the
+supervisor's one job is to restart training after jax itself died.
+PRs 2–7 enforced this at runtime: a subprocess per tool with a poisoned
+``jax`` module first on PYTHONPATH.  That guard paid ~1–2 s of
+interpreter startup per tool per suite run and only proved the code
+paths the smoke arguments happened to execute.
+
+This rule replaces it with a whole-file static proof: parse every
+contract module, resolve every import edge (top-level AND
+function-local — a lazy import still executes when the function runs)
+against the repo tree, and walk the closure.  Any path that reaches a
+jax-carrying root (jax, jaxlib, flax, optax, orbax, chex) is reported
+with the full chain.  Imports inside ``try:`` blocks whose handler
+catches ImportError (or a superclass) are runtime-safe degradation and
+are excluded.
+
+The contract set is computed, not listed: every ``tools/*.py`` whose
+own source has no direct jax import is a thin client (growing a direct
+jax import OPTS a tool OUT of the contract — same semantics as the old
+runtime guard's discovery), plus the two named library modules.  What
+this cannot see: ``importlib`` file-path loads (metrics_lint loads
+obs/schema.py by path).  Those are covered by naming their TARGETS in
+CONTRACT_FILES, which is exactly how the repo already uses them —
+file-path loading exists to AVOID package imports.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, SourceFile, Tree
+
+RULE = "jax-free"
+
+# Roots whose import means "jax is in the process" — flax/optax/orbax/
+# chex all import jax at their own import time.
+JAX_ROOTS = {"jax", "jaxlib", "flax", "optax", "orbax", "chex"}
+
+# Library modules that are jax-free by contract even though they live
+# inside the (jax-carrying) package: loaded by FILE PATH, never via the
+# package __init__ (tools/supervise.py, tools/metrics_lint.py).
+CONTRACT_FILES = (
+    "apex_example_tpu/resilience/supervisor.py",
+    "apex_example_tpu/obs/schema.py",
+)
+
+_IMPORT_EXC = {"ImportError", "ModuleNotFoundError", "Exception",
+               "BaseException"}
+
+
+def _soft_import(ancestors: Tuple[ast.AST, ...],
+                 node: ast.AST) -> bool:
+    """True when the import sits in the BODY of a try: whose handler
+    catches ImportError — a runtime-guarded optional dependency, not an
+    edge.  An import in the except handler itself (the classic
+    fallback: ``except ImportError: import other``), or in else/
+    finally, executes for real and stays a hard edge (review regression
+    on the first cut of this rule)."""
+    chain = list(ancestors) + [node]
+    for i, anc in enumerate(chain[:-1]):
+        if not isinstance(anc, ast.Try):
+            continue
+        child = chain[i + 1]
+        if not any(child is stmt for stmt in anc.body):
+            continue                 # handler/else/finally: hard edge
+        for handler in anc.handlers:
+            names: List[str] = []
+            t = handler.type
+            if t is None:
+                return True                          # bare except
+            for n in t.elts if isinstance(t, ast.Tuple) else [t]:
+                if isinstance(n, ast.Name):
+                    names.append(n.id)
+                elif isinstance(n, ast.Attribute):
+                    names.append(n.attr)
+            if _IMPORT_EXC & set(names):
+                return True
+    return False
+
+
+def module_imports(sf: SourceFile) -> List[Tuple[str, int, int]]:
+    """(module, level, lineno) for every hard import edge in the file.
+    ``from X import a, b`` yields X plus X.a / X.b — the submodule form
+    must resolve too (``from apex_example_tpu.obs import schema``)."""
+    if sf.tree is None:
+        return []
+    out: List[Tuple[str, int, int]] = []
+    from .base import walk_with_parents
+    for node, ancestors in walk_with_parents(sf.tree):
+        if isinstance(node, ast.Import):
+            if _soft_import(ancestors, node):
+                continue
+            for alias in node.names:
+                out.append((alias.name, 0, node.lineno))
+        elif isinstance(node, ast.ImportFrom):
+            if _soft_import(ancestors, node):
+                continue
+            mod = node.module or ""
+            out.append((mod, node.level, node.lineno))
+            for alias in node.names:
+                if alias.name != "*":
+                    sub = f"{mod}.{alias.name}" if mod else alias.name
+                    out.append((sub, node.level, node.lineno))
+    return out
+
+
+def _candidates(module: str, level: int, importer: str) -> List[str]:
+    """Repo-relative paths a dotted import could resolve to, including
+    every package __init__ along the dotted prefix (importing a
+    submodule EXECUTES its ancestors' __init__)."""
+    paths: List[str] = []
+    if level:                                        # relative import
+        base = os.path.dirname(importer)
+        for _ in range(level - 1):
+            base = os.path.dirname(base)
+        prefix = base.replace(os.sep, "/")
+        # A relative import executes the importing package's own
+        # __init__ chain too: ``from . import helper`` in pkg/mod.py
+        # pulls pkg/__init__.py (and every ancestor package's) before
+        # helper — missing these edges let a jax import hide in a
+        # subpackage __init__ (found by review of ISSUE 9's first cut).
+        comps = prefix.split("/") if prefix else []
+        for i in range(1, len(comps) + 1):
+            paths.append("/".join(comps[:i]) + "/__init__.py")
+    else:
+        prefix = ""
+    parts = [p for p in module.split(".") if p]
+    for i in range(1, len(parts) + 1):
+        stem = "/".join(([prefix] if prefix else []) + parts[:i])
+        paths.append(f"{stem}/__init__.py")
+        if i == len(parts):
+            paths.append(f"{stem}.py")
+    if level and not parts:
+        # bare ``from . import name``: the names resolve as submodules
+        # of the package itself (handled by module_imports emitting
+        # ``.name``), but the package __init__ alone is also an edge.
+        paths.append(f"{prefix}/__init__.py" if prefix
+                     else "__init__.py")
+    if not level and len(parts) == 1:
+        # Bare sibling import (tools scripts sys.path-insert their own
+        # directory: ``from metrics_lint import pct``).
+        sib = os.path.dirname(importer).replace(os.sep, "/")
+        if sib:
+            paths.append(f"{sib}/{parts[0]}.py")
+    return paths
+
+
+def _resolve(module: str, level: int, importer: str,
+             tree: Tree) -> List[str]:
+    """Repo files a hard import edge lands on (empty = external)."""
+    return [c for c in _candidates(module, level, importer)
+            if c in tree.files or tree.exists(c)]
+
+
+def has_direct_jax_import(sf: SourceFile) -> bool:
+    """The contract OPT-OUT marker: a tool that imports ``jax`` (or
+    ``jaxlib``) itself is declaring itself a jax tool — same discovery
+    semantics as the retired runtime guard.  Deliberately NOT the full
+    JAX_ROOTS set: a direct flax/optax import in an otherwise jax-free
+    tool is a violation to report, not an opt-out."""
+    return any(mod.split(".")[0] in ("jax", "jaxlib")
+               for mod, _level, _line in module_imports(sf))
+
+
+def contract_modules(tree: Tree) -> List[str]:
+    """The jax-free-by-contract set at HEAD: every tools/*.py without a
+    direct jax import, every tools/graftlint/*.py, plus the named
+    library modules."""
+    out: List[str] = []
+    for path, sf in sorted(tree.files.items()):
+        if not path.startswith("tools/"):
+            continue
+        if sf.tree is None:
+            continue                                  # parse-error finding
+        if not has_direct_jax_import(sf):
+            out.append(path)
+    for path in CONTRACT_FILES:
+        if tree.exists(path):
+            out.append(path)
+    return out
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    roots = contract_modules(tree)
+    # parent chain for the report: file -> (importer, module, line)
+    for root in roots:
+        chain = _reaches_jax(root, tree)
+        if chain:
+            hops = " -> ".join(chain)
+            findings.append(Finding(
+                RULE, root, _first_hop_line(root, tree, chain),
+                f"jax-free-by-contract module reaches jax: {hops}"))
+    return findings
+
+
+def _first_hop_line(root: str, tree: Tree, chain: List[str]) -> int:
+    sf = tree.files.get(root)
+    if sf is None or len(chain) < 2:
+        return 0
+    nxt = chain[1]
+    for mod, level, lineno in module_imports(sf):
+        resolved = _resolve(mod, level, root, tree)
+        if nxt in resolved or mod.split(".")[0] in JAX_ROOTS \
+                and nxt == mod:
+            return lineno
+    return 0
+
+
+def _reaches_jax(root: str, tree: Tree) -> Optional[List[str]]:
+    """BFS from ``root`` over hard import edges; returns the chain of
+    repo files ending in the jax-carrying module name, or None."""
+    seen: Set[str] = {root}
+    parent: Dict[str, str] = {}
+    queue: List[str] = [root]
+    while queue:
+        cur = queue.pop(0)
+        sf = tree.files.get(cur)
+        if sf is None:
+            if tree.root:
+                full = os.path.join(tree.root, cur)
+                try:
+                    with open(full, encoding="utf-8") as fh:
+                        sf = SourceFile.from_text(cur, fh.read())
+                except OSError:
+                    continue
+            else:
+                continue
+        for mod, level, lineno in module_imports(sf):
+            if mod.split(".")[0] in JAX_ROOTS:
+                chain = [mod]
+                node = cur
+                while node is not None:
+                    chain.append(node)
+                    node = parent.get(node)
+                return list(reversed(chain))
+            for target in _resolve(mod, level, cur, tree):
+                if target not in seen:
+                    seen.add(target)
+                    parent[target] = cur
+                    queue.append(target)
+    return None

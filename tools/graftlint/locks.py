@@ -1,0 +1,148 @@
+"""lock-discipline: ``# guarded-by: _lock`` annotations, enforced.
+
+The threaded host runtime (serve queue, JSONL sink, stall watchdog,
+flight recorder) guards shared state with per-object locks, but nothing
+stopped a new method from reading ``self._q`` without taking
+``self._lock`` — the resulting race only surfaces as a rare torn read
+under load.  This rule makes the guard declarative: an attribute whose
+assignment line carries ``# guarded-by: <lockname>`` may only be
+touched inside ``with self.<lockname>:`` within its class.
+
+Semantics:
+
+- the annotation line must assign ``self.<attr>`` (normally in
+  ``__init__``); the enclosing class owns the contract;
+- ``__init__`` itself is exempt (the object is not shared yet);
+- every other method's load/store/augassign of ``self.<attr>`` must be
+  lexically inside a ``with`` whose context expression is
+  ``self.<lockname>``;
+- any access to an annotated PRIVATE attribute from outside its class
+  (``other._q``) is flagged unconditionally — cross-object pokes at
+  guarded state cannot hold the right lock by construction;
+- ``# graftlint: ignore[lock-discipline]`` on the access line is the
+  per-site escape hatch for single-threaded phases (document why).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from .base import Finding, SourceFile, Tree, walk_with_parents
+
+RULE = "lock-discipline"
+
+_ANNOT = re.compile(r"#\s*guarded-by:\s*(\w+)")
+_SELF_ATTR = re.compile(r"self\.(\w+)")
+
+
+def _annotations(sf: SourceFile) -> Dict[int, Tuple[str, str]]:
+    """line -> (attr, lockname) for every guarded-by comment."""
+    out: Dict[int, Tuple[str, str]] = {}
+    for i, line in enumerate(sf.lines, start=1):
+        m = _ANNOT.search(line)
+        if not m:
+            continue
+        attr = _SELF_ATTR.search(line)
+        if attr:
+            out[i] = (attr.group(1), m.group(1))
+    return out
+
+
+def _class_guards(sf: SourceFile) -> Dict[str, Dict[str, str]]:
+    """class name -> {attr: lockname}, by mapping annotation lines into
+    class extents."""
+    annots = _annotations(sf)
+    if not annots:
+        return {}
+    guards: Dict[str, Dict[str, str]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            end = getattr(node, "end_lineno", node.lineno)
+            for line, (attr, lock) in annots.items():
+                if node.lineno <= line <= end:
+                    guards.setdefault(node.name, {})[attr] = lock
+    return guards
+
+
+def _holds_lock(ancestors, lockname: str) -> bool:
+    for node in ancestors:
+        if isinstance(node, ast.With):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Attribute) \
+                        and isinstance(expr.value, ast.Name) \
+                        and expr.value.id == "self" \
+                        and expr.attr == lockname:
+                    return True
+    return False
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef,
+                 guards: Dict[str, str], findings: List[Finding]) -> None:
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+            continue
+        if method.name == "__init__":
+            continue
+        for node, ancestors in walk_with_parents(method):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in guards):
+                continue
+            lock = guards[node.attr]
+            if _holds_lock(ancestors, lock):
+                continue
+            if sf.suppressed(RULE, node.lineno):
+                continue
+            findings.append(Finding(
+                RULE, sf.path, node.lineno,
+                f"{cls.name}.{method.name} touches self.{node.attr} "
+                f"(guarded-by: {lock}) outside 'with self.{lock}'"))
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    # attr -> (class, path) for the cross-class pass; only private
+    # names participate (public guarded attrs would collide with
+    # unrelated classes' unannotated fields).
+    private_guarded: Dict[str, Tuple[str, str]] = {}
+    per_file: List[Tuple[SourceFile, Dict[str, Dict[str, str]]]] = []
+    for path, sf in sorted(tree.files.items()):
+        if sf.tree is None:
+            continue
+        guards = _class_guards(sf)
+        per_file.append((sf, guards))
+        for cls_name, attrs in guards.items():
+            for attr in attrs:
+                if attr.startswith("_"):
+                    private_guarded[attr] = (cls_name, path)
+
+    for sf, guards in per_file:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name in guards:
+                _check_class(sf, node, guards[node.name], findings)
+        # Cross-class pokes at guarded private state.  Bare attribute
+        # names are weak evidence on their own (another class may own
+        # an unrelated ``_q``), so the access only fires when the file
+        # also references the DECLARING class by name — the cheap
+        # static proxy for "this code handles that type".
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in private_guarded \
+                    and not (isinstance(node.value, ast.Name)
+                             and node.value.id == "self"):
+                cls_name, decl_path = private_guarded[node.attr]
+                if cls_name not in sf.text:
+                    continue
+                if sf.suppressed(RULE, node.lineno):
+                    continue
+                findings.append(Finding(
+                    RULE, sf.path, node.lineno,
+                    f"access to {cls_name}.{node.attr} (guarded-by "
+                    f"annotation in {decl_path}) from outside its "
+                    "class — no lock can be held here"))
+    return findings

@@ -1,0 +1,295 @@
+"""schema-emission: every emitted record matches obs/schema.py — statically.
+
+``obs.schema.validate_record`` already rejects drift at RUNTIME, but
+only on code paths a test actually drives; an emitter call site behind
+a rarely-taken branch can ship a field the schema never learned about,
+forking the JSONL contract for every downstream tool.  This rule checks
+the EMITTING SOURCE against the schema tables:
+
+- find every dict literal carrying ``"record": "<type>"`` in the
+  package and the CLI scripts (the supervisor's hard-coded records
+  included — its jax-free contract forbids importing the schema, not
+  matching it);
+- collect the statically-knowable field set: the literal's keys, plus
+  later constant-key ``rec["field"] = ...`` assignments on the same
+  variable in the same function (including keys bound by a ``for key in
+  ("a", "b")`` loop over a constant tuple);
+- unknown record types and fields absent from REQUIRED ∪ OPTIONAL are
+  violations — a new field cannot ship without a schema bump;
+- missing REQUIRED fields are violations unless the dict is built
+  dynamically (``**`` expansion, non-constant subscript key that the
+  loop resolution can't bind, or ``.update(...)`` with a non-literal
+  argument) — dynamic builders degrade to the unknown-field check only.
+
+The schema tables are read by AST from obs/schema.py, not imported:
+the linter stays jax-free and needs no package on sys.path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, SourceFile, Tree, walk_with_parents
+
+RULE = "schema-emission"
+
+SCHEMA_PATH = "apex_example_tpu/obs/schema.py"
+
+
+def load_schema_fields(tree: Tree) -> Optional[Dict[str, Tuple[Set[str],
+                                                               Set[str]]]]:
+    """record type -> (required field names, optional field names),
+    parsed from the REQUIRED/OPTIONAL table literals."""
+    sf = tree.files.get(SCHEMA_PATH)
+    if sf is None or sf.tree is None:
+        return None
+    tables: Dict[str, Dict[str, Set[str]]] = {}
+    for node in ast.walk(sf.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in ("REQUIRED",
+                                                    "OPTIONAL") \
+                    and isinstance(value, ast.Dict):
+                table: Dict[str, Set[str]] = {}
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str) \
+                            and isinstance(v, ast.Dict):
+                        table[k.value] = {
+                            fk.value for fk in v.keys
+                            if isinstance(fk, ast.Constant)
+                            and isinstance(fk.value, str)}
+                tables[t.id] = table
+    if "REQUIRED" not in tables:
+        return None
+    out: Dict[str, Tuple[Set[str], Set[str]]] = {}
+    for rectype, req in tables["REQUIRED"].items():
+        opt = tables.get("OPTIONAL", {}).get(rectype, set())
+        out[rectype] = (req, opt)
+    return out
+
+
+def _constant_loop_bindings(func: ast.AST) -> Dict[str, Set[str]]:
+    """Loop variables bound over a literal tuple/list of constants:
+    ``for key in ("grad_norm", "lr"):`` -> {'key': {...}}.  Tuple
+    targets over tuples of constant tuples bind each element name
+    (``for attr, field in (("a", "b"), ...)``)."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(func):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        it = node.iter
+        if not isinstance(it, (ast.Tuple, ast.List)):
+            continue
+        if isinstance(node.target, ast.Name):
+            vals = {e.value for e in it.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+            if vals and len(vals) == len(it.elts):
+                out.setdefault(node.target.id, set()).update(vals)
+        elif isinstance(node.target, ast.Tuple) and all(
+                isinstance(n, ast.Name) for n in node.target.elts):
+            width = len(node.target.elts)
+            rows = []
+            for e in it.elts:
+                if isinstance(e, (ast.Tuple, ast.List)) \
+                        and len(e.elts) == width and all(
+                            isinstance(x, ast.Constant)
+                            and isinstance(x.value, str)
+                            for x in e.elts):
+                    rows.append([x.value for x in e.elts])
+                else:
+                    rows = []
+                    break
+            for i, name_node in enumerate(node.target.elts):
+                if rows:
+                    out.setdefault(name_node.id, set()).update(
+                        r[i] for r in rows)
+    return out
+
+
+class _Emission:
+    def __init__(self, rectype: str, line: int):
+        self.rectype = rectype
+        self.line = line
+        self.fields: Set[str] = set()
+        self.dynamic = False
+
+
+def _dict_literal_keys(d: ast.Dict) -> Tuple[Set[str], bool]:
+    keys: Set[str] = set()
+    dynamic = False
+    for k in d.keys:
+        if k is None:                      # ** expansion
+            dynamic = True
+        elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+        else:
+            dynamic = True
+    return keys, dynamic
+
+
+def _record_type(d: ast.Dict) -> Optional[str]:
+    for k, v in zip(d.keys, d.values):
+        if isinstance(k, ast.Constant) and k.value == "record" \
+                and isinstance(v, ast.Constant) \
+                and isinstance(v.value, str):
+            return v.value
+    return None
+
+
+def _collect_emissions(sf: SourceFile) -> List[_Emission]:
+    emissions: List[_Emission] = []
+    # Scope = innermost function (or module).  For each record dict
+    # literal, note the variable it is assigned to (if any), then fold
+    # in later static subscript assignments in the same scope.
+    for node, ancestors in walk_with_parents(sf.tree):
+        if isinstance(node, ast.Dict):
+            rectype = _record_type(node)
+            if rectype is None:
+                continue
+            em = _Emission(rectype, node.lineno)
+            keys, dynamic = _dict_literal_keys(node)
+            em.fields |= keys
+            em.dynamic |= dynamic
+            scope = next((a for a in reversed(ancestors)
+                          if isinstance(a, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef,
+                                            ast.Lambda))), sf.tree)
+            var = _assigned_name(node, ancestors)
+            if var:
+                _fold_subscript_assigns(scope, var, em)
+            elif not _is_direct_emit(node, ancestors):
+                # dict built inline into a larger expression we don't
+                # track (e.g. returned then mutated by the caller):
+                # keep the unknown-field check, skip missing-required.
+                em.dynamic = True
+            emissions.append(em)
+    return emissions
+
+
+def _assigned_name(d: ast.Dict, ancestors) -> Optional[str]:
+    if not ancestors:
+        return None
+    parent = ancestors[-1]
+    if isinstance(parent, ast.Assign) and parent.value is d:
+        for t in parent.targets:
+            if isinstance(t, ast.Name):
+                return t.id
+    if isinstance(parent, ast.AnnAssign) and parent.value is d \
+            and isinstance(parent.target, ast.Name):
+        return parent.target.id
+    return None
+
+
+def _is_direct_emit(d: ast.Dict, ancestors) -> bool:
+    """True when the literal is consumed whole (a call argument or a
+    return value built in place that nobody mutates afterwards)."""
+    if not ancestors:
+        return False
+    parent = ancestors[-1]
+    return isinstance(parent, (ast.Call, ast.Return, ast.Expr))
+
+
+def _rebind_linenos(scope: ast.AST, var: str, after: int) -> int:
+    """First line after ``after`` where ``var`` is rebound to a new
+    value — the end of the current binding's live range.  Field
+    assignments past a rebinding belong to a DIFFERENT record and must
+    not contaminate this one (review regression: two records sharing a
+    variable name in one function)."""
+    nxt = float("inf")
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == var \
+                        and node.lineno > after:
+                    nxt = min(nxt, node.lineno)
+    return nxt
+
+
+def _fold_subscript_assigns(scope: ast.AST, var: str,
+                            em: _Emission) -> None:
+    loops = _constant_loop_bindings(scope)
+    until = _rebind_linenos(scope, var, em.line)
+    for node in ast.walk(scope):
+        if not (em.line <= getattr(node, "lineno", em.line) < until):
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == var:
+                    key = t.slice
+                    if isinstance(key, ast.Constant) \
+                            and isinstance(key.value, str):
+                        em.fields.add(key.value)
+                    elif isinstance(key, ast.Name) \
+                            and key.id in loops:
+                        em.fields |= loops[key.id]
+                    else:
+                        em.dynamic = True
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "update" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == var:
+            merged = False
+            if len(node.args) == 1 and not node.keywords \
+                    and isinstance(node.args[0], ast.Dict):
+                keys, dynamic = _dict_literal_keys(node.args[0])
+                em.fields |= keys
+                em.dynamic |= dynamic
+                merged = True
+            if node.keywords and all(kw.arg for kw in node.keywords):
+                em.fields |= {kw.arg for kw in node.keywords}
+                merged = True
+            if not merged:
+                em.dynamic = True
+
+
+def check(tree: Tree) -> List[Finding]:
+    schema = load_schema_fields(tree)
+    if schema is None:
+        return [Finding(RULE, SCHEMA_PATH, 0,
+                        "cannot load REQUIRED/OPTIONAL tables from the "
+                        "schema module — schema-emission checks skipped")]
+    findings: List[Finding] = []
+    for path, sf in sorted(tree.files.items()):
+        if sf.tree is None or path == SCHEMA_PATH:
+            continue
+        for em in _collect_emissions(sf):
+            if em.rectype not in schema:
+                if not sf.suppressed(RULE, em.line):
+                    findings.append(Finding(
+                        RULE, path, em.line,
+                        f"unknown record type '{em.rectype}' "
+                        "(not declared in obs/schema.py)"))
+                continue
+            required, optional = schema[em.rectype]
+            known = required | optional
+            for fieldname in sorted(em.fields - known):
+                if not sf.suppressed(RULE, em.line):
+                    findings.append(Finding(
+                        RULE, path, em.line,
+                        f"record '{em.rectype}' emits field "
+                        f"'{fieldname}' that obs/schema.py does not "
+                        "declare — bump the schema before shipping it"))
+            if not em.dynamic:
+                for fieldname in sorted(required - em.fields):
+                    if not sf.suppressed(RULE, em.line):
+                        findings.append(Finding(
+                            RULE, path, em.line,
+                            f"record '{em.rectype}' never sets required "
+                            f"field '{fieldname}'"))
+    return findings

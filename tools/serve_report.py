@@ -19,8 +19,8 @@ Thin client of the obs schema (obs/schema.py):
 
     python tools/serve_report.py serve.jsonl
 
-No jax import; works on any host with the file (the tier-1 jax-free
-guard in tests/test_diag.py runs it under a poisoned jax module).
+No jax import; works on any host with the file (graftlint's static
+jax-free rule proves the whole import closure stays jax-free).
 """
 
 from __future__ import annotations

@@ -35,9 +35,10 @@ child's own flags.
 
 Thin client contract: **no jax import, direct or transitive** — the
 supervisor's one job is to restart training on hosts where training
-just died, including deaths caused by a broken jax install
-(tests/test_diag.py runs every tools/ thin client under a poisoned jax
-module).  resilience/supervisor.py is therefore loaded by file path:
+just died, including deaths caused by a broken jax install (graftlint's
+static jax-free rule proves the whole import closure stays jax-free —
+tools/graftlint/imports.py).  resilience/supervisor.py is therefore
+loaded by file path:
 importing the package would pull jax via apex_example_tpu/__init__.
 """
 
