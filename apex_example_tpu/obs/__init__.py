@@ -40,12 +40,22 @@ The cost stratum (what XLA compiled, schema v6):
   train.py / bench.py / serve.py; ``tools/cost_report.py`` is the
   jax-free report.
 
+The trace stratum (per-request/per-tick timelines, schema v9):
+
+- :mod:`~apex_example_tpu.obs.trace`  the trace-event emitter (pure
+  stdlib): ``--trace`` on serve.py / train.py arms a process-default
+  :class:`Tracer`; host spans, the serve engine's tick/request
+  lifecycle and the supervisor's restart decisions then land as
+  ``trace_event`` records on the metrics stream, exported to
+  Chrome/Perfetto by ``tools/trace_export.py``.
+
 The JSONL schema itself lives in :mod:`~apex_example_tpu.obs.schema`
 (pure stdlib — tools can validate without importing jax).
 """
 
-from apex_example_tpu.obs import costmodel
+from apex_example_tpu.obs import costmodel, trace
 from apex_example_tpu.obs.costmodel import CostModel
+from apex_example_tpu.obs.trace import Tracer
 from apex_example_tpu.obs.flight import FlightRecorder, format_thread_stacks
 from apex_example_tpu.obs.logging import get_logger, rank_print
 from apex_example_tpu.obs.metrics import (Counter, Gauge, Histogram,
@@ -69,7 +79,7 @@ __all__ = [
     "Histogram",
     "JsonlSink", "MetricsRegistry", "NumericsMonitor", "PHASES",
     "ProfilerWindow", "SCHEMA_VERSION", "StallWatchdog", "TelemetryEmitter",
-    "TensorBoardAdapter", "current_span", "device_memory_stats",
+    "TensorBoardAdapter", "Tracer", "current_span", "device_memory_stats",
     "device_span", "format_thread_stacks", "get_logger",
     "make_profiler_window", "module_grad_stats", "nearest_rank",
     "parse_window", "rank_print", "read_jsonl", "set_default_registry",

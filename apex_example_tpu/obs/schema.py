@@ -148,6 +148,27 @@ Version 8 adds the static-analysis stratum's one record field
 v8 is once more a strict superset: every v1–v7 stream validates
 unchanged.
 
+Version 9 adds the trace-event stratum (obs/trace.py; ``--trace`` on
+serve.py / train.py — README "Request tracing"):
+
+``trace_event``  one timeline event: ``ph`` B/E (begin/end of a nested
+                 region, matched stack-wise per ``tid`` row), X (a
+                 complete span with ``dur``), or i (an instant);
+                 ``ts``/``dur`` are MONOTONIC ``perf_counter`` seconds
+                 — never wall-clock; ``span_id``/``parent_id`` build
+                 the span tree, ``trace_id`` groups streams (a
+                 supervised restart's attempt streams share one, via
+                 the ``APEX_TRACE_ID`` env handoff).
+``clock_sync``   exactly one per traced stream: a ``perf_counter``
+                 reading (``ts``) paired with a back-to-back
+                 ``time.time()`` (``time``) — the anchor
+                 tools/trace_export.py uses to place streams (and an
+                 xprof device trace) on one wall-clock axis.
+
+Without ``--trace`` neither record is emitted — streams are
+byte-identical to v8 runs.  v9 is once more a strict superset: every
+v1–v8 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -159,7 +180,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -287,6 +308,18 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "record": str,
         "time": _NUM,
         "name": str,
+    },
+    # --- schema v9: trace-event records (obs/trace.py; --trace) ---
+    "trace_event": {
+        "record": str,
+        "ph": str,              # B | E | X | i
+        "name": str,
+        "ts": _NUM,             # perf_counter seconds (monotonic)
+    },
+    "clock_sync": {
+        "record": str,
+        "time": _NUM,           # wall clock (time.time())
+        "ts": _NUM,             # perf_counter taken back-to-back
     },
 }
 
@@ -474,6 +507,20 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "analytic_min_ms": _NUM,       # max(compute_ms, hbm_ms)
         "roofline": str,               # compute-bound | hbm-bound
         "mfu_ceiling_pct": _NUM,       # MFU the intensity admits
+    },
+    "trace_event": {
+        "run_id": str,
+        "dur": _NUM,            # X only: span length, perf seconds
+        "cat": str,             # coarse category (tick/request/span)
+        "tid": str,             # logical thread row within the stream
+        "span_id": str,         # stream-local span identity
+        "parent_id": str,       # span tree edge (same stream)
+        "trace_id": str,        # groups streams into one timeline
+        "args": dict,           # slot / blocks / status annotations
+    },
+    "clock_sync": {
+        "run_id": str,
+        "trace_id": str,
     },
 }
 

@@ -27,6 +27,8 @@ from typing import List, Optional
 
 import jax
 
+from apex_example_tpu.obs import trace as trace_lib
+
 # Canonical phase labels.  The device-side entries are emitted by
 # engine.make_train_step via device_span; the host-side entries by the
 # train loop.  Keep README's "Observability" section in sync.
@@ -54,13 +56,17 @@ def set_default_registry(registry) -> None:
 class Span:
     """One timed host region; ``dur_ms`` is set when the context exits."""
 
-    __slots__ = ("name", "t0", "dur_ms", "children")
+    __slots__ = ("name", "t0", "dur_ms", "children", "span_id")
 
     def __init__(self, name: str):
         self.name = name
         self.t0 = time.perf_counter()
         self.dur_ms: Optional[float] = None
         self.children: List["Span"] = []
+        # Allocated up front when a tracer is armed (--trace): children
+        # exit FIRST, so the parent's id must exist before its own X
+        # event is emitted.
+        self.span_id: Optional[str] = None
 
     @property
     def dur_s(self) -> float:
@@ -93,12 +99,21 @@ def span(name: str, registry=None, device: bool = False):
 
     Yields the :class:`Span`; read ``sp.dur_ms`` after the ``with`` for
     the measured duration.
+
+    With a default :class:`~apex_example_tpu.obs.trace.Tracer` armed
+    (``--trace``), each completed span additionally lands as a
+    schema-v9 ``trace_event`` (ph "X", tid = the host thread's name,
+    parented on the enclosing span) — the histograms above are
+    unchanged; the timeline is strictly additive.
     """
     stack = _stack()
     sp = Span(name)
     parent = stack[-1] if stack else None
     if parent is not None:
         parent.children.append(sp)
+    tracer = trace_lib.get_default()
+    if tracer is not None:
+        sp.span_id = tracer.next_id()
     stack.append(sp)
     scope = jax.named_scope(name) if device else None
     if scope is not None:
@@ -114,3 +129,9 @@ def span(name: str, registry=None, device: bool = False):
         if reg is not None:
             path = ".".join([s.name for s in stack] + [name])
             reg.histogram(f"span.{path}").observe(sp.dur_ms)
+        if tracer is not None:
+            tracer.complete(
+                name, sp.t0, sp.dur_ms / 1e3, cat="span",
+                tid=threading.current_thread().name,
+                span_id=sp.span_id,
+                parent_id=parent.span_id if parent is not None else None)

@@ -1,0 +1,181 @@
+"""The trace-event stratum: per-tick / per-request / per-step timelines.
+
+Pure stdlib ON PURPOSE (no jax import): the supervisor emits matching
+``trace_event`` records from its jax-free side of the fence, and the
+exporter (tools/trace_export.py) must run on hosts that only have the
+JSONL files.
+
+Histograms (obs/spans.py, obs/metrics.py) answer "how long does X take
+on average"; a timeline answers "what did THIS request wait on".  A
+:class:`Tracer` turns state transitions into schema-v9 ``trace_event``
+records on the existing metrics stream, flag-gated (``--trace`` on
+serve.py / train.py) so the default path emits nothing — byte-identical
+streams with the flag off.
+
+Record semantics (a deliberate subset of the Chrome trace-event
+phases, so the export is a projection, not a translation):
+
+``ph: "B"/"E"``  begin/end of a nested region on one ``tid`` row,
+                 matched stack-wise per row (the engine's tick span);
+``ph: "X"``      a complete span: ``ts`` + ``dur`` known at emission —
+                 the shape used for everything reconstructed after the
+                 fact (request lifecycle spans are emitted at terminal
+                 time from the timestamps the request accumulated, so
+                 a request stranded mid-flight can never leave an
+                 unbalanced B behind);
+``ph: "i"``      an instant (first_token, admit, drain markers).
+
+Span identity: ``span_id`` / ``parent_id`` are stream-local strings;
+``trace_id`` groups STREAMS — the supervisor passes it to children via
+``APEX_TRACE_ID`` so a SIGTERM -> drain -> restart renders as ONE
+timeline across attempt streams (tools/trace_export.py puts each
+stream on its own process row).
+
+Dual clocks: every ``ts``/``dur`` is ``time.perf_counter()`` (seconds)
+— monotonic, the single basis for all duration math — and each stream
+carries exactly one ``clock_sync`` record pairing a ``perf_counter``
+reading with ``time.time()`` taken back-to-back, the anchor the
+exporter uses to place streams (and an xprof device trace) on one
+wall-clock axis.  Wall-clock appears in emitted records only; it is
+never subtracted from a monotonic reading.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+TRACE_ID_ENV = "APEX_TRACE_ID"
+
+_PHASES = ("B", "E", "X", "i")
+
+
+class Tracer:
+    """Emits ``trace_event`` records to a sink (anything with
+    ``write(dict)`` — the obs JsonlSink, or the supervisor's _Stream).
+
+    ``trace_id`` defaults to the ``APEX_TRACE_ID`` environment variable
+    (set by a supervising parent) and falls back to a fresh uuid — a
+    standalone run is its own one-stream trace.  The ``clock_sync``
+    anchor is written lazily with the first event, so arming a tracer
+    on a run that never traces anything leaves the stream untouched.
+    """
+
+    def __init__(self, sink, trace_id: Optional[str] = None,
+                 run_id: Optional[str] = None):
+        self.sink = sink
+        self.trace_id = (trace_id or os.environ.get(TRACE_ID_ENV)
+                         or uuid.uuid4().hex[:12])
+        self.run_id = run_id
+        self.events = 0
+        self._ids = itertools.count(1)
+        self._synced = False
+
+    # ------------------------------------------------------------ core
+
+    def next_id(self) -> str:
+        """A fresh stream-local span id."""
+        return f"s{next(self._ids)}"
+
+    def _clock_sync(self) -> None:
+        """The per-stream clock anchor: one wall-clock reading paired
+        with one monotonic reading, taken back-to-back.  Everything
+        else in the stream is monotonic; the exporter maps via
+        ``wall = time + (ts - this.ts)``."""
+        rec: Dict[str, Any] = {
+            "record": "clock_sync",
+            "time": time.time(),
+            "ts": time.perf_counter(),
+            "trace_id": self.trace_id,
+        }
+        if self.run_id:
+            rec["run_id"] = self.run_id
+        self.sink.write(rec)
+        self._synced = True
+
+    def event(self, ph: str, name: str, *, ts: Optional[float] = None,
+              dur: Optional[float] = None, tid: str = "main",
+              cat: Optional[str] = None, span_id: Optional[str] = None,
+              parent_id: Optional[str] = None,
+              args: Optional[Dict[str, Any]] = None) -> None:
+        """Emit one trace_event.  ``ts``/``dur`` are perf_counter
+        seconds (``ts`` defaults to now)."""
+        if ph not in _PHASES:
+            raise ValueError(f"ph must be one of {_PHASES}, got {ph!r}")
+        if not self._synced:
+            self._clock_sync()
+        rec: Dict[str, Any] = {
+            "record": "trace_event",
+            "ph": ph,
+            "name": name,
+            "ts": time.perf_counter() if ts is None else ts,
+            "tid": tid,
+            "trace_id": self.trace_id,
+        }
+        if dur is not None:
+            rec["dur"] = dur
+        if cat is not None:
+            rec["cat"] = cat
+        if span_id is not None:
+            rec["span_id"] = span_id
+        if parent_id is not None:
+            rec["parent_id"] = parent_id
+        if args:
+            rec["args"] = args
+        if self.run_id:
+            rec["run_id"] = self.run_id
+        self.sink.write(rec)
+        self.events += 1
+
+    # ------------------------------------------------------- sugar
+
+    def begin(self, name: str, *, ts: Optional[float] = None,
+              tid: str = "main", cat=None,
+              span_id: Optional[str] = None, parent_id=None,
+              args=None) -> str:
+        """Open a nested region on ``tid``; returns its span id (pass
+        it to children as ``parent_id``).  Must be closed by ``end`` on
+        the same tid — stack-wise, like the Chrome B/E contract."""
+        sid = span_id or self.next_id()
+        self.event("B", name, ts=ts, tid=tid, cat=cat, span_id=sid,
+                   parent_id=parent_id, args=args)
+        return sid
+
+    def end(self, name: str, *, ts: Optional[float] = None,
+            tid: str = "main", args=None) -> None:
+        self.event("E", name, ts=ts, tid=tid, args=args)
+
+    def complete(self, name: str, ts: float, dur: float, *,
+                 tid: str = "main", cat=None,
+                 span_id: Optional[str] = None, parent_id=None,
+                 args=None) -> str:
+        """A complete span, timestamps known at emission (the
+        reconstruct-after-the-fact shape)."""
+        sid = span_id or self.next_id()
+        self.event("X", name, ts=ts, dur=max(dur, 0.0), tid=tid, cat=cat,
+                   span_id=sid, parent_id=parent_id, args=args)
+        return sid
+
+    def instant(self, name: str, *, ts: Optional[float] = None,
+                tid: str = "main", cat=None, parent_id=None,
+                args=None) -> None:
+        self.event("i", name, ts=ts, tid=tid, cat=cat,
+                   parent_id=parent_id, args=args)
+
+
+# Process-default instance (the costmodel pattern): serve.py / train.py
+# install one under --trace; the span layer and the serve engine consult
+# it so call sites stay flag-free.
+_default: Optional[Tracer] = None
+
+
+def set_default(tracer: Optional[Tracer]) -> None:
+    global _default
+    _default = tracer
+
+
+def get_default() -> Optional[Tracer]:
+    return _default

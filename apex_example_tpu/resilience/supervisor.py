@@ -45,6 +45,18 @@ restart loop: the child runs its own grace path, the supervisor exits
 with the child's status (75 if the child saved — a supervisor-of-
 supervisors can resume the whole tree).
 
+Trace continuity (schema v9, obs/trace.py): every child launches with
+``APEX_TRACE_ID`` set (inherited from our own environment when a
+grand-supervisor set it, else our run id), so the attempt streams of a
+``--trace`` child all carry ONE trace_id — a SIGTERM -> drain ->
+restart renders as one continuous timeline when
+``tools/trace_export.py`` merges them.  When the child argv carries
+``--trace`` the supervisor also emits its own side of the story into
+its stream: a ``clock_sync`` anchor, an X "attempt" span per child
+lifetime and an "i" restart marker per restart decision (timestamps
+are ``perf_counter``, like every trace event; the wall clock stays in
+the records' ``time`` fields only).
+
 The contract is child-agnostic: serve.py's graceful drain exits the
 same 75, so the supervisor restarts a drained server promptly and a
 crashed one with backoff.  Serving children differ in two ways —
@@ -69,9 +81,10 @@ from typing import Any, Dict, List, Optional
 
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) and
 # resilience/preemption.py (EX_TEMPFAIL) — this module must not import
-# either (jax-free contract).
-SCHEMA = 8
+# either (jax-free contract; same for obs/trace.py's APEX_TRACE_ID).
+SCHEMA = 9
 EX_TEMPFAIL = 75
+TRACE_ID_ENV = "APEX_TRACE_ID"
 
 
 def latest_checkpoint_step(directory: Optional[str]) -> Optional[int]:
@@ -249,6 +262,12 @@ class Supervisor:
         self.run_id = uuid.uuid4().hex[:12]
         self.restart_count = 0
         self._stream = _Stream(metrics_jsonl)
+        # Cross-restart trace continuity: children join OUR trace (or
+        # the one a grand-supervisor handed us) via the env; our own
+        # trace events are only emitted when the child actually traces.
+        self.trace_id = os.environ.get(TRACE_ID_ENV) or self.run_id
+        self._tracing = any(a == "--trace" for a in self.child_argv)
+        self._trace_synced = False
         self._stop = False
         self._child: Optional[subprocess.Popen] = None
         self._stall_killed = False
@@ -291,6 +310,32 @@ class Supervisor:
             "steps": int(last_step or 0), "overflow_count": 0,
             "restart_count": self.restart_count,
             "exit_code": int(exit_code)})
+
+    def _trace_event(self, ph: str, name: str, ts: float,
+                     dur: Optional[float] = None,
+                     args: Optional[Dict[str, Any]] = None) -> None:
+        """Schema-v9 trace_event into the supervisor's own stream
+        (hard-coded like every record here — the jax-free contract
+        forbids importing obs/trace.py's Tracer, not matching it).
+        ``ts``/``dur`` are perf_counter seconds; the lazy clock_sync
+        anchors them to the wall clock for the exporter."""
+        if not self._tracing:
+            return
+        if not self._trace_synced:
+            self._stream.write({
+                "record": "clock_sync", "time": time.time(),
+                "ts": time.perf_counter(), "trace_id": self.trace_id,
+                "run_id": self.run_id})
+            self._trace_synced = True
+        rec: Dict[str, Any] = {
+            "record": "trace_event", "ph": ph, "name": name, "ts": ts,
+            "tid": "supervisor", "trace_id": self.trace_id,
+            "run_id": self.run_id}
+        if dur is not None:
+            rec["dur"] = dur
+        if args:
+            rec["args"] = args
+        self._stream.write(rec)
 
     # ----------------------------------------------------------- child
 
@@ -445,7 +490,14 @@ class Supervisor:
                 metrics_path = self._metrics_path(attempt)
                 self._stall_killed = False
                 t_launch = time.time()
-                self._child = subprocess.Popen(argv)
+                t_launch_perf = time.perf_counter()
+                # Children join the supervisor's trace: a --trace
+                # child's Tracer picks the id up from the env, so a
+                # drain -> restart renders as ONE timeline across the
+                # attempt streams (obs/trace.py).
+                child_env = dict(os.environ)
+                child_env[TRACE_ID_ENV] = self.trace_id
+                self._child = subprocess.Popen(argv, env=child_env)
                 if self._stop:
                     # A stop signal that raced the launch (after the
                     # loop-top check, before Popen) was forwarded to a
@@ -456,6 +508,11 @@ class Supervisor:
                     except OSError:  # pragma: no cover
                         pass
                 rc = self._wait(metrics_path)
+                self._trace_event(
+                    "X", "attempt", t_launch_perf,
+                    dur=time.perf_counter() - t_launch_perf,
+                    args={"attempt": attempt + self._attempt_offset,
+                          "exit_code": int(rc)})
                 # Only trust a tail the CHILD just wrote: a file whose
                 # mtime predates this launch is a previous attempt's (or
                 # a previous supervisor incarnation's) — a child that
@@ -503,6 +560,11 @@ class Supervisor:
                 if ckstep is not None:
                     rec["checkpoint_step"] = ckstep
                 self._stream.write(rec)
+                self._trace_event(
+                    "i", "restart", time.perf_counter(),
+                    args={"attempt": attempt + self._attempt_offset,
+                          "exit_code": int(rc), "reason": reason,
+                          "backoff_s": float(backoff)})
                 self.log(f"supervisor: child exited {rc} ({reason}) at "
                          f"step {last_step if last_step is not None else '?'}"
                          f", checkpoint at "

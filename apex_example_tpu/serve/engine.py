@@ -69,6 +69,7 @@ import numpy as np
 
 from apex_example_tpu.models.gpt import sample_tokens
 from apex_example_tpu.obs import costmodel as costmodel_lib
+from apex_example_tpu.obs import trace as trace_lib
 from apex_example_tpu.obs.metrics import Histogram, nearest_rank
 from apex_example_tpu.resilience.faults import FaultInjected
 from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
@@ -76,7 +77,12 @@ from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
 from apex_example_tpu.serve.slots import BlockPool
 
 
-def _now() -> float:
+def _wall() -> float:
+    """Wall clock, for the ``time`` field of EMITTED RECORDS only.
+    Every duration in this module is a difference of ``perf_counter``
+    readings (the monotonic clock); the two domains meet nowhere except
+    the ``clock_sync`` anchor a --trace run writes (obs/trace.py) —
+    never in a subtraction."""
     return time.time()
 
 
@@ -133,7 +139,7 @@ def request_complete_record(comp: Completion,
     """The schema-v3 ``request_complete`` record for one ok completion."""
     rec: Dict[str, Any] = {
         "record": "request_complete",
-        "time": _now(),
+        "time": _wall(),
         "request_id": comp.request.uid,
         "prompt_tokens": len(comp.request.prompt),
         "output_tokens": len(comp.tokens),
@@ -160,7 +166,7 @@ def request_failed_record(comp: Completion,
     record instead — they are requeued, not failed)."""
     rec: Dict[str, Any] = {
         "record": "request_failed",
-        "time": _now(),
+        "time": _wall(),
         "request_id": comp.request.uid,
         "status": comp.status,
         "prompt_tokens": len(comp.request.prompt),
@@ -235,6 +241,18 @@ class ServeEngine:
         self._kv_hist = Histogram("serve.kv_bytes_live")
         self._blk_hist = Histogram("serve.blocks_live")
         self._committed_hist = Histogram("serve.kv_bytes_committed")
+        # --trace (obs/trace.py): the process-default tracer, when one
+        # is armed, receives the per-tick admit/dispatch/harvest spans
+        # and a per-request lifecycle span tree.  Everything below is
+        # host-side bookkeeping of timestamps the engine already takes:
+        # tracing changes NO device work and the compiled decode step
+        # is untouched.  _rtrace buffers each admitted request's
+        # prefill-chunk windows so its whole tree can be emitted in
+        # timestamp order at terminal time (a request stranded
+        # mid-flight at a --steps cap simply never emits, rather than
+        # leaving an unbalanced span behind).
+        self._tracer = trace_lib.get_default()
+        self._rtrace: Dict[str, List] = {}
 
     # ---------------------------------------------------------- intake
 
@@ -312,6 +330,8 @@ class ServeEngine:
                     self.queue.push_front(req)
                     break
                 pool.admit(req, step)
+                if self._tracer is not None:
+                    self._rtrace[req.uid] = []   # prefill-chunk buffer
         live = pool.live
         if not live:
             self.step_count += 1
@@ -323,6 +343,23 @@ class ServeEngine:
                 self.fault.maybe_fire(tick1)
             return False
 
+        tracer = self._tracer
+        tick_sid = None
+        t_admit_end = now
+        if tracer is not None:
+            # The tick span opens retroactively at the tick boundary
+            # (``now``, taken before expire/admit ran) so the admit
+            # phase is inside it; idle ticks emit nothing — a
+            # wall-clock producer's idle spin must not flood the
+            # stream.
+            t_admit_end = time.perf_counter()
+            tick_sid = tracer.begin("tick", tid="engine", ts=now,
+                                    cat="tick",
+                                    args={"tick": step,
+                                          "live": len(live)})
+            tracer.complete("admit", now, t_admit_end - now,
+                            tid="engine", cat="tick",
+                            parent_id=tick_sid)
         S, C = pool.num_slots, pool.block_size
         tok = np.zeros((S, C), np.int32)
         fill = np.zeros((S,), np.int32)
@@ -355,6 +392,14 @@ class ServeEngine:
         nxt = np.asarray(nxt)          # the scheduler's host sync
         finite = np.asarray(finite)
         now = time.perf_counter()
+        t_dispatch_end = now
+        if tracer is not None:
+            # Dispatch = host marshal + the compiled step + the host
+            # sync above: what one tick paid for device work.
+            tracer.complete("dispatch", t_admit_end, now - t_admit_end,
+                            tid="engine", cat="tick",
+                            parent_id=tick_sid,
+                            args={"lanes": int(n_new.sum())})
 
         fault = self.fault
         fail_slot = -1
@@ -382,11 +427,20 @@ class ServeEngine:
         for i in live:
             slot = pool.slots[i]
             reason = None
+            was_prefilling = slot.prefilling
             try:
                 if i == fail_slot:
                     raise FaultInjected(
                         f"injected slot_fail at tick {tick1} (slot {i})")
                 pool.commit_writes(i, int(n_new[i]))
+                if tracer is not None and was_prefilling:
+                    # Buffer the chunk window (the tick's dispatch
+                    # span) on the request; its tree is emitted whole,
+                    # in timestamp order, at terminal time.
+                    self._rtrace.setdefault(
+                        slot.request.uid, []).append(
+                        (t_admit_end, t_dispatch_end, int(n_new[i]),
+                         int(cow_dst[i]) >= 0))
                 if slot.prefilling:
                     continue           # prompt chunk fed; output discarded
                 out = int(nxt[i])
@@ -440,6 +494,14 @@ class ServeEngine:
             self.registry.gauge("serve.slots_live").set(live_slots)
             self.registry.gauge("serve.kv_bytes_live").set(kv_live)
             self.registry.gauge("serve.blocks_live").set(blocks_live)
+        if tracer is not None:
+            t_end = time.perf_counter()
+            tracer.complete("harvest", t_dispatch_end,
+                            t_end - t_dispatch_end, tid="engine",
+                            cat="tick", parent_id=tick_sid,
+                            args={"live": live_slots,
+                                  "blocks": blocks_live})
+            tracer.end("tick", tid="engine", ts=t_end)
         self.step_count += 1
         if fault is not None:
             # crash/sigterm/hang fire AFTER the tick's harvest (matching
@@ -487,6 +549,7 @@ class ServeEngine:
             error=digest)
         self.completions.append(comp)
         self.counts[status] += 1
+        self._trace_request(comp, slot_blocks=slot.n_mapped)
         self.pool.evict(idx)
         if self.sink is not None:
             record = request_complete_record if status == "ok" \
@@ -509,11 +572,12 @@ class ServeEngine:
             status=status)
         self.completions.append(comp)
         self.counts[status] += 1
+        self._trace_request(comp)
         if self.sink is None:
             return
         if status == "shed":
             rec: Dict[str, Any] = {
-                "record": "shed", "time": _now(), "request_id": req.uid,
+                "record": "shed", "time": _wall(), "request_id": req.uid,
                 "reason": "queue_full", "step": self.step_count,
                 "pending": pending if pending is not None
                 else self.queue.arrived_pending(self.step_count)}
@@ -525,6 +589,59 @@ class ServeEngine:
         elif status in ("timeout", "cancelled", "failed", "rejected"):
             self.sink.write(request_failed_record(comp, self.run_id))
         # "drained": accounted by the serve_drain record, not per-request.
+
+    # ----------------------------------------------------------- trace
+
+    def _trace_request(self, comp: Completion,
+                       slot_blocks: int = 0) -> None:
+        """Emit one terminated request's lifecycle span tree (--trace):
+        a root "request" span on its own ``req/<uid>`` row, with
+        submit-handoff / queued / per-chunk prefill / decode child
+        spans and first_token + terminal-status instants — every
+        timestamp a ``perf_counter`` the request already accumulated on
+        its way through, emitted in timestamp order at terminal time
+        (obs/trace.py module docstring on why X-after-the-fact)."""
+        tracer = self._tracer
+        if tracer is None:
+            return
+        req = comp.request
+        chunks = self._rtrace.pop(req.uid, [])
+        t_arr = req.t_arrival
+        t_sub = req.t_submit
+        start = t_sub if t_sub is not None and t_sub < t_arr else t_arr
+        tid = f"req/{req.uid}"
+        args: Dict[str, Any] = {
+            "request_id": req.uid, "status": comp.status,
+            "prompt_tokens": len(req.prompt),
+            "output_tokens": len(comp.tokens)}
+        if comp.slot >= 0:
+            args["slot"] = comp.slot
+            args["admitted_tick"] = comp.admitted_step
+            args["blocks"] = slot_blocks
+            args["cow_copies"] = sum(1 for c in chunks if c[3])
+        root = tracer.complete("request", start, comp.t_finish - start,
+                               tid=tid, cat="request", args=args)
+        if t_sub is not None and t_arr > t_sub:
+            # loadgen -> queue handoff (Request.t_submit): client-side
+            # latency the queue-wait metric must not absorb.
+            tracer.complete("submit", t_sub, t_arr - t_sub, tid=tid,
+                            cat="request", parent_id=root)
+        q_end = comp.t_admitted if comp.t_admitted is not None \
+            else comp.t_finish
+        tracer.complete("queued", t_arr, q_end - t_arr, tid=tid,
+                        cat="request", parent_id=root)
+        for t0, t1, n_toks, cow in chunks:
+            tracer.complete("prefill", t0, t1 - t0, tid=tid,
+                            cat="request", parent_id=root,
+                            args={"tokens": n_toks, "cow": cow})
+        if comp.t_first_token is not None:
+            tracer.instant("first_token", ts=comp.t_first_token,
+                           tid=tid, parent_id=root)
+            tracer.complete("decode", comp.t_first_token,
+                            comp.t_finish - comp.t_first_token,
+                            tid=tid, cat="request", parent_id=root)
+        tracer.instant(comp.status, ts=comp.t_finish, tid=tid,
+                       parent_id=root, args={"tick": comp.finished_step})
 
     # ------------------------------------------------------------ loop
 
@@ -556,6 +673,13 @@ class ServeEngine:
         ``serve_summary`` and exits ``EX_TEMPFAIL``."""
         self.draining = True
         drain_step = self.step_count
+        if self._tracer is not None:
+            # B/E (not X): the drain-phase ticks nest inside it on the
+            # engine row, and a drain always runs to completion within
+            # the bounded cap below, so the pair is balanced.
+            self._tracer.begin("drain", tid="engine", cat="tick",
+                               args={"signal": str(signal_name),
+                                     "tick": drain_step})
         before = dict(self.counts)
         requeued = self.queue.drain()
         for req in requeued:
@@ -569,7 +693,7 @@ class ServeEngine:
             self.step()
         rec: Dict[str, Any] = {
             "record": "serve_drain",
-            "time": _now(),
+            "time": _wall(),
             "signal": str(signal_name),
             "step": drain_step,
             "in_flight": in_flight,
@@ -581,6 +705,11 @@ class ServeEngine:
         }
         if self.run_id:
             rec["run_id"] = self.run_id
+        if self._tracer is not None:
+            self._tracer.end("drain", tid="engine",
+                             args={"completed": rec["completed"],
+                                   "evicted": rec["evicted"],
+                                   "requeued": rec["requeued"]})
         if self.sink is not None:
             self.sink.write(rec)
         return rec
@@ -605,7 +734,7 @@ class ServeEngine:
         pool = self.pool
         rec: Dict[str, Any] = {
             "record": "serve_summary",
-            "time": _now(),
+            "time": _wall(),
             "requests": len(comps),
             "output_tokens": self._tokens_out,
             "tokens_per_sec": round(self._tokens_out / max(duration, 1e-9),

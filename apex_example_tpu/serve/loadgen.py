@@ -21,6 +21,7 @@ sleeps (ISSUE 5).
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -73,13 +74,21 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
         m = int(rs.randint(max_new[0], max_new[1] + 1))
         prompt = prefix + rs.randint(0, vocab_size, size=(p,)).tolist()
         arrival = (i // burst) * stagger if stagger else None
+        # Client-side submission stamp: the request is BUILT here, then
+        # handed to the queue — a --trace timeline renders the
+        # loadgen->queue handoff as its own span (Request.t_submit).
+        # For arrival_step-gated requests RequestQueue.mature()
+        # re-stamps BOTH clocks at the virtual gate (the build->gate
+        # delay is deliberate staggering, not handoff), so a real
+        # submit span survives only on ungated wall-clock submissions.
         out.append(Request(prompt=prompt, max_new_tokens=m,
                            temperature=temperature, top_k=top_k,
                            eos_id=eos_id,
                            arrival_step=arrival,
                            deadline_step=(arrival or 0) + deadline_steps
                            if deadline_steps is not None else None,
-                           deadline_s=deadline_s))
+                           deadline_s=deadline_s,
+                           t_submit=time.perf_counter()))
     return out
 
 

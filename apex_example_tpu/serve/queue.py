@@ -91,6 +91,12 @@ class Request:
     # queue-wait must not charge the virtual pre-arrival wait to the
     # engine (the load generator builds all requests up front).
     t_arrival: float = field(default_factory=time.perf_counter)
+    # Client-side submission stamp (perf_counter), set by the producer
+    # that BUILT the request (serve/loadgen.py) before it reached the
+    # queue: the loadgen->queue handoff then shows as its own "submit"
+    # span on a --trace timeline instead of folding into queue wait.
+    # None (the default) means the request was built at submission.
+    t_submit: Optional[float] = None
     _arrival_stamped: bool = field(default=False, repr=False)
 
     def __post_init__(self):
@@ -217,6 +223,17 @@ class RequestQueue:
             if request.deadline_s is not None \
                     or request.deadline_step is not None:
                 self._has_deadlines = True
+            # An ungated request "arrives" NOW — at submission, as the
+            # t_arrival docstring has always said — not at whatever
+            # earlier moment the dataclass was constructed: the
+            # build->submit gap is the client's (the "submit" span on
+            # a --trace timeline, when t_submit is stamped), and queue
+            # wait must not absorb it.  Gated requests re-stamp at
+            # their virtual gate instead (mature()).
+            if request.arrival_step is None \
+                    and not request._arrival_stamped:
+                request.t_arrival = time.perf_counter()
+                request._arrival_stamped = True
             self._q.append(request)
 
     def submit_all(self, requests) -> None:
@@ -228,6 +245,12 @@ class RequestQueue:
         ``arrival_step`` has been reached at engine tick ``step`` — even
         the ones not yet poppable (all slots busy): time spent waiting
         AFTER the gate passes is genuine queue wait and must count.
+        ``t_submit`` is re-stamped with it: a virtually-gated request
+        was built up front by the load generator, so the build->gate
+        delay is deliberate staggering, not client handoff — charging
+        it to a "submit" span would reintroduce under a new name the
+        exact pre-arrival wait this re-stamp exists to exclude (real
+        handoff survives only on ungated, wall-clock submissions).
         The engine calls this once per tick, before admission."""
         now = time.perf_counter()
         with self._lock:
@@ -235,6 +258,8 @@ class RequestQueue:
                 if (req.arrival_step is not None and not
                         req._arrival_stamped and req.arrival_step <= step):
                     req.t_arrival = now
+                    if req.t_submit is not None:
+                        req.t_submit = now
                     req._arrival_stamped = True
 
     def shed_overflow(self, step: int) -> List[Request]:

@@ -129,6 +129,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--metrics-jsonl", default=None,
                    help="emit schema-valid serving records to this JSONL")
+    p.add_argument("--trace", action="store_true",
+                   help="with --metrics-jsonl: emit schema-v9 "
+                        "trace_event records — per-tick admit/dispatch/"
+                        "harvest spans and a per-request lifecycle span "
+                        "tree (queued -> prefill chunks -> first_token "
+                        "-> decode -> terminal status) — exportable to "
+                        "Perfetto via tools/trace_export.py; host-only, "
+                        "the compiled decode step is untouched "
+                        "(README 'Request tracing')")
     p.add_argument("--cost-model", action="store_true",
                    help="with --metrics-jsonl: AOT-compile the slot "
                         "decode step and emit schema-v6 compile_event + "
@@ -197,6 +206,9 @@ def run_serve(args):
         raise SystemExit("--cost-model requires --metrics-jsonl (the "
                          "compile_event/cost_model records ride the "
                          "metrics stream)")
+    if args.trace and not args.metrics_jsonl:
+        raise SystemExit("--trace requires --metrics-jsonl (the "
+                         "trace_event records ride the metrics stream)")
     fault = None
     if args.inject_fault:
         try:
@@ -218,6 +230,7 @@ def run_serve(args):
     # Clear any instance a previous in-process run leaked before this
     # run builds its engine (same hygiene as train.make_telemetry).
     obs.costmodel.set_default(None)
+    obs.trace.set_default(None)
     if args.metrics_jsonl:
         sink = obs.JsonlSink(args.metrics_jsonl)
         emitter = obs.TelemetryEmitter(sink)
@@ -233,6 +246,11 @@ def run_serve(args):
             # finally below clears it.
             obs.costmodel.set_default(obs.CostModel(
                 sink=sink, registry=emitter.registry, run_id=run_id))
+        if args.trace:
+            # Same process-default shape: the engine and the span
+            # layer consult it; trace_id joins a supervising parent's
+            # timeline via APEX_TRACE_ID (cross-restart continuity).
+            obs.trace.set_default(obs.Tracer(sink, run_id=run_id))
 
     # The drain grace path (README "Serving resilience"): the handler
     # only sets a flag; the engine loop notices it at the next tick
@@ -303,6 +321,7 @@ def run_serve(args):
         if preempt is not None:
             preempt.close()
         obs.costmodel.set_default(None)
+        obs.trace.set_default(None)
         if sink is not None:
             sink.close()
 

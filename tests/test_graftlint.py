@@ -136,7 +136,8 @@ def test_jax_free_contract_covers_the_retired_runtime_guard_set():
     for required in ("tools/metrics_lint.py", "tools/telemetry_report.py",
                      "tools/fleet_report.py", "tools/serve_report.py",
                      "tools/supervise.py", "tools/cost_report.py",
-                     "tools/ci_gate.py",
+                     "tools/ci_gate.py", "tools/trace_export.py",
+                     "tools/trace_top.py",
                      "apex_example_tpu/resilience/supervisor.py",
                      "apex_example_tpu/obs/schema.py"):
         assert required in contract, f"{required} left the jax-free set"
@@ -355,6 +356,44 @@ def emit(sink):
     assert schema_rules.check(tree) == []
 
 
+def test_schema_emission_picks_up_v9_trace_tables():
+    """ISSUE 11 regression: the REAL schema module's v9 tables reach
+    the AST rule — an undeclared field on a ``trace_event`` emission
+    and a brand-new emission site without a schema bump both fire
+    statically, and a well-formed trace emitter stays quiet.  This
+    pins 'a new field can never ship without a schema bump' for the
+    trace stratum specifically, not just via runtime validation."""
+    with open(os.path.join(REPO, "apex_example_tpu", "obs",
+                           "schema.py")) as fh:
+        real_schema = fh.read()
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": real_schema,
+        "pkg/emit.py": """
+def emit(sink, ts):
+    ok = {"record": "trace_event", "ph": "X", "name": "tick", "ts": ts,
+          "tid": "engine", "dur": 0.5}
+    sink.write(ok)
+    sink.write({"record": "clock_sync", "time": 1.0, "ts": ts})
+"""})
+    assert schema_rules.check(tree) == []       # valid emitters: quiet
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": real_schema,
+        "pkg/emit.py": """
+def emit(sink, ts):
+    rec = {"record": "trace_event", "ph": "X", "name": "tick", "ts": ts}
+    rec["wall_time"] = 1.0     # undeclared field: needs a schema bump
+    sink.write(rec)
+    sink.write({"record": "span_event", "ts": ts})   # new emission site
+    sink.write({"record": "trace_event", "ph": "B"}) # missing name/ts
+"""})
+    msgs = [f.message for f in schema_rules.check(tree)]
+    assert any("'trace_event' emits field 'wall_time'" in m
+               and "bump the schema" in m for m in msgs)
+    assert any("unknown record type 'span_event'" in m for m in msgs)
+    assert any("never sets required field 'name'" in m for m in msgs)
+    assert any("never sets required field 'ts'" in m for m in msgs)
+
+
 def test_schema_emission_dynamic_builders_skip_missing_check_only():
     """A ``**``-built record (bench.py shape) can't be proven complete
     statically — but its literal keys are still checked."""
@@ -533,7 +572,7 @@ def test_schema_v8_recompile_cause_validates():
         os.path.join(REPO, "apex_example_tpu", "obs", "schema.py"))
     schema = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(schema)
-    assert schema.SCHEMA_VERSION == 8
+    assert schema.SCHEMA_VERSION >= 9   # v8's tables are a subset since
     rec = {"record": "compile_event", "time": 1.0, "name": "f",
            "compile_ms": 5.0, "n_compiles": 2,
            "recompile_cause": "first divergent op: convert"}

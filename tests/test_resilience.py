@@ -608,13 +608,19 @@ def test_supervised_serve_drain_e2e(tmp_path):
     75 (EX_TEMPFAIL); tools/supervise.py treats that as prompt-restart
     (--no-resume, --drop-flag-on-restart stripping the one-shot drill),
     rotates the serve metrics stream, and the restarted attempt serves
-    to completion."""
+    to completion.
+
+    The child runs with --trace (ISSUE 11, same subprocess pair): the
+    APEX_TRACE_ID env handoff makes BOTH attempt streams and the
+    supervisor's own stream carry ONE trace_id, and the merged
+    trace_export timeline renders the drain + restart spans — a
+    supervised SIGTERM -> drain -> restart is one continuous story."""
     child_metrics = str(tmp_path / "serve.jsonl")
     sup_path = str(tmp_path / "sup.jsonl")
     child = [sys.executable, os.path.join(REPO, "serve.py"),
              "--requests", "6", "--slots", "2", "--max-len", "16",
              "--prompt-len", "3:5", "--max-new", "3:6", "--stagger", "2",
-             "--seed", "7", "--metrics-jsonl", child_metrics,
+             "--seed", "7", "--metrics-jsonl", child_metrics, "--trace",
              "--inject-fault", "sigterm@4"]
     supervise = _load_tool("supervise")
     rc = supervise.main(["--metrics-jsonl", sup_path,
@@ -626,10 +632,12 @@ def test_supervised_serve_drain_e2e(tmp_path):
 
     sup_recs = obs.read_jsonl(sup_path)
     assert obs_schema.validate_stream(sup_recs) == []
-    # no checkpoints, no resumes — just one drain-restart
-    assert [r["record"] for r in sup_recs] == \
+    # no checkpoints, no resumes — just one drain-restart (the trace
+    # stratum rides alongside: clock_sync + attempt/restart spans)
+    assert [r["record"] for r in sup_recs
+            if r["record"] not in ("trace_event", "clock_sync")] == \
         ["run_header", "restart", "run_summary"]
-    restart = sup_recs[1]
+    restart = next(r for r in sup_recs if r["record"] == "restart")
     assert restart["exit_code"] == EX_TEMPFAIL == 75   # the wire contract
     assert restart["reason"] == "preemption"
     assert sup_recs[-1]["restart_count"] == 1
@@ -665,3 +673,33 @@ def test_supervised_serve_drain_e2e(tmp_path):
     lint = _load_tool("metrics_lint")
     assert lint.lint(child_metrics)[0] == 0
     assert lint.lint(child_metrics + ".attempt1")[0] == 0
+
+    # --- cross-restart trace continuity (ISSUE 11) ---------------
+    # one trace_id across the drained attempt, the restarted attempt
+    # AND the supervisor's own stream (the APEX_TRACE_ID handoff)
+    streams = [att0, att1, sup_recs]
+    ids = {r["trace_id"] for recs in streams for r in recs
+           if r["record"] in ("trace_event", "clock_sync")
+           and "trace_id" in r}
+    assert len(ids) == 1, ids
+    # each stream carries its own clock_sync anchor
+    assert all(sum(1 for r in recs if r["record"] == "clock_sync") == 1
+               for recs in streams)
+    # attempt 0 traced the drain; the supervisor traced the restart
+    names0 = [r["name"] for r in att0 if r["record"] == "trace_event"]
+    assert "drain" in names0
+    sup_names = [r["name"] for r in sup_recs
+                 if r["record"] == "trace_event"]
+    assert sup_names == ["attempt", "restart", "attempt"]
+    # the merged export is ONE structurally-clean timeline holding
+    # the drain span and the restart marker
+    export = _load_tool("trace_export")
+    paths = [child_metrics, child_metrics + ".attempt1", sup_path]
+    assert export.main(["--check"] + paths) == 0
+    merged = str(tmp_path / "merged.json")
+    assert export.main(paths + ["-o", merged]) == 0
+    evs = json.load(open(merged))["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert "drain" in names and "restart" in names and "attempt" in names
+    assert len({e["pid"] for e in evs
+                if e.get("ph") not in ("M",)}) == 3   # 3 process rows

@@ -87,7 +87,14 @@ def test_continuous_batching_smoke(model_and_params, tmp_path, capsys):
     """The acceptance bar: >= 8 synthetic requests, staggered arrivals,
     mixed prompt/output lengths, SLOTS=4 — greedy outputs token-identical
     to one-shot generate(), completions interleaved across admission
-    waves, JSONL lints, serve_report shows nonzero TTFT/TPOT."""
+    waves, JSONL lints, serve_report shows nonzero TTFT/TPOT.
+
+    Runs WITH --trace armed (ISSUE 11): the same smoke also proves the
+    trace stratum is a pure observer — token identity holds, the stream
+    exports to valid Chrome JSON, the structural lint passes, and the
+    per-request critical-path components sum to each request's e2e
+    latency within 1%."""
+    from apex_example_tpu.obs import trace as trace_lib
     model, params = model_and_params
     path = str(tmp_path / "serve.jsonl")
     sink = obs.JsonlSink(path, rank=0)
@@ -100,8 +107,12 @@ def test_continuous_batching_smoke(model_and_params, tmp_path, capsys):
     # mixed lengths actually present
     assert len({len(r.prompt) for r in reqs}) > 1
     assert len({r.max_new_tokens for r in reqs}) > 1
-    eng, comps = _run_engine(model, params, reqs, sink=sink,
-                             run_id=emitter.run_id)
+    trace_lib.set_default(obs.Tracer(sink, run_id=emitter.run_id))
+    try:
+        eng, comps = _run_engine(model, params, reqs, sink=sink,
+                                 run_id=emitter.run_id)
+    finally:
+        trace_lib.set_default(None)
     sink.write(eng.summary_record())
     sink.close()
     assert len(comps) == 8
@@ -157,6 +168,40 @@ def test_continuous_batching_smoke(model_and_params, tmp_path, capsys):
     assert summary["kv_waste_pct"] <= 40.0
     assert summary["blocks_total"] == SLOTS * (MAX_LEN // 8)
     assert 0 < summary["blocks_live"]["max"] <= summary["blocks_total"]
+
+    # (e) the ISSUE 11 acceptance bar: the traced stream exports to
+    # valid Chrome trace JSON, passes the structural lint, carries the
+    # per-tick + per-request span vocabulary, and serve_report's
+    # critical-path components sum to each request's e2e within 1%.
+    evs = [r for r in records if r["record"] == "trace_event"]
+    assert evs and sum(1 for r in records
+                       if r["record"] == "clock_sync") == 1
+    names = {e["name"] for e in evs}
+    assert {"tick", "admit", "dispatch", "harvest", "request", "queued",
+            "prefill", "decode", "first_token", "ok"} <= names
+    # these requests are all arrival_step-GATED: mature() re-stamps
+    # t_submit with t_arrival, so no "submit" span may appear — the
+    # deliberate stagger must not masquerade as client handoff
+    # (review regression)
+    assert "submit" not in names
+    # one request root per request, each with its lifecycle children
+    roots = [e for e in evs if e["name"] == "request"]
+    assert len(roots) == 8
+    assert all(e["args"]["status"] == "ok" and e["args"]["blocks"] > 0
+               and e["args"]["slot"] >= 0 for e in roots)
+    export = _load_tool("trace_export")
+    assert export.main(["--check", path]) == 0
+    out_json = str(tmp_path / "trace.json")
+    assert export.main([path, "-o", out_json]) == 0
+    doc = json.loads(open(out_json).read())      # valid JSON
+    assert any(e.get("ph") == "s" for e in doc["traceEvents"])  # flows
+    rows = report.critical_path(records)
+    assert len(rows) == 8
+    for row in rows:
+        total = row["queue_ms"] + row["prefill_ms"] \
+            + row["decode_ms"] + row["stall_ms"]
+        assert total == pytest.approx(row["e2e_ms"], rel=0.01), row
+    capsys.readouterr()                          # drop the tool stdout
 
 
 # ------------------------------------------------- per-slot sampling
@@ -267,6 +312,10 @@ def test_serve_cli_smoke(tmp_path, capsys):
     assert records[0]["record"] == "run_header"
     assert records[0]["schema"] == obs_schema.SCHEMA_VERSION
     assert records[-1]["record"] == "serve_summary"
+    # --trace off: not a single trace-stratum record in the stream
+    # (the v9 contract — byte-identical streams without the flag)
+    assert not any(r["record"] in ("trace_event", "clock_sync")
+                   for r in records)
 
 
 def test_serve_cli_steps_cap(tmp_path, capsys):
@@ -409,8 +458,13 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
     ``compile_events.gate`` runs the actual cost_report
     --fail-on-recompile CI command over the stream).  Also checks the
     serve_summary KV gauges, v6 + the v7 block stratum.  Rides the
-    session's SLOTS=4/MAX_LEN=32 decode geometry."""
+    session's SLOTS=4/MAX_LEN=32 decode geometry.
+
+    --trace rides along (ISSUE 11): tracing is host-only, so the ONE
+    compile_event is also the proof that arming the tracer adds ZERO
+    compiled programs — the decode step is untouched."""
     from apex_example_tpu.obs import costmodel
+    from apex_example_tpu.obs import trace as trace_lib
     model, params = model_and_params
     path = str(tmp_path / "cm_serve.jsonl")
     sink = obs.JsonlSink(path, rank=0)
@@ -419,6 +473,7 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
                        arch="gpt_tiny")
     costmodel.set_default(obs.CostModel(
         sink=sink, registry=emitter.registry, run_id=emitter.run_id))
+    trace_lib.set_default(obs.Tracer(sink, run_id=emitter.run_id))
     try:
         reqs = synthetic_requests(6, vocab_size=model.vocab_size, seed=5,
                                   prompt_len=(3, 6), max_new=(3, 6),
@@ -432,6 +487,7 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
         comps = eng.run(max_steps=2000)
     finally:
         costmodel.set_default(None)
+        trace_lib.set_default(None)
     sink.write(eng.summary_record())
     sink.close()
     assert len(comps) == 6
@@ -439,9 +495,12 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
     records = obs.read_jsonl(path)
     assert obs_schema.validate_stream(records) == []
     # recompile guard: one engine, one decode program, one compilation —
-    # asserted on the counter AND through the CI gate command itself
+    # asserted on the counter AND through the CI gate command itself.
+    # The tracer was armed for the whole run: ZERO new compiled
+    # programs with tracing on.
     assert compile_events(records) == {"serve_decode_step": 1}
     assert compile_events.gate(path) == 0
+    assert any(r["record"] == "trace_event" for r in records)
     cm = next(r for r in records if r["record"] == "cost_model")
     assert cm["name"] == "serve_decode_step"
     assert cm["flops"] > 0 and cm["bytes_accessed"] > 0
@@ -894,6 +953,8 @@ def test_serve_cli_rejects_bad_fault():
         serve_mod.main(["--inject-fault", "slot_fail"])
     with pytest.raises(SystemExit, match="flight-recorder"):
         serve_mod.main(["--flight-recorder"])     # needs --metrics-jsonl
+    with pytest.raises(SystemExit, match="trace"):
+        serve_mod.main(["--trace"])               # needs --metrics-jsonl
 
 
 # ------------------------------------------------------- schema v5

@@ -1,0 +1,506 @@
+"""The trace-event stratum (obs/trace.py, tools/trace_export.py;
+ISSUE 11):
+
+- Tracer mechanics: lazy one-per-stream clock_sync, B/E/X/i emission,
+  unique span ids, ph validation,
+- schema v9: trace_event / clock_sync validate, malformed rejected,
+  v1-v8 streams still validate unchanged,
+- obs.span -> trace_event wiring (armed: X events with parent nesting;
+  unarmed: stream untouched),
+- trace_export: wall-clock merge of multi-process streams (clock_sync
+  anchoring), Chrome metadata rows, admission flows, the xprof overlay,
+  and the --check structural lint (balanced B/E, monotonic rows,
+  orphans, containment, clock_sync count) wired through ci_gate,
+- serve_report's per-request critical-path decomposition (components
+  sum to e2e),
+- supervisor-side continuity units: APEX_TRACE_ID env handoff to
+  children, attempt/restart trace events gated on a --trace child.
+
+Everything here is host-side: no model, no compile — the jax imports
+are the obs package's own.  The serving/e2e acceptance rides
+tests/test_serve.py (traced smoke) and tests/test_resilience.py
+(cross-restart continuity).
+"""
+
+import gzip
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from apex_example_tpu import obs
+from apex_example_tpu.obs import schema as obs_schema
+from apex_example_tpu.obs import trace as trace_lib
+
+pytestmark = pytest.mark.trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+# ------------------------------------------------------ Tracer core
+
+def test_tracer_clock_sync_lazy_and_events_validate():
+    sink = ListSink()
+    tr = trace_lib.Tracer(sink, trace_id="t1", run_id="r1")
+    assert sink.records == []            # armed but silent until traced
+    sid = tr.begin("tick", tid="engine", args={"tick": 0})
+    tr.complete("admit", 1.0, 0.5, tid="engine", parent_id=sid)
+    tr.instant("mark", tid="engine", parent_id=sid)
+    tr.end("tick", tid="engine")
+    assert [r["record"] for r in sink.records] == \
+        ["clock_sync", "trace_event", "trace_event", "trace_event",
+         "trace_event"]
+    sync = sink.records[0]
+    assert sync["trace_id"] == "t1" and sync["run_id"] == "r1"
+    # one sync per stream, ever
+    tr.instant("again")
+    assert sum(1 for r in sink.records
+               if r["record"] == "clock_sync") == 1
+    for rec in sink.records:
+        assert obs_schema.validate_record(rec) == [], rec
+    assert tr.events == 5
+    x = sink.records[2]
+    assert x["ph"] == "X" and x["ts"] == 1.0 and x["dur"] == 0.5
+    assert x["parent_id"] == sid
+    with pytest.raises(ValueError, match="ph"):
+        tr.event("Q", "bogus")
+    # span ids never collide
+    ids = {tr.next_id() for _ in range(100)}
+    assert len(ids) == 100
+
+
+def test_tracer_trace_id_from_env(monkeypatch):
+    monkeypatch.setenv(trace_lib.TRACE_ID_ENV, "from-parent")
+    tr = trace_lib.Tracer(ListSink())
+    assert tr.trace_id == "from-parent"
+    monkeypatch.delenv(trace_lib.TRACE_ID_ENV)
+    assert trace_lib.Tracer(ListSink()).trace_id != "from-parent"
+
+
+# ------------------------------------------------------- schema v9
+
+def test_schema_v9_trace_records_validate():
+    assert obs_schema.SCHEMA_VERSION == 9
+    ev = {"record": "trace_event", "ph": "X", "name": "request",
+          "ts": 1.25, "dur": 0.5, "cat": "request", "tid": "req/r-1",
+          "span_id": "s1", "parent_id": "s0", "trace_id": "t",
+          "args": {"slot": 1}, "run_id": "r"}
+    sync = {"record": "clock_sync", "time": 1e9, "ts": 12.5,
+            "trace_id": "t", "run_id": "r"}
+    assert obs.validate_record(ev) == []
+    assert obs.validate_record(sync) == []
+    # malformed still rejected: unknown field, missing required, typed
+    assert obs.validate_record(dict(ev, typo=1))
+    assert obs.validate_record({"record": "trace_event", "ph": "B"})
+    assert obs.validate_record(dict(sync, ts="12"))
+
+
+def test_schema_v1_v8_streams_still_validate():
+    header = {"record": "run_header", "schema": 1, "time": 0.0,
+              "run_id": "r", "num_devices": 1, "process_index": 0,
+              "platform": "cpu", "config": {}}
+    step = {"record": "step", "step": 1, "epoch": 0, "loss": 1.0,
+            "scale": 1.0, "step_time_ms": 5.0, "items_per_sec": 10.0}
+    v1 = [header, step,
+          {"record": "run_summary", "steps": 1, "overflow_count": 0}]
+    v5 = [dict(header, schema=5),
+          {"record": "request_failed", "time": 1.0, "request_id": "r-1",
+           "status": "timeout"},
+          {"record": "serve_summary", "time": 2.0, "requests": 1,
+           "output_tokens": 2, "tokens_per_sec": 5.0}]
+    v8 = [dict(header, schema=8), step,
+          {"record": "compile_event", "time": 1.0, "name": "f",
+           "compile_ms": 5.0, "n_compiles": 2,
+           "recompile_cause": "first divergent op: convert"},
+          {"record": "run_summary", "steps": 1, "overflow_count": 0}]
+    for stream in (v1, v5, v8):
+        assert obs_schema.validate_stream(stream) == []
+
+
+# -------------------------------------------------- span() wiring
+
+def test_span_emits_trace_events_only_when_armed():
+    sink = ListSink()
+    with obs.span("outer"):
+        with obs.span("inner"):
+            pass
+    assert sink.records == []            # unarmed: nothing anywhere
+    trace_lib.set_default(trace_lib.Tracer(sink, trace_id="t"))
+    try:
+        with obs.span("outer") as outer:
+            with obs.span("inner"):
+                pass
+    finally:
+        trace_lib.set_default(None)
+    evs = [r for r in sink.records if r["record"] == "trace_event"]
+    assert [e["name"] for e in evs] == ["inner", "outer"]  # exit order
+    inner, outer_ev = evs
+    assert inner["parent_id"] == outer_ev["span_id"] == outer.span_id
+    assert inner["ph"] == outer_ev["ph"] == "X"
+    assert inner["cat"] == "span"
+    # containment: the child window sits inside the parent's
+    assert inner["ts"] >= outer_ev["ts"]
+    assert inner["ts"] + inner["dur"] <= outer_ev["ts"] \
+        + outer_ev["dur"] + 1e-6
+
+
+# ----------------------------------------------------- trace_export
+
+def _stream(path, events, sync_wall, sync_perf, header=True,
+            trace_id="t"):
+    """Write a synthetic traced stream: run_header, clock_sync, events."""
+    recs = []
+    if header:
+        recs.append({"record": "run_header", "schema": 9, "time": 0.0,
+                     "run_id": "r", "num_devices": 1, "process_index": 0,
+                     "platform": "cpu", "config": {}, "arch": "gpt_tiny"})
+    recs.append({"record": "clock_sync", "time": sync_wall,
+                 "ts": sync_perf, "trace_id": trace_id})
+    recs.extend(dict(e, record="trace_event", trace_id=trace_id)
+                for e in events)
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    return path
+
+
+def test_trace_export_merges_streams_on_one_wall_axis(tmp_path):
+    """Two streams with unrelated perf_counter origins but overlapping
+    wall-clock windows land on one axis via their clock_sync anchors;
+    request spans get admission flows onto the engine row."""
+    export = _load_tool("trace_export")
+    # stream A: perf origin ~100, wall 1000; "engine" + one request
+    a = _stream(str(tmp_path / "a.jsonl"), [
+        {"ph": "B", "name": "tick", "ts": 100.0, "tid": "engine",
+         "span_id": "s1", "cat": "tick"},
+        {"ph": "E", "name": "tick", "ts": 100.5, "tid": "engine"},
+        {"ph": "X", "name": "request", "ts": 100.0, "dur": 0.4,
+         "tid": "req/r-0", "span_id": "s2", "cat": "request",
+         "args": {"request_id": "r-0", "status": "ok", "slot": 1}},
+        {"ph": "X", "name": "queued", "ts": 100.0, "dur": 0.1,
+         "tid": "req/r-0", "span_id": "s3", "parent_id": "s2",
+         "cat": "request"},
+        # a SHED request: root without a slot (never admitted) — its
+        # queued span ends at the terminal time and must NOT grow an
+        # admission flow arrow (review regression)
+        {"ph": "X", "name": "request", "ts": 100.0, "dur": 0.2,
+         "tid": "req/r-1", "span_id": "s4", "cat": "request",
+         "args": {"request_id": "r-1", "status": "shed"}},
+        {"ph": "X", "name": "queued", "ts": 100.0, "dur": 0.2,
+         "tid": "req/r-1", "span_id": "s5", "parent_id": "s4",
+         "cat": "request"},
+    ], sync_wall=1000.0, sync_perf=100.0)
+    # stream B: perf origin ~5000, wall 1000.2 (starts 0.2s later)
+    b = _stream(str(tmp_path / "b.jsonl"), [
+        {"ph": "i", "name": "restart", "ts": 5000.0,
+         "tid": "supervisor"},
+    ], sync_wall=1000.2, sync_perf=5000.0)
+    out = str(tmp_path / "trace.json")
+    assert export.main([a, b, "-o", out]) == 0
+    doc = json.loads(open(out).read())          # valid JSON by contract
+    evs = doc["traceEvents"]
+    # per-stream process rows with name metadata
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any("a.jsonl" in n for n in names)
+    assert any("b.jsonl" in n for n in names)
+    # wall alignment: stream A starts at t=0us, B's instant at +200ms
+    tick_b = next(e for e in evs if e["name"] == "tick"
+                  and e["ph"] == "B")
+    restart = next(e for e in evs if e["name"] == "restart")
+    assert tick_b["ts"] == 0.0
+    assert abs(restart["ts"] - 200000.0) < 1.0
+    # X spans export microsecond durations
+    req = next(e for e in evs if e["name"] == "request")
+    assert req["ph"] == "X" and abs(req["dur"] - 400000.0) < 1.0
+    # the admission flow binds the engine row to the ADMITTED request's
+    # row — exactly one pair: the shed request gets no arrow
+    assert sum(1 for e in evs if e.get("ph") == "s") == 1
+    flow_s = next(e for e in evs if e.get("ph") == "s")
+    flow_f = next(e for e in evs if e.get("ph") == "f")
+    assert flow_s["id"] == flow_f["id"]
+    assert flow_s["ts"] == flow_f["ts"] == pytest.approx(100000.0, abs=1)
+    admitted_root = next(e for e in evs if e["name"] == "request"
+                         and e.get("args", {}).get("slot") == 1)
+    assert flow_f["tid"] == admitted_root["tid"]   # lands on r-0's row
+
+
+def test_trace_export_check_catches_structural_breakage(tmp_path):
+    export = _load_tool("trace_export")
+
+    def run_check(events, **kw):
+        p = _stream(str(tmp_path / "c.jsonl"), events, 1000.0, 10.0,
+                    **kw)
+        records = export.read_stream(p)
+        return export.check_stream(records, p)
+
+    good = [
+        {"ph": "B", "name": "tick", "ts": 10.0, "tid": "engine",
+         "span_id": "s1"},
+        {"ph": "X", "name": "admit", "ts": 10.0, "dur": 0.1,
+         "tid": "engine", "span_id": "s2", "parent_id": "s1"},
+        {"ph": "E", "name": "tick", "ts": 10.5, "tid": "engine"},
+    ]
+    assert run_check(good) == []
+    # unbalanced B
+    errs = run_check(good[:2])
+    assert any("never closed" in e for e in errs)
+    # E without B / wrong nesting
+    errs = run_check([dict(good[2], name="other")] + good[:1])
+    assert any("no open B" in e for e in errs)
+    # backwards B/E timestamps on one row
+    errs = run_check([good[0], dict(good[2], ts=9.0)])
+    assert any("backwards" in e for e in errs)
+    # orphan parent_id
+    errs = run_check([dict(good[1], parent_id="nope")])
+    assert any("orphan parent_id" in e for e in errs)
+    # child escapes its parent's window
+    errs = run_check([good[0], dict(good[1], ts=11.0, dur=5.0),
+                      good[2]])
+    assert any("outside its parent" in e for e in errs)
+    # negative X duration
+    errs = run_check([dict(good[1], dur=-1.0, parent_id=None)])
+    assert any("dur >= 0" in e for e in errs)
+    # malformed ts / null dur on a PARENTED event must be reported,
+    # never crash the containment pass (review regression: the gate
+    # died with a TypeError on exactly the input it exists to catch)
+    errs = run_check([good[0], {"ph": "X", "name": "x", "tid": "engine",
+                                "parent_id": "s1", "dur": None},
+                      good[2]])
+    assert any("non-numeric ts" in e for e in errs)
+    errs = run_check([good[0], dict(good[1], dur=None), good[2]])
+    assert any("dur >= 0" in e for e in errs)
+    # a stream with no trace at all is an error for the gate
+    errs = run_check([])
+    assert any("no trace_event" in e for e in errs)
+    # two clock_syncs
+    p = str(tmp_path / "two.jsonl")
+    with open(p, "w") as fh:
+        for rec in ({"record": "clock_sync", "time": 1.0, "ts": 1.0},
+                    {"record": "clock_sync", "time": 2.0, "ts": 2.0},
+                    {"record": "trace_event", "ph": "i", "name": "m",
+                     "ts": 1.5}):
+            fh.write(json.dumps(rec) + "\n")
+    errs = export.check_stream(export.read_stream(p), p)
+    assert any("2 clock_sync" in e for e in errs)
+    # sync after the first event
+    p2 = str(tmp_path / "late.jsonl")
+    with open(p2, "w") as fh:
+        for rec in ({"record": "trace_event", "ph": "i", "name": "m",
+                     "ts": 1.5},
+                    {"record": "clock_sync", "time": 1.0, "ts": 1.0}):
+            fh.write(json.dumps(rec) + "\n")
+    errs = export.check_stream(export.read_stream(p2), p2)
+    assert any("must precede" in e for e in errs)
+
+
+def test_trace_export_missing_clock_sync_is_unexportable(tmp_path):
+    export = _load_tool("trace_export")
+    p = str(tmp_path / "nosync.jsonl")
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"record": "trace_event", "ph": "i",
+                             "name": "m", "ts": 1.0}) + "\n")
+    assert export.main([p, "-o", str(tmp_path / "o.json")]) == 2
+    assert export.main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_trace_export_xprof_overlay(tmp_path):
+    """A device trace with epoch-microsecond timestamps lands on the
+    same wall axis (the clock-sync pair), on its own process rows —
+    shares trace_top.py's parser, gz included."""
+    export = _load_tool("trace_export")
+    epoch = 1.7e9                                  # a realistic wall clock
+    host = _stream(str(tmp_path / "h.jsonl"), [
+        {"ph": "X", "name": "step", "ts": 50.0, "dur": 1.0,
+         "tid": "main", "span_id": "s1"},
+    ], sync_wall=epoch, sync_perf=50.0)
+    # device op 0.5s into the host span, epoch-us (the TPU convention)
+    xprof = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "fusion.1", "pid": 7, "tid": 0,
+         "ts": (epoch + 0.5) * 1e6, "dur": 100.0},
+    ]}
+    xp = str(tmp_path / "x.trace.json.gz")
+    with gzip.open(xp, "wt") as fh:
+        json.dump(xprof, fh)
+    out = str(tmp_path / "m.json")
+    assert export.main([host, "--xprof", xp, "-o", out]) == 0
+    evs = json.loads(open(out).read())["traceEvents"]
+    dev = next(e for e in evs if e["name"] == "fusion.1")
+    assert dev["pid"] >= 1000                   # its own process block
+    assert abs(dev["ts"] - 500000.0) < 1.0      # +0.5s on the shared axis
+
+
+def test_ci_gate_trace_stream_gate(tmp_path, capsys):
+    ci_gate = _load_tool("ci_gate")
+    good = _stream(str(tmp_path / "g.jsonl"), [
+        {"ph": "B", "name": "tick", "ts": 1.0, "tid": "engine"},
+        {"ph": "E", "name": "tick", "ts": 2.0, "tid": "engine"},
+    ], 100.0, 1.0)
+    assert ci_gate.main(["--trace-stream", good]) == 0
+    out = capsys.readouterr().out
+    assert "trace_export --check" in out and "ci_gate: PASS" in out
+    bad = _stream(str(tmp_path / "bad.jsonl"), [
+        {"ph": "B", "name": "tick", "ts": 1.0, "tid": "engine"},
+    ], 100.0, 1.0)
+    assert ci_gate.main(["--trace-stream", bad]) == 1
+    assert ci_gate.main(
+        ["--trace-stream", str(tmp_path / "missing.jsonl")]) == 2
+
+
+# ------------------------------------------- critical path (report)
+
+def test_serve_report_critical_path_sums_to_e2e(tmp_path, capsys):
+    report = _load_tool("serve_report")
+    recs = [{"record": "run_header", "schema": 9, "time": 0.0,
+             "run_id": "r", "num_devices": 1, "process_index": 0,
+             "platform": "cpu", "config": {}}]
+    for i, (q, p, d, extra) in enumerate(
+            [(2.0, 10.0, 30.0, 0.5), (0.5, 8.0, 12.0, 0.0),
+             (40.0, 9.0, 6.0, 1.5)]):
+        n = 5
+        recs.append({"record": "request_complete", "time": 1.0,
+                     "request_id": f"r-{i}", "prompt_tokens": 4,
+                     "output_tokens": n, "ttft_ms": q + p,
+                     "tpot_ms": d / (n - 1), "finish_reason": "length",
+                     "queue_wait_ms": q, "e2e_ms": q + p + d + extra})
+    recs.append({"record": "serve_summary", "time": 2.0, "requests": 3,
+                 "output_tokens": 15, "tokens_per_sec": 10.0})
+    # a traced (ungated, wall-clock) submission: its handoff span rides
+    # the table as its own component
+    recs[1:1] = [
+        {"record": "trace_event", "ph": "X", "name": "request",
+         "ts": 1.0, "dur": 0.1, "tid": "req/r-0", "span_id": "s1",
+         "cat": "request", "args": {"request_id": "r-0"}},
+        {"record": "trace_event", "ph": "X", "name": "submit",
+         "ts": 1.0, "dur": 0.007, "tid": "req/r-0", "span_id": "s2",
+         "parent_id": "s1", "cat": "request"}]
+    rows = report.critical_path(recs)
+    assert len(rows) == 3
+    assert rows[0]["handoff_ms"] == pytest.approx(7.0)
+    assert "handoff_ms" not in rows[1]
+    for row in rows:
+        total = row["queue_ms"] + row["prefill_ms"] + row["decode_ms"] \
+            + row["stall_ms"]
+        assert total == pytest.approx(row["e2e_ms"], rel=0.01)
+    assert rows[0]["stall_ms"] == pytest.approx(0.5)
+    path = str(tmp_path / "s.jsonl")
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "critical path (share of total e2e)" in out
+    # r-2's e2e (56.5) is both worst and p99; queue dominates it
+    assert "worst r-2" in out and "culprit queue" in out
+
+
+def test_submit_and_mature_stamp_arrival_clocks():
+    """Review regressions, both clocks: (a) an UNGATED request
+    "arrives" at submission — submit() re-stamps t_arrival, so the
+    build->submit gap is the client's "submit" span (t_submit kept),
+    never queue wait; (b) a GATED request's build->gate delay is
+    deliberate staggering, not handoff — mature() re-stamps t_submit
+    WITH t_arrival so no "submit" span can absorb it."""
+    from apex_example_tpu.serve import Request, RequestQueue
+    q = RequestQueue()
+    gated = Request(prompt=[1], max_new_tokens=1, arrival_step=3,
+                    t_submit=0.5)
+    ungated = Request(prompt=[2], max_new_tokens=1, t_submit=0.25)
+    built_at = ungated.t_arrival
+    q.submit_all([gated, ungated])
+    assert ungated.t_arrival > built_at          # arrives at submit()
+    assert ungated.t_submit == 0.25              # wall-clock handoff kept
+    assert gated.t_arrival < ungated.t_arrival   # gated: not submit-stamped
+    q.mature(0)
+    assert gated.t_submit == 0.5                 # gate not reached yet
+    q.mature(3)
+    assert gated.t_submit == gated.t_arrival     # re-stamped together
+
+
+# ------------------------------------- supervisor-side continuity
+
+def _load_supervisor():
+    spec = importlib.util.spec_from_file_location(
+        "apex_supervisor_trace_test",
+        os.path.join(REPO, "apex_example_tpu", "resilience",
+                     "supervisor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_supervisor_propagates_trace_id_and_emits_spans(tmp_path):
+    """A --trace child inherits APEX_TRACE_ID from the supervisor (one
+    trace across attempts), and the supervisor's own stream carries a
+    clock_sync + an X "attempt" span per child + an "i" restart marker
+    — all schema-valid and structurally clean under the --check lint."""
+    sup_mod = _load_supervisor()
+    seen = tmp_path / "seen_ids.txt"
+    marker = tmp_path / "ran_once"
+    child = tmp_path / "child.py"
+    child.write_text(f"""\
+import os, sys
+with open({str(seen)!r}, "a") as fh:
+    fh.write(os.environ.get("APEX_TRACE_ID", "MISSING") + "\\n")
+if os.path.exists({str(marker)!r}):
+    sys.exit(0)
+open({str(marker)!r}, "w").close()
+sys.exit(75)
+""")
+    sup = sup_mod.Supervisor(
+        [sys.executable, str(child), "--trace"],
+        metrics_jsonl=str(tmp_path / "sup.jsonl"),
+        max_restarts=2, backoff_s=0.01, sleep_fn=lambda s: None,
+        log=lambda *a: None)
+    assert sup._tracing
+    assert sup.run() == 0
+    ids = seen.read_text().splitlines()
+    assert ids == [sup.trace_id] * 2             # both attempts, one trace
+    recs = obs.read_jsonl(str(tmp_path / "sup.jsonl"))
+    assert obs_schema.validate_stream(recs) == []
+    assert sum(1 for r in recs if r["record"] == "clock_sync") == 1
+    evs = [r for r in recs if r["record"] == "trace_event"]
+    assert [e["name"] for e in evs] == ["attempt", "restart", "attempt"]
+    attempts = [e for e in evs if e["name"] == "attempt"]
+    assert [a["args"]["exit_code"] for a in attempts] == [75, 0]
+    assert all(e["trace_id"] == sup.trace_id for e in evs)
+    restart = evs[1]
+    assert restart["ph"] == "i"
+    assert restart["args"]["reason"] == "preemption"
+    export = _load_tool("trace_export")
+    assert export.check_stream(recs, "sup.jsonl") == []
+
+
+def test_supervisor_untraced_child_emits_no_trace_records(tmp_path):
+    sup_mod = _load_supervisor()
+    child = tmp_path / "ok.py"
+    child.write_text("import sys\nsys.exit(0)\n")
+    sup = sup_mod.Supervisor(
+        [sys.executable, str(child)],
+        metrics_jsonl=str(tmp_path / "sup.jsonl"),
+        log=lambda *a: None)
+    assert not sup._tracing
+    assert sup.run() == 0
+    recs = obs.read_jsonl(str(tmp_path / "sup.jsonl"))
+    assert not any(r["record"] in ("trace_event", "clock_sync")
+                   for r in recs)

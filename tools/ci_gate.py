@@ -5,6 +5,8 @@
     python tools/ci_gate.py --stream run.jsonl      # + recompile gate
     python tools/ci_gate.py --stream a.jsonl --stream b.jsonl
 
+    python tools/ci_gate.py --trace-stream traced.jsonl  # + trace lint
+
 Gates:
 
 1. **graftlint --fail-on-new** (tools/graftlint): the two-stratum
@@ -15,6 +17,10 @@ Gates:
    once contract over recorded ``--cost-model`` telemetry, with the
    schema-v8 ``recompile_cause`` diagnosis printed when a stream
    carries one.
+3. **trace_export --check** (per ``--trace-stream``): the structural
+   trace lint over recorded ``--trace`` telemetry — balanced B/E spans
+   per thread row, monotonic timestamps, orphan parent_ids, span
+   containment, exactly one clock_sync per stream (schema v9).
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -36,9 +42,9 @@ sys.path.insert(0, os.path.dirname(_HERE))    # `tools.graftlint` package
 from tools.graftlint.cli import main as graftlint_main  # noqa: E402
 
 
-def _load_cost_report():
+def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
-        "cost_report", os.path.join(_HERE, "cost_report.py"))
+        name, os.path.join(_HERE, f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -51,6 +57,11 @@ def main(argv=None) -> int:
                     metavar="JSONL",
                     help="a --cost-model telemetry stream to run the "
                          "recompile gate over (repeatable)")
+    ap.add_argument("--trace-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a --trace telemetry stream to run the "
+                         "trace_export --check structural lint over "
+                         "(repeatable)")
     ap.add_argument("--baseline", default=None,
                     help="graftlint baseline override")
     ap.add_argument("paths", nargs="*",
@@ -67,7 +78,7 @@ def main(argv=None) -> int:
     worst = max(worst, rc)
 
     if args.stream:
-        cost_report = _load_cost_report()
+        cost_report = _load_tool("cost_report")
         for stream in args.stream:
             if not os.path.isfile(stream):
                 print(f"ci_gate: no such stream: {stream}",
@@ -75,6 +86,18 @@ def main(argv=None) -> int:
                 return 2
             rc = cost_report.main([stream, "--fail-on-recompile"])
             print(f"ci_gate: cost_report --fail-on-recompile "
+                  f"{stream}: {'PASS' if rc == 0 else 'FAIL'}")
+            worst = max(worst, rc)
+
+    if args.trace_stream:
+        trace_export = _load_tool("trace_export")
+        for stream in args.trace_stream:
+            if not os.path.isfile(stream):
+                print(f"ci_gate: no such stream: {stream}",
+                      file=sys.stderr)
+                return 2
+            rc = trace_export.main(["--check", stream])
+            print(f"ci_gate: trace_export --check "
                   f"{stream}: {'PASS' if rc == 0 else 'FAIL'}")
             worst = max(worst, rc)
 

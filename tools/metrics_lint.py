@@ -19,11 +19,15 @@ summaries with measured compile totals, serve summaries with the
 KV-occupancy gauges) and v7 streams (the block-paged KV stratum:
 serve summaries with block_size / blocks_total / blocks_live /
 kv_bytes_committed / prefix_hit_rate / cow_copies / rejected, the
-block-accurate kv_waste_pct, request_failed status "rejected") and v8
+block-accurate kv_waste_pct, request_failed status "rejected"), v8
 streams (the static-analysis stratum: compile_event gains
 ``recompile_cause``, the graftlint HLO diff naming the first divergent
-op behind a recompile) all validate alongside v1 streams — each
-version's tables are a strict superset of the last.
+op behind a recompile) and v9 streams (the trace stratum from --trace
+runs: ``trace_event`` timeline records — ph B/E/X/i, perf_counter
+``ts``/``dur``, span_id/parent_id trees, a stream-grouping trace_id —
+plus the one-per-stream ``clock_sync`` wall-clock anchor
+tools/trace_export.py exports against) all validate alongside v1
+streams — each version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort
 exits 2.

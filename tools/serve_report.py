@@ -15,6 +15,15 @@ Schema v7 adds the block-paged KV line: block utilization (mean/max
 held blocks vs the arena), block-accurate ``kv_waste_pct``, the
 prefix-sharing hit rate and copy-on-write copy count.
 
+Schema v9 adds the per-request CRITICAL-PATH table: each completed
+request's e2e latency decomposed into queue wait / prefill / decode /
+stall (the residual: eviction waits, harvest overhead), the mean share
+each component takes of e2e, and the worst-p99 culprit — the component
+that dominates the p99-latency request.  Derived from the
+``request_complete`` timestamp trail, so it needs no ``--trace``; a
+traced stream additionally surfaces the loadgen->queue handoff span
+(``Request.t_submit``) as its own component.
+
 Thin client of the obs schema (obs/schema.py):
 
     python tools/serve_report.py serve.jsonl
@@ -41,6 +50,86 @@ def _dist(out, name, vals_ms):
     s = sorted(vals_ms)
     print(f"{name:14s} p50 {_pct(s, 50):8.1f}  p95 {_pct(s, 95):8.1f}  "
           f"max {s[-1]:8.1f}  (ms)", file=out)
+
+
+def _trace_handoffs(records):
+    """request_id -> loadgen->queue handoff ms, from a traced stream's
+    "submit" spans (children of the per-request root spans)."""
+    root_req = {}                      # span_id -> request_id
+    for r in records:
+        if r.get("record") == "trace_event" and r.get("ph") == "X" \
+                and r.get("name") == "request" and "span_id" in r:
+            rid = (r.get("args") or {}).get("request_id")
+            if rid:
+                root_req[r["span_id"]] = rid
+    out = {}
+    for r in records:
+        if r.get("record") == "trace_event" and r.get("ph") == "X" \
+                and r.get("name") == "submit" \
+                and r.get("parent_id") in root_req:
+            out[root_req[r["parent_id"]]] = r.get("dur", 0.0) * 1e3
+    return out
+
+
+def critical_path(records):
+    """Per-request latency decomposition for every completed request:
+    ``queue_ms`` (arrival -> admission), ``prefill_ms`` (admission ->
+    first token), ``decode_ms`` (first token -> finish, from TPOT x
+    (n-1)) and ``stall_ms`` — the residual of e2e the other three
+    don't explain.  The components sum to ``e2e_ms`` exactly (modulo
+    the records' ms rounding); on a traced stream ``handoff_ms`` rides
+    along (informational — submission precedes arrival, so it is NOT
+    part of the e2e the server owns)."""
+    handoffs = _trace_handoffs(records)
+    rows = []
+    for r in records:
+        if r.get("record") != "request_complete":
+            continue
+        if not all(k in r for k in ("ttft_ms", "tpot_ms", "e2e_ms",
+                                    "queue_wait_ms", "output_tokens")):
+            continue
+        queue = r["queue_wait_ms"]
+        prefill = max(r["ttft_ms"] - queue, 0.0)
+        decode = r["tpot_ms"] * max(r["output_tokens"] - 1, 0)
+        stall = r["e2e_ms"] - queue - prefill - decode
+        row = {"request_id": r.get("request_id", "?"),
+               "e2e_ms": r["e2e_ms"], "queue_ms": round(queue, 3),
+               "prefill_ms": round(prefill, 3),
+               "decode_ms": round(decode, 3),
+               "stall_ms": round(stall, 3)}
+        if r.get("request_id") in handoffs:
+            row["handoff_ms"] = round(handoffs[r["request_id"]], 3)
+        rows.append(row)
+    return rows
+
+
+_COMPONENTS = ("queue_ms", "prefill_ms", "decode_ms", "stall_ms")
+
+
+def _print_critical_path(out, rows):
+    total = sum(r["e2e_ms"] for r in rows)
+    if not rows or total <= 0:
+        return
+    shares = "  ".join(
+        f"{c[:-3]} {100.0 * sum(r[c] for r in rows) / total:.1f}%"
+        for c in _COMPONENTS)
+    print(f"critical path (share of total e2e): {shares}", file=out)
+    if any("handoff_ms" in r for r in rows):
+        hand = sorted(r["handoff_ms"] for r in rows if "handoff_ms" in r)
+        print(f"handoff_ms (loadgen->queue, traced)   p50 "
+              f"{_pct(hand, 50):8.1f}  max {hand[-1]:8.1f}  (ms)",
+              file=out)
+    by_e2e = sorted(rows, key=lambda r: r["e2e_ms"])
+    worst = by_e2e[-1]
+    p99 = _pct([r["e2e_ms"] for r in by_e2e], 99)
+    p99_row = next(r for r in by_e2e if r["e2e_ms"] >= p99)
+    for tag, row in (("worst", worst), ("p99", p99_row)):
+        culprit = max(_COMPONENTS, key=lambda c: row[c])
+        parts = " + ".join(f"{row[c]:.1f} {c[:-3]}" for c in _COMPONENTS)
+        print(f"{tag:5s} {row['request_id']}  {row['e2e_ms']:.1f} ms = "
+              f"{parts}; culprit {culprit[:-3]} "
+              f"({100.0 * row[culprit] / max(row['e2e_ms'], 1e-9):.0f}%)",
+              file=out)
 
 
 def report(path: str, out=sys.stdout) -> int:
@@ -121,6 +210,7 @@ def report(path: str, out=sys.stdout) -> int:
             s = sorted(rates)
             print(f"tokens_per_sec p50 {_pct(s, 50):6.1f}  max "
                   f"{s[-1]:6.1f}  (per request)", file=out)
+        _print_critical_path(out, critical_path(records))
     for d in drains:
         print(f"DRAIN: {d.get('signal', '?')} at step {d.get('step', '?')}"
               f" — in_flight {d.get('in_flight', '?')}, completed "

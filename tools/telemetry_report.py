@@ -11,7 +11,10 @@ streams surface their ``restart``/``resume`` records and the summary's
 ``compile_event`` (recompiles flagged), COST lines per ``cost_model``
 record, and measured compile totals replacing the first-vs-steady
 estimate when a ``--cost-model`` run recorded them
-(tools/cost_report.py renders the full roofline join).
+(tools/cost_report.py renders the full roofline join) — and the trace
+stratum (schema v9): a TRACE summary line (event count, trace_id,
+clock_sync presence) when a ``--trace`` run recorded a timeline
+(tools/trace_export.py renders the actual Perfetto export).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -68,6 +71,10 @@ def report(path: str, out=sys.stdout) -> int:
     compile_events = [r for r in records
                       if r.get("record") == "compile_event"]
     cost_models = [r for r in records if r.get("record") == "cost_model"]
+    trace_events = [r for r in records
+                    if r.get("record") == "trace_event"]
+    clock_syncs = [r for r in records
+                   if r.get("record") == "clock_sync"]
     # Schema-invalid step records were warned about above; summarize only
     # the ones carrying the contract fields rather than crashing.
     steps = [r for r in records if r.get("record") == "step"
@@ -142,6 +149,15 @@ def report(path: str, out=sys.stdout) -> int:
         worst = max(s.get("seconds_since_step", 0) for s in stalls)
         print(f"stalls: {len(stalls)} (longest {worst:.0f}s without a "
               "step)", file=out)
+    if trace_events:
+        # Schema v9 (--trace): the timeline lives in trace_export.py;
+        # this line says there IS one and whether it can be exported
+        # (no clock_sync = no wall-clock anchor).
+        tid = next((t.get("trace_id") for t in trace_events
+                    if t.get("trace_id")), "?")
+        print(f"TRACE: {len(trace_events)} event(s), trace_id {tid}"
+              + ("" if clock_syncs
+                 else "  (NO clock_sync — not exportable)"), file=out)
     if not steps:
         if is_supervisor_stream:
             # Supervisor streams carry no step records by design — the

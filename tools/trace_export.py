@@ -1,0 +1,410 @@
+#!/usr/bin/env python
+"""Export schema-v9 trace_event JSONL streams to Chrome/Perfetto trace
+JSON — and structurally lint them (``--check``).
+
+    # one run -> one timeline (load trace.json in ui.perfetto.dev)
+    python tools/trace_export.py serve.jsonl -o trace.json
+
+    # a supervised restart: every attempt stream + the supervisor's own
+    # stream merge into ONE timeline (they share a trace_id via the
+    # APEX_TRACE_ID env handoff; each stream gets its own process row)
+    python tools/trace_export.py serve.jsonl serve.jsonl.attempt1 \\
+        sup.jsonl -o trace.json
+
+    # overlay the device-side xprof trace on the same wall-clock axis
+    python tools/trace_export.py train.jsonl --xprof /tmp/xprof -o t.json
+
+    # structural lint (the ci_gate --trace-stream gate): balanced B/E
+    # per thread row, monotonic B/E timestamps, orphan parent_ids,
+    # X-span containment, exactly one clock_sync
+    python tools/trace_export.py --check serve.jsonl
+
+Clock mapping: every ``ts``/``dur`` in a stream is monotonic
+``perf_counter`` seconds; the stream's single ``clock_sync`` record
+pairs one such reading with a back-to-back ``time.time()``, so
+``wall = sync.time + (ts - sync.ts)`` places all streams — emitted by
+different processes with unrelated perf_counter origins — on one
+wall-clock axis.  Exported ``ts`` are microseconds relative to the
+earliest event across streams.  An xprof trace whose timestamps are
+epoch-microseconds (the TPU runtime's convention) lands on the same
+axis; a relative-timestamped one is appended as-is from t=0 with a
+warning (no clock pair to anchor it).
+
+Thin client contract: no jax import, direct or transitive (graftlint's
+static jax-free rule proves it) — shares the xprof parser with
+tools/trace_top.py.
+
+Exit codes: 0 = exported / check clean; 1 = --check found structural
+errors; 2 = usage (missing file, no clock_sync to export against).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Same no-jax sibling imports as tools/serve_report.py.
+from trace_top import load_chrome_trace  # noqa: E402
+
+PHASES = ("B", "E", "X", "i")
+# Containment slack for float round-trips; spans are differences of the
+# same perf_counter readings, so anything past this is structural.
+EPS = 1e-6
+
+
+def read_stream(path: str) -> List[Dict[str, Any]]:
+    """Parse one JSONL stream (tolerant of a killed writer's torn final
+    line, like every thin client here)."""
+    records = []
+    with open(path) as fh:
+        for n, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"WARNING: {path}:{n + 1}: not JSON, skipped",
+                      file=sys.stderr)
+    return records
+
+
+def _trace_records(records):
+    events = [r for r in records if r.get("record") == "trace_event"]
+    syncs = [r for r in records if r.get("record") == "clock_sync"]
+    return events, syncs
+
+
+# ------------------------------------------------------------- check
+
+def check_stream(records: List[Dict[str, Any]], path: str) -> List[str]:
+    """Structural lint for one stream's trace events.  Schema-level
+    validation is metrics_lint's job; this checks what a timeline
+    viewer would silently mis-render:
+
+    - exactly one ``clock_sync``, before the first event;
+    - ``ph`` is B/E/X/i; X carries a non-negative ``dur``;
+    - B/E are balanced stack-wise per ``tid`` row and their timestamps
+      are monotonic per row in file order (they are emitted live —
+      out-of-order B/E means interleaved writers or a clock step);
+    - ``span_id`` unique; every ``parent_id`` resolves in-stream (no
+      orphans);
+    - a child span/instant lies inside its parent's window (X spans
+      are emitted after the fact, so containment — not file order —
+      is their structural invariant).
+    """
+    errors: List[str] = []
+
+    def err(msg):
+        errors.append(f"{path}: {msg}")
+
+    events, syncs = _trace_records(records)
+    if not events:
+        err("no trace_event records (was the run started with --trace?)")
+        return errors
+    if len(syncs) != 1:
+        err(f"{len(syncs)} clock_sync records (expected exactly 1)")
+    first_event_line = min((i for i, r in enumerate(records)
+                            if r.get("record") == "trace_event"),
+                           default=None)
+    first_sync_line = min((i for i, r in enumerate(records)
+                           if r.get("record") == "clock_sync"),
+                          default=None)
+    if syncs and first_event_line is not None \
+            and first_sync_line > first_event_line:
+        err("clock_sync must precede the first trace_event")
+
+    spans: Dict[str, Tuple[float, Optional[float]]] = {}
+    open_b: Dict[str, List[Tuple[str, float, Optional[str]]]] = {}
+    last_be_ts: Dict[str, float] = {}
+    for n, e in enumerate(events):
+        ph, name = e.get("ph"), e.get("name", "?")
+        tid = e.get("tid", "main")
+        ts = e.get("ts")
+        where = f"event {n + 1} ({ph} {name!r}, tid {tid})"
+        if ph not in PHASES:
+            err(f"{where}: ph {ph!r} not one of {PHASES}")
+            continue
+        if not isinstance(ts, (int, float)):
+            err(f"{where}: non-numeric ts")
+            continue
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                err(f"{where}: X span needs a dur >= 0, got {dur!r}")
+                dur = 0.0
+            if "span_id" in e:
+                if e["span_id"] in spans:
+                    err(f"{where}: duplicate span_id {e['span_id']!r}")
+                spans[e["span_id"]] = (ts, ts + dur)
+        elif ph == "B":
+            if tid in last_be_ts and ts < last_be_ts[tid] - EPS:
+                err(f"{where}: B ts went backwards on its row "
+                    f"({ts:.6f} < {last_be_ts[tid]:.6f})")
+            last_be_ts[tid] = max(last_be_ts.get(tid, ts), ts)
+            open_b.setdefault(tid, []).append((name, ts, e.get("span_id")))
+            if "span_id" in e:
+                if e["span_id"] in spans:
+                    err(f"{where}: duplicate span_id {e['span_id']!r}")
+                spans[e["span_id"]] = (ts, None)      # closed by its E
+        elif ph == "E":
+            if tid in last_be_ts and ts < last_be_ts[tid] - EPS:
+                err(f"{where}: E ts went backwards on its row "
+                    f"({ts:.6f} < {last_be_ts[tid]:.6f})")
+            last_be_ts[tid] = max(last_be_ts.get(tid, ts), ts)
+            stack = open_b.get(tid, [])
+            if not stack:
+                err(f"{where}: E with no open B on this row")
+            else:
+                b_name, b_ts, b_sid = stack.pop()
+                if b_name != name:
+                    err(f"{where}: E closes {b_name!r} (B/E must nest "
+                        "stack-wise per row)")
+                if b_sid is not None:
+                    spans[b_sid] = (b_ts, ts)
+    for tid, stack in open_b.items():
+        for b_name, b_ts, _sid in stack:
+            errors.append(f"{path}: unbalanced B {b_name!r} on tid "
+                          f"{tid!r} never closed (ts {b_ts:.6f})")
+
+    for n, e in enumerate(events):
+        pid = e.get("parent_id")
+        if pid is None:
+            continue
+        where = (f"event {n + 1} ({e.get('ph')} {e.get('name', '?')!r})")
+        if pid not in spans:
+            err(f"{where}: orphan parent_id {pid!r}")
+            continue
+        p0, p1 = spans[pid]
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue                    # already reported above
+        dur = e.get("dur", 0.0)
+        if not isinstance(dur, (int, float)):
+            dur = 0.0                   # already reported above
+        end = ts + dur if e.get("ph") == "X" else ts
+        if ts < p0 - EPS or (p1 is not None and end > p1 + EPS):
+            err(f"{where}: outside its parent {pid!r} window "
+                f"[{p0:.6f}, {p1 if p1 is None else round(p1, 6)}]")
+    return errors
+
+
+# ------------------------------------------------------------ export
+
+def _stream_label(path: str, records) -> str:
+    header = next((r for r in records if r.get("record") == "run_header"),
+                  None)
+    base = os.path.basename(path)
+    if header is None:
+        return base
+    cfg = header.get("config", {})
+    arch = header.get("arch", cfg.get("arch"))
+    platform = header.get("platform", "?")
+    label = f"{base} [{platform}"
+    if arch:
+        label += f"/{arch}"
+    return label + "]"
+
+
+def export(streams: List[Tuple[str, List[Dict[str, Any]]]],
+           xprof_events: Optional[list] = None) -> Dict[str, Any]:
+    """Chrome trace-event JSON for one or more JSONL streams (each on
+    its own process row) plus an optional xprof overlay.  Streams
+    without a clock_sync cannot be placed on the shared axis and raise
+    ValueError (the --check gate reports them first)."""
+    anchored = []
+    for path, records in streams:
+        events, syncs = _trace_records(records)
+        if not events:
+            print(f"WARNING: {path}: no trace events, skipped",
+                  file=sys.stderr)
+            continue
+        if not syncs:
+            raise ValueError(f"{path}: no clock_sync record — cannot "
+                             "place this stream on the shared timeline")
+        sync = syncs[0]
+        # wall = sync.time + (ts - sync.ts): the per-stream anchor.
+        offset = sync["time"] - sync["ts"]
+        anchored.append((path, records, events, offset))
+    if not anchored:
+        raise ValueError("no traced stream to export")
+
+    t_base = min(e["ts"] + off for _p, _r, evs, off in anchored
+                 for e in evs)
+    xprof_epoch = None
+    if xprof_events:
+        xs = [e["ts"] for e in xprof_events
+              if e.get("ph") == "X" and isinstance(e.get("ts"),
+                                                   (int, float))]
+        if xs and min(xs) > 1e14:      # epoch microseconds
+            xprof_epoch = True
+            t_base = min(t_base, min(xs) / 1e6)
+        else:
+            xprof_epoch = False
+            print("WARNING: xprof timestamps are not epoch-anchored; "
+                  "overlay starts at t=0 instead of wall-aligned",
+                  file=sys.stderr)
+
+    out: List[Dict[str, Any]] = []
+    flow_id = 0
+    for pid0, (path, records, events, offset) in enumerate(anchored):
+        pid = pid0 + 1
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": _stream_label(path,
+                                                             records)}})
+        out.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                    "tid": 0, "args": {"sort_index": pid}})
+        tids: Dict[str, int] = {}
+
+        def tid_of(name: str) -> int:
+            if name not in tids:
+                tids[name] = len(tids)
+                out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                            "tid": tids[name], "args": {"name": name}})
+                out.append({"ph": "M", "name": "thread_sort_index",
+                            "pid": pid, "tid": tids[name],
+                            "args": {"sort_index": tids[name]}})
+            return tids[name]
+
+        def us(ts: float) -> float:
+            return round((ts + offset - t_base) * 1e6, 3)
+
+        roots: Dict[str, Dict[str, Any]] = {}   # request span_id -> event
+        queued_end: Dict[str, float] = {}       # request span_id -> ts us
+        for e in events:
+            ph = e["ph"]
+            ev: Dict[str, Any] = {
+                "ph": ph, "name": e.get("name", "?"), "pid": pid,
+                "tid": tid_of(e.get("tid", "main")), "ts": us(e["ts"])}
+            if e.get("cat"):
+                ev["cat"] = e["cat"]
+            args = dict(e.get("args") or {})
+            for k in ("span_id", "parent_id"):
+                if k in e:
+                    args[k] = e[k]
+            if args:
+                ev["args"] = args
+            if ph == "X":
+                ev["dur"] = round(e.get("dur", 0.0) * 1e6, 3)
+            elif ph == "i":
+                ev["s"] = "t"
+            out.append(ev)
+            if ph == "X" and e.get("cat") == "request":
+                # Only ADMITTED requests (args.slot is set iff the
+                # request reached a slot) get a flow arrow: a shed/
+                # rejected/drained root also has a "queued" child, but
+                # its end is the terminal time, not an admission.
+                if e.get("name") == "request" and "span_id" in e \
+                        and "slot" in (e.get("args") or {}):
+                    roots[e["span_id"]] = ev
+                elif e.get("name") == "queued" \
+                        and e.get("parent_id") is not None:
+                    queued_end[e["parent_id"]] = us(e["ts"]
+                                                    + e.get("dur", 0.0))
+        # Request admissions as flows: an arrow from the engine row to
+        # the request row at the moment its queued span ends (= slot
+        # admission), binding the scheduler's timeline to the request's.
+        if "engine" in tids:
+            for sid, root_ev in roots.items():
+                if sid not in queued_end:
+                    continue
+                flow_id += 1
+                ts = queued_end[sid]
+                common = {"cat": "admit", "name": "admit", "id": flow_id,
+                          "pid": pid}
+                out.append(dict(common, ph="s", tid=tids["engine"],
+                                ts=ts))
+                out.append(dict(common, ph="f", bp="e",
+                                tid=root_ev["tid"], ts=ts))
+
+    if xprof_events:
+        xpid = 1001
+        seen_pids: Dict[Any, int] = {}
+        for e in xprof_events:
+            pid_in = e.get("pid", 0)
+            if pid_in not in seen_pids:
+                seen_pids[pid_in] = xpid + len(seen_pids)
+            ev = dict(e)
+            ev["pid"] = seen_pids[pid_in]
+            if isinstance(ev.get("ts"), (int, float)) \
+                    and ev.get("ph") != "M":
+                ev["ts"] = round(ev["ts"] - t_base * 1e6, 3) \
+                    if xprof_epoch else ev["ts"]
+            out.append(ev)
+
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# -------------------------------------------------------------- main
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="export/lint schema-v9 trace_event streams "
+                    "(Chrome/Perfetto trace JSON)")
+    ap.add_argument("streams", nargs="+", metavar="JSONL",
+                    help="metrics stream(s) from --trace runs; pass a "
+                         "run's attempt streams + the supervisor stream "
+                         "together to merge one timeline")
+    ap.add_argument("-o", "--out", default="trace.json",
+                    help="output path (default trace.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="structural lint only: balanced B/E per row, "
+                         "monotonic B/E timestamps, orphan parent_ids, "
+                         "span containment, one clock_sync per stream")
+    ap.add_argument("--xprof", default=None, metavar="PATH",
+                    help="xprof trace (*.trace.json.gz or a profiler "
+                         "logdir) to overlay on the same timeline")
+    args = ap.parse_args(argv)
+
+    streams = []
+    for path in args.streams:
+        if not os.path.isfile(path):
+            print(f"trace_export: no such stream: {path}",
+                  file=sys.stderr)
+            return 2
+        streams.append((path, read_stream(path)))
+
+    if args.check:
+        errors: List[str] = []
+        n_events = 0
+        for path, records in streams:
+            errors.extend(check_stream(records, path))
+            n_events += sum(1 for r in records
+                            if r.get("record") == "trace_event")
+        for e in errors:
+            print(e, file=sys.stderr)
+        if errors:
+            print(f"trace_export --check: {len(errors)} error(s) over "
+                  f"{len(streams)} stream(s)")
+            return 1
+        print(f"trace_export --check: {len(streams)} stream(s) OK "
+              f"({n_events} events)")
+        return 0
+
+    xprof_events = None
+    if args.xprof:
+        if not os.path.exists(args.xprof):
+            print(f"trace_export: no such xprof trace: {args.xprof}",
+                  file=sys.stderr)
+            return 2
+        xprof_events = load_chrome_trace(args.xprof)
+    try:
+        doc = export(streams, xprof_events=xprof_events)
+    except ValueError as e:
+        print(f"trace_export: {e}", file=sys.stderr)
+        return 2
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh)
+    n = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(f"{args.out}: {n} event(s) from {len(streams)} stream(s)"
+          + (" + xprof overlay" if xprof_events else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

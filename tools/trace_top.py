@@ -2,6 +2,11 @@
 """Summarize a jax.profiler Chrome trace: top device ops by total duration.
 
 Usage: python tools/trace_top.py /tmp/xprof_c2 [--top 40]
+
+``find_trace`` / ``load_chrome_trace`` / ``device_pids`` are the shared
+xprof-trace parser: ``tools/trace_export.py`` reuses them to merge a
+device trace onto a host trace-event timeline (no jax import in either
+tool — graftlint's jax-free rule covers both).
 """
 
 from __future__ import annotations
@@ -15,6 +20,43 @@ import os
 import re
 
 
+def find_trace(logdir: str) -> str:
+    """The newest ``*.trace.json.gz`` under a profiler logdir (what
+    ``jax.profiler.start_trace`` leaves behind)."""
+    traces = glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                       recursive=True)
+    assert traces, f"no trace.json.gz under {logdir}"
+    return max(traces, key=os.path.getmtime)
+
+
+def resolve_trace(path: str) -> str:
+    """A trace FILE for ``path``: logdirs resolve to their newest
+    trace, files pass through — the one place this decision lives."""
+    return find_trace(path) if os.path.isdir(path) else path
+
+
+def load_chrome_trace(path: str):
+    """Parse a Chrome trace file (gzipped or plain JSON) into its
+    ``traceEvents`` list.  ``path`` may also be a profiler logdir."""
+    path = resolve_trace(path)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        j = json.load(f)
+    return j["traceEvents"] if isinstance(j, dict) else j
+
+
+def device_pids(events):
+    """(pid -> process name, device pid set): which process rows are
+    TPU/device rows, by the trace's own name metadata."""
+    pid_name = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e["pid"]] = e["args"].get("name", "")
+    dev = {pid for pid, n in pid_name.items()
+           if re.search(r"TPU|/device", n, re.I)}
+    return pid_name, dev
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("logdir")
@@ -23,21 +65,9 @@ def main():
                     help="don't merge fusion instances (keep full names)")
     args = ap.parse_args()
 
-    traces = glob.glob(os.path.join(args.logdir, "**", "*.trace.json.gz"),
-                       recursive=True)
-    assert traces, f"no trace.json.gz under {args.logdir}"
-    path = max(traces, key=os.path.getmtime)
-    with gzip.open(path, "rt") as f:
-        j = json.load(f)
-    events = j["traceEvents"]
-
-    # Identify device (TPU) process ids by name metadata.
-    pid_name = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_name[e["pid"]] = e["args"].get("name", "")
-    dev_pids = {pid for pid, n in pid_name.items()
-                if re.search(r"TPU|/device", n, re.I)}
+    path = resolve_trace(args.logdir)
+    events = load_chrome_trace(path)
+    pid_name, dev_pids = device_pids(events)
 
     tot = collections.Counter()
     cnt = collections.Counter()

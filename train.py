@@ -217,6 +217,13 @@ def parse_args(argv=None):
                         "bytes/memory + roofline verdict) record per "
                         "compilation (obs/costmodel.py; zero extra "
                         "compiles — tools/cost_report.py reports)")
+    p.add_argument("--trace", action="store_true",
+                   help="with --metrics-jsonl: emit schema-v9 "
+                        "trace_event records for every host span "
+                        "(data / step / checkpoint) — a per-step "
+                        "timeline exportable to Perfetto via "
+                        "tools/trace_export.py; histograms and stdout "
+                        "unchanged (README 'Request tracing')")
     # diagnostics stratum (obs/flight.py, obs/watchdog.py, obs/numerics.py;
     # README "Diagnostics") — all write to the --metrics-jsonl sink
     p.add_argument("--flight-recorder", action="store_true",
@@ -318,11 +325,12 @@ def make_telemetry(args):
     Also binds the span registry so host spans ("data"/"step") aggregate
     into the run_summary."""
     emitter = recorder = watchdog = None
-    # Clear any cost-model instance a previous in-process run leaked
-    # (e.g. it died between telemetry setup and its finally): this
-    # run's instrument() sites run after us, so a stale default must
-    # not write records into the old run's stream.
+    # Clear any cost-model/tracer instance a previous in-process run
+    # leaked (e.g. it died between telemetry setup and its finally):
+    # this run's instrument() sites run after us, so a stale default
+    # must not write records into the old run's stream.
     obs.costmodel.set_default(None)
+    obs.trace.set_default(None)
     if args.metrics_jsonl:
         registry = obs.MetricsRegistry()
         obs.set_default_registry(registry)
@@ -338,6 +346,13 @@ def make_telemetry(args):
             # inherit the instance).
             obs.costmodel.set_default(obs.CostModel(
                 sink=sink, registry=registry, run_id=emitter.run_id))
+        if getattr(args, "trace", False):
+            # Same process-default shape: the span layer (obs/spans.py)
+            # consults it, so every data/step/checkpoint span lands as
+            # a schema-v9 trace_event alongside its histogram; a
+            # supervised restart joins the parent timeline via
+            # APEX_TRACE_ID (obs/trace.py).
+            obs.trace.set_default(obs.Tracer(sink, run_id=emitter.run_id))
         if args.flight_recorder:
             recorder = obs.FlightRecorder(emitter, config=vars(args),
                                           keep=args.flight_recorder_keep)
@@ -379,6 +394,7 @@ def close_telemetry(emitter, profwin, recorder=None, watchdog=None):
         emitter.close()
     obs.set_default_registry(None)
     obs.costmodel.set_default(None)
+    obs.trace.set_default(None)
 
 
 def make_resilience(args, recorder):
@@ -580,6 +596,9 @@ def main(argv=None):
         raise SystemExit("--cost-model emits compile_event/cost_model "
                          "records to the telemetry sink; add "
                          "--metrics-jsonl PATH")
+    if args.trace and not args.metrics_jsonl:
+        raise SystemExit("--trace emits trace_event records to the "
+                         "telemetry sink; add --metrics-jsonl PATH")
     if args.stall_trace and args.stall_timeout <= 0:
         raise SystemExit("--stall-trace arms on a stall; it needs "
                          "--stall-timeout S")
@@ -812,8 +831,10 @@ def main(argv=None):
                 if args.save_every_steps and mgr is not None \
                         and is_main_process() \
                         and global_step % args.save_every_steps == 0:
-                    mgr.save(state, wait=not args.async_checkpoint,
-                             host_state=host_loop_state(args, global_step))
+                    with span("checkpoint"):
+                        mgr.save(state, wait=not args.async_checkpoint,
+                                 host_state=host_loop_state(args,
+                                                            global_step))
                     last_saved = global_step
                     rank_print(f"saved checkpoint at step {global_step}")
                 if fault is not None:
@@ -849,8 +870,9 @@ def main(argv=None):
                 # state is replicated so one host's copy is the full state.
                 # (last_saved guard: a --save-every-steps boundary landing
                 # on the epoch end already wrote this exact step.)
-                mgr.save(state, wait=not args.async_checkpoint,
-                         host_state=host_loop_state(args, global_step))
+                with span("checkpoint"):
+                    mgr.save(state, wait=not args.async_checkpoint,
+                             host_state=host_loop_state(args, global_step))
                 last_saved = int(state.step)
                 rank_print(f"saved checkpoint at step {int(state.step)}")
             if preempt is not None and preempt.preempted:
@@ -1634,8 +1656,10 @@ def _lm_main_impl(args, policy, scaler):
                 if args.save_every_steps and mgr is not None \
                         and is_main_process() \
                         and global_step % args.save_every_steps == 0:
-                    mgr.save(state, wait=not args.async_checkpoint,
-                             host_state=host_loop_state(args, global_step))
+                    with span("checkpoint"):
+                        mgr.save(state, wait=not args.async_checkpoint,
+                                 host_state=host_loop_state(args,
+                                                            global_step))
                     last_saved = global_step
                     rank_print(f"saved checkpoint at step {global_step}")
                 if fault is not None:
@@ -1676,8 +1700,9 @@ def _lm_main_impl(args, policy, scaler):
                             f"eval/{metric[0]}": metric[1]}, global_step)
             if mgr is not None and is_main_process() \
                     and last_saved != int(state.step):
-                mgr.save(state, wait=not args.async_checkpoint,
-                         host_state=host_loop_state(args, global_step))
+                with span("checkpoint"):
+                    mgr.save(state, wait=not args.async_checkpoint,
+                             host_state=host_loop_state(args, global_step))
                 last_saved = int(state.step)
                 rank_print(f"saved checkpoint at step {int(state.step)}")
             if preempt is not None and preempt.preempted:
