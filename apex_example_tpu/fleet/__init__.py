@@ -1,0 +1,40 @@
+"""apex_example_tpu.fleet — a jax-free router over N serve replicas.
+
+The fleet stratum composes five prior strata into a multi-replica
+deployment (ROADMAP item 5): the supervisor's drain/EX_TEMPFAIL
+contract, deterministic fault injection, the burst load generator, the
+paged-KV serve engine and cross-restart trace continuity — and scores
+the result as a fleet-level availability number under scripted chaos.
+
+- ``fleet/replica.py``    replica handles: an in-process
+  :class:`ThreadReplica` over a real ``ServeEngine`` and a
+  :class:`ProcReplica` spawning ``tools/supervise.py``-wrapped
+  ``serve.py`` children fed through a file-based inbox/outbox.
+- ``fleet/router.py``     :class:`FleetRouter`: dispatch policies
+  (round_robin / least_pending / least_kv), requeue-on-drain,
+  deadline-aware retry, circuit breaking; schema-v10
+  ``route``/``replica_state``/``fleet_summary`` records.
+- ``fleet/scenarios.py``  scripted chaos (``rolling_restart``,
+  ``crash_storm``, ``straggler``) scored into ``fleet_summary``.
+
+Like ``resilience/supervisor.py``, the three modules are **jax-free by
+contract** (graftlint-proved) and carry NO package imports, so
+``fleet.py`` (the CLI) loads them by file path on hosts without jax;
+importing THIS package is the in-process convenience surface (jax is
+already loaded by then via ``apex_example_tpu/__init__``).
+``tools/fleet_report.py`` renders the router stream.
+"""
+
+from apex_example_tpu.fleet.replica import (STATES, ProcReplica,
+                                            ThreadReplica,
+                                            newest_attempt_path,
+                                            tail_records)
+from apex_example_tpu.fleet.router import POLICIES, FleetRouter
+from apex_example_tpu.fleet.scenarios import (SCENARIOS, run_scenario,
+                                              synthetic_specs)
+
+__all__ = [
+    "FleetRouter", "POLICIES", "ProcReplica", "SCENARIOS", "STATES",
+    "ThreadReplica", "newest_attempt_path", "run_scenario",
+    "synthetic_specs", "tail_records",
+]

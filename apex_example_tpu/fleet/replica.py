@@ -1,0 +1,578 @@
+"""Replica handles for the fleet router: two transports, one contract.
+
+Pure stdlib ON PURPOSE — like resilience/supervisor.py, this module is
+**jax-free by contract** (graftlint's static ``jax-free`` rule proves
+the whole import closure): the fleet router's job includes surviving
+replicas whose jax just died, so the routing layer itself must run on a
+bare host.  ``fleet.py`` (the CLI) loads this file by path; importing
+it through the package works too once jax is already in the process
+(tests, the in-process transport).
+
+A replica handle is whatever the router can ``submit`` to and ``poll``
+— the contract is duck-typed, never imported:
+
+``submit(spec) -> bool``   hand one request spec (a plain dict:
+                           uid/prompt/max_new_tokens/temperature/top_k/
+                           eos_id/deadline_s) to the replica; False
+                           means the replica cannot take it right now
+                           (draining/dead) and the router re-routes.
+``poll() -> [dict]``       terminal events since the last poll:
+                           ``{"uid", "status", ...}`` with status one
+                           of the serve Completion statuses plus
+                           ``lost`` (the replica died holding it — the
+                           router's deadline-aware retry input).
+``state() -> dict``        a health snapshot: ``state`` (one of
+                           :data:`STATES`), ``tick``, ``pending``,
+                           ``blocks_live``, ``last_progress``, ``pid``.
+``interrupt()``            the rolling-restart chaos action: drain and
+                           come back (SIGTERM the serve child / drain
+                           the in-process engine and rebuild it).
+
+Two transports:
+
+- :class:`ThreadReplica` wraps a REAL ``ServeEngine`` in-process and
+  drives it on a daemon thread.  The engine is built by a caller-
+  supplied factory (this module must not import the serve package), so
+  token-identity and routing tests ride the session's existing
+  SLOTS=4/MAX_LEN=32 compiled decode program — zero new compiles.  The
+  drive loop ticks ONLY when work exists (no idle virtual ticks), so a
+  ``FaultPlan`` armed at tick N fires at a workload-deterministic
+  point: in-process chaos scenarios score deterministically.
+- :class:`ProcReplica` spawns a ``tools/supervise.py``-wrapped
+  ``serve.py`` child fed through a file-based request INBOX and
+  reporting through an append-only completion OUTBOX (``--inbox`` /
+  ``--outbox`` on serve.py).  The inbox is replayed and the outbox
+  consulted on every supervised restart, so a crashed child re-serves
+  exactly the uids that never reached a terminal status — the
+  transport self-heals without router involvement, and the router only
+  re-routes when the supervisor itself gives up.  Health is tailed
+  from the child's metrics JSONL (``replica_state`` heartbeats: last
+  tick, queue depth, ``blocks_live`` — the ``least_kv`` policy input)
+  and from the supervisor's stream (``restart`` records carry the v10
+  exit ``classification``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# Replica lifecycle states the router keys on.  "healthy" accepts
+# traffic; "starting" is pre-first-heartbeat (routable — the inbox
+# buffers); the rest do not accept new dispatches.
+STATES = ("starting", "healthy", "draining", "restarting", "crashed",
+          "stopped")
+
+# Keep in sync with apex_example_tpu/serve/queue.py STATUSES — this
+# module must not import it (jax-free contract; the serve package pulls
+# jax through apex_example_tpu/__init__).  "lost" is fleet-local: the
+# replica died holding the request and nobody will ever report it.
+TERMINAL_STATUSES = ("ok", "timeout", "shed", "cancelled", "failed",
+                     "drained", "rejected", "lost")
+
+TRACE_ID_ENV = "APEX_TRACE_ID"
+
+_TAIL_BYTES = 256 * 1024
+
+
+def tail_records(path: Optional[str], want: str,
+                 tail_bytes: int = _TAIL_BYTES) -> List[Dict[str, Any]]:
+    """The ``record == want`` dicts in the bounded TAIL of a JSONL file
+    (file order preserved).  Tolerates a missing file, a torn final
+    line and the torn first line of the tail window — the supervisor's
+    tail_last_step contract, generalized."""
+    if not path or not os.path.exists(path):
+        return []
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - tail_bytes))
+            chunk = fh.read().decode("utf-8", errors="replace")
+    except OSError:  # pragma: no cover
+        return []
+    out: List[Dict[str, Any]] = []
+    for line in chunk.splitlines():
+        line = line.strip()
+        if not line or f'"{want}"' not in line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("record") == want:
+            out.append(rec)
+    return out
+
+
+def newest_attempt_path(base: Optional[str]) -> Optional[str]:
+    """Where a supervised child is writing NOW: the highest-numbered
+    existing ``base.attemptK`` sibling, else ``base`` — the read-side
+    mirror of the supervisor's per-attempt metrics rotation."""
+    if not base:
+        return None
+    best, best_n = (base, 0) if os.path.exists(base) else (None, -1)
+    parent = os.path.dirname(base) or "."
+    prefix = os.path.basename(base) + ".attempt"
+    try:
+        names = os.listdir(parent)
+    except OSError:  # pragma: no cover
+        return best
+    for name in names:
+        if name.startswith(prefix) and name[len(prefix):].isdigit():
+            n = int(name[len(prefix):])
+            if n > best_n:
+                best, best_n = os.path.join(parent, name), n
+    return best
+
+
+# ====================================================== in-process
+
+class ThreadReplica:
+    """A real ``ServeEngine`` behind the replica contract.
+
+    ``engine_factory()`` builds a fresh engine with an OPEN queue (the
+    caller owns model/params/geometry, so this module stays jax-free);
+    ``make_request(spec)`` turns a router spec dict into the engine's
+    ``Request`` type.  ``fault`` is an optional serve ``FaultPlan``
+    attached to each engine this replica builds — a plan that already
+    fired stays inert across restarts, matching the supervisor's
+    drop-flag-on-restart semantics for one-shot drills.
+
+    The drive thread ticks the engine only when the queue or a slot
+    holds work, so virtual time does not advance while idle — a
+    ``crash@tick`` drill fires at a point determined by the workload,
+    not by host speed.  Any exception escaping ``engine.step()`` IS a
+    crash (slot-level isolation already contained everything
+    containable): the replica drains its queue and live slots into
+    ``lost`` events and parks in state "crashed" until ``restart()``.
+    """
+
+    def __init__(self, name: str, engine_factory: Callable[[], Any],
+                 make_request: Callable[[Dict[str, Any]], Any],
+                 fault=None):
+        self.name = name
+        self._factory = engine_factory
+        self._make_request = make_request
+        self._fault = fault
+        self.restarts = 0
+        self._lock = threading.Lock()
+        self._state = "starting"                # guarded-by: _lock
+        self._events: List[Dict[str, Any]] = []  # guarded-by: _lock
+        self._consumed = 0
+        self._stopping = False                  # guarded-by: _lock
+        self._interrupted = False               # guarded-by: _lock
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._progress = time.perf_counter()
+        self.engine = engine_factory()
+        if fault is not None:
+            self.engine.fault = fault
+
+    # ------------------------------------------------------- contract
+
+    def submit(self, spec: Dict[str, Any]) -> bool:
+        with self._lock:
+            if self._state not in ("starting", "healthy"):
+                return False
+            eng = self.engine
+        try:
+            eng.queue.submit(self._make_request(spec))
+        except RuntimeError:            # queue closed under us (drain)
+            return False
+        self._wake.set()
+        return True
+
+    def poll(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._events = self._events, []
+        return out
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            st = self._state
+            eng = self.engine
+        return {
+            "name": self.name,
+            "state": st,
+            "tick": eng.step_count,
+            "pending": eng.queue.pending(),
+            "blocks_live": eng.pool.blocks_live(),
+            # Seconds since the last completed tick — each transport
+            # computes the age in ITS OWN clock domain (perf_counter
+            # here, heartbeat wall-time for ProcReplica), so the router
+            # never subtracts across domains.
+            "progress_age_s": time.perf_counter() - self._progress,
+            "pid": os.getpid(),
+            "restarts": self.restarts,
+        }
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "ThreadReplica":
+        """Launch the drive thread (idempotent while one is running).
+        Callable before OR after submits — pre-loading the queue then
+        starting gives chaos scenarios a fully deterministic tick
+        evolution."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        with self._lock:
+            self._state = "healthy"
+        self._thread = threading.Thread(
+            target=self._drive, name=f"replica-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def interrupt(self) -> None:
+        """The rolling-restart action: drain (queued requests come back
+        as status "drained" for the router to requeue on siblings),
+        then rebuild the engine and return to "healthy" — the
+        in-process equivalent of SIGTERM -> exit 75 -> supervised
+        restart."""
+        with self._lock:
+            self._interrupted = True
+            self._state = "draining"    # stop routing to us NOW
+        self._wake.set()
+
+    def restart(self) -> None:
+        """Bring a crashed replica back with a fresh engine (the
+        scenario script plays supervisor for the in-process
+        transport).  The factory's compiled decode step is cached on
+        the module-clone config, so no recompile happens here."""
+        with self._lock:
+            if self._state not in ("crashed", "stopped"):
+                raise RuntimeError(
+                    f"{self.name}: restart from state {self._state!r}")
+        self._rebuild()
+        self.start()
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Graceful shutdown: close the queue, let in-flight requests
+        finish, join the thread."""
+        with self._lock:
+            self._stopping = True
+            eng = self.engine
+        eng.queue.close()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    # ------------------------------------------------------- internals
+
+    def _rebuild(self) -> None:
+        eng = self._factory()
+        if self._fault is not None:
+            eng.fault = self._fault     # already-fired plans stay inert
+        with self._lock:
+            self.engine = eng
+            self._consumed = 0
+            self._interrupted = False
+        self.restarts += 1
+
+    def _emit(self, events: List[Dict[str, Any]]) -> None:
+        if events:
+            with self._lock:
+                self._events.extend(events)
+
+    def _harvest(self, eng) -> None:
+        comps = eng.completions
+        new = comps[self._consumed:]
+        self._consumed = len(comps)
+        self._emit([{
+            "uid": c.request.uid, "status": c.status,
+            "tokens": [int(t) for t in c.tokens],
+            "finish_reason": c.finish_reason, "tick": c.finished_step,
+            "replica": self.name} for c in new])
+
+    def _drive(self) -> None:
+        eng = self.engine
+        while True:
+            with self._lock:
+                stopping = self._stopping
+                interrupted = self._interrupted
+            if interrupted:
+                eng.drain("fleet-interrupt")
+                self._harvest(eng)      # drained statuses included
+                self._rebuild()
+                eng = self.engine
+                with self._lock:
+                    self._state = "healthy"
+                continue
+            if eng.queue.drained() and not eng.pool.any_live():
+                with self._lock:
+                    self._state = "stopped"
+                return
+            if eng.queue.pending() == 0 and not eng.pool.any_live():
+                if stopping:
+                    with self._lock:
+                        self._state = "stopped"
+                    return
+                # Idle: wait for work WITHOUT ticking — virtual time
+                # must not advance, or tick-armed drills would fire at
+                # host-speed-dependent points.
+                self._wake.wait(0.005)
+                self._wake.clear()
+                continue
+            try:
+                eng.step()
+                self._progress = time.perf_counter()
+            except BaseException as e:  # noqa: BLE001 — a crash IS the event
+                lost = [r.uid for r in eng.queue.drain()]
+                lost += [eng.pool.slots[i].request.uid
+                         for i in eng.pool.live]
+                self._harvest(eng)
+                self._emit([{"uid": u, "status": "lost",
+                             "replica": self.name,
+                             "error": f"{type(e).__name__}: {e}"}
+                            for u in lost])
+                with self._lock:
+                    self._state = "crashed"
+                return
+            self._harvest(eng)
+
+
+# ====================================================== subprocess
+
+class ProcReplica:
+    """A ``tools/supervise.py``-wrapped ``serve.py`` child behind the
+    replica contract.
+
+    Filesystem layout under ``workdir`` (all replica-private):
+
+    - ``inbox.jsonl``    router-appended request specs + a final
+                         ``{"close": true}`` sentinel; every attempt
+                         replays it from byte 0;
+    - ``outbox.jsonl``   child-appended terminal events (append-mode,
+                         so it SURVIVES restarts — the restarted child
+                         reads it to skip already-terminal uids:
+                         crash-safe exactly-once);
+    - ``serve.jsonl``    the child's metrics stream (rotated
+                         ``.attemptK`` by the supervisor) — tailed for
+                         ``replica_state`` heartbeats;
+    - ``sup.jsonl``      the supervisor's own stream — tailed for
+                         ``restart`` records (exit classification).
+
+    ``serve_args`` extends the child argv (geometry, --trace, a
+    ``--inject-fault`` drill for crash/straggler scenarios — the
+    supervisor strips it on restart).  The spawned tree joins the
+    router's trace via the ``APEX_TRACE_ID`` environment handoff.
+    """
+
+    def __init__(self, name: str, workdir: str, repo_root: str,
+                 serve_args: Optional[List[str]] = None,
+                 supervise_args: Optional[List[str]] = None,
+                 python: str = sys.executable,
+                 stale_after_s: float = 30.0):
+        self.name = name
+        self.workdir = os.path.join(workdir, name)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.repo_root = repo_root
+        self.python = python
+        self.stale_after_s = stale_after_s
+        self.inbox = os.path.join(self.workdir, "inbox.jsonl")
+        self.outbox = os.path.join(self.workdir, "outbox.jsonl")
+        self.child_metrics = os.path.join(self.workdir, "serve.jsonl")
+        self.sup_metrics = os.path.join(self.workdir, "sup.jsonl")
+        self.serve_args = list(serve_args or [])
+        self.supervise_args = list(supervise_args or [])
+        self.proc: Optional[subprocess.Popen] = None
+        self._inbox_fh = None
+        self._outbox_pos = 0
+        self._routed: List[str] = []
+        self._terminal: set = set()
+        self._lost_reported = False
+        self._closed = False
+        # Health-tail cache keyed by (mtime, size): the router polls
+        # state() every ~10 ms but heartbeats land every --heartbeat-s
+        # — re-reading an unchanged 256 KB tail per poll is pure waste.
+        self._tail_cache: Dict[str, Any] = {}
+
+    # ------------------------------------------------------ lifecycle
+
+    def argv(self) -> List[str]:
+        sup = os.path.join(self.repo_root, "tools", "supervise.py")
+        srv = os.path.join(self.repo_root, "serve.py")
+        return ([self.python, sup, "--no-resume",
+                 "--metrics-jsonl", self.sup_metrics,
+                 "--drop-flag-on-restart=--inject-fault"]
+                + self.supervise_args
+                + ["--", self.python, srv,
+                   "--inbox", self.inbox, "--outbox", self.outbox,
+                   "--replica-id", self.name,
+                   "--metrics-jsonl", self.child_metrics]
+                + self.serve_args)
+
+    def start(self) -> "ProcReplica":
+        """Spawn the supervised serve tree (idempotent while it runs).
+        The environment is inherited as-is: the router/CLI sets
+        APEX_TRACE_ID in os.environ before spawning, so the whole tree
+        (supervisor -> serve child -> restarts) joins ONE trace."""
+        if self.proc is not None and self.proc.poll() is None:
+            return self
+        self.proc = subprocess.Popen(self.argv())
+        return self
+
+    def _inbox(self):
+        # Lazy: submits are legal before start() (the child replays the
+        # inbox from byte 0 whenever it comes up).
+        if self._inbox_fh is None:
+            self._inbox_fh = open(self.inbox, "a")
+        return self._inbox_fh
+
+    def submit(self, spec: Dict[str, Any]) -> bool:
+        if self._closed or (self.proc is not None
+                            and self.proc.poll() is not None):
+            return False
+        fh = self._inbox()
+        fh.write(json.dumps(spec, separators=(",", ":")) + "\n")
+        fh.flush()
+        self._routed.append(spec["uid"])
+        return True
+
+    def close(self) -> None:
+        """End-of-stream sentinel: the child finishes what is queued
+        and exits 0; the supervisor sees done."""
+        if not self._closed:
+            fh = self._inbox()
+            fh.write('{"close": true}\n')
+            fh.flush()
+            self._closed = True
+
+    def wait(self, timeout_s: float = 120.0) -> Optional[int]:
+        if self.proc is None:
+            return None
+        try:
+            return self.proc.wait(timeout_s)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            return None
+
+    def terminate(self) -> None:
+        """Tear the whole supervised tree down (fleet shutdown, not
+        chaos: SIGTERM to the supervisor forwards to the child AND
+        stops the restart loop)."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+
+    # ---------------------------------------------------------- chaos
+
+    def _tail_cached(self, path: Optional[str],
+                     want: str) -> List[Dict[str, Any]]:
+        """``tail_records`` behind an (mtime, size) cache — unchanged
+        files cost one stat per poll instead of a 256 KB re-read."""
+        if not path:
+            return []
+        try:
+            st = os.stat(path)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return []
+        key = (path, want)
+        cached = self._tail_cache.get(key)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        recs = tail_records(path, want)
+        self._tail_cache[key] = (sig, recs)
+        return recs
+
+    def child_pid(self) -> Optional[int]:
+        """The serve child's pid, from its newest heartbeat."""
+        path = newest_attempt_path(self.child_metrics)
+        beats = self._tail_cached(path, "replica_state")
+        return int(beats[-1]["pid"]) if beats and "pid" in beats[-1] \
+            else None
+
+    def interrupt(self) -> Optional[int]:
+        """The rolling-restart action: SIGTERM the serve CHILD (not the
+        supervisor) — it drains, exits 75, and the supervisor restarts
+        it promptly with the metrics stream rotated.  Returns the pid
+        signalled (the caller waits for a heartbeat from a DIFFERENT
+        pid to confirm the restart landed)."""
+        pid = self.child_pid()
+        if pid is not None:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:  # pragma: no cover — raced a crash
+                return None
+        return pid
+
+    # ------------------------------------------------------- contract
+
+    def poll(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(self.outbox) as fh:
+                fh.seek(self._outbox_pos)
+                chunk = fh.read()
+                # Only consume complete lines; a torn tail is re-read
+                # whole on the next poll.
+                consumed = chunk.rfind("\n") + 1
+                self._outbox_pos += consumed
+                for line in chunk[:consumed].splitlines():
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(ev, dict) and "uid" in ev:
+                        if ev.get("status") != "drained":
+                            self._terminal.add(ev["uid"])
+                        ev.setdefault("replica", self.name)
+                        out.append(ev)
+        except OSError:
+            pass                        # child has not opened it yet
+        # Supervisor gone (restart budget exhausted, or done): whatever
+        # we routed that never reached a terminal status is lost —
+        # reported once, for the router's deadline-aware retry.
+        if self.proc is not None and self.proc.poll() is not None \
+                and self.proc.returncode != 0 and not self._lost_reported:
+            self._lost_reported = True
+            for uid in self._routed:
+                if uid not in self._terminal:
+                    out.append({"uid": uid, "status": "lost",
+                                "replica": self.name,
+                                "error": "supervised replica exited "
+                                         f"{self.proc.returncode}"})
+        return out
+
+    def state(self) -> Dict[str, Any]:
+        st = "healthy"
+        rc = self.proc.poll() if self.proc is not None else None
+        path = newest_attempt_path(self.child_metrics)
+        beats = self._tail_cached(path, "replica_state")
+        beat = beats[-1] if beats else {}
+        restarts = self._tail_cached(self.sup_metrics, "restart")
+        if rc is not None:
+            st = "stopped" if rc == 0 else "crashed"
+        elif not beats:
+            st = "starting"
+        elif beat.get("state") == "draining":
+            st = "draining"
+        elif restarts and "time" in restarts[-1] \
+                and "time" in beat \
+                and restarts[-1]["time"] > beat["time"]:
+            # The supervisor decided a restart after the last heartbeat:
+            # the next attempt has not spoken yet.
+            st = "restarting"
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "state": st,
+            "tick": int(beat.get("tick", 0)),
+            "pending": int(beat.get("pending", 0)),
+            "blocks_live": int(beat.get("blocks_live", 0)),
+            "progress_age_s": (time.time() - float(beat["time"]))
+            if "time" in beat else 0.0,
+            "pid": beat.get("pid"),
+            "restarts": len(restarts),
+        }
+        if restarts:
+            out["classification"] = restarts[-1].get("classification")
+            out["exit_code"] = restarts[-1].get("exit_code")
+        return out

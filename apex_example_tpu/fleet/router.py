@@ -1,0 +1,662 @@
+"""The fleet router: admission + dispatch over N serve replicas.
+
+Pure stdlib ON PURPOSE — **jax-free by contract** like
+resilience/supervisor.py (graftlint's static rule proves the import
+closure): routing must keep working while individual replicas' jax is
+dying, so nothing here may touch the serve package.  Replica handles
+(fleet/replica.py) are duck-typed, never imported.
+
+What the router owns:
+
+- **Dispatch policies** (``--policy``): ``round_robin`` (cycle the
+  routable set), ``least_pending`` (the smallest queued backlog, from
+  each replica's tailed/live gauges), ``least_kv`` (the fewest live KV
+  arena blocks — the tailed ``blocks_live`` gauge).  A replica is
+  routable when its handle reports healthy/starting AND its circuit
+  breaker admits traffic.  When nothing is routable the request parks
+  in the router backlog and is re-dispatched as capacity returns —
+  admission never silently drops.
+- **Requeue-on-drain**: a replica exiting 75 hands its still-queued
+  requests back with status "drained"; the router requeues each to a
+  SIBLING, exactly once per drain report (a duplicate report of the
+  same drain is counted, not re-dispatched).  Drains are the expected
+  steady state under rolling restarts, so they never trip the breaker.
+- **Deadline-aware retry**: a request lost to a replica crash is
+  re-dispatched while its wall-clock deadline allows and the retry
+  budget lasts; past either it terminates first-class (``timeout`` /
+  ``failed``) instead of spinning.
+- **Circuit breaking**: a crashed or stalled replica's breaker opens
+  (exponential backoff), half-opens after the backoff to admit ONE
+  probe request, and closes again only when the probe completes ok —
+  the classic pattern, deterministic enough to unit-test.
+
+Every decision lands in the router's own schema-v10 stream: one
+``route`` record per dispatch (policy, attempt, reason), a
+``replica_state`` record per observed transition (with the
+supervisor's exit ``classification`` when known), and a closing
+``fleet_summary`` (per-status totals, retry/requeue accounting,
+``lost`` — the zero-lost acceptance counter — fleet availability,
+per-replica breakdown, routing-balance stats).  With ``trace=True``
+the same stream carries hard-coded schema-v9 trace events (the
+supervisor's pattern: clock_sync + instants/X spans on the "router"
+row), and the router exports ``APEX_TRACE_ID`` so every replica tree
+it spawns joins ONE Perfetto timeline.
+
+Thread-safety: ``submit`` may be called from a load-generator thread
+while the main thread polls; all shared state (``_replicas`` metadata
+incl. breaker fields, ``_inflight``, ``_backlog``, ``_done``) is
+guarded by ``_lock`` — annotated for graftlint's lock-discipline rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) —
+# jax-free contract forbids importing it (same stance as the
+# supervisor's hard-coded records).
+SCHEMA = 10
+TRACE_ID_ENV = "APEX_TRACE_ID"
+
+POLICIES = ("round_robin", "least_pending", "least_kv")
+
+# Statuses a replica can report that end a request for good at the
+# fleet level (drained and lost are re-routed instead).
+_TERMINAL = ("ok", "timeout", "shed", "cancelled", "failed", "rejected")
+
+
+class _Stream:
+    """Minimal JSONL writer (the jax-free contract rules out
+    obs.JsonlSink — the supervisor carries the same copy, minus the
+    lock: here a load-generator thread may submit() — and therefore
+    emit route records — while the poll thread writes, so each line is
+    one atomic write under an internal lock or the stream tears."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = None                 # guarded-by: _lock
+
+    def write(self, rec: Dict[str, Any]) -> None:
+        if self.path is None:
+            return
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            if self._fh is None:
+                parent = os.path.dirname(self.path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                self._fh = open(self.path, "w")
+            self._fh.write(line)
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class _Meta:
+    """Per-replica routing state.  Every field is guarded by the
+    router's ``_lock`` (reached only through ``self._replicas``)."""
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.dispatches = 0
+        self.inflight = 0
+        self.counts: Dict[str, int] = {}
+        self.health: Dict[str, Any] = {"state": "starting"}
+        self.emitted_state: Optional[str] = None
+        # Circuit breaker: closed -> open (backoff) -> half_open
+        # (single probe) -> closed | open.
+        self.breaker = "closed"
+        self.fail_streak = 0
+        self.opened_at = 0.0
+        self.probe_uid: Optional[str] = None
+
+    def bump(self, status: str) -> None:
+        self.counts[status] = self.counts.get(status, 0) + 1
+
+
+class FleetRouter:
+    """Route request specs across replica handles; see module doc."""
+
+    def __init__(self, replicas, policy: str = "round_robin",
+                 metrics_jsonl: Optional[str] = None, sink=None,
+                 run_id: Optional[str] = None, max_retries: int = 2,
+                 breaker_backoff_s: float = 0.25,
+                 breaker_backoff_max_s: float = 5.0,
+                 stall_after_s: Optional[float] = None,
+                 default_deadline_s: Optional[float] = None,
+                 trace: bool = False, log=print):
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {policy!r}")
+        if not replicas:
+            raise ValueError("fleet needs at least one replica")
+        self.policy = policy
+        self.max_retries = int(max_retries)
+        self.breaker_backoff_s = float(breaker_backoff_s)
+        self.breaker_backoff_max_s = float(breaker_backoff_max_s)
+        self.stall_after_s = stall_after_s
+        self.default_deadline_s = default_deadline_s
+        self.log = log
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self._stream = sink if sink is not None else _Stream(metrics_jsonl)
+        self._lock = threading.Lock()
+        self._order = [r.name for r in replicas]
+        self._replicas = {r.name: _Meta(r) for r in replicas}  # guarded-by: _lock
+        self._inflight: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._backlog: deque = deque()                  # guarded-by: _lock
+        self._done: Dict[str, str] = {}                 # guarded-by: _lock
+        # uid -> replica still holding a LIVE booking for a uid that
+        # terminated via an abandoned copy's late report (its own
+        # report releases it — see _absorb's duplicate branch).
+        self._stale: Dict[str, str] = {}                # guarded-by: _lock
+        self._rr = 0
+        self._submitted = 0
+        self._retries = 0
+        self._drained_requeued = 0
+        self._duplicates = 0
+        self._router_terminal = 0     # timeouts/failures decided HERE
+        self.results: Dict[str, Dict[str, Any]] = {}    # uid -> final event
+        self.scenario: Optional[str] = None
+        self.verdict: Optional[str] = None
+        self._t0 = time.perf_counter()
+        # Trace continuity: the router's trace id is inherited from a
+        # parent (APEX_TRACE_ID) or minted here, and EXPORTED so every
+        # replica tree spawned after construction joins the timeline.
+        self.trace_id = os.environ.get(TRACE_ID_ENV) or self.run_id
+        self._tracing = bool(trace)
+        # Own lock (not _lock: trace_event is called from inside and
+        # outside _lock holders alike): a submit-thread route event and
+        # a poll-thread state event racing the lazy clock_sync would
+        # both write one — and trace_export --check requires EXACTLY
+        # one per stream.
+        self._trace_lock = threading.Lock()
+        self._trace_synced = False
+        if self._tracing:
+            os.environ[TRACE_ID_ENV] = self.trace_id
+        self._header()
+
+    # --------------------------------------------------------- records
+
+    def _header(self) -> None:
+        self._stream.write({
+            "record": "run_header", "schema": SCHEMA, "time": time.time(),
+            "run_id": self.run_id, "num_devices": 0, "process_index": 0,
+            "platform": "fleet-router",
+            "config": {"policy": self.policy,
+                       "replicas": list(self._order),
+                       "max_retries": self.max_retries,
+                       "breaker_backoff_s": self.breaker_backoff_s,
+                       "stall_after_s": self.stall_after_s,
+                       "default_deadline_s": self.default_deadline_s}})
+
+    def _route_rec(self, uid: str, replica: str, attempt: int,
+                   reason: str, from_replica: Optional[str]) -> None:
+        rec: Dict[str, Any] = {
+            "record": "route", "time": time.time(), "request_id": uid,
+            "replica": replica, "policy": self.policy,
+            "attempt": attempt, "reason": reason, "run_id": self.run_id}
+        if from_replica:
+            rec["from_replica"] = from_replica
+        self._stream.write(rec)
+        self.trace_event("i", "route",
+                         args={"request_id": uid, "replica": replica,
+                               "reason": reason})
+
+    def _state_rec(self, replica: str, state: str,
+                   health: Optional[Dict[str, Any]] = None,
+                   detail: Optional[str] = None) -> None:
+        rec: Dict[str, Any] = {
+            "record": "replica_state", "time": time.time(),
+            "replica": replica, "state": state, "run_id": self.run_id}
+        if health:
+            rec["tick"] = int(health.get("tick", 0))
+            rec["pending"] = int(health.get("pending", 0))
+            rec["blocks_live"] = int(health.get("blocks_live", 0))
+            if health.get("classification"):
+                rec["classification"] = str(health["classification"])
+            if health.get("exit_code") is not None:
+                rec["exit_code"] = int(health["exit_code"])
+        if detail:
+            rec["detail"] = detail
+        self._stream.write(rec)
+        self.trace_event("i", "replica_state",
+                         args={"replica": replica, "state": state})
+
+    def trace_event(self, ph: str, name: str,
+                    ts: Optional[float] = None,
+                    dur: Optional[float] = None,
+                    args: Optional[Dict[str, Any]] = None) -> None:
+        """Hard-coded schema-v9 trace_event into the router stream
+        (supervisor pattern — the jax-free contract forbids importing
+        obs/trace.py, not matching it).  No-op unless ``trace=True``."""
+        if not self._tracing:
+            return
+        with self._trace_lock:
+            if not self._trace_synced:
+                self._stream.write({
+                    "record": "clock_sync", "time": time.time(),
+                    "ts": time.perf_counter(), "trace_id": self.trace_id,
+                    "run_id": self.run_id})
+                self._trace_synced = True
+        rec: Dict[str, Any] = {
+            "record": "trace_event", "ph": ph, "name": name,
+            "ts": time.perf_counter() if ts is None else ts,
+            "tid": "router", "trace_id": self.trace_id,
+            "run_id": self.run_id}
+        if dur is not None:
+            rec["dur"] = dur
+        if args:
+            rec["args"] = args
+        self._stream.write(rec)
+
+    # -------------------------------------------------------- breaker
+
+    def _backoff(self, streak: int) -> float:
+        return min(self.breaker_backoff_s * (2 ** max(streak - 1, 0)),
+                   self.breaker_backoff_max_s)
+
+    def _open_breaker(self, meta: _Meta) -> None:
+        """Caller holds ``_lock`` (meta is only reachable through the
+        guarded ``_replicas`` map)."""
+        meta.breaker = "open"
+        meta.fail_streak += 1
+        meta.opened_at = time.time()
+        meta.probe_uid = None
+
+    def _routable(self, meta: _Meta, now: float) -> bool:
+        """Caller holds ``_lock``."""
+        if meta.health.get("state") not in ("starting", "healthy"):
+            return False
+        if meta.breaker == "closed":
+            return True
+        if meta.breaker == "open":
+            if now - meta.opened_at >= self._backoff(meta.fail_streak):
+                meta.breaker = "half_open"
+                meta.probe_uid = None
+                return True
+            return False
+        return meta.probe_uid is None          # half_open: one probe
+
+    # ------------------------------------------------------- dispatch
+
+    def _pick(self, metas: Dict[str, _Meta], now: float,
+              avoid: Tuple[str, ...],
+              refused: Tuple[str, ...]) -> Optional[str]:
+        """Policy selection over the routable set.  Caller holds
+        ``_lock`` and passes the guarded ``_replicas`` map in (so the
+        guarded name is only ever touched inside the lock).  ``avoid``
+        is a preference (the replica a retry/requeue is leaving —
+        routed back to only when it is the sole survivor); ``refused``
+        is hard (it already refused this spec in this dispatch)."""
+        names = [n for n in self._order
+                 if n not in refused and self._routable(metas[n], now)]
+        preferred = [n for n in names if n not in avoid]
+        names = preferred or names
+        if not names:
+            return None
+        if self.policy == "round_robin":
+            ordered = self._order[self._rr:] + self._order[:self._rr]
+            for n in ordered:
+                if n in names:
+                    self._rr = (self._order.index(n) + 1) \
+                        % len(self._order)
+                    return n
+            return None
+
+        def load_key(n: str):
+            gauge = "pending" if self.policy == "least_pending" \
+                else "blocks_live"
+            return (metas[n].health.get(gauge, 0), metas[n].inflight,
+                    self._order.index(n))
+        return min(names, key=load_key)
+
+    def _dispatch(self, uid: str, reason: str,
+                  exclude: Tuple[str, ...] = ()) -> Optional[str]:
+        """Hand ``uid`` to a replica chosen by the policy; park it in
+        the backlog when nothing is routable.  Returns the replica
+        name, or None when backlogged/already-terminal."""
+        refused: Tuple[str, ...] = ()
+        while True:
+            now = time.time()
+            with self._lock:
+                entry = self._inflight.get(uid)
+                if entry is None:
+                    return None                     # already terminal
+                name = self._pick(self._replicas, now, exclude, refused)
+                if name is None:
+                    self._backlog.append(uid)
+                    return None
+                meta = self._replicas[name]
+                meta.dispatches += 1
+                meta.inflight += 1
+                if meta.breaker == "half_open":
+                    meta.probe_uid = uid
+                entry["replica"] = name
+                attempt = entry["attempts"]
+                entry["attempts"] += 1
+                handle = meta.handle
+                spec = entry["spec"]
+                src = entry.get("from")
+            if handle.submit(spec):
+                self._route_rec(uid, name, attempt, reason, src)
+                return name
+            # Refused at the door (draining/dead under us): undo the
+            # booking, remember the refusal, try the next candidate.
+            with self._lock:
+                meta = self._replicas[name]
+                meta.dispatches -= 1
+                meta.inflight = max(meta.inflight - 1, 0)
+                if meta.probe_uid == uid:
+                    meta.probe_uid = None
+                ent = self._inflight.get(uid)
+                if ent is not None:
+                    ent["replica"] = None
+                    ent["attempts"] -= 1
+            refused = refused + (name,)
+
+    # --------------------------------------------------------- intake
+
+    def submit(self, spec: Dict[str, Any]) -> None:
+        """Admit one request spec (a plain dict with at least ``uid``,
+        ``prompt`` and ``max_new_tokens``) and dispatch it."""
+        uid = spec["uid"]
+        deadline_s = spec.get("deadline_s", self.default_deadline_s)
+        with self._lock:
+            if uid in self._inflight or uid in self._done:
+                raise ValueError(f"duplicate uid {uid!r}")
+            self._inflight[uid] = {
+                "spec": spec, "replica": None, "attempts": 0,
+                "retries": 0, "from": None,
+                "deadline": (time.time() + deadline_s)
+                if deadline_s else None}
+            self._submitted += 1
+        self._dispatch(uid, "dispatch")
+
+    # --------------------------------------------------------- absorb
+
+    def _absorb(self, ev: Dict[str, Any]) -> None:
+        uid = ev.get("uid")
+        status = ev.get("status")
+        src = ev.get("replica")
+        with self._lock:
+            entry = self._inflight.get(uid)
+            if entry is None:
+                # Late/duplicate report for an already-terminal uid (a
+                # stall-rescued request's original copy finishing, a
+                # replayed outbox line): counted, never re-applied.
+                # Inflight accounting: decrement ONLY when this report
+                # releases a booking still counted live (recorded in
+                # _stale when the uid terminated from a different
+                # replica) — a report from a replica whose booking was
+                # already released at rescue/drain time must not eat an
+                # unrelated request's slot (review finding, ISSUE 12).
+                if uid in self._done:
+                    self._duplicates += 1
+                    if self._stale.get(uid) == src:
+                        del self._stale[uid]
+                        meta = self._replicas.get(src)
+                        if meta is not None:
+                            meta.inflight = max(meta.inflight - 1, 0)
+                return
+            meta = self._replicas.get(src or entry["replica"])
+            if status in _TERMINAL:
+                self._done[uid] = status
+                del self._inflight[uid]
+                self.results[uid] = ev
+                if meta is not None:
+                    meta.bump(status)
+                    if entry["replica"] == src:
+                        meta.inflight = max(meta.inflight - 1, 0)
+                    elif entry["replica"] is not None:
+                        # Terminal reported by an ABANDONED copy while
+                        # another replica still holds a live booking:
+                        # that booking is released when its own report
+                        # arrives (the duplicate branch above).
+                        self._stale[uid] = entry["replica"]
+                    if meta.probe_uid == uid:
+                        # The half-open probe's verdict: ok closes the
+                        # breaker, anything else re-opens it.
+                        if status == "ok":
+                            meta.breaker = "closed"
+                            meta.fail_streak = 0
+                        else:
+                            self._open_breaker(meta)
+                        meta.probe_uid = None
+                return
+            # drained / lost: the uid lives on — but only the replica
+            # that currently holds it may hand it back (exactly-once
+            # per drain: duplicate reports find the entry already
+            # moved).
+            if src is not None and entry["replica"] != src:
+                self._duplicates += 1
+                return
+            entry["replica"] = None
+            entry["from"] = src
+            if meta is not None:
+                meta.inflight = max(meta.inflight - 1, 0)
+                meta.bump(status)
+                if meta.probe_uid == uid:
+                    self._open_breaker(meta)
+                    meta.probe_uid = None
+            now = time.time()
+            if status == "drained":
+                self._drained_requeued += 1
+                action = "requeue_drain"
+            else:                                        # lost
+                if entry["deadline"] is not None \
+                        and now > entry["deadline"]:
+                    self._router_done(self._done, self._inflight,
+                                      uid, "timeout", src)
+                    return
+                if entry["retries"] >= self.max_retries:
+                    self._router_done(self._done, self._inflight,
+                                      uid, "failed", src)
+                    return
+                entry["retries"] += 1
+                self._retries += 1
+                action = "retry"
+        self._dispatch(uid, action,
+                       exclude=(src,) if src else ())
+
+    def _router_done(self, done: Dict[str, str],
+                     inflight: Dict[str, Dict[str, Any]], uid: str,
+                     status: str, src: Optional[str]) -> None:
+        """A terminal decision made by the ROUTER (deadline passed /
+        retry budget exhausted).  The caller holds ``_lock`` and passes
+        the guarded maps in."""
+        done[uid] = status
+        del inflight[uid]
+        self._router_terminal += 1
+        self.results[uid] = {"uid": uid, "status": status,
+                             "replica": src, "router_decided": True}
+
+    # ----------------------------------------------------------- poll
+
+    def _refresh_health(self) -> None:
+        """Snapshot every handle's health (outside the lock — proc
+        handles do bounded file tails) and act on transitions: crashed
+        replicas open their breaker and surface their in-flight uids
+        as lost; stalled replicas (no progress for ``stall_after_s``
+        while holding work) are treated the same."""
+        snaps = []
+        with self._lock:
+            handles = [(n, self._replicas[n].handle)
+                       for n in self._order]
+        for name, handle in handles:
+            snaps.append((name, handle.state()))
+        rescue: List[Dict[str, Any]] = []
+        for name, snap in snaps:
+            with self._lock:
+                meta = self._replicas[name]
+                meta.health = snap
+                state = snap.get("state")
+                stalled = (self.stall_after_s is not None
+                           and state == "healthy" and meta.inflight > 0
+                           and snap.get("progress_age_s", 0.0)
+                           > self.stall_after_s)
+                if stalled:
+                    state = "stalled"
+                    meta.health = dict(snap, state="stalled")
+                newly_down = state in ("crashed", "stalled") \
+                    and meta.emitted_state not in ("crashed", "stalled")
+                if newly_down:
+                    self._open_breaker(meta)
+                    # Everything this replica holds is not coming
+                    # back on its own: surface as lost for the
+                    # deadline-aware retry path.  (A crashed
+                    # ThreadReplica reports its own lost set via
+                    # poll(); the src-match guard in _absorb dedupes.)
+                    if state == "stalled":
+                        rescue.extend(
+                            {"uid": u, "status": "lost",
+                             "replica": name}
+                            for u, e in self._inflight.items()
+                            if e["replica"] == name)
+                emit = state != meta.emitted_state
+                if emit:
+                    meta.emitted_state = state
+            if emit:
+                self._state_rec(name, state, snap)
+                if self.log and state in ("crashed", "stalled"):
+                    self.log(f"fleet: replica {name} {state} "
+                             f"(breaker open)")
+        for ev in rescue:
+            self._absorb(ev)
+
+    def poll(self) -> int:
+        """One router turn: refresh health, harvest replica events,
+        requeue/retry, drain the backlog.  Returns the number of
+        events absorbed."""
+        self._refresh_health()
+        with self._lock:
+            handles = [(n, self._replicas[n].handle)
+                       for n in self._order]
+        events: List[Dict[str, Any]] = []
+        for name, handle in handles:
+            for ev in handle.poll():
+                ev.setdefault("replica", name)
+                events.append(ev)
+        for ev in events:
+            self._absorb(ev)
+        # Backlog: one dispatch attempt per uid per poll (a failed
+        # attempt re-parks it).
+        with self._lock:
+            parked = list(self._backlog)
+            self._backlog.clear()
+        now = time.time()
+        for uid in parked:
+            expired = False
+            with self._lock:
+                entry = self._inflight.get(uid)
+                if entry is None:
+                    continue
+                if entry["deadline"] is not None \
+                        and now > entry["deadline"]:
+                    self._router_done(self._done, self._inflight,
+                                      uid, "timeout", None)
+                    expired = True
+            if not expired:
+                self._dispatch(uid, "backlog")
+        return len(events)
+
+    def done(self) -> bool:
+        with self._lock:
+            return not self._inflight
+
+    def replica_state(self, name: str) -> Optional[str]:
+        """The ROUTER's view of one replica (breaker/stall verdicts
+        included — a stalled replica reports "healthy" about itself)."""
+        with self._lock:
+            meta = self._replicas.get(name)
+            return meta.emitted_state if meta is not None else None
+
+    def run(self, timeout_s: float = 120.0,
+            poll_interval_s: float = 0.01) -> bool:
+        """Poll until every submitted uid is terminal (True) or the
+        timeout passes (False — the leftovers count as ``lost``)."""
+        t0 = time.time()
+        while time.time() - t0 < timeout_s:
+            self.poll()
+            if self.done():
+                return True
+            time.sleep(poll_interval_s)
+        return self.done()
+
+    # -------------------------------------------------------- summary
+
+    def summary_record(self) -> Dict[str, Any]:
+        with self._lock:
+            done = dict(self._done)
+            lost = len(self._inflight)
+            per_replica: Dict[str, Any] = {}
+            dispatches: Dict[str, int] = {}
+            for name in self._order:
+                meta = self._replicas[name]
+                per_replica[name] = dict(meta.counts)
+                per_replica[name]["dispatches"] = meta.dispatches
+                ok_r = meta.counts.get("ok", 0)
+                owned = sum(v for k, v in meta.counts.items()
+                            if k not in ("drained", "lost"))
+                per_replica[name]["availability"] = round(
+                    ok_r / owned, 3) if owned else 1.0
+                per_replica[name]["state"] = \
+                    meta.health.get("state", "?")
+                dispatches[name] = meta.dispatches
+            submitted = self._submitted
+            retries = self._retries
+            requeued = self._drained_requeued
+            dups = self._duplicates
+        ok = sum(1 for s in done.values() if s == "ok")
+        terminal = len(done)
+        counts = {s: sum(1 for v in done.values() if v == s)
+                  for s in _TERMINAL}
+        vals = list(dispatches.values())
+        mean = sum(vals) / len(vals) if vals else 0.0
+        skew = round(max(vals) / mean, 3) if mean else 0.0
+        rec: Dict[str, Any] = {
+            "record": "fleet_summary",
+            "time": time.time(),
+            "replicas": len(self._order),
+            "requests": submitted,
+            "policy": self.policy,
+            "duration_s": round(time.perf_counter() - self._t0, 3),
+            "completed": counts["ok"],
+            "failed": counts["failed"],
+            "timed_out": counts["timeout"],
+            "shed": counts["shed"],
+            "cancelled": counts["cancelled"],
+            "rejected": counts["rejected"],
+            "drained_requeued": requeued,
+            "retries": retries,
+            "duplicates": dups,
+            "lost": lost,
+            "availability": round(ok / terminal, 3) if terminal else 1.0,
+            "per_replica": per_replica,
+            "routing": {"dispatches": dispatches,
+                        "balance_skew": skew},
+            "run_id": self.run_id,
+        }
+        if self.scenario:
+            rec["scenario"] = self.scenario
+        if self.verdict:
+            rec["verdict"] = self.verdict
+        return rec
+
+    def close(self) -> Dict[str, Any]:
+        """Write the fleet_summary and close the stream; returns the
+        summary record."""
+        rec = self.summary_record()
+        self._stream.write(rec)
+        self._stream.close()
+        return rec

@@ -169,6 +169,41 @@ Without ``--trace`` neither record is emitted — streams are
 byte-identical to v8 runs.  v9 is once more a strict superset: every
 v1–v8 stream validates unchanged.
 
+Version 10 adds the fleet stratum (apex_example_tpu/fleet/; ``fleet.py``
+— a jax-free router over N supervised serve replicas, README "Fleet
+serving & chaos scenarios"):
+
+``route``          one per router dispatch decision — which replica a
+                   request was handed to, under which policy, on which
+                   attempt, and why (``reason``: the initial dispatch,
+                   a deadline-aware ``retry`` after a replica died, a
+                   ``requeue_drain`` after a replica exited 75 and
+                   handed its queued requests back, or a ``backlog``
+                   drain once capacity returned).
+``replica_state``  a replica health/lifecycle observation.  Emitted
+                   from BOTH sides of the fence: a serve.py replica
+                   (``--inbox`` mode) heartbeats its own
+                   tick/pending/blocks_live/pid, and the router records
+                   the transitions it acts on (healthy / stalled /
+                   crashed / restarting / stopped), carrying the
+                   supervisor's exit ``classification`` when one is
+                   known.
+``fleet_summary``  one per fleet run, last line of the router's stream
+                   — request totals per terminal status, retry/requeue
+                   accounting, ``lost`` (uids that never reached a
+                   terminal status — the rolling-restart acceptance
+                   pins this at 0), the fleet ``availability`` ratio
+                   (ok / non-drained terminal across all replicas),
+                   the per-replica breakdown and the routing-balance
+                   stats, plus the scenario name + verdict when a
+                   scripted chaos scenario drove the run.
+
+plus ``classification`` on ``restart`` (the supervisor's verdict on how
+the child died: ``preempted`` / ``crashed`` / ``stall_killed`` — so
+fleet tooling distinguishes drains from crashes without re-parsing
+child streams).  v10 is once more a strict superset: every v1–v9
+stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -180,7 +215,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -321,6 +356,26 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "time": _NUM,           # wall clock (time.time())
         "ts": _NUM,             # perf_counter taken back-to-back
     },
+    # --- schema v10: fleet records (apex_example_tpu/fleet/; fleet.py) ---
+    "route": {
+        "record": str,
+        "time": _NUM,
+        "request_id": str,
+        "replica": str,         # the replica the request was handed to
+    },
+    "replica_state": {
+        "record": str,
+        "time": _NUM,
+        "replica": str,
+        "state": str,           # serving|draining|healthy|stalled|
+    },                          #   crashed|restarting|stopped
+    "fleet_summary": {
+        "record": str,
+        "time": _NUM,
+        "replicas": int,
+        "requests": int,
+        "availability": _NUM,   # ok / non-drained terminal, fleet-wide
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -442,6 +497,9 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "backoff_s": _NUM,
         "last_step": int,        # tailed from the child's metrics JSONL
         "checkpoint_step": int,  # latest checkpoint at restart time
+        # v10: how the child died, as the supervisor saw it — fleet
+        # tooling keys on this instead of re-parsing child streams.
+        "classification": str,   # preempted | crashed | stall_killed
     },
     "resume": {
         "run_id": str,
@@ -521,6 +579,44 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
     "clock_sync": {
         "run_id": str,
         "trace_id": str,
+    },
+    # --- schema v10: fleet records (apex_example_tpu/fleet/) ---
+    "route": {
+        "run_id": str,
+        "policy": str,           # round_robin | least_pending | least_kv
+        "attempt": int,          # 0 = first dispatch of this uid
+        "reason": str,           # dispatch | retry | requeue_drain |
+        "from_replica": str,     #   backlog; the replica being left on
+    },                           #   a retry/requeue
+    "replica_state": {
+        "run_id": str,
+        "tick": int,             # the replica's engine tick counter
+        "pending": int,          # its queued-request backlog
+        "blocks_live": int,      # KV arena blocks held (least_kv input)
+        "pid": int,              # serve-child pid (chaos scripts signal it)
+        "attempt": int,          # supervisor attempt index, when known
+        "exit_code": int,        # with state crashed/restarting
+        "classification": str,   # preempted | crashed | stall_killed
+        "detail": str,
+    },
+    "fleet_summary": {
+        "run_id": str,
+        "policy": str,
+        "scenario": str,         # rolling_restart | crash_storm | ...
+        "verdict": str,          # pass | fail (the scenario's score)
+        "duration_s": _NUM,
+        "completed": int,        # per-status fleet totals ("requests"
+        "failed": int,           #   stays the submitted total)
+        "timed_out": int,
+        "shed": int,
+        "cancelled": int,
+        "rejected": int,
+        "drained_requeued": int,  # requeue-on-drain handoffs performed
+        "retries": int,           # deadline-aware re-dispatches
+        "duplicates": int,        # late/duplicate terminal reports ignored
+        "lost": int,              # uids with NO terminal status (must be 0)
+        "per_replica": dict,      # name -> per-status breakdown
+        "routing": dict,          # dispatch counts + balance skew
     },
 }
 

@@ -36,9 +36,11 @@ On each launch attempt the child argv is rewritten:
 The supervisor keeps its OWN telemetry stream (``metrics_jsonl``):
 ``run_header`` (platform "supervisor"), a ``resume`` record per
 checkpoint-resumed launch, a ``restart`` record per restart decision
-(exit code, reason, backoff, the child's last step tailed from its
-metrics JSONL), and a closing ``run_summary`` carrying ``restart_count``
-— schema v5 (obs/schema.py; hard-coded here to stay import-free).
+(exit code, reason, the v10 exit ``classification`` —
+``preempted``/``crashed``/``stall_killed``, the field fleet tooling
+keys on — backoff, the child's last step tailed from its metrics
+JSONL), and a closing ``run_summary`` carrying ``restart_count`` —
+schema v10 (obs/schema.py; hard-coded here to stay import-free).
 
 SIGTERM/SIGINT to the supervisor forward to the child and stop the
 restart loop: the child runs its own grace path, the supervisor exits
@@ -82,7 +84,7 @@ from typing import Any, Dict, List, Optional
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) and
 # resilience/preemption.py (EX_TEMPFAIL) — this module must not import
 # either (jax-free contract; same for obs/trace.py's APEX_TRACE_ID).
-SCHEMA = 9
+SCHEMA = 10
 EX_TEMPFAIL = 75
 TRACE_ID_ENV = "APEX_TRACE_ID"
 
@@ -544,16 +546,24 @@ class Supervisor:
                     return rc
                 if rc == EX_TEMPFAIL:
                     reason, backoff = "preemption", self.preempt_delay_s
+                    classification = "preempted"
                 else:
                     reason = "stall" if self._stall_killed else "crash"
+                    classification = "stall_killed" if self._stall_killed \
+                        else "crashed"
                     backoff = min(self.backoff_s * (2 ** crash_restarts),
                                   self.backoff_max_s)
                     crash_restarts += 1
+                # v10: the exit classification rides the restart record
+                # so fleet tooling (fleet/replica.py's health tail,
+                # tools/fleet_report.py) can tell a drain from a crash
+                # without re-parsing the child's own stream.
                 rec: Dict[str, Any] = {
                     "record": "restart", "time": time.time(),
                     "run_id": self.run_id,
                     "attempt": attempt + self._attempt_offset,
                     "exit_code": int(rc), "reason": reason,
+                    "classification": classification,
                     "backoff_s": float(backoff)}
                 if last_step is not None:
                     rec["last_step"] = last_step

@@ -25,7 +25,8 @@ drain-to-EX_TEMPFAIL, ``--inject-fault`` drills);
 from apex_example_tpu.serve.engine import (ServeEngine, SlotFailure,
                                            request_complete_record,
                                            request_failed_record)
-from apex_example_tpu.serve.loadgen import parse_range, synthetic_requests
+from apex_example_tpu.serve.loadgen import (parse_range, substream,
+                                            synthetic_requests)
 from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
                                           RequestQueue)
 from apex_example_tpu.serve.slots import BlockAllocator, BlockPool, Slot
@@ -34,5 +35,5 @@ __all__ = [
     "BlockAllocator", "BlockPool", "Completion", "Request",
     "RequestQueue", "STATUSES", "ServeEngine", "Slot", "SlotFailure",
     "parse_range", "request_complete_record", "request_failed_record",
-    "synthetic_requests",
+    "substream", "synthetic_requests",
 ]

@@ -646,18 +646,24 @@ class ServeEngine:
     # ------------------------------------------------------------ loop
 
     def run(self, max_steps: Optional[int] = None,
-            idle_wait_s: float = 0.0, stop=None) -> List[Completion]:
+            idle_wait_s: float = 0.0, stop=None,
+            on_tick=None) -> List[Completion]:
         """Drive ticks until the queue is drained and every slot is free
         (or ``max_steps`` ticks, or ``stop()`` — a callable the caller
         flips on SIGTERM to hand control to ``drain()``).
         ``idle_wait_s`` throttles idle spins when a producer thread
-        feeds the queue in wall-clock time."""
+        feeds the queue in wall-clock time.  ``on_tick(engine)``, when
+        given, runs after every tick (idle ticks included) — the
+        replica-mode hook serve.py uses to flush its completion outbox
+        and heartbeat without the engine knowing about either."""
         while max_steps is None or self.step_count < max_steps:
             if stop is not None and stop():
                 break
             if self.queue.drained() and not self.pool.any_live():
                 break
             ran = self.step()
+            if on_tick is not None:
+                on_tick(self)
             if not ran and idle_wait_s:
                 time.sleep(idle_wait_s)
         return self.completions

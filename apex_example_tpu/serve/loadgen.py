@@ -22,11 +22,30 @@ sleeps (ISSUE 5).
 from __future__ import annotations
 
 import time
+import zlib
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from apex_example_tpu.serve.queue import Request
+
+
+def substream(seed: int, index: int) -> int:
+    """Derive the ``index``-th independent seed from a base ``seed``.
+
+    The fleet bugfix (ISSUE 12): N replicas handed the same ``--seed``
+    drew from ONE seed stream and served IDENTICAL prompt sets — a
+    "fleet" workload that was really one workload N times.  Replica i
+    now derives ``substream(seed, i)``: disjoint with overwhelming
+    probability across indices, yet a pure function of (seed, index),
+    so fleet workloads stay exactly reproducible.  ``index`` 0 is NOT
+    the identity on purpose — a one-replica substreamed run must not
+    silently alias the un-substreamed workload for a different reason
+    than replica 1 differs from it.  stdlib-only (crc32), so jax-free
+    consumers can mirror the derivation."""
+    if index < 0:
+        raise ValueError(f"substream index must be >= 0, got {index}")
+    return zlib.crc32(f"{seed}/{index}".encode()) & 0x7FFFFFFF
 
 
 def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
@@ -37,7 +56,9 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
                        stagger: int = 0, burst: int = 1,
                        deadline_steps: Optional[int] = None,
                        deadline_s: Optional[float] = None,
-                       shared_prefix: int = 0) -> List[Request]:
+                       shared_prefix: int = 0,
+                       seed_substream: Optional[int] = None
+                       ) -> List[Request]:
     """``n`` requests with uniform prompt/output lengths in the given
     inclusive ranges; request i arrives at virtual step
     ``(i // burst) * stagger`` (stagger 0 = all at once; burst b = b
@@ -50,7 +71,13 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
     prompt — the workload mode that makes the paged KV cache's
     copy-on-write prefix sharing measurable: the common blocks are
     computed once and refcounted across requests (ISSUE 8;
-    ``prompt_len`` still sizes only the per-request sampled part)."""
+    ``prompt_len`` still sizes only the per-request sampled part).
+
+    ``seed_substream`` (fleet mode, ISSUE 12): replica index i derives
+    its RandomState from ``substream(seed, i)`` instead of ``seed``
+    directly, so N replicas sharing one base seed serve DISJOINT yet
+    individually-deterministic workloads (``--seed-substream`` on
+    serve.py)."""
     if n < 1:
         raise ValueError(f"need n >= 1 requests, got {n}")
     if prompt_len[0] < 1 or prompt_len[0] > prompt_len[1]:
@@ -65,7 +92,8 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
     if shared_prefix < 0:
         raise ValueError(f"shared_prefix must be >= 0, got "
                          f"{shared_prefix}")
-    rs = np.random.RandomState(seed)
+    rs = np.random.RandomState(seed if seed_substream is None
+                               else substream(seed, seed_substream))
     prefix = rs.randint(0, vocab_size, size=(shared_prefix,)).tolist() \
         if shared_prefix else []
     out = []

@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Fleet CLI: a router over N serve replicas, with scripted chaos.
+
+    # 2 supervised subprocess replicas, rolling restart under load,
+    # scored on zero lost requests (the ROADMAP item-5 acceptance):
+    python fleet.py --replicas 2 --transport proc \\
+        --scenario rolling_restart --requests 24 \\
+        --metrics-jsonl fleet.jsonl --workdir /tmp/fleet --trace
+
+    # in-process replicas over one shared compiled decode program,
+    # k replicas crashed mid-serve via a deterministic drill:
+    python fleet.py --replicas 3 --transport thread \\
+        --scenario crash_storm --crash-replicas 1 --fault-tick 6 \\
+        --requests 18 --metrics-jsonl fleet.jsonl
+
+    # then render the router stream (jax-free):
+    python tools/fleet_report.py fleet.jsonl
+
+Transports (fleet/replica.py):
+
+- ``proc``    spawns N ``tools/supervise.py``-wrapped ``serve.py``
+              children fed through file-based inbox/outbox pairs under
+              ``--workdir``.  This path is **jax-free**: the fleet
+              modules are loaded by file path (the supervisor
+              pattern), so the router keeps running when the replicas'
+              jax is the thing that is dying.
+- ``thread``  drives N in-process ``ServeEngine``s (jax imported
+              lazily); every replica shares ONE compiled decode
+              program (the step cache keys on the module-clone
+              config), so an N-replica fleet costs one compile.
+
+The router (fleet/router.py) dispatches by ``--policy`` (round_robin /
+least_pending / least_kv via the tailed replica gauges), requeues
+drained requests to siblings, deadline-aware-retries requests lost to
+crashes, and circuit-breaks dead replicas with half-open probes.  Its
+``--metrics-jsonl`` stream carries schema-v10 ``route`` /
+``replica_state`` / ``fleet_summary`` records; ``--trace`` adds
+trace events and exports ``APEX_TRACE_ID`` so one
+``tools/trace_export.py`` merge shows the whole fleet on a single
+Perfetto timeline.
+
+Scenarios (fleet/scenarios.py; ``--scenario``): ``none``,
+``rolling_restart`` (SIGTERM each replica in turn; zero lost requests
+required), ``crash_storm`` (``--crash-replicas`` k die at
+``--fault-tick``), ``straggler`` (one replica hangs; the router's
+stall detector rescues its requests).  The run exits 0 only when the
+scenario verdict is "pass".
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_fleet(name: str):
+    """File-path load (tools/supervise.py pattern): the proc transport
+    must work on hosts where importing the package — which pulls jax —
+    is exactly what cannot happen."""
+    path = os.path.join(REPO, "apex_example_tpu", "fleet", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"apex_fleet_{name}",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="route a workload over N serve replicas, "
+                    "optionally under scripted chaos")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="replica count (default 2)")
+    p.add_argument("--transport", default="thread",
+                   choices=["thread", "proc"],
+                   help="thread = in-process ServeEngines (one shared "
+                        "compiled program); proc = supervised serve.py "
+                        "subprocesses over file inbox/outbox (jax-free "
+                        "router path)")
+    p.add_argument("--policy", default="round_robin",
+                   choices=["round_robin", "least_pending", "least_kv"],
+                   help="dispatch policy (fleet/router.py)")
+    p.add_argument("--scenario", default="none",
+                   choices=["none", "rolling_restart", "crash_storm",
+                            "straggler"],
+                   help="scripted chaos scenario, scored into "
+                        "fleet_summary (fleet/scenarios.py)")
+    p.add_argument("--requests", type=int, default=16,
+                   help="workload size (synthetic specs)")
+    p.add_argument("--prompt-len", default="3:8",
+                   help="prompt length, N or MIN:MAX tokens")
+    p.add_argument("--max-new", default="3:10",
+                   help="output budget, N or MIN:MAX tokens")
+    p.add_argument("--vocab-size", type=int, default=256,
+                   help="prompt token range for proc replicas (thread "
+                        "mode reads it off the model; 256 = gpt_tiny)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--slots", type=int, default=4,
+                   help="per-replica KV slot count")
+    p.add_argument("--max-len", type=int, default=None,
+                   help="per-replica cache length (default: serve.py's)")
+    p.add_argument("--block-size", type=int, default=8,
+                   help="per-replica KV block size")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="per-request wall deadline the router's retry "
+                        "path honors (default: none)")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="re-dispatch budget for requests lost to "
+                        "replica crashes (default 2)")
+    p.add_argument("--breaker-backoff", type=float, default=0.25,
+                   metavar="S",
+                   help="circuit-breaker backoff base (default 0.25)")
+    p.add_argument("--stall-after", type=float, default=None,
+                   metavar="S",
+                   help="mark a replica stalled after S seconds "
+                        "without progress while holding work "
+                        "(default: 0.75 under --scenario straggler, "
+                        "else off)")
+    p.add_argument("--crash-replicas", type=int, default=1,
+                   help="crash_storm: how many replicas get the "
+                        "crash drill (default 1)")
+    p.add_argument("--fault-tick", type=int, default=6,
+                   help="engine tick the chaos drill fires at "
+                        "(crash_storm/straggler; default 6)")
+    p.add_argument("--timeout", type=float, default=120.0, metavar="S",
+                   help="scenario wall-clock budget (default 120)")
+    p.add_argument("--availability-min", type=float, default=1.0,
+                   help="fleet availability the verdict requires "
+                        "(default 1.0)")
+    p.add_argument("--workdir", default=None,
+                   help="proc transport scratch dir (inbox/outbox/"
+                        "metrics per replica; default: alongside "
+                        "--metrics-jsonl, else /tmp)")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="the ROUTER's schema-v10 stream (route/"
+                        "replica_state/fleet_summary)")
+    p.add_argument("--trace", action="store_true",
+                   help="emit trace events from the router and serve "
+                        "children and share one APEX_TRACE_ID so "
+                        "trace_export merges the whole fleet")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="proc transport: per-replica supervisor "
+                        "restart budget (default 3)")
+    return p
+
+
+def run_fleet(args):
+    """Build replicas + router, run the scenario, shut down.  Returns
+    (summary_record, rc)."""
+    replica_mod = _load_fleet("replica")
+    router_mod = _load_fleet("router")
+    scen_mod = _load_fleet("scenarios")
+
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+    if args.scenario == "crash_storm" \
+            and args.crash_replicas >= args.replicas:
+        raise SystemExit("crash_storm needs at least one surviving "
+                         f"replica (--crash-replicas {args.crash_replicas}"
+                         f" vs --replicas {args.replicas})")
+    stall_after = args.stall_after
+    if stall_after is None and args.scenario == "straggler":
+        stall_after = 0.75
+
+    def lohi(spec, name):
+        parts = spec.split(":")
+        try:
+            lo, hi = (int(parts[0]), int(parts[-1]))
+        except ValueError:
+            raise SystemExit(f"--{name} wants N or MIN:MAX, got {spec!r}")
+        if len(parts) > 2 or lo < 1 or lo > hi:
+            raise SystemExit(f"--{name}: bad range {spec!r}")
+        return lo, hi
+
+    prompt_len = lohi(args.prompt_len, "prompt-len")
+    max_new = lohi(args.max_new, "max-new")
+
+    names = [f"r{i}" for i in range(args.replicas)]
+    crashed_names = names[:args.crash_replicas] \
+        if args.scenario == "crash_storm" else []
+    straggler_name = names[0] if args.scenario == "straggler" else None
+
+    if args.transport == "proc":
+        workdir = args.workdir or (
+            os.path.join(os.path.dirname(args.metrics_jsonl) or ".",
+                         "fleet_work") if args.metrics_jsonl
+            else "/tmp/apex_fleet_work")
+        replicas = []
+        for name in names:
+            serve_args = ["--slots", str(args.slots),
+                          "--block-size", str(args.block_size)]
+            if args.max_len is not None:
+                serve_args += ["--max-len", str(args.max_len)]
+            if args.trace:
+                serve_args += ["--trace"]
+            if name in crashed_names:
+                serve_args += ["--inject-fault",
+                               f"crash@{args.fault_tick}"]
+            sup_args = ["--max-restarts", str(args.max_restarts),
+                        "--backoff", "0.2"]
+            if name == straggler_name:
+                serve_args += ["--inject-fault",
+                               f"hang@{args.fault_tick}"]
+                # The supervisor's stall-kill is the hung child's only
+                # way out; the router rescues the requests first.
+                sup_args += ["--stall-kill", "10"]
+            replicas.append(replica_mod.ProcReplica(
+                name, workdir, REPO, serve_args=serve_args,
+                supervise_args=sup_args))
+        vocab = args.vocab_size
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        from apex_example_tpu.models.gpt import gpt_tiny
+        from apex_example_tpu.resilience.faults import (SERVE_KINDS,
+                                                        FaultPlan)
+        from apex_example_tpu.serve import Request, ServeEngine
+
+        model = gpt_tiny()
+        params = model.init(jax.random.PRNGKey(args.seed),
+                            jnp.zeros((1, 4), jnp.int32))["params"]
+        vocab = int(model.vocab_size)
+        max_len = args.max_len or min(model.max_position, 128)
+
+        def factory():
+            # Every replica's engine clones the same module config, so
+            # the jitted decode step is built ONCE and shared.
+            return ServeEngine(model, params, num_slots=args.slots,
+                               max_len=max_len,
+                               block_size=args.block_size,
+                               rng=jax.random.PRNGKey(args.seed))
+
+        def make_request(spec):
+            return Request(prompt=spec["prompt"],
+                           max_new_tokens=int(spec["max_new_tokens"]),
+                           temperature=float(spec.get("temperature", 0)),
+                           top_k=int(spec.get("top_k", 0)),
+                           eos_id=spec.get("eos_id"),
+                           deadline_s=spec.get("deadline_s"),
+                           uid=spec["uid"])
+
+        replicas = []
+        for name in names:
+            fault = None
+            if name in crashed_names:
+                fault = FaultPlan("crash", args.fault_tick,
+                                  kinds=SERVE_KINDS)
+            elif name == straggler_name:
+                fault = FaultPlan("hang", args.fault_tick,
+                                  kinds=SERVE_KINDS)
+            replicas.append(replica_mod.ThreadReplica(
+                name, factory, make_request, fault=fault))
+
+    specs = scen_mod.synthetic_specs(
+        args.requests, vocab_size=vocab, seed=args.seed,
+        prompt_len=prompt_len, max_new=max_new,
+        deadline_s=args.deadline_s)
+
+    router = router_mod.FleetRouter(
+        replicas, policy=args.policy,
+        metrics_jsonl=args.metrics_jsonl,
+        max_retries=args.max_retries,
+        breaker_backoff_s=args.breaker_backoff,
+        stall_after_s=stall_after,
+        default_deadline_s=args.deadline_s,
+        trace=args.trace)
+    print(f"fleet: {args.replicas} x {args.transport} replica(s)  "
+          f"policy={args.policy}  scenario={args.scenario}  "
+          f"requests={args.requests}")
+
+    kw = {"timeout_s": args.timeout,
+          "availability_min": args.availability_min}
+    if args.scenario == "crash_storm":
+        kw["crashed_names"] = crashed_names
+        kw["restart_crashed"] = args.transport == "thread"
+    elif args.scenario == "straggler":
+        kw["straggler_name"] = straggler_name
+    try:
+        summary = scen_mod.run_scenario(args.scenario, router, replicas,
+                                        specs, **kw)
+    finally:
+        for r in replicas:
+            if args.transport == "proc":
+                r.close()
+            elif router.replica_state(r.name) not in ("stalled",):
+                r.stop(timeout_s=5.0)
+        if args.transport == "proc":
+            for r in replicas:
+                if r.wait(30.0) is None:
+                    r.terminate()
+
+    per = summary.get("per_replica", {})
+    for name in names:
+        stats = per.get(name, {})
+        print(f"  {name}: dispatches={stats.get('dispatches', 0)}  "
+              f"ok={stats.get('ok', 0)}  "
+              f"drained={stats.get('drained', 0)}  "
+              f"lost={stats.get('lost', 0)}  "
+              f"availability={stats.get('availability', 1.0)}  "
+              f"state={stats.get('state', '?')}")
+    print(f"fleet_summary: availability={summary['availability']}  "
+          f"lost={summary['lost']}  retries={summary['retries']}  "
+          f"requeued={summary['drained_requeued']}  "
+          f"skew={summary['routing']['balance_skew']}"
+          + (f"  verdict={summary['verdict']}"
+             if "verdict" in summary else ""))
+    rc = 0 if summary.get("verdict") == "pass" else 1
+    return summary, rc
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _, rc = run_fleet(args)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,12 +50,33 @@ sink: a run_header, one ``request_complete`` / ``request_failed`` /
 closing ``serve_summary`` (throughput, latency percentiles, per-status
 counts, availability).  The stream passes tools/metrics_lint.py like
 every other obs stream.
+
+Fleet replica mode (ISSUE 12; README "Fleet serving & chaos
+scenarios"): ``--inbox``/``--outbox`` replace the synthetic workload
+with the file-based fleet protocol — a router (fleet.py /
+apex_example_tpu/fleet/) APPENDS request specs to the inbox and this
+process APPENDS one terminal line per request to the outbox.  Both
+files are append-only and replayed across supervised restarts: a
+restarted attempt re-reads the whole inbox and skips every uid already
+in the outbox, so a crash re-serves exactly the requests that never
+reached a terminal status (crash-safe exactly-once).  A
+``{"close": true}`` sentinel ends the stream (exit 0).  With
+``--metrics-jsonl`` the replica also heartbeats schema-v10
+``replica_state`` records (tick / queue depth / blocks_live / pid) the
+router tails for health and its ``least_kv`` policy.
+``--seed-substream I`` derives replica i's synthetic workload from
+``substream(seed, i)`` so standalone fleet members sharing one base
+seed serve disjoint, individually-deterministic streams.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
+import threading
+import time
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -127,6 +148,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=0,
                    help="engine tick cap (0 = run until drained)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--seed-substream", type=int, default=None,
+                   metavar="I",
+                   help="derive the workload seed as substream(seed, I) "
+                        "— fleet members sharing a base seed serve "
+                        "disjoint yet deterministic prompt sets "
+                        "(serve/loadgen.py)")
+    p.add_argument("--inbox", default=None, metavar="JSONL",
+                   help="fleet replica mode: serve request specs "
+                        "APPENDED to this file by a router instead of "
+                        "the synthetic workload; replayed from byte 0 "
+                        "on every supervised restart; a "
+                        "'{\"close\": true}' line ends the stream")
+    p.add_argument("--outbox", default=None, metavar="JSONL",
+                   help="fleet replica mode: append one terminal line "
+                        "per request (uid/status/tokens); append-only "
+                        "across restarts — the restart-skip set and "
+                        "the router's completion feed")
+    p.add_argument("--replica-id", default="replica",
+                   help="this replica's name in heartbeat and fleet "
+                        "records")
+    p.add_argument("--heartbeat-s", type=float, default=0.25,
+                   metavar="S",
+                   help="replica-mode health heartbeat period: a "
+                        "schema-v10 replica_state record (tick, queue "
+                        "depth, blocks_live, pid) every S seconds on "
+                        "the metrics stream")
     p.add_argument("--metrics-jsonl", default=None,
                    help="emit schema-valid serving records to this JSONL")
     p.add_argument("--trace", action="store_true",
@@ -161,6 +208,125 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+class _Outbox:
+    """The replica-side completion outbox: APPEND-only (it must survive
+    supervised restarts — truncation would forget what attempt K-1
+    already served), one JSON line per terminal request.  On startup it
+    replays itself into the inbox feeder's skip logic (crash-safe
+    exactly-once):
+
+    - a NON-drained terminal ends the uid for good — every later inbox
+      occurrence is skipped;
+    - a "drained" line consumed ONE inbox occurrence without serving it
+      (the router requeued that copy — possibly to a sibling, possibly
+      back to THIS replica as a fresh inbox line when it is the only
+      survivor), so exactly that many occurrences are skipped and the
+      next one is served.  Treating drained as terminal would silently
+      lose requeue-to-self requests after a restart."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.done = set()
+        self._drained: dict = {}        # uid -> unconsumed drain count
+        if os.path.exists(path):
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue        # a killed writer's torn tail
+                    if isinstance(ev, dict) and "uid" in ev:
+                        if ev.get("status") == "drained":
+                            self._drained[ev["uid"]] = \
+                                self._drained.get(ev["uid"], 0) + 1
+                        else:
+                            self.done.add(ev["uid"])
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a")
+        self._consumed = 0
+
+    def should_skip(self, uid: str) -> bool:
+        """Called by the inbox feeder once per inbox OCCURRENCE of
+        ``uid`` (feeder thread only — no locking needed)."""
+        if uid in self.done:
+            return True
+        n = self._drained.get(uid, 0)
+        if n > 0:
+            self._drained[uid] = n - 1  # that occurrence was drained
+            return True
+        return False
+
+    def flush_from(self, engine) -> None:
+        comps = engine.completions
+        for c in comps[self._consumed:]:
+            self._fh.write(json.dumps(
+                {"uid": c.request.uid, "status": c.status,
+                 "finish_reason": c.finish_reason,
+                 "tokens": [int(t) for t in c.tokens],
+                 "tick": c.finished_step},
+                separators=(",", ":")) + "\n")
+        self._consumed = len(comps)
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def _feed_inbox(path, queue, outbox, stop_event, request_cls):
+    """Daemon thread: tail the inbox JSONL (which may not exist yet)
+    and submit every spec occurrence the outbox replay does not skip
+    (``_Outbox.should_skip``).  Only complete lines are consumed — a
+    torn tail is retried whole.  Ends on the close sentinel (queue
+    closed: the engine loop finishes and exits 0), on a drain closing
+    the queue under us, or on ``stop_event``."""
+    pos = 0
+    while not stop_event.is_set():
+        if not os.path.exists(path):
+            time.sleep(0.02)
+            continue
+        with open(path) as fh:
+            fh.seek(pos)
+            chunk = fh.read()
+        consumed = chunk.rfind("\n") + 1
+        pos += consumed
+        for line in chunk[:consumed].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                spec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(spec, dict):
+                continue
+            if spec.get("close"):
+                queue.close()
+                return
+            uid = spec.get("uid")
+            if uid is None or outbox.should_skip(uid):
+                continue
+            req = request_cls(
+                prompt=spec["prompt"],
+                max_new_tokens=int(spec["max_new_tokens"]),
+                temperature=float(spec.get("temperature", 0.0)),
+                top_k=int(spec.get("top_k", 0)),
+                eos_id=spec.get("eos_id"),
+                deadline_s=spec.get("deadline_s"),
+                deadline_step=spec.get("deadline_step"),
+                uid=uid)
+            try:
+                queue.submit(req)
+            except RuntimeError:
+                return                  # drain closed the queue
+        if consumed == 0:
+            time.sleep(0.02)
+
+
 def run_serve(args):
     """Build, restore, drive — and drain gracefully on SIGTERM/SIGUSR1.
     Returns (completions, summary_record, rc) — split from main() so
@@ -174,8 +340,9 @@ def run_serve(args):
     from apex_example_tpu.resilience import (EX_TEMPFAIL, FaultPlan,
                                              PreemptionHandler)
     from apex_example_tpu.resilience.faults import SERVE_KINDS
-    from apex_example_tpu.serve import (RequestQueue, ServeEngine,
-                                        parse_range, synthetic_requests)
+    from apex_example_tpu.serve import (Request, RequestQueue,
+                                        ServeEngine, parse_range,
+                                        synthetic_requests)
     from apex_example_tpu.utils.checkpoint import restore_params
 
     model = {"gpt_tiny": gpt_tiny, "gpt_base": gpt_base}[args.arch]()
@@ -209,6 +376,14 @@ def run_serve(args):
     if args.trace and not args.metrics_jsonl:
         raise SystemExit("--trace requires --metrics-jsonl (the "
                          "trace_event records ride the metrics stream)")
+    replica_mode = bool(args.inbox or args.outbox)
+    if replica_mode and not (args.inbox and args.outbox):
+        raise SystemExit("--inbox and --outbox come together (the "
+                         "fleet replica protocol: specs in, terminal "
+                         "lines out)")
+    if args.heartbeat_s <= 0:
+        raise SystemExit(f"--heartbeat-s must be > 0, got "
+                         f"{args.heartbeat_s}")
     fault = None
     if args.inject_fault:
         try:
@@ -263,13 +438,6 @@ def run_serve(args):
         preempt = PreemptionHandler(recorder=recorder)
         preempt.install()
 
-    requests = synthetic_requests(
-        args.requests, vocab_size=model.vocab_size, seed=args.seed,
-        prompt_len=prompt_len, max_new=max_new,
-        temperature=args.temperature, top_k=args.top_k,
-        eos_id=args.eos_id, stagger=args.stagger, burst=args.burst,
-        deadline_steps=args.deadline_steps, deadline_s=args.deadline_s,
-        shared_prefix=args.shared_prefix)
     queue = RequestQueue(max_pending=args.max_pending,
                          shed_policy=args.shed_policy)
     engine = ServeEngine(model, params, num_slots=args.slots,
@@ -279,11 +447,52 @@ def run_serve(args):
                          queue=queue, sink=sink, run_id=run_id,
                          fault=fault,
                          registry=emitter.registry if emitter else None)
-    engine.queue.submit_all(requests)
-    engine.queue.close()
+    outbox = feeder_stop = on_tick = None
+    idle_wait_s = 0.0
+    if replica_mode:
+        outbox = _Outbox(args.outbox)
+        feeder_stop = threading.Event()
+        threading.Thread(
+            target=_feed_inbox,
+            args=(args.inbox, queue, outbox, feeder_stop, Request),
+            name="inbox-feeder", daemon=True).start()
+        idle_wait_s = 0.004             # wall-clock producer: don't spin
+
+        def _beat(state: str) -> None:
+            if sink is None:
+                return
+            sink.write({"record": "replica_state", "time": time.time(),
+                        "replica": args.replica_id, "state": state,
+                        "tick": engine.step_count,
+                        "pending": engine.queue.pending(),
+                        "blocks_live": engine.pool.blocks_live(),
+                        "pid": os.getpid(), "run_id": run_id})
+
+        last_beat = [0.0]
+
+        def on_tick(eng) -> None:
+            outbox.flush_from(eng)
+            now = time.time()
+            if now - last_beat[0] >= args.heartbeat_s:
+                last_beat[0] = now
+                _beat("serving")
+    else:
+        requests = synthetic_requests(
+            args.requests, vocab_size=model.vocab_size, seed=args.seed,
+            prompt_len=prompt_len, max_new=max_new,
+            temperature=args.temperature, top_k=args.top_k,
+            eos_id=args.eos_id, stagger=args.stagger, burst=args.burst,
+            deadline_steps=args.deadline_steps,
+            deadline_s=args.deadline_s,
+            shared_prefix=args.shared_prefix,
+            seed_substream=args.seed_substream)
+        engine.queue.submit_all(requests)
+        engine.queue.close()
 
     pool = engine.pool
-    print(f"serve: {args.requests} request(s)  arch={args.arch}  "
+    workload = f"{args.requests} request(s)" if not replica_mode \
+        else f"replica {args.replica_id} (inbox-fed)"
+    print(f"serve: {workload}  arch={args.arch}  "
           f"slots={args.slots}  max_len={max_len}  "
           f"blocks={pool.num_blocks}x{pool.block_size}  "
           f"params from {source}")
@@ -291,8 +500,14 @@ def run_serve(args):
     try:
         completions = engine.run(
             max_steps=args.steps or None,
-            stop=(lambda: preempt.preempted) if preempt else None)
+            idle_wait_s=idle_wait_s,
+            stop=(lambda: preempt.preempted) if preempt else None,
+            on_tick=on_tick)
         if preempt is not None and preempt.preempted:
+            if feeder_stop is not None:
+                feeder_stop.set()
+            if replica_mode:
+                _beat("draining")       # the router sees the drain start
             drain = engine.drain(preempt.signal_name)
             completions = engine.completions
             print(f"drain ({drain['signal']}): admission stopped at tick "
@@ -302,10 +517,19 @@ def run_serve(args):
                   f"requeued={drain['requeued']}; exiting {EX_TEMPFAIL} "
                   f"(resumable)")
             rc = EX_TEMPFAIL
+        if outbox is not None:
+            # Everything terminal — drained requeues included — must be
+            # on disk before the summary: the restart-skip set and the
+            # router's completion feed both read from here.
+            outbox.flush_from(engine)
         summary = engine.summary_record()
         if sink is not None:
             sink.write(summary)
     finally:
+        if feeder_stop is not None:
+            feeder_stop.set()
+        if outbox is not None:
+            outbox.close()
         # Mirror train.close_telemetry: called while an exception is
         # unwinding (sys.exc_info live inside a finally — the crash
         # fault's path), route through the flight recorder (crash_dump +
@@ -326,8 +550,17 @@ def run_serve(args):
             sink.close()
 
     counts = engine.counts
-    stranded = args.requests - len(completions)
-    print(f"done: {counts['ok']}/{args.requests} completed  "
+    if replica_mode:
+        # A --steps-capped replica can run out of ticks with inbox
+        # requests still queued or mid-decode; they reached no terminal
+        # status and no outbox line, so exiting 0 would hide the loss
+        # (review finding, ISSUE 12).
+        stranded = engine.queue.pending() + len(engine.pool.live)
+        n_expected = len(completions) + stranded
+    else:
+        n_expected = args.requests
+        stranded = n_expected - len(completions)
+    print(f"done: {counts['ok']}/{n_expected} completed  "
           f"out_tokens={summary['output_tokens']}  "
           f"tok/s={summary['tokens_per_sec']}  "
           f"steps={summary['steps']}  "

@@ -139,7 +139,13 @@ def test_jax_free_contract_covers_the_retired_runtime_guard_set():
                      "tools/ci_gate.py", "tools/trace_export.py",
                      "tools/trace_top.py",
                      "apex_example_tpu/resilience/supervisor.py",
-                     "apex_example_tpu/obs/schema.py"):
+                     "apex_example_tpu/obs/schema.py",
+                     # ISSUE 12: the fleet stratum carries the same
+                     # contract — the router must outlive its replicas'
+                     # jax (fleet.py loads these by file path).
+                     "apex_example_tpu/fleet/replica.py",
+                     "apex_example_tpu/fleet/router.py",
+                     "apex_example_tpu/fleet/scenarios.py"):
         assert required in contract, f"{required} left the jax-free set"
     # and graftlint must eat its own dogfood
     assert "tools/graftlint/cli.py" in contract

@@ -98,7 +98,9 @@ def test_tracer_trace_id_from_env(monkeypatch):
 # ------------------------------------------------------- schema v9
 
 def test_schema_v9_trace_records_validate():
-    assert obs_schema.SCHEMA_VERSION == 9
+    # the CURRENT version is pinned exactly in test_fleet (v10); here
+    # only that the trace stratum's tables are still in force
+    assert obs_schema.SCHEMA_VERSION >= 9
     ev = {"record": "trace_event", "ph": "X", "name": "request",
           "ts": 1.25, "dur": 0.5, "cat": "request", "tid": "req/r-1",
           "span_id": "s1", "parent_id": "s0", "trace_id": "t",

@@ -7,6 +7,8 @@
 
     python tools/ci_gate.py --trace-stream traced.jsonl  # + trace lint
 
+    python tools/ci_gate.py --fleet-stream fleet.jsonl   # + fleet gate
+
 Gates:
 
 1. **graftlint --fail-on-new** (tools/graftlint): the two-stratum
@@ -21,6 +23,13 @@ Gates:
    trace lint over recorded ``--trace`` telemetry — balanced B/E spans
    per thread row, monotonic timestamps, orphan parent_ids, span
    containment, exactly one clock_sync per stream (schema v9).
+4. **fleet availability** (per ``--fleet-stream``): the scenario
+   contract over a recorded fleet-router stream (schema v10) — every
+   record validates, exactly one ``fleet_summary``, ZERO lost requests
+   and ``availability >= --fleet-availability-min`` (default 1.0); a
+   scenario verdict other than "pass" fails the gate.  Run over the
+   checked-in scenario stream, this turns "handles a rolling restart"
+   into a regression-tested number.
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -50,6 +59,54 @@ def _load_tool(name):
     return mod
 
 
+def _fleet_gate(stream: str, availability_min: float) -> int:
+    """The fleet-scenario gate: schema-v10 validation + zero lost +
+    availability threshold + a passing verdict over one recorded
+    fleet-router stream.  Returns 0/1 (2 is the caller's unreadable-
+    stream path)."""
+    import json
+
+    metrics_lint = _load_tool("metrics_lint")
+    records = []
+    with open(stream) as fh:
+        for n, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"{stream}: line {n + 1}: not JSON",
+                      file=sys.stderr)
+                return 1
+    errors = metrics_lint.validate_stream(records)
+    for e in errors:
+        print(f"{stream}: {e}", file=sys.stderr)
+    summaries = [r for r in records
+                 if r.get("record") == "fleet_summary"]
+    if len(summaries) != 1:
+        print(f"{stream}: {len(summaries)} fleet_summary records "
+              "(expected exactly 1)", file=sys.stderr)
+        return 1
+    if errors:
+        return 1
+    summ = summaries[0]
+    rc = 0
+    if summ.get("lost", 0) != 0:
+        print(f"{stream}: {summ['lost']} request(s) LOST (uids with no "
+              "terminal status)", file=sys.stderr)
+        rc = 1
+    if summ["availability"] < availability_min:
+        print(f"{stream}: fleet availability {summ['availability']} < "
+              f"required {availability_min}", file=sys.stderr)
+        rc = 1
+    if "verdict" in summ and summ["verdict"] != "pass":
+        print(f"{stream}: scenario {summ.get('scenario', '?')} verdict "
+              f"is {summ['verdict']!r}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="one command for every static CI gate")
@@ -62,6 +119,16 @@ def main(argv=None) -> int:
                     help="a --trace telemetry stream to run the "
                          "trace_export --check structural lint over "
                          "(repeatable)")
+    ap.add_argument("--fleet-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a fleet-router stream to run the scenario "
+                         "gate over: schema-v10 validation, zero lost "
+                         "requests, availability threshold, passing "
+                         "verdict (repeatable)")
+    ap.add_argument("--fleet-availability-min", type=float, default=1.0,
+                    metavar="X",
+                    help="fleet availability the --fleet-stream gate "
+                         "requires (default 1.0)")
     ap.add_argument("--baseline", default=None,
                     help="graftlint baseline override")
     ap.add_argument("paths", nargs="*",
@@ -100,6 +167,16 @@ def main(argv=None) -> int:
             print(f"ci_gate: trace_export --check "
                   f"{stream}: {'PASS' if rc == 0 else 'FAIL'}")
             worst = max(worst, rc)
+
+    for stream in args.fleet_stream:
+        if not os.path.isfile(stream):
+            print(f"ci_gate: no such stream: {stream}",
+                  file=sys.stderr)
+            return 2
+        rc = _fleet_gate(stream, args.fleet_availability_min)
+        print(f"ci_gate: fleet gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
 
     print(f"ci_gate: {'PASS' if worst == 0 else 'FAIL'}")
     return worst                 # 1 = gate failure, 2 = usage error

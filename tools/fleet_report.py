@@ -1,6 +1,8 @@
 #!/usr/bin/env python
-"""Cross-host fleet report: merge per-rank --metrics-jsonl files and find
-the rank that is ruining everyone's day.
+"""Fleet report: training ranks OR a serving fleet, one tool.
+
+TRAIN-RANK MODE (the original): merge per-rank --metrics-jsonl files
+and find the rank that is ruining everyone's day.
 
 Multi-host runs with ``--metrics-all-ranks`` write one JSONL file per
 process (``out.jsonl`` for rank 0, ``out.jsonl.rankK`` for K > 0); every
@@ -13,7 +15,26 @@ cross-compares the files no other tool reads together:
                                                       # out.jsonl.rank*
     python tools/fleet_report.py r0.jsonl r1.jsonl    # explicit files
 
-Checks:
+SERVE-FLEET MODE (ISSUE 12): point it at a fleet-router stream
+(fleet.py --metrics-jsonl; schema-v10 ``route`` / ``replica_state`` /
+``fleet_summary`` records) and it renders the serving-fleet story
+instead — detected automatically by the records present:
+
+    python tools/fleet_report.py fleet.jsonl
+    #   serve fleet: 2 replica(s), policy round_robin,
+    #       scenario rolling_restart
+    #   replica  dispatched  ok  drained  lost  avail  state
+    #   ...
+    #   routing balance: skew 1.11x
+    #   scenario verdict: PASS (availability 1.0, lost 0)
+
+Per-replica availability, routing-balance skew (max dispatches over the
+mean — ``--skew-factor`` flags imbalance), replica lifecycle anomalies
+(crashes/stalls, with the supervisor's v10 exit classification), and
+the scenario verdict line.  Still jax-free — same thin-client contract,
+proved by graftlint's import rule.
+
+Train-rank checks:
 - per-rank status: aborted (crash_dump / aborted summary / no summary),
   stalls, step-record counts that diverge across ranks;
 - straggler: a rank whose steady-state p50 step time exceeds
@@ -212,13 +233,135 @@ def analyze(ranks: Dict[int, dict], straggler_factor: float,
     return anomalies
 
 
+# ------------------------------------------------- serve-fleet mode
+
+def load_fleet_records(path: str) -> Optional[List[dict]]:
+    """Parse one file; return its records when it is a fleet-router
+    stream (carries fleet records), else None."""
+    records = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass                # killed runs truncate the tail
+    except OSError as e:
+        print(f"WARNING: {path}: {e}", file=sys.stderr)
+        return None
+    kinds = {r.get("record") for r in records if isinstance(r, dict)}
+    # Router-EXCLUSIVE markers only: a serve.py replica child's own
+    # stream also carries replica_state heartbeats, and it must fall
+    # through to the rank path (serve_report is its real tool), not be
+    # misread as a truncated router stream.  A router stream killed
+    # before its first dispatch still self-identifies via its header
+    # platform, so the truncation diagnostic stays reachable.
+    if kinds & {"fleet_summary", "route"}:
+        return records
+    header = next((r for r in records
+                   if isinstance(r, dict)
+                   and r.get("record") == "run_header"), None)
+    if header is not None and header.get("platform") == "fleet-router":
+        return records
+    return None
+
+
+def analyze_fleet(records: List[dict], skew_factor: float,
+                  out=sys.stdout) -> int:
+    """The serve-fleet report; returns the anomaly count (exit-code
+    semantics match the rank mode: 0 clean, 1 anomalies, 2 unusable —
+    the caller maps a missing fleet_summary to 2)."""
+    anomalies = 0
+    summary = next((r for r in records
+                    if r.get("record") == "fleet_summary"), None)
+    routes = [r for r in records if r.get("record") == "route"]
+    states = [r for r in records if r.get("record") == "replica_state"]
+    if summary is None:
+        print("no fleet_summary record (was the router stream "
+              "truncated?)", file=sys.stderr)
+        return -1
+    scenario = summary.get("scenario", "none")
+    print(f"serve fleet: {summary['replicas']} replica(s), policy "
+          f"{summary.get('policy', '?')}, scenario {scenario}, "
+          f"{summary['requests']} request(s) in "
+          f"{summary.get('duration_s', 0.0):.1f}s", file=out)
+
+    per = summary.get("per_replica", {})
+    print("replica  dispatched  ok    drained  lost  avail  state",
+          file=out)
+    for name in sorted(per):
+        stats = per[name]
+        avail = stats.get("availability", 1.0)
+        print(f"{name:<8} {stats.get('dispatches', 0):<11} "
+              f"{stats.get('ok', 0):<5} {stats.get('drained', 0):<8} "
+              f"{stats.get('lost', 0):<5} {avail:<6} "
+              f"{stats.get('state', '?')}", file=out)
+        if avail < 1.0:
+            anomalies += 1
+            print(f"REPLICA AVAILABILITY: {name} = {avail} < 1.0 "
+                  "(non-ok terminal statuses on this replica)",
+                  file=out)
+
+    routing = summary.get("routing", {})
+    skew = routing.get("balance_skew", 0.0)
+    print(f"routing balance: {len(routes)} route record(s), "
+          f"skew {skew}x", file=out)
+    if skew > skew_factor:
+        anomalies += 1
+        print(f"ROUTING IMBALANCE: max dispatches = {skew}x the mean "
+              f"(> {skew_factor}x) — one replica is soaking the "
+              "fleet", file=out)
+
+    # Lifecycle anomalies the router recorded (crash/stall transitions
+    # carry the supervisor's v10 exit classification when known).
+    for rec in states:
+        if rec.get("state") in ("crashed", "stalled"):
+            cls = rec.get("classification")
+            print(f"DOWN: replica {rec['replica']} went "
+                  f"{rec['state']}"
+                  + (f" (classification {cls})" if cls else ""),
+                  file=out)
+            if scenario in ("none", None):
+                anomalies += 1          # chaos scenarios EXPECT these
+
+    if summary.get("lost", 0):
+        anomalies += 1
+        print(f"LOST REQUESTS: {summary['lost']} uid(s) never reached "
+              "a terminal status", file=out)
+    retries = summary.get("retries", 0)
+    requeued = summary.get("drained_requeued", 0)
+    if retries or requeued:
+        print(f"recovery: {requeued} drain-requeue(s), {retries} "
+              f"crash-retry(s), {summary.get('duplicates', 0)} "
+              "duplicate report(s) ignored", file=out)
+
+    avail = summary["availability"]
+    verdict = summary.get("verdict")
+    if verdict is not None:
+        print(f"scenario verdict: {verdict.upper()} (availability "
+              f"{avail}, lost {summary.get('lost', 0)})", file=out)
+        if verdict != "pass":
+            anomalies += 1
+    elif avail < 1.0:
+        anomalies += 1
+        print(f"FLEET AVAILABILITY: {avail} < 1.0", file=out)
+
+    print(f"anomalies: {anomalies}", file=out)
+    return anomalies
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="cross-host straggler/anomaly report over per-rank "
-                    "--metrics-jsonl files")
+                    "--metrics-jsonl files, or a serve-fleet report "
+                    "over a fleet-router stream (auto-detected)")
     ap.add_argument("paths", nargs="+",
-                    help="rank-0 file (siblings .rankK auto-discovered) "
-                         "or an explicit list of per-rank files")
+                    help="rank-0 file (siblings .rankK auto-discovered),"
+                         " an explicit list of per-rank files, or a "
+                         "fleet.py router stream")
     ap.add_argument("--straggler-factor", type=float, default=1.25,
                     help="flag ranks whose steady p50 exceeds this factor "
                          "x the fleet median (default 1.25)")
@@ -229,7 +372,21 @@ def main(argv=None) -> int:
                     help="flag ranks whose second-half p50 step time "
                          "exceeds this factor x the first half "
                          "(default 1.3)")
+    ap.add_argument("--skew-factor", type=float, default=2.0,
+                    help="serve-fleet mode: flag routing imbalance when "
+                         "max dispatches exceed this factor x the mean "
+                         "(default 2.0)")
     args = ap.parse_args(argv)
+
+    # Serve-fleet streams are self-identifying (schema-v10 records);
+    # a single path that carries them switches modes.
+    if len(args.paths) == 1:
+        fleet_records = load_fleet_records(args.paths[0])
+        if fleet_records is not None:
+            anomalies = analyze_fleet(fleet_records, args.skew_factor)
+            if anomalies < 0:
+                return 2
+            return 1 if anomalies else 0
 
     files = discover(args.paths)
     ranks = {i: r for i, r in

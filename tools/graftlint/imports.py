@@ -44,10 +44,18 @@ JAX_ROOTS = {"jax", "jaxlib", "flax", "optax", "orbax", "chex"}
 
 # Library modules that are jax-free by contract even though they live
 # inside the (jax-carrying) package: loaded by FILE PATH, never via the
-# package __init__ (tools/supervise.py, tools/metrics_lint.py).
+# package __init__ (tools/supervise.py, tools/metrics_lint.py,
+# fleet.py).  The fleet stratum's three modules carry the contract the
+# same way the supervisor does: the router must keep routing while a
+# replica's jax is the thing that died (fleet/__init__.py is the
+# in-process convenience surface and is deliberately NOT listed — it
+# re-exports for callers that already carry jax).
 CONTRACT_FILES = (
     "apex_example_tpu/resilience/supervisor.py",
     "apex_example_tpu/obs/schema.py",
+    "apex_example_tpu/fleet/replica.py",
+    "apex_example_tpu/fleet/router.py",
+    "apex_example_tpu/fleet/scenarios.py",
 )
 
 _IMPORT_EXC = {"ImportError", "ModuleNotFoundError", "Exception",

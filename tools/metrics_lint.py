@@ -22,12 +22,18 @@ kv_bytes_committed / prefix_hit_rate / cow_copies / rejected, the
 block-accurate kv_waste_pct, request_failed status "rejected"), v8
 streams (the static-analysis stratum: compile_event gains
 ``recompile_cause``, the graftlint HLO diff naming the first divergent
-op behind a recompile) and v9 streams (the trace stratum from --trace
+op behind a recompile), v9 streams (the trace stratum from --trace
 runs: ``trace_event`` timeline records — ph B/E/X/i, perf_counter
 ``ts``/``dur``, span_id/parent_id trees, a stream-grouping trace_id —
 plus the one-per-stream ``clock_sync`` wall-clock anchor
-tools/trace_export.py exports against) all validate alongside v1
-streams — each version's tables are a strict superset of the last.
+tools/trace_export.py exports against) and v10 streams (the fleet
+stratum from fleet.py / apex_example_tpu/fleet/: ``route`` dispatch
+records, ``replica_state`` health/lifecycle records — serve.py
+replica-mode heartbeats and router transitions alike — the closing
+``fleet_summary`` with per-replica breakdown + availability + the
+zero-lost counter, and the supervisor's ``restart`` records gaining
+the exit ``classification``) all validate alongside v1 streams — each
+version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort
 exits 2.

@@ -28,10 +28,13 @@ Child exit contract: 0 = done; 75 (EX_TEMPFAIL — train.py's
 restart promptly; any other status = crash, restart with exponential
 backoff.  Every restart consumes one unit of ``--max-restarts``.
 
-``--metrics-jsonl`` here gives the SUPERVISOR its own schema-v5 stream
+``--metrics-jsonl`` here gives the SUPERVISOR its own schema-v10 stream
 (``restart``/``resume`` records, ``run_summary`` with ``restart_count``
-— obs/schema.py); ``--checkpoint-dir``/child metrics default from the
-child's own flags.
+— obs/schema.py).  Each ``restart`` record carries the child's exit
+``classification`` (``preempted`` / ``crashed`` / ``stall_killed``), so
+fleet tooling (fleet/replica.py, tools/fleet_report.py) distinguishes a
+drain from a crash without re-parsing the child's stream.
+``--checkpoint-dir``/child metrics default from the child's own flags.
 
 Thin client contract: **no jax import, direct or transitive** — the
 supervisor's one job is to restart training on hosts where training

@@ -14,7 +14,11 @@ estimate when a ``--cost-model`` run recorded them
 (tools/cost_report.py renders the full roofline join) — and the trace
 stratum (schema v9): a TRACE summary line (event count, trace_id,
 clock_sync presence) when a ``--trace`` run recorded a timeline
-(tools/trace_export.py renders the actual Perfetto export).
+(tools/trace_export.py renders the actual Perfetto export) — and the
+fleet stratum (schema v10): a FLEET line (replica/request totals,
+availability, lost count, route count, crash/stall transitions,
+scenario verdict) when the stream is a fleet-router's
+(tools/fleet_report.py renders the per-replica breakdown).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -75,6 +79,11 @@ def report(path: str, out=sys.stdout) -> int:
                     if r.get("record") == "trace_event"]
     clock_syncs = [r for r in records
                    if r.get("record") == "clock_sync"]
+    fleet_summaries = [r for r in records
+                       if r.get("record") == "fleet_summary"]
+    routes = [r for r in records if r.get("record") == "route"]
+    replica_states = [r for r in records
+                      if r.get("record") == "replica_state"]
     # Schema-invalid step records were warned about above; summarize only
     # the ones carrying the contract fields rather than crashing.
     steps = [r for r in records if r.get("record") == "step"
@@ -94,6 +103,29 @@ def report(path: str, out=sys.stdout) -> int:
         r.get("record") == "step" for r in records)
     is_supervisor_stream = (header or {}).get("platform") == "supervisor" \
         or bool(restarts or resumes)
+    # Schema v10: a fleet-router stream closes with fleet_summary, not
+    # run_summary — never an abort.  tools/fleet_report.py renders the
+    # full per-replica story; this is the one-line acknowledgement.
+    is_fleet_stream = (header or {}).get("platform") == "fleet-router" \
+        or bool(fleet_summaries or routes)
+    if is_fleet_stream:
+        fs = fleet_summaries[-1] if fleet_summaries else None
+        downs = [r for r in replica_states
+                 if r.get("state") in ("crashed", "stalled")]
+        if fs is not None:
+            print(f"FLEET: {fs.get('replicas', '?')} replica(s), "
+                  f"{fs.get('requests', '?')} request(s), availability "
+                  f"{fs.get('availability', '?')}, lost "
+                  f"{fs.get('lost', '?')}, {len(routes)} route(s), "
+                  f"{len(downs)} crash/stall transition(s)"
+                  + (f"  scenario {fs['scenario']}="
+                     f"{fs.get('verdict', '?')}"
+                     if "scenario" in fs else "")
+                  + "  (tools/fleet_report.py for the breakdown)",
+                  file=out)
+        else:
+            print("TRUNCATED FLEET STREAM: ends without a "
+                  "fleet_summary (router killed?)", file=out)
     def print_preempted(p, truncated=False):
         # A graceful preemption is NOT an abort: the run saved, exited
         # 75 and is resumable — the distinction supervisors key on.
@@ -107,7 +139,9 @@ def report(path: str, out=sys.stdout) -> int:
                  else ""), file=out)
 
     if summary is None:
-        if is_supervisor_stream:
+        if is_fleet_stream:
+            pass                        # fleet_summary is its close
+        elif is_supervisor_stream:
             # Supervisors have no flight recorder; a truncated stream
             # means the supervisor itself was killed mid-flight.
             print("TRUNCATED SUPERVISOR STREAM: ends without a "
@@ -159,6 +193,8 @@ def report(path: str, out=sys.stdout) -> int:
               + ("" if clock_syncs
                  else "  (NO clock_sync — not exportable)"), file=out)
     if not steps:
+        if is_fleet_stream:
+            return 0 if fleet_summaries else 1
         if is_supervisor_stream:
             # Supervisor streams carry no step records by design — the
             # child's stream(s) hold those.  A truncated one (no
