@@ -10,21 +10,26 @@ of the reference surface (``scale_loss``, ``state_dict``/``load_state_dict``,
 from apex_example_tpu.amp.autocast import (ModuleDtypes, cast_args,
                                            disable_casts, module_dtypes,
                                            op_dtype)
-from apex_example_tpu.amp.lists import (register_float_function,
+from apex_example_tpu.amp.lists import (quant_classify,
+                                        register_float_function,
                                         register_half_function,
-                                        register_promote_function)
-from apex_example_tpu.amp.policy import Policy, get_policy, opt_level_table
+                                        register_promote_function,
+                                        register_quant_function)
+from apex_example_tpu.amp.policy import (Policy, QuantPolicy, get_policy,
+                                         get_quant_policy,
+                                         opt_level_table)
 from apex_example_tpu.amp.scaler import (
     ScalerState, all_finite, load_state_dict, make_scaler, scale_loss,
     select_tree, state_dict, unscale_grads, update as update_scaler)
 
 __all__ = [
-    "ModuleDtypes", "Policy", "ScalerState", "all_finite", "cast_args",
-    "disable_casts", "get_policy", "initialize", "load_state_dict", "make_scaler",
-    "module_dtypes", "op_dtype", "opt_level_table",
+    "ModuleDtypes", "Policy", "QuantPolicy", "ScalerState", "all_finite",
+    "cast_args", "disable_casts", "get_policy", "get_quant_policy",
+    "initialize", "load_state_dict", "make_scaler",
+    "module_dtypes", "op_dtype", "opt_level_table", "quant_classify",
     "register_float_function", "register_half_function",
-    "register_promote_function", "scale_loss", "select_tree", "state_dict",
-    "unscale_grads", "update_scaler",
+    "register_promote_function", "register_quant_function", "scale_loss",
+    "select_tree", "state_dict", "unscale_grads", "update_scaler",
 ]
 
 

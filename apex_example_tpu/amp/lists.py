@@ -38,6 +38,19 @@ PROMOTE_FUNCS = {
     "where", "residual_add",
 }
 
+# Op classes whose WEIGHTS may drop to int8/fp8 for serving (ISSUE 13):
+# the MXU-fed storage-bound classes — dense/conv kernels and embedding
+# tables, where per-channel symmetric scales bound the error and the
+# dequant fuses into the consuming matmul.  Everything outside this set
+# (norm statistics, biases, softmax — the FP32_FUNCS sensitivity story)
+# keeps its high-precision storage: quantizing a layernorm scale saves
+# nothing and moves the normalization point.
+INT8_FUNCS = {
+    "conv", "conv1d", "conv2d", "conv3d", "conv_transpose",
+    "dense", "linear", "matmul", "mm", "bmm", "addmm", "einsum",
+    "embedding",
+}
+
 
 def register_half_function(name: str) -> None:
     """apex parity: ``amp.register_half_function(module, fn_name)`` — adds an
@@ -52,6 +65,15 @@ def register_float_function(name: str) -> None:
 
 def register_promote_function(name: str) -> None:
     _move(name, PROMOTE_FUNCS)
+
+
+def register_quant_function(name: str) -> None:
+    """Extension point mirroring the half/float registrations: mark an
+    op class's weights as int8/fp8-eligible (quant/weights.py consults
+    this at checkpoint-restore time).  Quant eligibility is orthogonal
+    to the half/float COMPUTE classification, so this does not move the
+    name between those tables."""
+    INT8_FUNCS.add(name)
 
 
 def _move(name: str, target: set) -> None:
@@ -69,3 +91,13 @@ def classify(name: str) -> str:
     if name in PROMOTE_FUNCS:
         return "promote"
     return "none"
+
+
+def quant_classify(name: str) -> str:
+    """'quant' | 'keep' for an op-class name: may this class's weights
+    drop to int8/fp8 for serving?  FP32_FUNCS membership wins over an
+    INT8_FUNCS entry — a class someone registered as numerically
+    sensitive must never quantize."""
+    if name in FP32_FUNCS:
+        return "keep"
+    return "quant" if name in INT8_FUNCS else "keep"

@@ -116,6 +116,60 @@ def opt_level_table(half_dtype=jnp.bfloat16):
     }
 
 
+@dataclasses.dataclass(frozen=True)
+class QuantPolicy:
+    """The serving-time quantization bundle (ISSUE 13) — the AMP policy
+    engine's answer to "what runs below bf16", the way :class:`Policy`
+    answers "what runs below fp32".
+
+    Attributes:
+      weight_mode: "none" | "int8" | "fp8" — storage dtype of the
+        quant-eligible weight classes (amp/lists.INT8_FUNCS; norms,
+        biases and softmax stay high-precision per FP32_FUNCS, the same
+        sensitivity tables O1 casting consults).
+      kv_int8: store the paged KV arenas as int8 with bf16 per-token
+        block scales (quant/kv.py).
+      emulate_fp8: set by the builder when this jax has no native
+        float8_e4m3fn — values ride the e4m3 grid in bf16 (accuracy
+        parity, no byte win).
+
+    The casting RULES stay in the op tables (lists.quant_classify);
+    this dataclass is configuration, exactly like Policy vs lists.
+    """
+
+    weight_mode: str = "none"
+    kv_int8: bool = False
+    emulate_fp8: bool = False
+
+    @property
+    def weight_dtype_name(self) -> str:
+        if self.weight_mode == "fp8":
+            return "fp8_e4m3_emulated" if self.emulate_fp8 \
+                else "float8_e4m3"
+        return self.weight_mode if self.weight_mode != "none" \
+            else "float32"
+
+    @property
+    def any_armed(self) -> bool:
+        return self.kv_int8 or self.weight_mode != "none"
+
+
+def get_quant_policy(weight_mode: str = "none",
+                     kv_int8: bool = False) -> QuantPolicy:
+    """Resolve a :class:`QuantPolicy`, detecting fp8 emulation (the
+    gate on missing jnp.float8_e4m3fn the ISSUE requires instead of a
+    hard dependency)."""
+    if weight_mode not in ("none", "int8", "fp8"):
+        raise ValueError(f"weight quant mode must be none|int8|fp8, "
+                         f"got {weight_mode!r}")
+    emulate = False
+    if weight_mode == "fp8":
+        from apex_example_tpu.quant import core as _qcore
+        emulate = _qcore.fp8_dtype() is None
+    return QuantPolicy(weight_mode=weight_mode, kv_int8=kv_int8,
+                       emulate_fp8=emulate)
+
+
 def get_policy(opt_level: str,
                loss_scale: Union[None, str, float] = None,
                keep_batchnorm_fp32: Optional[bool] = None,

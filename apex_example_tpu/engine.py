@@ -154,7 +154,8 @@ def make_train_step(model, optimizer, policy: Policy,
     # per-shard so allreduce_grads controls the reduction.
     explicit_reduce = (axis_name is not None and
                        (ddp.gradient_predivide_factor != 1.0 or
-                        ddp.allreduce_always_fp32))
+                        ddp.allreduce_always_fp32 or
+                        ddp.quantized_allreduce))
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
         x, y = batch

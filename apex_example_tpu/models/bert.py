@@ -131,6 +131,15 @@ class BertSelfAttention(nn.Module):
     slot_decode: bool = False
     kv_num_blocks: int = 0
     kv_block_size: int = 0
+    # Quantized paged KV (ISSUE 13, with slot_decode): the arenas store
+    # int8 K/V with bf16 PER-TOKEN BLOCK SCALES ([NB, BS] per arena) —
+    # quantized on the scatter write, dequantized (scale-fused) in the
+    # gathered attention, scale rows copied with their payload rows on
+    # COW so prefix-sharing semantics carry over unchanged.  Geometry
+    # stays static; the program still compiles exactly once.  The
+    # attention math itself (softmax included) runs at full precision
+    # on the dequantized values — the amp/lists sensitivity contract.
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias, paged=None):
@@ -190,11 +199,24 @@ class BertSelfAttention(nn.Module):
                         "slot_decode is block-paged: clone the model "
                         "with kv_num_blocks/kv_block_size >= 1 "
                         f"(got {NB}/{BS})")
+                kv_store = jnp.int8 if self.kv_quant else k.dtype
                 ck = self.variable("cache", "cached_key", jnp.zeros,
-                                   (NB, BS, h, hd), k.dtype)
+                                   (NB, BS, h, hd), kv_store)
                 cv = self.variable("cache", "cached_value", jnp.zeros,
-                                   (NB, BS, h, hd), v.dtype)
+                                   (NB, BS, h, hd), kv_store)
+                if self.kv_quant:
+                    from apex_example_tpu.quant import kv as kv_quant
+                    cks = self.variable("cache", "cached_key_scale",
+                                        jnp.zeros, (NB, BS),
+                                        kv_quant.KV_SCALE_DTYPE)
+                    cvs = self.variable("cache", "cached_value_scale",
+                                        jnp.zeros, (NB, BS),
+                                        kv_quant.KV_SCALE_DTYPE)
             else:
+                if self.kv_quant:
+                    raise ValueError("kv_quant quantizes the block-"
+                                     "paged arena; it requires "
+                                     "slot_decode=True")
                 ck = self.variable("cache", "cached_key", jnp.zeros,
                                    k.shape, k.dtype)
                 cv = self.variable("cache", "cached_value", jnp.zeros,
@@ -223,6 +245,14 @@ class BertSelfAttention(nn.Module):
                                                 mode="drop")
                 cv.value = cv.value.at[dst].set(cv.value[src],
                                                 mode="drop")
+                if self.kv_quant:
+                    # Scales are block-resident state: a COW must carry
+                    # them with the payload, or the copy dequantizes
+                    # under the zero scales of a fresh block.
+                    cks.value = cks.value.at[dst].set(cks.value[src],
+                                                      mode="drop")
+                    cvs.value = cvs.value.at[dst].set(cvs.value[src],
+                                                      mode="drop")
                 # 2. Scatter this tick's K/V through the block table:
                 # token j of slot s lands at logical position fill[s]+j,
                 # physical arena row table[s, pos//BS]*BS + pos%BS.
@@ -236,6 +266,19 @@ class BertSelfAttention(nn.Module):
                 flat = blk * BS + pos % BS
                 valid = jnp.arange(C)[None, :] < n_new[:, None]
                 flat = jnp.where(valid, flat, NB * BS).reshape(-1)
+                if self.kv_quant:
+                    # Quantize on the write: one symmetric max-abs
+                    # scale per token over its [h, hd] vector, scale
+                    # rows scattered through the SAME flat indices as
+                    # the int8 payload (quant/kv.py).
+                    k, k_sc = kv_quant.quantize_write(k)
+                    v, v_sc = kv_quant.quantize_write(v)
+                    cks.value = cks.value.reshape(NB * BS).at[flat].set(
+                        k_sc.reshape(S * C),
+                        mode="drop").reshape(NB, BS)
+                    cvs.value = cvs.value.reshape(NB * BS).at[flat].set(
+                        v_sc.reshape(S * C),
+                        mode="drop").reshape(NB, BS)
                 ck.value = ck.value.reshape(NB * BS, h, hd).at[flat].set(
                     k.reshape(S * C, h, hd),
                     mode="drop").reshape(NB, BS, h, hd)
@@ -251,6 +294,14 @@ class BertSelfAttention(nn.Module):
                 tbl = jnp.clip(table, 0, NB - 1)
                 keys = ck.value[tbl].reshape(S, -1, h, hd)
                 vals = cv.value[tbl].reshape(S, -1, h, hd)
+                if self.kv_quant:
+                    # Scale-fused dequant of the gathered logical view:
+                    # attention (softmax included) runs at full
+                    # precision on the dequantized values.
+                    keys = kv_quant.dequantize_gather(
+                        keys, cks.value[tbl].reshape(S, -1), self.dtype)
+                    vals = kv_quant.dequantize_gather(
+                        vals, cvs.value[tbl].reshape(S, -1), self.dtype)
                 L = keys.shape[1]
                 live = jnp.arange(L)[None, None, :] <= pos[:, :, None]
                 # head_spec: under TP the arena shards over heads
@@ -375,6 +426,7 @@ class BertLayer(nn.Module):
     slot_decode: bool = False
     kv_num_blocks: int = 0
     kv_block_size: int = 0
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias, paged=None):
@@ -396,6 +448,7 @@ class BertLayer(nn.Module):
                                  slot_decode=self.slot_decode,
                                  kv_num_blocks=self.kv_num_blocks,
                                  kv_block_size=self.kv_block_size,
+                                 kv_quant=self.kv_quant,
                                  name="attention")(x, mask_bias,
                                                    paged=paged)
         x = FusedLayerNorm(dtype=ln_io, name="attention_ln")(

@@ -76,6 +76,11 @@ class GPTForCausalLM(nn.Module):
     slot_decode: bool = False
     kv_num_blocks: int = 0
     kv_block_size: int = 0
+    # Quantized paged KV (ISSUE 13, with slot_decode): int8 arenas with
+    # bf16 per-token block scales — quantize on the scatter write,
+    # scale-fused dequant in the gathered attention, scales copied with
+    # their blocks on COW (models/bert.py holds the mechanics).
+    kv_quant: bool = False
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True, paged=None):
@@ -181,6 +186,7 @@ class GPTForCausalLM(nn.Module):
                           slot_decode=self.slot_decode,
                           kv_num_blocks=self.kv_num_blocks,
                           kv_block_size=self.kv_block_size,
+                          kv_quant=self.kv_quant,
                           name=f"layer_{i}")(x, None, paged=paged)
             if self.moe_experts:
                 x, aux = x

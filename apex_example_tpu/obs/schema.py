@@ -204,6 +204,24 @@ fleet tooling distinguishes drains from crashes without re-parsing
 child streams).  v10 is once more a strict superset: every v1–v9
 stream validates unchanged.
 
+Version 11 adds the quantization stratum (apex_example_tpu/quant/;
+ISSUE 13 — ``--weight-quant``/``--kv-quant`` on serve.py,
+``--quantized-allreduce`` on train.py):
+
+``quant_event``  one per quantization application at startup — which
+                 stratum quantized (``kind``: weights | kv), the
+                 storage dtype, tensor/byte accounting and the scale
+                 spread (the number the error bound is a multiple of).
+
+plus precision fields on ``serve_summary``: ``kv_dtype`` /
+``weight_dtype`` (the arena payload and weight storage dtypes — so
+``kv_bytes_committed``/``kv_bytes_live`` are now interpretable as
+DTYPE-ACCURATE bytes), and ``kv_bytes_per_token`` /
+``kv_bytes_per_token_bf16`` (the actual vs bf16-equivalent per-token
+cost; their ratio is the compression the serve_report QUANT line
+renders and the ci_gate ``--quant-stream`` floor enforces).  v11 is
+once more a strict superset: every v1–v10 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -215,7 +233,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -376,6 +394,13 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "requests": int,
         "availability": _NUM,   # ok / non-drained terminal, fleet-wide
     },
+    # --- schema v11: quantization records (apex_example_tpu/quant/) ---
+    "quant_event": {
+        "record": str,
+        "time": _NUM,
+        "kind": str,            # weights | kv
+        "dtype": str,           # int8 | float8_e4m3 | fp8_e4m3_emulated
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -486,6 +511,12 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "prefix_hit_rate": _NUM,    # shared / total prompt tokens
         "cow_copies": int,          # copy-on-write block copies
         "rejected": int,            # unservable, rejected at admission
+        # v11: the precision story (quant stratum, ISSUE 13) — the
+        # byte gauges above are dtype-accurate against these fields.
+        "kv_dtype": str,            # arena payload dtype ("int8" armed)
+        "weight_dtype": str,        # weight storage mode/dtype
+        "kv_bytes_per_token": int,  # actual (scales included)
+        "kv_bytes_per_token_bf16": int,  # bf16-equivalent baseline
     },
     "preemption": {
         "run_id": str,
@@ -598,6 +629,19 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "exit_code": int,        # with state crashed/restarting
         "classification": str,   # preempted | crashed | stall_killed
         "detail": str,
+    },
+    # --- schema v11: quantization records (apex_example_tpu/quant/) ---
+    "quant_event": {
+        "run_id": str,
+        "tensors": int,          # leaves quantized (weights kind)
+        "kept": int,             # leaves kept high-precision
+        "bytes_before": int,
+        "bytes_after": int,
+        "scale_min": _NUM,       # per-channel/block scale spread —
+        "scale_max": _NUM,       #   the error bound's multiplier
+        "emulated": bool,        # fp8 without native jnp support
+        "block_size": int,       # kv kind: scale granularity (tokens)
+        "scale_dtype": str,      # kv kind: block-scale storage dtype
     },
     "fleet_summary": {
         "run_id": str,

@@ -41,10 +41,37 @@ from apex_example_tpu.parallel.mesh import DATA_AXIS
 
 @dataclasses.dataclass(frozen=True)
 class DDPConfig:
-    """Ctor-surface parity with apex.parallel.DistributedDataParallel."""
+    """Ctor-surface parity with apex.parallel.DistributedDataParallel.
+
+    ``quantized_allreduce`` (ISSUE 13; EQuARX, PAPERS.md) goes beyond
+    the reference surface: the gradient exchange rides int8.  Per
+    ``quant_chunk``-element chunk, the devices agree on ONE shared
+    max-abs scale (a pmax — every replica must quantize onto the same
+    grid or the sum is meaningless), round their local chunk onto it,
+    psum the integers with an int32 accumulator (world * 127 per
+    element can never wrap), and multiply the sum back by the scale.
+    The exchange bytes drop 4x (f32) / 2x (bf16) wire-side; the psum's
+    accumulator width is an implementation detail of the reduction,
+    exactly as NCCL's fp32 accumulation is for the reference.
+
+    Error bound, documented and pinned by tests/test_parallel.py: each
+    replica contributes a rounding error <= scale/2 per element, so
+    ``|quantized - exact| <= world * scale / 2`` element-wise, with
+    ``scale = max_over_replicas(chunk max-abs) / 127``.  Composition
+    with ``allreduce_always_fp32`` is strict: the quantized path always
+    scales/accumulates/dequantizes in f32 (there is nothing wider to
+    upcast to), then restores the gradient dtype — so flipping
+    allreduce_always_fp32 under quantization changes nothing, which is
+    the only composition that cannot silently double-round.
+
+    ``quantized_allreduce=False`` (the default) leaves the psum path
+    byte-identical to the unquantized implementation.
+    """
     gradient_average: bool = True
     gradient_predivide_factor: float = 1.0
     allreduce_always_fp32: bool = False
+    quantized_allreduce: bool = False
+    quant_chunk: int = 1024
     # Accepted for CLI/API parity; no-ops on TPU (see module docstring):
     delay_allreduce: bool = True
     message_size: int = 10_000_000
@@ -86,6 +113,11 @@ def allreduce_grads(grads: Any, config: DDPConfig = DDPConfig(),
             if config.gradient_average:
                 g = (g.astype(jnp.float32) / world).astype(dt)
             return g
+        if config.quantized_allreduce:
+            g = _quantized_psum(g, axis_name, config)
+            if post != 1.0:
+                g = g / post
+            return g.astype(dt)
         if config.allreduce_always_fp32:
             g = g.astype(jnp.float32)
         if pre != 1.0:
@@ -96,6 +128,31 @@ def allreduce_grads(grads: Any, config: DDPConfig = DDPConfig(),
         return g.astype(dt)
 
     return jax.tree_util.tree_map(reduce_one, grads)
+
+
+def _quantized_psum(g, axis_name: str, config: DDPConfig):
+    """Shared-scale int8 chunk reduction (DDPConfig docstring).  Input
+    may be pre-divided; output is the f32 SUM (the caller applies the
+    averaging convention, same as the unquantized path).
+    """
+    from apex_example_tpu.quant import core as qcore
+    chunk = max(int(config.quant_chunk), 1)
+    pre = config.gradient_predivide_factor
+    flat = g.astype(jnp.float32).reshape(-1)
+    if pre != 1.0:
+        flat = flat / pre
+    n = flat.shape[0]
+    pad = (-n) % chunk
+    flat = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
+    # One scale per chunk, agreed across the axis: pmax of the local
+    # max-abs.  Every replica quantizes onto the SAME grid, so the
+    # integer psum is exact and the only error is each replica's
+    # rounding (<= scale/2 per element per replica).
+    scale = lax.pmax(qcore.abs_max_scale(flat, axis=1), axis_name)
+    q = qcore.quantize_int8(flat, scale).astype(jnp.int32)
+    total = lax.psum(q, axis_name)
+    out = total.astype(jnp.float32) * scale
+    return out.reshape(-1)[:n].reshape(g.shape)
 
 
 def broadcast_from_zero(tree: Any, axis_name: str = DATA_AXIS) -> Any:

@@ -1,0 +1,34 @@
+"""Quantization stratum (ISSUE 13; ROADMAP item 3).
+
+Three consumers, one numerics module:
+
+- ``quant.weights`` — int8/fp8 per-channel weight quantization applied
+  at checkpoint-restore time in serve.py; dequant runs inside the
+  compiled decode step (scale-fused matmul).
+- ``quant.kv`` — int8 paged-KV block scales: quantize on the arena
+  scatter, dequantize in the gathered attention (models/bert.py),
+  scales copied with their blocks under COW/prefix sharing.
+- ``parallel/distributed.py`` consumes ``quant.core`` for the DDP
+  quantized-allreduce mode (per-chunk shared-scale int8 psum).
+
+Casting POLICY (which op classes may drop to int8) lives with the AMP
+engine: amp/lists.INT8_FUNCS + amp/policy.QuantPolicy.
+"""
+
+from apex_example_tpu.quant import core, kv, weights
+from apex_example_tpu.quant.core import (FP8_QMAX, INT8_QMAX,
+                                         abs_max_scale, dequantize,
+                                         fp8_dtype, quantize_fp8,
+                                         quantize_int8)
+from apex_example_tpu.quant.kv import (KV_SCALE_DTYPE, dequantize_gather,
+                                       quantize_write)
+from apex_example_tpu.quant.weights import (dequantize_tree,
+                                            is_quantized_leaf,
+                                            quantize_params)
+
+__all__ = [
+    "FP8_QMAX", "INT8_QMAX", "KV_SCALE_DTYPE", "abs_max_scale",
+    "core", "dequantize", "dequantize_gather", "dequantize_tree",
+    "fp8_dtype", "is_quantized_leaf", "kv", "quantize_fp8",
+    "quantize_int8", "quantize_params", "quantize_write", "weights",
+]

@@ -1,0 +1,142 @@
+"""Weight quantization for the serve path (checkpoint-restore time).
+
+serve.py restores (or random-inits) a params pytree, hands it to
+:func:`quantize_params`, and the engine's compiled decode step calls
+:func:`dequantize_tree` as its FIRST traced op — so int8/fp8 bytes are
+what sit in HBM and stream into the step, and the dequant is a
+scale-fused convert+multiply XLA folds into each consuming matmul.
+Nothing about the model changes: the step function sees the same
+f32 params it always did, one fused multiply later.
+
+WHICH leaves quantize is an AMP-policy question, answered by the same
+op-classification tables that drive O1 casting (amp/lists.py): a leaf
+is mapped to its op class (``kernel`` -> dense, ``embedding`` ->
+embedding, norm scale/bias -> layer_norm, anything else -> bias-like)
+and only classes in ``lists.INT8_FUNCS`` quantize — layernorm
+parameters, biases and the fp32 lm head bias stay high-precision
+exactly like softmax/norms stay fp32 under O1 (amp/policy.QuantPolicy
+is the bundled spelling).
+
+Granularity: symmetric PER-CHANNEL scales —
+
+- ``kernel`` [in, out] (and conv [..., in, out]): one scale per OUTPUT
+  channel (max-abs over all input axes), the per-column scheme that
+  keeps each output feature's dynamic range independent;
+- ``embedding`` [vocab, hidden]: one scale per vocab ROW (each row is
+  gathered whole per token, and rows differ in norm far more than
+  hidden channels do).
+
+A quantized leaf is replaced by a ``{"qvalue", "scale"}`` dict (both
+jax arrays, so the pytree flattens straight through jit);
+``dequantize_tree`` restores the original structure/dtype in-trace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu.amp import lists
+from apex_example_tpu.quant import core
+
+MODES = ("int8", "fp8")
+
+# Leaf name -> the amp/lists op class whose quant eligibility applies.
+_LEAF_OP_CLASS = {
+    "kernel": "dense",
+    "embedding": "embedding",
+    "scale": "layer_norm",
+    "bias": "layer_norm",
+}
+
+
+def _leaf_op_class(path) -> str:
+    name = getattr(path[-1], "key", getattr(path[-1], "name",
+                                            str(path[-1])))
+    return _LEAF_OP_CLASS.get(name, "bias")
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and "qvalue" in x and "scale" in x
+
+
+def _channel_axes(path, ndim: int) -> Tuple[int, ...]:
+    """Axes the max-abs reduces over (the complement of the scale
+    axes): everything but the last for kernels, everything but the
+    FIRST for embeddings (per-row)."""
+    name = getattr(path[-1], "key", getattr(path[-1], "name",
+                                            str(path[-1])))
+    if name == "embedding":
+        return tuple(range(1, ndim))
+    return tuple(range(ndim - 1))
+
+
+def quantize_params(params: Any, mode: str = "int8"
+                    ) -> Tuple[Any, Dict[str, Any]]:
+    """Quantize every eligible leaf of ``params``; returns
+    ``(quantized_tree, stats)`` where stats feeds the ``quant_event``
+    record (schema v11): tensor counts, byte totals, scale spread.
+    """
+    if mode not in MODES:
+        raise ValueError(f"weight quant mode must be one of {MODES}, "
+                         f"got {mode!r}")
+    qmax = core.INT8_QMAX if mode == "int8" else core.FP8_QMAX
+    stats = {"tensors": 0, "kept": 0, "bytes_before": 0,
+             "bytes_after": 0, "scale_min": float("inf"),
+             "scale_max": 0.0, "emulated": False}
+
+    def one(path, leaf):
+        nbytes = leaf.size * jnp.dtype(leaf.dtype).itemsize
+        stats["bytes_before"] += int(nbytes)
+        # issubdtype, not dtype.kind: bfloat16's numpy kind is 'V'
+        # (void), and a kind check would silently skip every bf16 leaf.
+        if (not jnp.issubdtype(leaf.dtype, jnp.floating) or leaf.ndim < 2
+                or lists.quant_classify(_leaf_op_class(path)) != "quant"):
+            stats["kept"] += 1
+            stats["bytes_after"] += int(nbytes)
+            return leaf
+        axes = _channel_axes(path, leaf.ndim)
+        # Scales keep the ORIGINAL param dtype: dequantize_tree reads
+        # the output dtype off the scale leaf (a traced array cannot
+        # carry a dtype string through the pytree).  Narrow BEFORE
+        # quantizing — rounding must happen against the STORED scale
+        # for the documented bound to hold (quant/core.py; same order
+        # as quant/kv.quantize_write).
+        scale = core.abs_max_scale(leaf, axis=axes,
+                                   qmax=qmax).astype(leaf.dtype)
+        # The f32 floor can flush to 0 in a narrower storage dtype
+        # (fp16's tiny ~6e-8 >> SCALE_EPS): re-floor so an all-zero
+        # channel quantizes to zeros, never 0/0 = NaN.
+        scale = jnp.maximum(scale, jnp.finfo(leaf.dtype).tiny)
+        if mode == "int8":
+            q = core.quantize_int8(leaf, scale)
+        else:
+            q, emulated = core.quantize_fp8(leaf, scale)
+            stats["emulated"] = stats["emulated"] or emulated
+        stats["tensors"] += 1
+        stats["bytes_after"] += int(
+            q.size * jnp.dtype(q.dtype).itemsize
+            + scale.size * jnp.dtype(scale.dtype).itemsize)
+        smin = float(jnp.min(scale))
+        smax = float(jnp.max(scale))
+        stats["scale_min"] = min(stats["scale_min"], smin)
+        stats["scale_max"] = max(stats["scale_max"], smax)
+        return {"qvalue": q, "scale": scale}
+
+    out = jax.tree_util.tree_map_with_path(one, params)
+    if stats["tensors"] == 0:
+        stats["scale_min"] = 0.0
+    return out, stats
+
+
+def dequantize_tree(params: Any) -> Any:
+    """Restore a :func:`quantize_params` tree to plain arrays — called
+    INSIDE the compiled step (the dequant is part of the traced
+    program; the int8/fp8 leaves are its arguments)."""
+    return jax.tree_util.tree_map(
+        lambda x: core.dequantize(x["qvalue"], x["scale"],
+                                  x["scale"].dtype)
+        if is_quantized_leaf(x) else x,
+        params, is_leaf=is_quantized_leaf)

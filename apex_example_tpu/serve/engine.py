@@ -94,7 +94,7 @@ def _pct_dict(vals_ms: List[float]) -> Dict[str, float]:
 
 
 @functools.lru_cache(maxsize=8)
-def _slot_step(dec):
+def _slot_step(dec, dequant_weights: bool = False):
     """One compiled decode step for a PAGED slot-decode model clone
     (cached on the frozen module config — block geometry included —
     with params as an argument, the same contract as
@@ -108,11 +108,21 @@ def _slot_step(dec):
     logits-finite mask: argmax/categorical over NaN logits yield an
     IN-RANGE index, so a token-range check alone can never see real NaN
     fallout — the finiteness of the logits themselves is the signal,
-    and computing it here fuses it into the decode program."""
+    and computing it here fuses it into the decode program.
+
+    ``dequant_weights`` (ISSUE 13): params arrive as quant/weights.py's
+    int8/fp8 {qvalue, scale} leaves and the dequant is the step's FIRST
+    traced op — the low-bit bytes are the step's arguments (what HBM
+    streams), and XLA fuses the scale multiply into each consuming
+    matmul.  Part of the lru_cache key: arming quantization builds ONE
+    new program; re-running either variant reuses its compile."""
 
     @jax.jit
     def step(params, cache, tok, block_table, fill, n_new, cow_src,
              cow_dst, rng, temperature, top_k):
+        if dequant_weights:
+            from apex_example_tpu.quant import weights as _qw
+            params = _qw.dequantize_tree(params)
         paged = {"block_table": block_table, "fill": fill, "n_new": n_new,
                  "cow_src": cow_src, "cow_dst": cow_dst}
         logits, mut = dec.apply({"params": params, "cache": cache}, tok,
@@ -126,6 +136,22 @@ def _slot_step(dec):
         return mut["cache"], nxt, finite
 
     return step
+
+
+def _weight_dtype_name(mode: str, params) -> str:
+    """serve_summary's ``weight_dtype`` (schema v11): the storage dtype
+    of the quant-eligible weight classes — via the AMP quant policy
+    when quantization is armed (so fp8 reports its emulated spelling on
+    a jax without native fp8), and the ACTUAL params dtype when it is
+    not (a bf16 checkpoint must report bf16, not a hardcoded
+    float32)."""
+    if mode != "none":
+        from apex_example_tpu.amp.policy import get_quant_policy
+        return get_quant_policy(mode).weight_dtype_name
+    for leaf in jax.tree_util.tree_leaves(params):
+        if hasattr(leaf, "dtype"):
+            return str(leaf.dtype)
+    return "none"
 
 
 class SlotFailure(RuntimeError):
@@ -206,10 +232,18 @@ class ServeEngine:
                  num_blocks: Optional[int] = None, rng=None,
                  queue: Optional[RequestQueue] = None,
                  sink=None, run_id: Optional[str] = None,
-                 fault=None, registry=None):
+                 fault=None, registry=None, kv_quant: bool = False,
+                 weight_quant: str = "none"):
+        if weight_quant not in ("none", "int8", "fp8"):
+            raise ValueError(f"weight_quant must be none|int8|fp8, got "
+                             f"{weight_quant!r}")
         self.pool = BlockPool(model, num_slots, max_len,
                               block_size=block_size,
-                              num_blocks=num_blocks)
+                              num_blocks=num_blocks, kv_quant=kv_quant)
+        # weight_quant names the mode ``params`` ALREADY carries (the
+        # caller quantized at restore time — serve.py); the engine's
+        # job is to dequantize inside the compiled step.
+        self.weight_quant = weight_quant
         self.vocab_size = int(model.vocab_size)
         self.params = params
         self.queue = queue if queue is not None else RequestQueue()
@@ -229,7 +263,9 @@ class ServeEngine:
         # records — the batch geometry is static, so a second
         # compile_event for this name is a recompile regression.
         self._step_fn = costmodel_lib.instrument(
-            "serve_decode_step", _slot_step(self.pool.dec))
+            "serve_decode_step",
+            _slot_step(self.pool.dec,
+                       dequant_weights=weight_quant != "none"))
         self._t0 = time.perf_counter()
         self._tokens_out = 0
         self._occupancy_sum = 0
@@ -763,6 +799,15 @@ class ServeEngine:
             "cow_copies": pool.cow_copies,
             "availability": round(self.counts["ok"] / owned, 3)
             if owned else 1.0,
+            # v11 (ISSUE 13): the precision story — arena payload dtype,
+            # weight storage mode, and the dtype-accurate vs
+            # bf16-equivalent per-token costs the QUANT report line and
+            # the ci_gate --quant-stream compression floor key on.
+            "kv_dtype": pool.kv_dtype,
+            "weight_dtype": _weight_dtype_name(self.weight_quant,
+                                               self.params),
+            "kv_bytes_per_token": pool.kv_bytes_per_token(),
+            "kv_bytes_per_token_bf16": pool.kv_bytes_per_token_bf16(),
         }
         if self.compute_steps:
             rec["occupancy"] = round(

@@ -55,7 +55,11 @@ import numpy as np
 
 from apex_example_tpu.serve.queue import Request
 
+# Arena payload leaves, and the scale tables that ride along under
+# kv_quant (ISSUE 13) — accounting sums BOTH so the committed/live
+# byte gauges stay honest about the quantized layout's true footprint.
 _PAGE_LEAVES = ("cached_key", "cached_value")
+_SCALE_LEAVES = ("cached_key_scale", "cached_value_scale")
 
 
 def _leaf_name(path) -> str:
@@ -277,7 +281,8 @@ class BlockPool:
     """
 
     def __init__(self, model, num_slots: int, max_len: int,
-                 block_size: int = 8, num_blocks: Optional[int] = None):
+                 block_size: int = 8, num_blocks: Optional[int] = None,
+                 kv_quant: bool = False):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
@@ -294,10 +299,17 @@ class BlockPool:
         self.max_len = max_len
         self.block_size = block_size
         self.num_blocks = num_blocks
+        # kv_quant (ISSUE 13): int8 arenas + bf16 per-token block
+        # scales, quantize-on-scatter / dequant-in-gather inside the
+        # same ONE compiled step (models/bert.py).  Allocation, COW
+        # pairs and refcounts in this module are dtype-blind — only
+        # the byte accounting below changes.
+        self.kv_quant = bool(kv_quant)
         self.dec = model.clone(decode=True, slot_decode=True,
                                fused_attention=False,
                                kv_num_blocks=num_blocks,
-                               kv_block_size=block_size)
+                               kv_block_size=block_size,
+                               kv_quant=self.kv_quant)
         shapes = jax.eval_shape(
             self.dec.init, jax.random.PRNGKey(0),
             jnp.zeros((num_slots, max_len), jnp.int32))["cache"]
@@ -479,16 +491,39 @@ class BlockPool:
             total = 0
             for path, leaf in jax.tree_util.tree_flatten_with_path(
                     self.cache)[0]:
-                if _leaf_name(path) in _PAGE_LEAVES:
+                if _leaf_name(path) in _PAGE_LEAVES + _SCALE_LEAVES:
                     total += leaf.size * leaf.dtype.itemsize
             self._kv_reserved = total
         return self._kv_reserved
 
     def kv_bytes_per_token(self) -> int:
         """Bytes one cached token occupies across every layer's K and V
-        arena (``kv_bytes_reserved / (num_blocks * block_size)``)."""
+        arena (``kv_bytes_reserved / (num_blocks * block_size)``) —
+        dtype-accurate: int8 payload plus the bf16 block scales under
+        kv_quant, the full-precision payload otherwise."""
         return self.kv_bytes_reserved() \
             // (self.num_blocks * self.block_size)
+
+    def kv_bytes_per_token_bf16(self) -> int:
+        """What one cached token WOULD cost in a bf16 dense-payload
+        arena of this geometry (2 bytes per K/V element, no scales) —
+        the bf16-equivalent baseline the quant compression ratio and
+        the ci_gate ``--quant-stream`` floor are computed against."""
+        elems = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.cache)[0]:
+            if _leaf_name(path) in _PAGE_LEAVES:
+                elems += leaf.size
+        return elems * 2 // (self.num_blocks * self.block_size)
+
+    @property
+    def kv_dtype(self) -> str:
+        """The arena payload dtype name ("int8" under kv_quant)."""
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.cache)[0]:
+            if _leaf_name(path) in _PAGE_LEAVES:
+                return str(leaf.dtype)
+        return "none"                        # zero-layer model; untestable
 
     def kv_bytes_live(self) -> int:
         """Bytes of KV the live slots logically hold (per-slot fill

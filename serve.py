@@ -34,6 +34,19 @@ worst-case block budget — out-of-blocks resolves as deterministic
 head-of-line queueing, and a request that could never be served (its
 prompt fills the cache) terminates with status "rejected" at admission.
 
+Quantization (ISSUE 13; README "Quantization"): ``--weight-quant
+{int8,fp8}`` quantizes the restored weights per-channel at restore
+time (dequant runs scale-fused inside the one compiled decode step;
+layernorms/biases stay high-precision per amp/lists.py) and
+``--kv-quant`` stores the paged KV arenas as int8 with bf16 per-token
+block scales — quantize on the scatter write, dequant in the gathered
+attention, scales copied with their blocks under COW/prefix sharing.
+Geometry stays static, so the program still compiles exactly once;
+``serve_summary`` carries ``kv_dtype``/``weight_dtype`` and the
+dtype-accurate vs bf16-equivalent per-token bytes (schema v11), and
+``tools/ci_gate.py --quant-stream`` enforces the >= 1.9x compression
+floor over a recorded stream.
+
 Resilience (README "Serving resilience"; ISSUE 5): SIGTERM/SIGUSR1
 triggers a graceful drain — admission stops, queued requests are handed
 back with status "drained" (requeue-able on another replica), in-flight
@@ -174,6 +187,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "schema-v10 replica_state record (tick, queue "
                         "depth, blocks_live, pid) every S seconds on "
                         "the metrics stream")
+    p.add_argument("--weight-quant", default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="quantize the restored weights for serving "
+                        "(ISSUE 13): symmetric per-channel int8, or "
+                        "float8_e4m3 where this jax supports it (else "
+                        "emulated on the e4m3 grid); layernorms/biases "
+                        "stay high-precision per the AMP op tables "
+                        "(amp/lists.py) and dequant runs scale-fused "
+                        "inside the one compiled decode step")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="store the paged KV arenas as int8 with bf16 "
+                        "per-token block scales: quantize on the "
+                        "scatter write, dequantize in the gathered "
+                        "attention, scales copied with their blocks "
+                        "under COW/prefix sharing (quant/kv.py) — "
+                        "~1.9x the bf16 arena's bytes, ~3.9x fp32's")
     p.add_argument("--metrics-jsonl", default=None,
                    help="emit schema-valid serving records to this JSONL")
     p.add_argument("--trace", action="store_true",
@@ -400,6 +429,17 @@ def run_serve(args):
             jnp.zeros((1, 4), jnp.int32))["params"]
         source = "random init (smoke mode)"
 
+    # Quantization applies at RESTORE time (ISSUE 13): the engine's
+    # compiled step receives the int8/fp8 leaves as arguments and
+    # dequantizes in-trace — low-bit bytes are what HBM holds/streams.
+    quant_stats = None
+    if args.weight_quant != "none":
+        from apex_example_tpu.amp.policy import get_quant_policy
+        from apex_example_tpu.quant import quantize_params
+        qpolicy = get_quant_policy(args.weight_quant, args.kv_quant)
+        params, quant_stats = quantize_params(params, args.weight_quant)
+        source += f" -> {qpolicy.weight_dtype_name} weights"
+
     emitter = sink = recorder = None
     run_id = None
     # Clear any instance a previous in-process run leaked before this
@@ -426,6 +466,29 @@ def run_serve(args):
             # layer consult it; trace_id joins a supervising parent's
             # timeline via APEX_TRACE_ID (cross-restart continuity).
             obs.trace.set_default(obs.Tracer(sink, run_id=run_id))
+        if quant_stats is not None:
+            # schema v11: one quant_event per applied stratum — the
+            # scale spread is the multiplier of every error bound
+            # downstream tooling reasons about.  qpolicy is the policy
+            # the restore block above actually APPLIED (one resolution,
+            # one fp8-capability probe).
+            rec = {"record": "quant_event", "time": time.time(),
+                   "kind": "weights",
+                   "dtype": qpolicy.weight_dtype_name,
+                   "run_id": run_id}
+            rec.update({k: quant_stats[k] for k in
+                        ("tensors", "kept", "bytes_before",
+                         "bytes_after", "scale_min", "scale_max",
+                         "emulated")})
+            sink.write(rec)
+        if args.kv_quant:
+            from apex_example_tpu.quant import kv as kv_quant_lib
+            sink.write({"record": "quant_event", "time": time.time(),
+                        "kind": "kv", "dtype": "int8",
+                        "block_size": args.block_size,
+                        "scale_dtype": str(jnp.dtype(
+                            kv_quant_lib.KV_SCALE_DTYPE)),
+                        "run_id": run_id})
 
     # The drain grace path (README "Serving resilience"): the handler
     # only sets a flag; the engine loop notices it at the next tick
@@ -446,7 +509,9 @@ def run_serve(args):
                          rng=jax.random.PRNGKey(args.seed),
                          queue=queue, sink=sink, run_id=run_id,
                          fault=fault,
-                         registry=emitter.registry if emitter else None)
+                         registry=emitter.registry if emitter else None,
+                         kv_quant=args.kv_quant,
+                         weight_quant=args.weight_quant)
     outbox = feeder_stop = on_tick = None
     idle_wait_s = 0.0
     if replica_mode:

@@ -1,0 +1,29 @@
+// graftlint HLO fixture (ISSUE 13): the int8-clean quantized forward.
+// Recorded shape: the serve decode step's weight path under a
+// --weight-quant int8 policy — kernels arrive as i8 {qvalue} plus a
+// per-output-channel f32 scale, are dequantized DOWN onto the bf16
+// compute grid (convert i8 -> bf16, multiply by the bf16-cast scale),
+// and every dot_general runs bf16.  The claimed-int8 upcast-leak mode
+// (--policy int8) must stay QUIET here: i8 tensors present, no wide
+// heavy op.  int8_f32_leak.mlir is the same program with the second
+// dequant converted UP to f32 — the silent whole-matmul pin the rule
+// exists to catch.
+module @jit_qmlp attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<16x32xi8>, %arg1: tensor<1x32xf32>, %arg2: tensor<32x8xi8>, %arg3: tensor<1x8xf32>, %arg4: tensor<8x16xbf16>) -> (tensor<8x8xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.convert %arg0 : (tensor<16x32xi8>) -> tensor<16x32xbf16>
+    %1 = stablehlo.convert %arg1 : (tensor<1x32xf32>) -> tensor<1x32xbf16>
+    %2 = stablehlo.broadcast_in_dim %1, dims = [0, 1] : (tensor<1x32xbf16>) -> tensor<16x32xbf16>
+    %3 = stablehlo.multiply %0, %2 : tensor<16x32xbf16>
+    %4 = stablehlo.dot_general %arg4, %3, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x16xbf16>, tensor<16x32xbf16>) -> tensor<8x32xbf16>
+    %cst = stablehlo.constant dense<0.000000e+00> : tensor<bf16>
+    %5 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<bf16>) -> tensor<8x32xbf16>
+    %6 = stablehlo.maximum %4, %5 : tensor<8x32xbf16>
+    %7 = stablehlo.convert %arg2 : (tensor<32x8xi8>) -> tensor<32x8xbf16>
+    %8 = stablehlo.convert %arg3 : (tensor<1x8xf32>) -> tensor<1x8xbf16>
+    %9 = stablehlo.broadcast_in_dim %8, dims = [0, 1] : (tensor<1x8xbf16>) -> tensor<32x8xbf16>
+    %10 = stablehlo.multiply %7, %9 : tensor<32x8xbf16>
+    %11 = stablehlo.dot_general %6, %10, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x32xbf16>, tensor<32x8xbf16>) -> tensor<8x8xbf16>
+    %12 = stablehlo.convert %11 : (tensor<8x8xbf16>) -> tensor<8x8xf32>
+    return %12 : tensor<8x8xf32>
+  }
+}

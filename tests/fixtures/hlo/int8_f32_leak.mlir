@@ -1,0 +1,27 @@
+// graftlint HLO fixture (ISSUE 13): the SEEDED f32 dequant pin.
+// Identical program to int8_clean.mlir except the second weight's
+// dequant: the i8 kernel is converted UP to f32, the relu output
+// follows it, and the dot_general runs wide — the exact signature of
+// a dequant placed outside the scale-fused path (or an f32 scale
+// joining the matmul uncast).  The HBM bytes the int8 storage saved
+// are spent right back on the widened matmul operands.  The
+// claimed-int8 upcast-leak mode (--policy int8) must FIRE on the f32
+// dot_general, and diff_lowerings(clean, leak) must name it.
+module @jit_qmlp attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<16x32xi8>, %arg1: tensor<1x32xf32>, %arg2: tensor<32x8xi8>, %arg3: tensor<1x8xf32>, %arg4: tensor<8x16xbf16>) -> (tensor<8x8xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.convert %arg0 : (tensor<16x32xi8>) -> tensor<16x32xbf16>
+    %1 = stablehlo.convert %arg1 : (tensor<1x32xf32>) -> tensor<1x32xbf16>
+    %2 = stablehlo.broadcast_in_dim %1, dims = [0, 1] : (tensor<1x32xbf16>) -> tensor<16x32xbf16>
+    %3 = stablehlo.multiply %0, %2 : tensor<16x32xbf16>
+    %4 = stablehlo.dot_general %arg4, %3, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x16xbf16>, tensor<16x32xbf16>) -> tensor<8x32xbf16>
+    %cst = stablehlo.constant dense<0.000000e+00> : tensor<bf16>
+    %5 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<bf16>) -> tensor<8x32xbf16>
+    %6 = stablehlo.maximum %4, %5 : tensor<8x32xbf16>
+    %7 = stablehlo.convert %arg2 : (tensor<32x8xi8>) -> tensor<32x8xf32>
+    %8 = stablehlo.broadcast_in_dim %arg3, dims = [0, 1] : (tensor<1x8xf32>) -> tensor<32x8xf32>
+    %9 = stablehlo.multiply %7, %8 : tensor<32x8xf32>
+    %10 = stablehlo.convert %6 : (tensor<8x32xbf16>) -> tensor<8x32xf32>
+    %11 = stablehlo.dot_general %10, %9, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<8x32xf32>, tensor<32x8xf32>) -> tensor<8x8xf32>
+    return %11 : tensor<8x8xf32>
+  }
+}

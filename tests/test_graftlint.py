@@ -37,6 +37,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HLO_DIR = os.path.join(REPO, "tests", "fixtures", "hlo")
 CLEAN_MLIR = os.path.join(HLO_DIR, "bf16_clean.mlir")
 LEAK_MLIR = os.path.join(HLO_DIR, "bf16_f32_leak.mlir")
+INT8_CLEAN_MLIR = os.path.join(HLO_DIR, "int8_clean.mlir")
+INT8_LEAK_MLIR = os.path.join(HLO_DIR, "int8_f32_leak.mlir")
 
 
 def _load_tool(name):
@@ -467,6 +469,43 @@ def test_recompile_cause_diff_names_divergent_op(clean_text, leak_text):
     renumbered = clean_text.replace("%5", "%55").replace("%6", "%66") \
         .replace("// graftlint", "// renamed")
     assert hlo_rules.diff_lowerings(clean_text, renumbered) is None
+
+
+def test_int8_region_fixture_pair():
+    """The claimed-int8 region mode (ISSUE 13): the recorded quantized
+    forward (i8 weights dequantized to bf16, scale-fused) stays quiet;
+    the seeded pair — the SAME program with one dequant converted UP to
+    f32 — fails on the wide dot_general; and the recompile-cause diff
+    names the divergence."""
+    with open(INT8_CLEAN_MLIR) as fh:
+        clean = fh.read()
+    with open(INT8_LEAK_MLIR) as fh:
+        leak = fh.read()
+    assert hlo_rules.upcast_leak(clean, "int8") == []
+    fs = hlo_rules.upcast_leak(leak, "int8")
+    assert len(fs) == 1 and fs[0].rule == "hlo-upcast-leak"
+    assert "dot_general" in fs[0].message and "f32" in fs[0].message
+    assert "int8" in fs[0].message
+    # a dequant pinned in f32 is still legal under a plain f32 policy —
+    # the finding is a property of the CLAIM, not the program
+    assert hlo_rules.upcast_leak(leak, "f32") == []
+    # the claim itself is checked: a program with no i8/f8 tensor at
+    # all "quantized" nothing
+    fs = hlo_rules.upcast_leak(
+        clean.replace("i8", "bf16"), "int8")
+    assert len(fs) == 1 and "silently skipped" in fs[0].message
+    # the diff names the leak (the f32 convert feeding the wide dot)
+    diff = hlo_rules.diff_lowerings(clean, leak)
+    assert diff is not None and diff["op"] == "convert"
+
+
+def test_int8_cli_policy(capsys):
+    assert graftlint_main(["--hlo", INT8_CLEAN_MLIR,
+                           "--policy", "int8"]) == 0
+    assert graftlint_main(["--hlo", INT8_LEAK_MLIR,
+                           "--policy", "int8"]) == 1
+    out = capsys.readouterr().out
+    assert "hlo-upcast-leak" in out
 
 
 def test_hlo_cli_exit_codes(capsys):

@@ -1,0 +1,452 @@
+"""Quantization stratum (apex_example_tpu/quant/; ISSUE 13).
+
+- Pure-numpy round-trip coverage (NO compile cost): int8 and fp8
+  quantize/dequantize against the documented error bounds
+  (quant/core.py — <= scale/2 unclipped, <= scale at the clipped
+  extreme, scales stored NARROWER than f32).
+- Weight-tree quantization: the AMP op tables decide eligibility
+  (kernels/embeddings quantize; layernorm scale/bias, biases and the
+  fp32 lm head bias stay high-precision), dequantize_tree restores
+  structure/dtype, per-channel error bounded.
+- The serving acceptance bar: int8-weight + int8-KV greedy outputs on
+  the tiny-GPT fixture >= 95% token match vs the full-precision
+  generate() reference with the first divergence reported; ONE
+  compiled decode program with quantization armed (compile_events
+  gate); kv_bytes_committed <= 55% of the bf16-equivalent bytes.
+- COW-under-quantization regression: diverging a shared int8 block
+  copies its SCALES with the payload (shared-prefix outputs stay
+  identical to solo quantized runs of the same prompts).
+- The jax-free tool surface: ci_gate --quant-stream over the
+  checked-in quantized-smoke fixture, serve_report's QUANT line,
+  schema-v11 quant_event validation + v1-v10 back-compat.
+
+Engine tests share ONE quantized engine geometry (the session's
+SLOTS=4 / MAX_LEN=32 / block-size-8) through a module-scoped fixture,
+so the quantized decode program — the suite's one deliberate new
+compile — is built exactly once.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import obs
+from apex_example_tpu.amp import lists as amp_lists
+from apex_example_tpu.amp.policy import get_quant_policy
+from apex_example_tpu.models.gpt import generate, gpt_tiny
+from apex_example_tpu.obs import schema as obs_schema
+from apex_example_tpu.quant import core as qcore
+from apex_example_tpu.quant import kv as qkv
+from apex_example_tpu.quant import weights as qweights
+from apex_example_tpu.serve import Request, ServeEngine, synthetic_requests
+
+pytestmark = pytest.mark.quant
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QUANT_FIXTURE = os.path.join(REPO, "tests", "fixtures", "quant",
+                             "quant_smoke.jsonl")
+SLOTS, MAX_LEN, BS = 4, 32, 8
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ===================== pure-numpy numerics (no compile) ==============
+
+def test_int8_roundtrip_error_bound():
+    """|x - dq| <= stored_scale/2 for unclipped values, <= stored_scale
+    at the clipped extreme — rounding happens against the STORED
+    (possibly narrowed) scale, so the bound holds exactly even when the
+    scale lost mantissa bits on the way to bf16."""
+    x = np.random.RandomState(0).randn(64, 32).astype(np.float32) * 3.0
+    for scale_dtype in (jnp.float32, jnp.bfloat16):
+        scale = qcore.abs_max_scale(x, axis=1).astype(scale_dtype)
+        q = qcore.quantize_int8(jnp.asarray(x), scale)
+        assert q.dtype == jnp.int8
+        dq = np.asarray(qcore.dequantize(q, scale))
+        s = np.asarray(scale, np.float32)
+        err = np.abs(x - dq)
+        assert (err <= s * 1.0 + 1e-7).all()          # clipped extreme
+        interior = np.abs(x) < np.abs(x).max(axis=1, keepdims=True)
+        assert (err[interior.nonzero()]
+                <= (np.broadcast_to(s, x.shape)[interior.nonzero()] / 2
+                    + 1e-7)).all()
+
+
+def test_int8_all_zero_slice_is_finite():
+    x = jnp.zeros((4, 8))
+    scale = qcore.abs_max_scale(x, axis=1)
+    dq = np.asarray(qcore.dequantize(qcore.quantize_int8(x, scale),
+                                     scale))
+    assert np.array_equal(dq, np.zeros((4, 8), np.float32))
+
+
+def test_fp8_roundtrip_error_bound():
+    """e4m3 carries 3 mantissa bits: error <= |x|/16 relative plus half
+    a subnormal step (scale * 2^-10) absolute.  Native float8_e4m3fn on
+    this rig; the emulated e4m3 grid covers the normal range."""
+    x = np.random.RandomState(1).randn(256).astype(np.float32)
+    scale = qcore.abs_max_scale(x, qmax=qcore.FP8_QMAX)
+    q, emulated = qcore.quantize_fp8(jnp.asarray(x), scale)
+    dq = np.asarray(qcore.dequantize(q, scale))
+    s = float(np.asarray(scale).reshape(()))
+    bound = np.abs(x) / 16.0 + s * 2.0 ** -9
+    assert (np.abs(x - dq) <= bound + 1e-9).all()
+    if qcore.fp8_dtype() is not None:
+        assert not emulated and q.dtype == qcore.fp8_dtype()
+    # the emulation grid itself: 3-bit mantissa snapping on normals
+    # (1.0625 sits mid-step and rounds half-to-even back to 1.0)
+    em = np.asarray(qcore._round_e4m3(jnp.asarray(
+        [1.0, 1.0625, 1.09, 2.5, -3.1, 448.0], jnp.float32)))
+    np.testing.assert_allclose(
+        em, [1.0, 1.0, 1.125, 2.5, -3.0, 448.0], rtol=0, atol=0)
+
+
+def test_quant_policy_and_lists():
+    """The AMP engine hosts the eligibility rules: MXU weight classes
+    quantize, the FP32 sensitivity set always wins, registration
+    mutates the same tables the O1 lists do."""
+    assert amp_lists.quant_classify("dense") == "quant"
+    assert amp_lists.quant_classify("embedding") == "quant"
+    assert amp_lists.quant_classify("layer_norm") == "keep"
+    assert amp_lists.quant_classify("softmax") == "keep"
+    assert amp_lists.quant_classify("unknown_op") == "keep"
+    amp_lists.register_quant_function("my_custom_mm")
+    try:
+        assert amp_lists.quant_classify("my_custom_mm") == "quant"
+    finally:
+        amp_lists.INT8_FUNCS.discard("my_custom_mm")
+    p = get_quant_policy("int8", kv_int8=True)
+    assert p.weight_dtype_name == "int8" and p.any_armed
+    p8 = get_quant_policy("fp8")
+    assert p8.weight_dtype_name in ("float8_e4m3", "fp8_e4m3_emulated")
+    assert get_quant_policy("none").weight_dtype_name == "float32"
+    assert not get_quant_policy("none").any_armed
+    with pytest.raises(ValueError, match="none|int8|fp8"):
+        get_quant_policy("int4")
+
+
+def test_weight_tree_classification_and_roundtrip():
+    """Kernels/embeddings quantize per-channel; norm scale/bias, biases
+    and lm_bias keep their dtype/identity; dequantize_tree restores
+    structure with a bounded per-channel error."""
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    qtree, stats = qweights.quantize_params(params, "int8")
+    # every kernel and embedding leaf quantized, nothing else
+    flat = jax.tree_util.tree_flatten_with_path(
+        qtree, is_leaf=qweights.is_quantized_leaf)[0]
+    for path, leaf in flat:
+        name = path[-1].key
+        if name in ("kernel", "embedding"):
+            assert qweights.is_quantized_leaf(leaf), path
+            assert leaf["qvalue"].dtype == jnp.int8
+        else:
+            assert not qweights.is_quantized_leaf(leaf), path
+    assert stats["tensors"] > 0 and stats["kept"] > 0
+    assert stats["bytes_after"] < stats["bytes_before"] / 3
+    assert 0 < stats["scale_min"] <= stats["scale_max"]
+    deq = qweights.dequantize_tree(qtree)
+    ref_flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    deq_flat = jax.tree_util.tree_flatten_with_path(deq)[0]
+    assert [p for p, _ in ref_flat] == [p for p, _ in deq_flat]
+    for (path, a), (_, b) in zip(ref_flat, deq_flat):
+        assert a.shape == b.shape and a.dtype == b.dtype, path
+        name = path[-1].key
+        if name in ("kernel", "embedding"):
+            amax = np.abs(np.asarray(a)).max()
+            assert np.abs(np.asarray(a) - np.asarray(b)).max() \
+                <= amax / 127 + 1e-6, path
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(path))
+    # fp8 mode rides the same tree shape
+    q8, s8 = qweights.quantize_params(params, "fp8")
+    assert s8["tensors"] == stats["tensors"]
+    d8 = qweights.dequantize_tree(q8)
+    for (path, a), (_, b) in zip(
+            ref_flat, jax.tree_util.tree_flatten_with_path(d8)[0]):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=0.14, atol=1e-4,
+                                   err_msg=str(path))
+    with pytest.raises(ValueError, match="int8"):
+        qweights.quantize_params(params, "int4")
+
+
+def test_kv_write_gather_roundtrip():
+    """quantize_write/dequantize_gather: per-token scales over the
+    [H, D] vector, bf16 scale storage, bound <= scale."""
+    x = np.random.RandomState(2).randn(4, 8, 4, 16).astype(np.float32)
+    q, scale = qkv.quantize_write(jnp.asarray(x))
+    assert q.shape == x.shape and q.dtype == jnp.int8
+    assert scale.shape == (4, 8) and scale.dtype == jnp.bfloat16
+    dq = np.asarray(qkv.dequantize_gather(q, scale, jnp.float32))
+    s = np.asarray(scale, np.float32)[..., None, None]
+    assert (np.abs(x - dq) <= np.broadcast_to(s, x.shape) + 1e-6).all()
+
+
+# ==================== serving acceptance (one compile) ===============
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def qparams(model_and_params):
+    _, params = model_and_params
+    qtree, _ = qweights.quantize_params(params, "int8")
+    return qtree
+
+
+def _quant_engine(model, qtree, requests, sink=None, run_id=None,
+                  registry=None):
+    """Every engine here shares ONE module config (int8 KV + int8
+    weights at the session geometry), so _slot_step's lru_cache hands
+    all of them the same compiled program — the suite's single
+    deliberate new compile."""
+    eng = ServeEngine(model, qtree, num_slots=SLOTS, max_len=MAX_LEN,
+                      rng=jax.random.PRNGKey(0), sink=sink,
+                      run_id=run_id, registry=registry,
+                      kv_quant=True, weight_quant="int8")
+    eng.queue.submit_all(requests)
+    eng.queue.close()
+    eng.run(max_steps=2000)
+    return eng
+
+
+def test_quantized_serve_token_match_and_bytes(model_and_params,
+                                               qparams, tmp_path,
+                                               compile_events, capsys):
+    """The ISSUE 13 acceptance bar, one run: >= 95% positional token
+    match vs the full-precision generate() reference (first divergence
+    reported), ONE compile_event with quantization armed (+ the actual
+    CI gate command), dtype-accurate committed bytes <= 55% of the
+    bf16-equivalent, v11 stream validity, and the serve_report QUANT
+    line."""
+    from apex_example_tpu.obs import costmodel
+    model, params = model_and_params
+    path = str(tmp_path / "quant_serve.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    emitter = obs.TelemetryEmitter(sink)
+    emitter.run_header(config={"slots": SLOTS, "max_len": MAX_LEN},
+                       arch="gpt_tiny")
+    costmodel.set_default(obs.CostModel(
+        sink=sink, registry=emitter.registry, run_id=emitter.run_id))
+    try:
+        reqs = synthetic_requests(8, vocab_size=model.vocab_size,
+                                  seed=3, prompt_len=(3, 8),
+                                  max_new=(4, 10), stagger=2)
+        eng = _quant_engine(model, qparams, reqs, sink=sink,
+                            run_id=emitter.run_id,
+                            registry=emitter.registry)
+    finally:
+        costmodel.set_default(None)
+    summary = eng.summary_record()
+    sink.write(summary)
+    sink.close()
+    assert eng.counts["ok"] == 8
+
+    # (a) token match vs the full-precision one-shot reference,
+    # positional, with the first divergence named in the failure.
+    match = total = 0
+    first_div = None
+    for c in sorted(eng.completions, key=lambda c: c.request.uid):
+        P = len(c.request.prompt)
+        ref = np.asarray(generate(
+            model, params,
+            jnp.asarray([list(c.request.prompt)], jnp.int32),
+            max_len=MAX_LEN))[0, P:P + len(c.tokens)]
+        eq = ref == np.asarray(c.tokens, np.int32)
+        match += int(eq.sum())
+        total += len(eq)
+        if not eq.all() and first_div is None:
+            first_div = (c.request.uid, int(np.argmin(eq)))
+    assert total > 20
+    assert match / total >= 0.95, (
+        f"int8 serve matched {match}/{total} tokens "
+        f"({match / total:.3f} < 0.95); first divergence at "
+        f"(request, step) {first_div}")
+
+    # (b) compile-once with quantization armed: the quantized program
+    # is ONE new compile, checked through the counter AND the CI gate.
+    records = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(records) == []
+    assert compile_events(records) == {"serve_decode_step": 1}
+    assert compile_events.gate(path) == 0
+    cm = next(r for r in records if r["record"] == "cost_model")
+    assert cm["flops"] > 0 and cm["bytes_accessed"] > 0
+
+    # (c) dtype-accurate bytes: per-token cost = int8 payload + bf16
+    # block scales; committed <= 55% of the bf16-equivalent workload.
+    per = summary["kv_bytes_per_token"]
+    bf16 = summary["kv_bytes_per_token_bf16"]
+    assert summary["kv_dtype"] == "int8"
+    assert summary["weight_dtype"] == "int8"
+    assert per == 2 * model.num_layers * (model.hidden_size + 2)
+    assert bf16 == 2 * model.num_layers * model.hidden_size * 2
+    assert bf16 / per >= 1.9
+    committed = summary["kv_bytes_committed"]["max"]
+    assert committed <= 0.55 * (committed / per * bf16)
+    assert eng.pool.kv_bytes_reserved() \
+        == eng.pool.num_blocks * BS * per
+
+    # (d) the QUANT report line renders from the v11 fields, jax-free.
+    report = _load_tool("serve_report")
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "QUANT: weights=int8  kv=int8" in out
+    assert "compression 1.9" in out
+
+
+def test_quantized_cow_copies_scales(model_and_params, qparams):
+    """The COW-under-quantization regression: diverging a shared int8
+    block must copy its SCALE rows with the payload.  Shared-prefix
+    requests (two full shared blocks + a partial overlap -> a real COW)
+    produce exactly the tokens the same prompts produce in solo
+    quantized runs — if scales were not copied, the COW'd block would
+    dequantize under a fresh block's zero scales and the streams would
+    diverge immediately."""
+    model, _ = model_and_params
+    reqs = synthetic_requests(6, vocab_size=model.vocab_size, seed=7,
+                              prompt_len=(3, 6), max_new=(4, 8),
+                              stagger=3, shared_prefix=20)
+    eng = _quant_engine(model, qparams, reqs)
+    assert eng.counts["ok"] == 6
+    assert eng.pool.cow_copies >= 1          # the drill actually fired
+    assert eng.pool.prefix_hit_rate() > 0.4
+    solo_tokens = {}
+    for c in eng.completions:
+        solo = _quant_engine(
+            model, qparams,
+            [Request(prompt=list(c.request.prompt),
+                     max_new_tokens=c.request.max_new_tokens)])
+        solo_tokens[c.request.uid] = solo.completions[0].tokens
+        assert c.tokens == solo_tokens[c.request.uid], (
+            f"{c.request.uid}: shared-prefix quantized stream diverged "
+            "from the solo quantized run — COW dropped the scales")
+
+
+def test_quant_disabled_path_untouched(model_and_params):
+    """The fp32-scale path (quantization off) keeps its identity
+    contract: summary reports the full-precision dtypes and the arena
+    allocates no scale leaves (kv_bytes_per_token is the v7 value)."""
+    model, params = model_and_params
+    eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                      rng=jax.random.PRNGKey(0))
+    s = eng.summary_record()
+    assert s["kv_dtype"] == "float32"
+    assert s["weight_dtype"] == "float32"
+    per_v7 = 2 * model.num_layers * model.hidden_size * 4
+    assert s["kv_bytes_per_token"] == per_v7
+    assert s["kv_bytes_per_token_bf16"] == per_v7 // 2
+    with pytest.raises(ValueError, match="weight_quant"):
+        ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                    weight_quant="int4")
+
+
+def test_kv_quant_requires_slot_decode():
+    model = gpt_tiny(decode=True, kv_quant=True)
+    with pytest.raises(ValueError, match="slot_decode"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+# ===================== jax-free tool surface =========================
+
+def test_ci_gate_quant_stream_fixture(capsys):
+    """The tier-1 quant gate: the checked-in quantized-smoke stream
+    passes `ci_gate --quant-stream` (v11 validation + exactly-one
+    serve_summary + the 1.9x compression floor), and tampering the
+    committed bytes above the floor fails it."""
+    ci_gate = _load_tool("ci_gate")
+    assert ci_gate.main(["--quant-stream", QUANT_FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert "quant gate" in out and "PASS" in out
+
+
+def test_ci_gate_quant_stream_rejects_regression(tmp_path, capsys):
+    records = [json.loads(ln) for ln in open(QUANT_FIXTURE)
+               if ln.strip()]
+    ci_gate = _load_tool("ci_gate")
+
+    def run_tampered(mutate):
+        recs = [json.loads(json.dumps(r)) for r in records]
+        mutate(recs)
+        p = str(tmp_path / "tampered.jsonl")
+        with open(p, "w") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+        rc = ci_gate.main(["--quant-stream", p])
+        capsys.readouterr()
+        return rc
+
+    def summ(recs):
+        return next(r for r in recs
+                    if r["record"] == "serve_summary")
+
+    # committed bytes ballooned past the bf16-equivalent/1.9 floor
+    def fat(recs):
+        s = summ(recs)
+        s["kv_bytes_per_token"] = s["kv_bytes_per_token_bf16"]
+    assert run_tampered(fat) == 1
+    # quantization silently off
+    def off(recs):
+        summ(recs)["kv_dtype"] = "float32"
+    assert run_tampered(off) == 1
+    # missing the quant_event announcement
+    def silent(recs):
+        recs[:] = [r for r in recs if r["record"] != "quant_event"]
+    assert run_tampered(silent) == 1
+    # duplicated summary
+    def dup(recs):
+        recs.append(summ(recs))
+    assert run_tampered(dup) == 1
+
+
+def test_schema_v11_quant_records_validate():
+    assert obs_schema.SCHEMA_VERSION == 11
+    good = [
+        {"record": "quant_event", "time": 1.0, "kind": "weights",
+         "dtype": "int8", "tensors": 14, "kept": 25,
+         "bytes_before": 368128, "bytes_after": 102912,
+         "scale_min": 0.001, "scale_max": 0.004, "emulated": False,
+         "run_id": "r1"},
+        {"record": "quant_event", "time": 1.0, "kind": "kv",
+         "dtype": "int8", "block_size": 8, "scale_dtype": "bfloat16"},
+    ]
+    for rec in good:
+        assert obs_schema.validate_record(rec) == [], rec
+    # unknown field, missing required, wrong type
+    assert obs_schema.validate_record(
+        {"record": "quant_event", "time": 1.0, "kind": "kv",
+         "dtype": "int8", "zstd": True})
+    assert obs_schema.validate_record(
+        {"record": "quant_event", "time": 1.0, "kind": "kv"})
+    assert obs_schema.validate_record(
+        {"record": "quant_event", "time": 1.0, "kind": 3,
+         "dtype": "int8"})
+    # v11 serve_summary fields validate; pre-v11 summaries still do
+    v11 = {"record": "serve_summary", "time": 1.0, "requests": 1,
+           "output_tokens": 4, "tokens_per_sec": 1.0,
+           "kv_dtype": "int8", "weight_dtype": "int8",
+           "kv_bytes_per_token": 264, "kv_bytes_per_token_bf16": 512}
+    assert obs_schema.validate_record(v11) == []
+    v10 = {"record": "serve_summary", "time": 1.0, "requests": 1,
+           "output_tokens": 4, "tokens_per_sec": 1.0}
+    assert obs_schema.validate_record(v10) == []

@@ -30,6 +30,14 @@ Gates:
    scenario verdict other than "pass" fails the gate.  Run over the
    checked-in scenario stream, this turns "handles a rolling restart"
    into a regression-tested number.
+5. **quant compression** (per ``--quant-stream``): the quantized-
+   serving contract over a recorded ``--kv-quant`` stream (schema
+   v11) — every record validates, exactly one ``serve_summary``, an
+   int8 ``kv_dtype`` announced by a ``quant_event``, and
+   ``kv_bytes_committed`` at or below its bf16-equivalent /
+   ``--quant-compression-min`` (default 1.9).  Run over the checked-in
+   quantized-smoke stream (tests/fixtures/quant/), this turns "the KV
+   cache got smaller" into a regression-tested number.
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -59,11 +67,12 @@ def _load_tool(name):
     return mod
 
 
-def _fleet_gate(stream: str, availability_min: float) -> int:
-    """The fleet-scenario gate: schema-v10 validation + zero lost +
-    availability threshold + a passing verdict over one recorded
-    fleet-router stream.  Returns 0/1 (2 is the caller's unreadable-
-    stream path)."""
+def _load_gated_stream(stream: str, summary_record: str):
+    """Shared preamble of the stream gates: parse the JSONL, validate
+    every record against the schema, require EXACTLY one summary of
+    ``summary_record``.  Returns ``(summary, records)`` on success,
+    ``(None, records)`` after printing the failure (the caller exits
+    1)."""
     import json
 
     metrics_lint = _load_tool("metrics_lint")
@@ -78,19 +87,29 @@ def _fleet_gate(stream: str, availability_min: float) -> int:
             except json.JSONDecodeError:
                 print(f"{stream}: line {n + 1}: not JSON",
                       file=sys.stderr)
-                return 1
+                return None, records
     errors = metrics_lint.validate_stream(records)
     for e in errors:
         print(f"{stream}: {e}", file=sys.stderr)
     summaries = [r for r in records
-                 if r.get("record") == "fleet_summary"]
+                 if r.get("record") == summary_record]
     if len(summaries) != 1:
-        print(f"{stream}: {len(summaries)} fleet_summary records "
+        print(f"{stream}: {len(summaries)} {summary_record} records "
               "(expected exactly 1)", file=sys.stderr)
-        return 1
+        return None, records
     if errors:
+        return None, records
+    return summaries[0], records
+
+
+def _fleet_gate(stream: str, availability_min: float) -> int:
+    """The fleet-scenario gate: schema-v10 validation + zero lost +
+    availability threshold + a passing verdict over one recorded
+    fleet-router stream.  Returns 0/1 (2 is the caller's unreadable-
+    stream path)."""
+    summ, _ = _load_gated_stream(stream, "fleet_summary")
+    if summ is None:
         return 1
-    summ = summaries[0]
     rc = 0
     if summ.get("lost", 0) != 0:
         print(f"{stream}: {summ['lost']} request(s) LOST (uids with no "
@@ -103,6 +122,54 @@ def _fleet_gate(stream: str, availability_min: float) -> int:
     if "verdict" in summ and summ["verdict"] != "pass":
         print(f"{stream}: scenario {summ.get('scenario', '?')} verdict "
               f"is {summ['verdict']!r}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def _quant_gate(stream: str, min_ratio: float) -> int:
+    """The quantized-serving gate (ISSUE 13): schema-v11 validation,
+    exactly one serve_summary, an armed int8 KV arena, and the
+    compression floor — ``kv_bytes_committed`` must sit at or below
+    its bf16-equivalent divided by ``min_ratio`` (default 1.9: int8
+    payload + bf16 block scales beats a scale-free bf16 arena by at
+    least that much at every supported geometry).  Returns 0/1 (2 is
+    the caller's unreadable-stream path)."""
+    summ, records = _load_gated_stream(stream, "serve_summary")
+    if summ is None:
+        return 1
+    rc = 0
+    if summ.get("kv_dtype") != "int8":
+        print(f"{stream}: kv_dtype is {summ.get('kv_dtype')!r} "
+              "(quant stream must serve an int8 KV arena)",
+              file=sys.stderr)
+        rc = 1
+    if not any(r.get("record") == "quant_event" for r in records):
+        print(f"{stream}: no quant_event record (the applied "
+              "quantization must announce itself)", file=sys.stderr)
+        rc = 1
+    per = summ.get("kv_bytes_per_token")
+    bf16 = summ.get("kv_bytes_per_token_bf16")
+    committed = (summ.get("kv_bytes_committed") or {}).get("max")
+    if per is None or bf16 is None or committed is None:
+        print(f"{stream}: serve_summary lacks the v11 per-token/"
+              "committed byte fields", file=sys.stderr)
+        return 1
+    if per <= 0 or bf16 <= 0:
+        print(f"{stream}: degenerate per-token bytes "
+              f"(kv_bytes_per_token={per}, bf16-eq={bf16})",
+              file=sys.stderr)
+        return 1
+    # committed <= (committed / per * bf16) / min_ratio is algebraically
+    # per * min_ratio <= bf16 — checked in that form so an empty run
+    # (committed max 0, which would make 0 > 0 vacuously pass) cannot
+    # sneak a regressed geometry through the floor.
+    bf16_equiv = committed / per * bf16
+    if per * min_ratio > bf16:
+        print(f"{stream}: kv_bytes_committed max {committed:.0f} > "
+              f"bf16-equivalent {bf16_equiv:.0f} / {min_ratio} — "
+              f"compression {bf16 / per:.2f}x under the floor "
+              f"({per} B/token vs bf16-eq {bf16})",
+              file=sys.stderr)
         rc = 1
     return rc
 
@@ -129,6 +196,18 @@ def main(argv=None) -> int:
                     metavar="X",
                     help="fleet availability the --fleet-stream gate "
                          "requires (default 1.0)")
+    ap.add_argument("--quant-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a quantized-serving stream to run the quant "
+                         "gate over: schema-v11 validation, exactly one "
+                         "serve_summary, int8 kv_dtype + quant_event, "
+                         "and kv_bytes_committed <= bf16-equivalent / "
+                         "--quant-compression-min (repeatable)")
+    ap.add_argument("--quant-compression-min", type=float, default=1.9,
+                    metavar="X",
+                    help="KV compression ratio the --quant-stream gate "
+                         "requires vs the bf16-equivalent arena "
+                         "(default 1.9)")
     ap.add_argument("--baseline", default=None,
                     help="graftlint baseline override")
     ap.add_argument("paths", nargs="*",
@@ -175,6 +254,16 @@ def main(argv=None) -> int:
             return 2
         rc = _fleet_gate(stream, args.fleet_availability_min)
         print(f"ci_gate: fleet gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
+
+    for stream in args.quant_stream:
+        if not os.path.isfile(stream):
+            print(f"ci_gate: no such stream: {stream}",
+                  file=sys.stderr)
+            return 2
+        rc = _quant_gate(stream, args.quant_compression_min)
+        print(f"ci_gate: quant gate {stream}: "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         worst = max(worst, rc)
 

@@ -16,7 +16,11 @@ Rules:
   ``convolution`` ops in a program whose AMP policy says compute runs
   in bf16/f16.  One leaked convert on an activation path silently
   doubles the MXU and HBM cost of every downstream matmul; the f32 op
-  in the lowering is the first observable symptom.
+  in the lowering is the first observable symptom.  The ``int8``
+  policy is the CLAIMED-INT8 REGION mode (ISSUE 13): quantized
+  programs must dequantize to the half compute dtype, so a dequant
+  that pins a matmul in f32 fails the same rule (fixture pair
+  tests/fixtures/hlo/int8_clean.mlir / int8_f32_leak.mlir).
 - **host-transfer-in-step** — ``infeed`` / ``outfeed`` / ``send`` /
   ``recv`` (and optionally ``custom_call @Sharding``) inside a step
   program that is expected to be a pure device computation: a host
@@ -43,8 +47,11 @@ RULE_HOST = "hlo-host-transfer"
 # `%3 = stablehlo.dot_general %1, %2 ...` and the generic
 # `%3 = "stablehlo.dot_general"(%1, %2) ...` form.
 _OP = re.compile(r'=\s*"?(?:stablehlo|mhlo|chlo)\.([A-Za-z_][\w]*)"?')
+# Uppercase allowed after the first char: MLIR spells fp8 types
+# f8E4M3FN / f8E5M2 (the claimed-int8 mode accepts them as quantized
+# storage alongside i8).
 _TENSOR_DTYPE = re.compile(r"tensor<(?:[0-9x?*\[\],]+x)?"
-                           r"([a-z][a-z0-9]*)(?:[,>])")
+                           r"([a-z][a-zA-Z0-9]*)(?:[,>])")
 _CUSTOM_TARGET = re.compile(r'custom_call\s*@(\w+)'
                             r'|call_target_name\s*=\s*"(\w+)"')
 _SSA = re.compile(r"%[\w#.]+")
@@ -52,8 +59,15 @@ _LOC = re.compile(r"\s*loc\(.*?\)\s*$")
 
 HEAVY_OPS = {"dot_general", "dot", "convolution", "conv"}
 HOST_OPS = {"infeed", "outfeed", "send", "recv"}
+# "int8" is the CLAIMED-INT8 REGION mode (ISSUE 13): a program whose
+# weights/KV are quantized dequantizes to the bf16/f16 compute dtype
+# for the MXU op — scale-fused, so the matmul itself runs half.  A
+# dequant that converts UP to f32 instead silently pins the whole
+# matmul wide (4x the int8 HBM win gone, plus f32 MXU throughput);
+# the f32 dot_general in the lowering is the first observable symptom,
+# exactly like the bf16 policy's upcast leak.
 WIDE = {"bf16": {"f32", "f64"}, "f16": {"f32", "f64"},
-        "f32": {"f64"}}
+        "f32": {"f64"}, "int8": {"f32", "f64"}}
 
 
 def ops(text: str):
@@ -71,7 +85,10 @@ def line_dtypes(line: str) -> List[str]:
 def upcast_leak(text: str, compute_dtype: str = "bf16",
                 path: str = "<hlo>") -> List[Finding]:
     """Wide heavy ops in a reduced-precision program.  ``compute_dtype``
-    is the AMP policy's MXU dtype (O1/O2 => bf16 on this repo)."""
+    is the AMP policy's MXU dtype (O1/O2 => bf16 on this repo); "int8"
+    is the claimed-int8 region mode — quantized storage, half-dtype
+    matmuls, and the claim itself is checked (a region that claims
+    int8 but lowers no i8 tensor at all quantized nothing)."""
     wide = WIDE.get(compute_dtype)
     if wide is None:
         raise ValueError(f"unknown compute dtype {compute_dtype!r} "
@@ -87,6 +104,14 @@ def upcast_leak(text: str, compute_dtype: str = "bf16",
                 f"{opname} runs in {'/'.join(hit)} inside a "
                 f"{compute_dtype} policy region — an upcast leaked "
                 "into the MXU path"))
+    if compute_dtype == "int8":
+        seen = {dt for _, _, line in ops(text)
+                for dt in line_dtypes(line)}
+        if not any(dt == "i8" or dt.startswith("f8") for dt in seen):
+            findings.append(Finding(
+                RULE_UPCAST, path, 1,
+                "program claims an int8 policy region but lowers no "
+                "i8/f8 tensor — quantization was silently skipped"))
     return findings
 
 

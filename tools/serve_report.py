@@ -221,6 +221,27 @@ def report(path: str, out=sys.stdout) -> int:
               f"{summary['output_tokens']} token(s)  "
               f"{summary['tokens_per_sec']} tok/s aggregate  "
               f"occupancy {summary.get('occupancy', '?')}", file=out)
+        quantized = (summary.get("kv_dtype") == "int8"
+                     or summary.get("weight_dtype") in
+                     ("int8", "float8_e4m3", "fp8_e4m3_emulated"))
+        if quantized and "kv_bytes_per_token" in summary:
+            # schema v11 QUANT line (ISSUE 13), only when some stratum
+            # actually quantized — every v11 run carries the dtype
+            # fields, and an unquantized fp32 run must not print a
+            # sub-1.0 "compression" banner: dtypes, the per-request KV
+            # cost vs its bf16-equivalent, and the compression ratio
+            # ci_gate --quant-stream gates at >= 1.9x.
+            per = summary["kv_bytes_per_token"]
+            bf16 = summary.get("kv_bytes_per_token_bf16", per)
+            ratio = bf16 / per if per else 0.0
+            toks = (prompt_tokens + out_tokens) / len(reqs) if reqs \
+                else 0.0
+            print(f"QUANT: weights={summary.get('weight_dtype', '?')}  "
+                  f"kv={summary['kv_dtype']}  "
+                  f"kv_bytes/token {per} vs bf16-eq {bf16}  "
+                  f"per-request kv {toks * per / 1024:.1f} KiB vs "
+                  f"bf16-eq {toks * bf16 / 1024:.1f} KiB  "
+                  f"compression {ratio:.2f}x", file=out)
         if "blocks_total" in summary:
             blk = summary.get("blocks_live") or {}
             total = summary["blocks_total"]

@@ -114,6 +114,18 @@ def parse_args(argv=None):
                         "image workloads, >1 device, static loss scale)")
     p.add_argument("--delay-allreduce", action="store_true", default=True)
     p.add_argument("--gradient-predivide-factor", type=float, default=1.0)
+    p.add_argument("--quantized-allreduce", default="off",
+                   choices=["off", "int8"],
+                   help="DDP gradient exchange precision (ISSUE 13; "
+                        "EQuARX, PAPERS.md): int8 reduces each "
+                        "--quant-chunk-element chunk under one "
+                        "pmax-shared max-abs scale (error bound "
+                        "world*scale/2 per element, see "
+                        "parallel/distributed.py); off is bit-identical "
+                        "to the unquantized path")
+    p.add_argument("--quant-chunk", type=int, default=1024,
+                   help="chunk size (elements) for --quantized-allreduce "
+                        "scales")
     p.add_argument("--num-devices", type=int, default=None,
                    help="devices to use (default: all)")
     # Megatron-style model parallelism (apex.transformer parity, GSPMD form)
@@ -706,7 +718,9 @@ def main(argv=None):
 
     ddp = DDPConfig(
         delay_allreduce=args.delay_allreduce,
-        gradient_predivide_factor=args.gradient_predivide_factor)
+        gradient_predivide_factor=args.gradient_predivide_factor,
+        quantized_allreduce=args.quantized_allreduce == "int8",
+        quant_chunk=args.quant_chunk)
 
     if n_dev > 1:
         mesh = make_data_mesh(devices=devices)
