@@ -55,3 +55,26 @@ def vma_of(x) -> frozenset:
     if _TYPEOF is None:
         return frozenset()
     return getattr(_TYPEOF(x), "vma", frozenset())
+
+
+class _NoAbstractMesh:
+    """Stand-in for ``jax.sharding.get_abstract_mesh()``'s result on jax
+    versions that predate abstract meshes: no axes are trace-manual (the
+    partially-manual shard_map compositions that NEED manual-axis
+    detection also need the vma-era shard_map, so on pre-vma jax every
+    constraint targets the registered concrete mesh)."""
+
+    manual_axes: tuple = ()
+    shape: dict = {}
+
+
+def get_abstract_mesh():
+    """``jax.sharding.get_abstract_mesh`` where it exists; a no-manual-
+    axes stand-in before abstract meshes (jax <= 0.4.x).  Keeps the TP
+    layers' ``constrain``/``batch_axis`` — and with them TP generate()
+    and the TP-sharded serve engine — working under plain-GSPMD jit on
+    the pinned CPU-rig jax."""
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    if fn is not None:
+        return fn()
+    return _NoAbstractMesh()

@@ -204,6 +204,10 @@ class ThreadReplica:
             "tick": eng.step_count,
             "pending": eng.queue.pending(),
             "blocks_live": eng.pool.blocks_live(),
+            # v12: dtype-accurate bytes (int8 arenas + scales count
+            # their true footprint) — what least_kv prefers, so a
+            # quantized replica advertises its real headroom.
+            "kv_bytes_live": eng.pool.kv_bytes_live(),
             # Seconds since the last completed tick — each transport
             # computes the age in ITS OWN clock domain (perf_counter
             # here, heartbeat wall-time for ProcReplica), so the router
@@ -360,8 +364,11 @@ class ProcReplica:
 
     ``serve_args`` extends the child argv (geometry, --trace, a
     ``--inject-fault`` drill for crash/straggler scenarios — the
-    supervisor strips it on restart).  The spawned tree joins the
-    router's trace via the ``APEX_TRACE_ID`` environment handoff.
+    supervisor strips it on restart — and sharding flags: a
+    ``--mesh dp,tp`` child serves TP-sharded and its heartbeats carry
+    the dtype-accurate ``kv_bytes_live`` gauge ``least_kv`` prefers).
+    The spawned tree joins the router's trace via the
+    ``APEX_TRACE_ID`` environment handoff.
     """
 
     def __init__(self, name: str, workdir: str, repo_root: str,
@@ -567,6 +574,13 @@ class ProcReplica:
             "tick": int(beat.get("tick", 0)),
             "pending": int(beat.get("pending", 0)),
             "blocks_live": int(beat.get("blocks_live", 0)),
+            # v12 heartbeats carry the dtype-accurate byte gauge.  A
+            # pre-v12 child's heartbeat lacks it — reported as None
+            # (NOT 0: an absent gauge must not read as an empty
+            # replica), which degrades the router's least_kv to the
+            # block count for the whole candidate set.
+            "kv_bytes_live": int(beat["kv_bytes_live"])
+            if "kv_bytes_live" in beat else None,
             "progress_age_s": (time.time() - float(beat["time"]))
             if "time" in beat else 0.0,
             "pid": beat.get("pid"),

@@ -10,8 +10,10 @@ What the router owns:
 
 - **Dispatch policies** (``--policy``): ``round_robin`` (cycle the
   routable set), ``least_pending`` (the smallest queued backlog, from
-  each replica's tailed/live gauges), ``least_kv`` (the fewest live KV
-  arena blocks — the tailed ``blocks_live`` gauge).  A replica is
+  each replica's tailed/live gauges), ``least_kv`` (the least live KV
+  — the tailed dtype-accurate ``kv_bytes_live`` byte gauge of a v12
+  replica, falling back to the raw ``blocks_live`` block count for
+  older children).  A replica is
   routable when its handle reports healthy/starting AND its circuit
   breaker admits traffic.  When nothing is routable the request parks
   in the router backlog and is re-dispatched as capacity returns —
@@ -314,11 +316,26 @@ class FleetRouter:
                     return n
             return None
 
+        # least_kv keys on the dtype-accurate byte gauge a v12 replica
+        # heartbeats (kv_bytes_live: int8 arenas report their true
+        # footprint, so a quantized replica with the same block count
+        # advertises the headroom it really has) — but ONLY when every
+        # candidate reports it: a pre-v12 child carries no such field,
+        # and letting its absence key as 0 bytes would route every
+        # request to the oldest replica no matter how loaded it is.
+        # Mixed fleets degrade to the block count for everyone.
+        use_bytes = self.policy == "least_kv" and all(
+            metas[n].health.get("kv_bytes_live") is not None
+            for n in names)
+
         def load_key(n: str):
-            gauge = "pending" if self.policy == "least_pending" \
-                else "blocks_live"
-            return (metas[n].health.get(gauge, 0), metas[n].inflight,
-                    self._order.index(n))
+            if self.policy == "least_pending":
+                load = metas[n].health.get("pending", 0)
+            elif use_bytes:
+                load = metas[n].health["kv_bytes_live"]
+            else:
+                load = metas[n].health.get("blocks_live", 0)
+            return (load, metas[n].inflight, self._order.index(n))
         return min(names, key=load_key)
 
     def _dispatch(self, uid: str, reason: str,

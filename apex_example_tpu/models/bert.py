@@ -232,6 +232,18 @@ class BertSelfAttention(nn.Module):
                         "it each tick)")
                 NB, BS = self.kv_num_blocks, self.kv_block_size
                 S, C = x.shape[0], x.shape[1]
+                if self.tensor_parallel:
+                    # Under TP the [NB, BS, h, hd] arenas shard over
+                    # heads on 'model' exactly like the dense decode
+                    # cache; re-constraining after every in-place
+                    # update keeps GSPMD from gathering the arena
+                    # through the COW/scatter chain (the block tables,
+                    # fills and scale tables stay replicated — they
+                    # are host policy, not sharded state).
+                    arena = lambda t: constrain(t, None, None, "model",
+                                                None)
+                else:
+                    arena = lambda t: t
                 table = paged["block_table"]          # [S, max_blocks]
                 fill = paged["fill"]                  # [S] tokens cached
                 n_new = paged["n_new"]                # [S] fed this tick
@@ -241,10 +253,10 @@ class BertSelfAttention(nn.Module):
                 src = jnp.clip(paged["cow_src"], 0, NB - 1)
                 dst = jnp.where(paged["cow_dst"] >= 0, paged["cow_dst"],
                                 NB)
-                ck.value = ck.value.at[dst].set(ck.value[src],
-                                                mode="drop")
-                cv.value = cv.value.at[dst].set(cv.value[src],
-                                                mode="drop")
+                ck.value = arena(ck.value.at[dst].set(ck.value[src],
+                                                      mode="drop"))
+                cv.value = arena(cv.value.at[dst].set(cv.value[src],
+                                                      mode="drop"))
                 if self.kv_quant:
                     # Scales are block-resident state: a COW must carry
                     # them with the payload, or the copy dequantizes
@@ -279,12 +291,14 @@ class BertSelfAttention(nn.Module):
                     cvs.value = cvs.value.reshape(NB * BS).at[flat].set(
                         v_sc.reshape(S * C),
                         mode="drop").reshape(NB, BS)
-                ck.value = ck.value.reshape(NB * BS, h, hd).at[flat].set(
-                    k.reshape(S * C, h, hd),
-                    mode="drop").reshape(NB, BS, h, hd)
-                cv.value = cv.value.reshape(NB * BS, h, hd).at[flat].set(
-                    v.reshape(S * C, h, hd),
-                    mode="drop").reshape(NB, BS, h, hd)
+                ck.value = arena(
+                    ck.value.reshape(NB * BS, h, hd).at[flat].set(
+                        k.reshape(S * C, h, hd),
+                        mode="drop").reshape(NB, BS, h, hd))
+                cv.value = arena(
+                    cv.value.reshape(NB * BS, h, hd).at[flat].set(
+                        v.reshape(S * C, h, hd),
+                        mode="drop").reshape(NB, BS, h, hd))
                 # 3. Gather each slot's logical K/V view back out of the
                 # arena ([S, max_blocks*BS, H, D], logical order) and
                 # attend under the per-slot causal live mask: query j

@@ -222,6 +222,30 @@ cost; their ratio is the compression the serve_report QUANT line
 renders and the ci_gate ``--quant-stream`` floor enforces).  v11 is
 once more a strict superset: every v1–v10 stream validates unchanged.
 
+Version 12 adds the sharded/disaggregated-serving stratum
+(serve/disagg.py; ``--mesh dp,tp`` and ``--role prefill|decode`` on
+serve.py):
+
+``kv_handoff``  one per KV-cache handoff side: a prefill worker that
+                chunk-prefilled a prompt into its paged arena and
+                shipped the request's blocks (payload + int8 scales +
+                fill level) emits ``direction: "out"``; the decode
+                worker that scattered them into its own arena and
+                took over decoding emits ``direction: "in"`` (with
+                ``handoff_ms``, the out-stamp -> admission wall-clock
+                transit, and ``requeued``, the times admission was
+                deferred for free blocks).
+
+plus sharding/role fields on ``serve_summary``: ``role`` (prefill /
+decode / both), ``mesh`` / ``dp`` / ``tp`` (the registered serve mesh,
+weights and KV arenas head-sharded over ``model``), and the handoff
+accounting (``handoffs_out`` / ``handoffs_in`` / ``handoff_requeued``
+/ ``handoff_bytes`` / ``handoff_ms`` percentiles); ``replica_state``
+heartbeats gain ``kv_bytes_live`` (the dtype-accurate byte gauge the
+fleet router's ``least_kv`` policy prefers over the raw block count).
+v12 is once more a strict superset: every v1–v11 stream validates
+unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -233,7 +257,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -401,6 +425,16 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "kind": str,            # weights | kv
         "dtype": str,           # int8 | float8_e4m3 | fp8_e4m3_emulated
     },
+    # --- schema v12: disaggregated-serving records (serve/disagg.py) ---
+    "kv_handoff": {
+        "record": str,
+        "time": _NUM,
+        "request_id": str,
+        "direction": str,       # out (prefill -> transport) | in
+        "fill": int,            # tokens of KV in the payload
+        "blocks": int,          # arena blocks in the payload
+        "payload_bytes": int,   # payload + scale bytes, dtype-accurate
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -517,6 +551,18 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "weight_dtype": str,        # weight storage mode/dtype
         "kv_bytes_per_token": int,  # actual (scales included)
         "kv_bytes_per_token_bf16": int,  # bf16-equivalent baseline
+        # v12: sharded + disaggregated serving (serve/disagg.py)
+        "role": str,                # both | prefill | decode
+        "mesh": str,                # "data=D,model=T" when sharded
+        "dp": int,                  # mesh data-axis size
+        "tp": int,                  # mesh model-axis size
+        "handoffs_out": int,        # prefill: requests handed off
+        "handoffs_in": int,         # decode: handoffs admitted
+        "handoff_requeued": int,    # decode: handoffs that had to wait
+                                    #   for free slots/blocks (episodes,
+                                    #   not retry attempts)
+        "handoff_bytes": int,       # payload bytes moved, this role
+        "handoff_ms": dict,         # decode: transit percentiles
     },
     "preemption": {
         "run_id": str,
@@ -624,6 +670,8 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "tick": int,             # the replica's engine tick counter
         "pending": int,          # its queued-request backlog
         "blocks_live": int,      # KV arena blocks held (least_kv input)
+        "kv_bytes_live": int,    # v12: dtype-accurate KV bytes live —
+                                 #   what least_kv prefers when present
         "pid": int,              # serve-child pid (chaos scripts signal it)
         "attempt": int,          # supervisor attempt index, when known
         "exit_code": int,        # with state crashed/restarting
@@ -642,6 +690,23 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "emulated": bool,        # fp8 without native jnp support
         "block_size": int,       # kv kind: scale granularity (tokens)
         "scale_dtype": str,      # kv kind: block-scale storage dtype
+    },
+    # --- schema v12: disaggregated-serving records (serve/disagg.py) ---
+    "kv_handoff": {
+        "run_id": str,
+        "kv_dtype": str,         # arena payload dtype in the payload
+        "prompt_tokens": int,
+        "first_token": int,      # the prefill-side sampled first token
+        "ttft_ms": _NUM,         # out only: the REAL first-token
+                                 #   latency (measured where the first
+                                 #   token was sampled — the decode
+                                 #   side's request_complete can only
+                                 #   see its own clock domain)
+        "queue_wait_ms": _NUM,   # out only: prefill-side queue wait
+        "src": str,              # role/replica ids, when known
+        "dst": str,
+        "handoff_ms": _NUM,      # in only: out-stamp -> admission wall
+        "requeued": int,         # in only: deferred-admission count
     },
     "fleet_summary": {
         "run_id": str,

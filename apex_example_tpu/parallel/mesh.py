@@ -68,6 +68,45 @@ def initialize_model_parallel(tensor_parallel: int = 1,
     return Mesh(arr, (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS))
 
 
+def parse_serve_mesh(spec: str) -> "tuple[int, int]":
+    """Parse serve.py's ``--mesh dp,tp`` value into ``(dp, tp)``.
+
+    Two comma-separated positive integers: the data-axis size (replica
+    batch sharding of the slot dimension) and the model-axis size
+    (Megatron TP: weights and per-layer KV arenas shard over heads).
+    ``"1,4"`` is pure TP, ``"2,4"`` the mixed mesh the virtual-device
+    tests pin."""
+    parts = spec.split(",")
+    if len(parts) != 2:
+        raise ValueError(f"--mesh wants 'dp,tp' (two comma-separated "
+                         f"ints), got {spec!r}")
+    try:
+        dp, tp = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(f"--mesh wants 'dp,tp' (two comma-separated "
+                         f"ints), got {spec!r}")
+    if dp < 1 or tp < 1:
+        raise ValueError(f"--mesh axes must be >= 1, got dp={dp} tp={tp}")
+    return dp, tp
+
+
+def serve_mesh(dp: int, tp: int,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """The serving mesh: ``(pipe=1, data=dp, context=1, model=tp)``,
+    built over exactly ``dp * tp`` devices (the standard 4-axis layout,
+    so the TP layers' ``constrain`` points and ``batch_axis()`` work
+    unchanged).  TP innermost — ICI neighbours — exactly like the
+    training mesh."""
+    if devices is None:
+        devices = jax.devices()
+    need = dp * tp
+    if len(devices) < need:
+        raise ValueError(f"serve mesh data={dp} x model={tp} needs "
+                         f"{need} devices, have {len(devices)}")
+    return initialize_model_parallel(tensor_parallel=tp,
+                                     devices=list(devices)[:need])
+
+
 def require_model_axis_match(mesh: Mesh, model_is_tp: bool) -> int:
     """Validate a model's ``tensor_parallel`` flag against the mesh's
     'model' axis; returns that axis's size.  Shared by the partially-manual

@@ -16,12 +16,24 @@ cancellation, slot-level failure isolation with a degenerate-token
 guard, and graceful drain (``ServeEngine.drain``) — every request
 terminates in a first-class ``Completion(status=...)``.
 
+Sharded + disaggregated serving (ISSUE 14): under a registered
+parallel_state mesh the engine TP-shards the weights and per-layer KV
+arenas over heads on the ``model`` axis (block tables and admission
+stay host-side and replicated), and ``serve/disagg.py`` splits prefill
+and decode into separate roles connected by a KV-block handoff
+transport — long prompts stop stalling decode ticks.
+
 ``serve.py`` at the repo root is the CLI driver (checkpoint restore or
 random init, synthetic stream, schema-v5 JSONL serving records, SIGTERM
-drain-to-EX_TEMPFAIL, ``--inject-fault`` drills);
+drain-to-EX_TEMPFAIL, ``--inject-fault`` drills, ``--mesh dp,tp`` and
+``--role prefill|decode|both``);
 ``tools/serve_report.py`` is the jax-free summary client.
 """
 
+from apex_example_tpu.serve.disagg import (FileTransport, KvHandoff,
+                                           QueueTransport,
+                                           run_decode_role, run_disagg,
+                                           run_prefill_role)
 from apex_example_tpu.serve.engine import (ServeEngine, SlotFailure,
                                            request_complete_record,
                                            request_failed_record)
@@ -32,8 +44,10 @@ from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
 from apex_example_tpu.serve.slots import BlockAllocator, BlockPool, Slot
 
 __all__ = [
-    "BlockAllocator", "BlockPool", "Completion", "Request",
+    "BlockAllocator", "BlockPool", "Completion", "FileTransport",
+    "KvHandoff", "QueueTransport", "Request",
     "RequestQueue", "STATUSES", "ServeEngine", "Slot", "SlotFailure",
     "parse_range", "request_complete_record", "request_failed_record",
-    "substream", "synthetic_requests",
+    "run_decode_role", "run_disagg", "run_prefill_role", "substream",
+    "synthetic_requests",
 ]

@@ -1,0 +1,356 @@
+"""Disaggregated prefill/decode serving: roles + KV-handoff transport.
+
+The interleaved engine (serve/engine.py, role "both") runs prefill
+chunks and decode steps through ONE [SLOTS, block_size]-wide compiled
+program: a tick that advances three long prefills and one decoding slot
+charges the decoding slot the full chunked-prefill geometry.  The
+production-TPU serving shape (the Gemma serving paper, PAPERS.md)
+splits the two phases onto separate worker pools instead:
+
+- a **prefill worker** (role "prefill") admits fresh requests, chunk-
+  prefills each prompt into its local paged arena, samples the FIRST
+  token, then terminates the request locally with status "handoff",
+  shipping its KV blocks through a transport;
+- a **decode worker** (role "decode") scatters each payload into its
+  own arena (``BlockPool.admit_prefilled``) and decodes from there —
+  its compiled step is [SLOTS, 1]-wide, so decode ticks stop paying
+  for prefill lanes entirely.  TPOT on the decode role beats the
+  interleaved baseline because every one of its ticks is the cheap
+  program.
+
+The handoff payload (:class:`KvHandoff`) is storage-dtype-exact: int8
+arenas ship int8 rows plus their bf16 per-token block scales
+(quant/kv.py), full-precision arenas ship full-precision rows.  The
+copy is deep by construction — a COW-shared prefix block's bytes are
+gathered out of the arena, so refcounts on the prefill side stay
+consistent (the shared block parks in the reusable cache at eviction)
+and the decode side can never alias it.
+
+Transports:
+
+- :class:`QueueTransport` — in-process deque, what the tier-1
+  comparison test and :func:`run_disagg` drive;
+- :class:`FileTransport` — a spool directory of ``handoff-*.npz``
+  files written atomically (tmp + rename) plus a ``close.json``
+  sentinel, connecting a ``serve.py --role prefill`` process to a
+  ``--role decode`` process with no shared memory.  Files survive on
+  disk until the consumer ACKS them at admission, so a decode worker
+  stopped at a --steps cap (or before admitting) leaves its
+  unadmitted handoffs re-servable; a worker that dies between ack and
+  terminal status still loses those in-flight requests (the fleet
+  stratum's exactly-once machinery is the inbox/outbox protocol, not
+  this spool — compose them by fronting each role with a router).
+
+Determinism: handoffs are sequence-numbered at send time and admitted
+in that order; a payload that exceeds the decode worker's free blocks
+is REQUEUED at the head (``admit_handoff`` returns False leaving no
+state behind) and retried after evictions free capacity — never
+dropped, never a crash.
+
+Both sides emit schema-v12 ``kv_handoff`` records (direction out/in);
+``tools/ci_gate.py --disagg-stream`` checks a recorded pair of role
+streams for conservation (zero lost handoffs) and
+``tools/serve_report.py`` renders the HANDOFF latency line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_example_tpu.serve.queue import Completion, Request
+
+
+@dataclass
+class KvHandoff:
+    """One request's prefilled KV state in flight between roles.
+
+    ``tokens`` is the full token list so far — the prompt plus the
+    prefill worker's first sampled token; ``fill`` counts tokens whose
+    KV the payload covers (== prompt length); ``payload`` maps each
+    arena leaf's path string to a ``[n_blocks, block_size, ...]`` host
+    array in the leaf's storage dtype."""
+
+    uid: str
+    request: Request
+    tokens: List[int]
+    fill: int
+    block_size: int
+    kv_dtype: str
+    payload: Dict[str, np.ndarray]
+    payload_bytes: int
+    t_out_wall: float
+    src: str = ""
+    requeued: int = 0       # deferred-admission episodes, decode side
+    # prefill-side latency trail (wall-independent, for the kv_handoff
+    # record): the request's measured TTFT/queue wait up to handoff.
+    ttft_ms: Optional[float] = None
+    queue_wait_ms: Optional[float] = None
+    spool_file: Optional[str] = None   # FileTransport bookkeeping
+
+
+class QueueTransport:
+    """In-process handoff channel: FIFO, closed explicitly by the
+    prefill side once its workload is drained."""
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._closed = False
+        self.sent = 0
+
+    def send(self, handoff: KvHandoff) -> None:
+        if self._closed:
+            raise RuntimeError("transport is closed")
+        self.sent += 1
+        self._q.append(handoff)
+
+    def poll(self) -> List[KvHandoff]:
+        out = list(self._q)
+        self._q.clear()
+        return out
+
+    def ack(self, handoff: KvHandoff) -> None:
+        """Admission consumed the handoff (no-op in process: nothing
+        outlives the deque)."""
+
+    def close(self) -> None:
+        self._closed = True
+
+    def finished(self) -> bool:
+        """No more handoffs will ever arrive (closed and drained)."""
+        return self._closed and not self._q
+
+
+class FileTransport:
+    """File-spool handoff channel between role processes.
+
+    The prefill side writes ``handoff-<seq>-<uid>.npz`` (payload arrays
+    plus a JSON meta member) via tmp-file + atomic rename, then a
+    ``close.json`` sentinel carrying the total count.  The decode side
+    polls the directory, loads files in sequence order exactly once and
+    deletes them.  Single producer, single consumer."""
+
+    SENTINEL = "close.json"
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._seq = 0
+        self.sent = 0
+        self._expected: Optional[int] = None
+        self._consumed = 0
+        self._loaded: set = set()
+
+    def pending_on_disk(self) -> int:
+        """Spool files not yet acked — what a stopped decode worker
+        leaves behind for the next one (serve.py counts these as
+        stranded at a --steps cap)."""
+        return sum(1 for n in os.listdir(self.path)
+                   if n.startswith("handoff-") and n.endswith(".npz"))
+
+    # ------------------------------------------------------ prefill side
+
+    def send(self, handoff: KvHandoff) -> None:
+        name = f"handoff-{self._seq:06d}-{handoff.uid}.npz"
+        self._seq += 1
+        req = handoff.request
+        meta = {
+            "uid": handoff.uid,
+            "tokens": [int(t) for t in handoff.tokens],
+            "fill": handoff.fill,
+            "block_size": handoff.block_size,
+            "kv_dtype": handoff.kv_dtype,
+            "payload_bytes": handoff.payload_bytes,
+            "t_out_wall": handoff.t_out_wall,
+            "src": handoff.src,
+            "keys": list(handoff.payload.keys()),
+            "request": {
+                "prompt": [int(t) for t in req.prompt],
+                "max_new_tokens": req.max_new_tokens,
+                "temperature": req.temperature,
+                "top_k": req.top_k,
+                "eos_id": req.eos_id,
+            },
+        }
+        arrays = {f"a{i}": handoff.payload[k].view(np.uint8)
+                  if handoff.payload[k].dtype.kind == "V"
+                  else handoff.payload[k]
+                  for i, k in enumerate(meta["keys"])}
+        # bfloat16 has no portable npz spelling; ship raw bytes plus
+        # the dtype names needed to reinterpret on the other side.
+        meta["dtypes"] = [str(handoff.payload[k].dtype)
+                          for k in meta["keys"]]
+        meta["shapes"] = [list(handoff.payload[k].shape)
+                          for k in meta["keys"]]
+        tmp = os.path.join(self.path, f".tmp-{name}")
+        with open(tmp, "wb") as fh:
+            np.savez(fh, meta=np.frombuffer(
+                json.dumps(meta).encode(), np.uint8), **arrays)
+        os.replace(tmp, os.path.join(self.path, name))
+        self.sent += 1
+
+    def close(self) -> None:
+        tmp = os.path.join(self.path, ".tmp-" + self.SENTINEL)
+        with open(tmp, "w") as fh:
+            json.dump({"handoffs": self.sent, "time": time.time()}, fh)
+        os.replace(tmp, os.path.join(self.path, self.SENTINEL))
+
+    # ------------------------------------------------------- decode side
+
+    def poll(self) -> List[KvHandoff]:
+        """Load every not-yet-loaded spool file, in sequence order.
+        Files stay ON DISK until the consumer acks them (admission
+        succeeded or the handoff terminated) — a decode worker stopped
+        at a --steps cap leaves its unadmitted handoffs in the spool,
+        re-servable by the next worker, instead of silently discarding
+        them.  A torn write is impossible (atomic rename); a broken
+        file is a real bug and raises."""
+        out = []
+        names = sorted(n for n in os.listdir(self.path)
+                       if n.startswith("handoff-") and n.endswith(".npz")
+                       and n not in self._loaded)
+        for name in names:
+            out.append(self._load(os.path.join(self.path, name)))
+            out[-1].spool_file = name
+            self._loaded.add(name)
+        return out
+
+    def ack(self, handoff: KvHandoff) -> None:
+        """The consumer owns the handoff now (admitted or terminally
+        rejected): drop its spool file."""
+        name = handoff.spool_file
+        if name:
+            try:
+                os.remove(os.path.join(self.path, name))
+            except FileNotFoundError:
+                pass
+            handoff.spool_file = None
+        self._consumed += 1
+
+    def _load(self, full: str) -> KvHandoff:
+        import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+        with np.load(full) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            payload = {}
+            for i, key in enumerate(meta["keys"]):
+                arr = z[f"a{i}"]
+                want = np.dtype(meta["dtypes"][i])
+                if arr.dtype != want:
+                    arr = arr.view(want)
+                payload[key] = arr.reshape(meta["shapes"][i])
+        spec = meta["request"]
+        req = Request(prompt=spec["prompt"],
+                      max_new_tokens=int(spec["max_new_tokens"]),
+                      temperature=float(spec.get("temperature", 0.0)),
+                      top_k=int(spec.get("top_k", 0)),
+                      eos_id=spec.get("eos_id"),
+                      uid=meta["uid"])
+        return KvHandoff(
+            uid=meta["uid"], request=req, tokens=meta["tokens"],
+            fill=int(meta["fill"]), block_size=int(meta["block_size"]),
+            kv_dtype=meta["kv_dtype"],
+            payload=payload,
+            payload_bytes=int(meta["payload_bytes"]),
+            t_out_wall=float(meta["t_out_wall"]),
+            src=meta.get("src", ""))
+
+    def finished(self) -> bool:
+        sentinel = os.path.join(self.path, self.SENTINEL)
+        if self._expected is None and os.path.exists(sentinel):
+            with open(sentinel) as fh:
+                self._expected = int(json.load(fh)["handoffs"])
+        return self._expected is not None \
+            and self._consumed >= self._expected
+
+
+# ------------------------------------------------------------ drive loops
+
+
+def run_prefill_role(engine, transport, max_steps: Optional[int] = None,
+                     idle_wait_s: float = 0.0, stop=None,
+                     on_tick=None) -> List[Completion]:
+    """Drive a prefill-role engine over its (already submitted) queue,
+    then close the transport — the decode side's end-of-stream signal.
+    The engine itself ships each handoff at first-token time
+    (``handoff_sink`` is the transport's ``send``)."""
+    comps = engine.run(max_steps=max_steps, idle_wait_s=idle_wait_s,
+                       stop=stop, on_tick=on_tick)
+    transport.close()
+    return comps
+
+
+def run_decode_role(engine, transport, max_steps: Optional[int] = None,
+                    idle_wait_s: float = 0.0, stop=None,
+                    on_tick=None) -> List[Completion]:
+    """Drive a decode-role engine off a transport: poll for handoffs,
+    admit them IN ORDER (a handoff the pool cannot fit yet stays at the
+    head and is retried next tick — deterministic requeue, never a
+    drop), tick while there is work, exit once the transport is
+    finished and every admitted request terminated."""
+    engine.queue.close()               # decode-role intake is the transport
+    pending: deque = deque()
+    while max_steps is None or engine.step_count < max_steps:
+        if stop is not None and stop():
+            break
+        pending.extend(transport.poll())
+        while pending and engine.admit_handoff(pending[0]):
+            transport.ack(pending.popleft())
+        has_work = engine.pool.any_live()
+        if has_work:
+            engine.step()
+        if on_tick is not None:
+            on_tick(engine)
+        if not has_work:
+            if transport.finished() and not pending:
+                break
+            if idle_wait_s:
+                time.sleep(idle_wait_s)
+    return engine.completions
+
+
+def run_disagg(prefill_engine, decode_engine, requests,
+               max_ticks: int = 10000
+               ) -> Tuple[List[Completion], List[Completion]]:
+    """In-process disaggregated run: one prefill engine and one decode
+    engine over a :class:`QueueTransport`, ticked in lockstep (each
+    engine only when it has work, so the combined tick count is
+    comparable with an interleaved baseline's).  Returns
+    ``(prefill_completions, decode_completions)``; the caller checks
+    conservation (every handoff uid terminates on the decode side)."""
+    transport = prefill_engine.handoff_sink.__self__ \
+        if hasattr(prefill_engine.handoff_sink, "__self__") else None
+    if not isinstance(transport, QueueTransport):
+        raise ValueError("run_disagg drives a QueueTransport pair: build "
+                         "the prefill engine with handoff_sink="
+                         "transport.send")
+    prefill_engine.queue.submit_all(requests)
+    prefill_engine.queue.close()
+    decode_engine.queue.close()
+    pending: deque = deque()
+    ticks = 0
+    while ticks < max_ticks:
+        p_active = not (prefill_engine.queue.drained()
+                        and not prefill_engine.pool.any_live())
+        if p_active:
+            prefill_engine.step()
+            ticks += 1
+        pending.extend(transport.poll())
+        while pending and decode_engine.admit_handoff(pending[0]):
+            transport.ack(pending.popleft())
+        if decode_engine.pool.any_live():
+            decode_engine.step()
+            ticks += 1
+        if not p_active and not pending \
+                and not decode_engine.pool.any_live():
+            break
+    else:
+        raise RuntimeError(f"disagg run did not converge within "
+                           f"{max_ticks} ticks")
+    transport.close()
+    return prefill_engine.completions, decode_engine.completions

@@ -52,6 +52,21 @@ cost of host-side scheduling, mirroring the telemetry layer's stance on
 device fetches: the batch geometry stays static, so the compiled program
 never changes — the TPU-native substrate for a serving engine.
 
+Sharding (ISSUE 14): under a registered parallel_state mesh the engine
+serves TP-sharded — weights placed per the training TP layers'
+partition metadata and every paged-KV arena head-sharded over 'model'
+(serve/slots.BlockPool.shard), while the block tables, free-list
+allocator and admission logic above stay host-side and replicated.
+The step lowers once per geometry with GSPMD shardings and greedy
+output stays token-identical to the dense path.
+
+Roles (ISSUE 14, serve/disagg.py): ``role="prefill"`` terminates each
+request at its FIRST sampled token with status "handoff", shipping its
+KV blocks through ``handoff_sink``; ``role="decode"`` admits such
+handoffs (``admit_handoff``) and decodes with a [SLOTS, 1]-wide step —
+its ticks stop paying for prefill lanes entirely.  ``role="both"``
+(default) is the classic interleaved engine.
+
 Sampling is per-slot (temperature / top_k vectors through
 models/gpt.sample_tokens), so greedy and sampled requests batch together.
 """
@@ -136,6 +151,51 @@ def _slot_step(dec, dequant_weights: bool = False):
         return mut["cache"], nxt, finite
 
     return step
+
+
+def _current_mesh():
+    """The registered parallel_state mesh, or None when serving runs
+    unsharded (no mesh, or every axis trivial)."""
+    from apex_example_tpu.transformer import parallel_state
+    mesh = parallel_state.get_mesh()
+    if mesh is None or all(s <= 1 for s in mesh.shape.values()):
+        return None
+    return mesh
+
+
+def _shard_params(mesh, dec, params):
+    """Place ``params`` per the TP layers' partition metadata (heads/
+    vocab over 'model', everything else replicated) — the same
+    device_put the TP generate() test does, extended to quantized
+    trees: an int8/fp8 ``{qvalue, scale}`` leaf shards its qvalue like
+    the original kernel (same shape, same spec) with the per-channel
+    scale replicated (small, and a replicated multiplicand fuses
+    cleanly into the sharded matmul)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_example_tpu.quant.weights import is_quantized_leaf
+    from apex_example_tpu.transformer.tensor_parallel.layers import (
+        param_partition_specs)
+    abs_vars = jax.eval_shape(dec.init, jax.random.PRNGKey(0),
+                              jnp.zeros((1, 4), jnp.int32))
+    specs = param_partition_specs(abs_vars)["params"]
+    spec_by_path = {
+        jax.tree_util.keystr(path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=is_quantized_leaf)
+    out = []
+    for path, leaf in flat:
+        spec = spec_by_path.get(jax.tree_util.keystr(path), P())
+        if is_quantized_leaf(leaf):
+            out.append({
+                "qvalue": jax.device_put(leaf["qvalue"],
+                                         NamedSharding(mesh, spec)),
+                "scale": jax.device_put(leaf["scale"],
+                                        NamedSharding(mesh, P()))})
+        else:
+            out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _weight_dtype_name(mode: str, params) -> str:
@@ -233,10 +293,18 @@ class ServeEngine:
                  queue: Optional[RequestQueue] = None,
                  sink=None, run_id: Optional[str] = None,
                  fault=None, registry=None, kv_quant: bool = False,
-                 weight_quant: str = "none"):
+                 weight_quant: str = "none", role: str = "both",
+                 handoff_sink=None):
         if weight_quant not in ("none", "int8", "fp8"):
             raise ValueError(f"weight_quant must be none|int8|fp8, got "
                              f"{weight_quant!r}")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, got "
+                             f"{role!r}")
+        if role == "prefill" and handoff_sink is None:
+            raise ValueError("a prefill-role engine needs a "
+                             "handoff_sink to ship finished prefills to "
+                             "(serve/disagg.py transports)")
         self.pool = BlockPool(model, num_slots, max_len,
                               block_size=block_size,
                               num_blocks=num_blocks, kv_quant=kv_quant)
@@ -245,6 +313,41 @@ class ServeEngine:
         # job is to dequantize inside the compiled step.
         self.weight_quant = weight_quant
         self.vocab_size = int(model.vocab_size)
+        # Disaggregation (ISSUE 14): a "prefill" engine chunk-prefills
+        # prompts, samples each request's FIRST token, then ships its
+        # KV blocks through ``handoff_sink`` (status "handoff"); a
+        # "decode" engine admits those payloads via admit_handoff() and
+        # decodes ONE token per live slot per tick — its compiled step
+        # is [SLOTS, 1]-wide, so decode ticks stop paying for the
+        # [SLOTS, block_size] prefill geometry entirely.  "both" is the
+        # classic interleaved engine.
+        self.role = role
+        self.handoff_sink = handoff_sink
+        self.chunk = 1 if role == "decode" else self.pool.block_size
+        self.handoffs_in = 0
+        self.handoff_requeued = 0
+        self._handoff_bytes = 0
+        self._handoff_ms: List[float] = []
+        # Mesh awareness: under a registered parallel_state mesh the
+        # weights and per-layer KV arenas shard over heads on the
+        # 'model' axis (the bert/gpt constraint points from the TP
+        # training path do the in-trace work); block tables, free-list
+        # and admission stay host-side and replicated.  The compiled
+        # step lowers ONCE per geometry with GSPMD shardings; pallas
+        # kernels are opaque to the partitioner, so sharded calls pin
+        # the XLA reference ops exactly like generate() under TP.
+        self.mesh = None
+        self.dp = self.tp = 1
+        mesh = _current_mesh()
+        if mesh is not None:
+            from apex_example_tpu.parallel.mesh import (
+                DATA_AXIS, require_model_axis_match)
+            self.tp = require_model_axis_match(
+                mesh, bool(model.tensor_parallel))
+            self.dp = mesh.shape.get(DATA_AXIS, 1)
+            self.mesh = mesh
+            params = _shard_params(mesh, self.pool.dec, params)
+            self.pool.shard(mesh)
         self.params = params
         self.queue = queue if queue is not None else RequestQueue()
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -261,9 +364,13 @@ class ServeEngine:
         # installed, the decode step compiles through the AOT path and
         # that ONE compilation lands as compile_event + cost_model
         # records — the batch geometry is static, so a second
-        # compile_event for this name is a recompile regression.
+        # compile_event for this name is a recompile regression.  The
+        # prefill role instruments under its own name: its program is
+        # [SLOTS, block_size]-wide while the decode role's is
+        # [SLOTS, 1]-wide — one program per role, each compiling once.
         self._step_fn = costmodel_lib.instrument(
-            "serve_decode_step",
+            "serve_prefill_step" if role == "prefill"
+            else "serve_decode_step",
             _slot_step(self.pool.dec,
                        dequant_weights=weight_quant != "none"))
         self._t0 = time.perf_counter()
@@ -396,7 +503,13 @@ class ServeEngine:
             tracer.complete("admit", now, t_admit_end - now,
                             tid="engine", cat="tick",
                             parent_id=tick_sid)
-        S, C = pool.num_slots, pool.block_size
+        # Chunk width: block_size for interleaved/prefill engines, ONE
+        # for a decode-role engine — its slots only ever feed a single
+        # token per tick (handoffs arrive pre-filled), so its compiled
+        # step drops the prefill lanes and each decode tick pays
+        # 1/block_size of the interleaved program's token FLOPs: the
+        # decode-tick stall the disaggregation removes.
+        S, C = pool.num_slots, self.chunk
         tok = np.zeros((S, C), np.int32)
         fill = np.zeros((S,), np.int32)
         n_new = np.zeros((S,), np.int32)
@@ -419,12 +532,26 @@ class ServeEngine:
             temps[i] = slot.request.temperature
             ks[i] = slot.request.top_k
         self.rng, key = jax.random.split(self.rng)
-        pool.cache, nxt, finite = self._step_fn(
-            self.params, pool.cache, jnp.asarray(tok),
-            jnp.asarray(pool.table), jnp.asarray(fill),
-            jnp.asarray(n_new), jnp.asarray(cow_src),
-            jnp.asarray(cow_dst), key,
-            jnp.asarray(temps), jnp.asarray(ks))
+        if self.mesh is not None:
+            # Pallas custom calls are opaque to the SPMD partitioner;
+            # pin the XLA reference ops for the sharded trace exactly
+            # like generate() under TP (the compiled program is cached,
+            # so this costs nothing after the first call).
+            from apex_example_tpu.ops import _config as ops_config
+            with ops_config.force_xla():
+                pool.cache, nxt, finite = self._step_fn(
+                    self.params, pool.cache, jnp.asarray(tok),
+                    jnp.asarray(pool.table), jnp.asarray(fill),
+                    jnp.asarray(n_new), jnp.asarray(cow_src),
+                    jnp.asarray(cow_dst), key,
+                    jnp.asarray(temps), jnp.asarray(ks))
+        else:
+            pool.cache, nxt, finite = self._step_fn(
+                self.params, pool.cache, jnp.asarray(tok),
+                jnp.asarray(pool.table), jnp.asarray(fill),
+                jnp.asarray(n_new), jnp.asarray(cow_src),
+                jnp.asarray(cow_dst), key,
+                jnp.asarray(temps), jnp.asarray(ks))
         nxt = np.asarray(nxt)          # the scheduler's host sync
         finite = np.asarray(finite)
         now = time.perf_counter()
@@ -512,6 +639,14 @@ class ServeEngine:
             # already-evicted slot.
             if reason is not None:
                 self._finish(i, reason, now)
+            elif self.role == "prefill" and slot.n_generated == 1:
+                # Prefill role: the prompt is fully cached and the
+                # FIRST token sampled — ship the KV blocks to a decode
+                # worker instead of occupying a prefill slot with
+                # 1-token decode ticks.  (A request whose first token
+                # already finished it — eos, or a 1-token budget —
+                # completed above and never transits.)
+                self._handoff_slot(i, now)
         self.compute_steps += 1
         self._occupancy_sum += len(live)
         # Gauge the tick AFTER harvest: what is RESIDENT at the tick
@@ -587,7 +722,9 @@ class ServeEngine:
         self.counts[status] += 1
         self._trace_request(comp, slot_blocks=slot.n_mapped)
         self.pool.evict(idx)
-        if self.sink is not None:
+        if self.sink is not None and status != "handoff":
+            # A handoff's record is the kv_handoff _handoff_slot wrote
+            # (the request is continuing elsewhere, not failing here).
             record = request_complete_record if status == "ok" \
                 else request_failed_record
             self.sink.write(record(comp, self.run_id))
@@ -625,6 +762,114 @@ class ServeEngine:
         elif status in ("timeout", "cancelled", "failed", "rejected"):
             self.sink.write(request_failed_record(comp, self.run_id))
         # "drained": accounted by the serve_drain record, not per-request.
+
+    # --------------------------------------------------------- handoff
+
+    def _handoff_slot(self, idx: int, now: float) -> None:
+        """Prefill-role terminal: gather slot ``idx``'s KV blocks into a
+        :class:`~apex_example_tpu.serve.disagg.KvHandoff` (deep copy —
+        COW-shared prefix blocks ship as payload bytes, never as
+        references), emit the ``kv_handoff`` record (direction "out"),
+        evict the slot with status "handoff" and push the payload into
+        the transport.  Runs OUTSIDE the slot-isolation try like every
+        terminal transition."""
+        from apex_example_tpu.serve.disagg import KvHandoff
+        slot = self.pool.slots[idx]
+        req = slot.request
+        fill, n_blocks, payload = self.pool.extract_blocks(idx)
+        payload_bytes = sum(int(a.nbytes) for a in payload.values())
+        # The REAL first-token latency is measurable only here, where
+        # the first token was sampled — the decode side's timestamps
+        # live in its own clock domain, so they ride the out record.
+        ttft_ms = round((slot.t_first_token - req.t_arrival) * 1e3, 3) \
+            if slot.t_first_token is not None else None
+        queue_ms = round((slot.t_admitted - req.t_arrival) * 1e3, 3)
+        handoff = KvHandoff(
+            uid=req.uid, request=req, tokens=[int(t) for t in slot.tokens],
+            fill=fill, block_size=self.pool.block_size,
+            kv_dtype=self.pool.kv_dtype, payload=payload,
+            payload_bytes=payload_bytes, t_out_wall=_wall(),
+            src=self.role, ttft_ms=ttft_ms, queue_wait_ms=queue_ms)
+        self._handoff_bytes += payload_bytes
+        if self.sink is not None:
+            rec: Dict[str, Any] = {
+                "record": "kv_handoff", "time": _wall(),
+                "request_id": req.uid, "direction": "out",
+                "fill": fill, "blocks": n_blocks,
+                "payload_bytes": payload_bytes,
+                "kv_dtype": self.pool.kv_dtype,
+                "prompt_tokens": len(req.prompt),
+                "first_token": int(slot.tokens[-1]),
+                "queue_wait_ms": queue_ms,
+                "src": self.role}
+            if ttft_ms is not None:
+                rec["ttft_ms"] = ttft_ms
+            if self.run_id:
+                rec["run_id"] = self.run_id
+            self.sink.write(rec)
+        self._evict_terminal(idx, "handoff", "handoff", now)
+        self.handoff_sink(handoff)
+
+    def admit_handoff(self, handoff) -> bool:
+        """Decode-role intake: admit a prefill worker's KV handoff into
+        a slot, scattering its block payload into this engine's arena
+        and resuming at ``cursor == fill`` with the first token already
+        sampled.  Returns False — with NO state left behind — when a
+        free slot or the worst-case block budget is missing right now:
+        the caller requeues the same handoff deterministically and
+        retries after evictions free capacity.  A handoff this engine
+        could NEVER serve terminates first-class as "rejected" and
+        returns True (consumed)."""
+        req = handoff.request
+        if self.draining:
+            return False             # drain stopped admission (requeue)
+        if handoff.block_size != self.pool.block_size:
+            raise ValueError(
+                f"handoff block_size {handoff.block_size} vs engine "
+                f"{self.pool.block_size} — prefill and decode roles "
+                "must share the arena geometry")
+        if not self.pool.fits(req):
+            self._terminal_unadmitted(req, "rejected")
+            return True
+        if not self.pool.can_admit_prefilled(req):
+            if not handoff.requeued:
+                # Counted once per handoff (an episode, not a retry
+                # tally — the caller retries every tick and the wait
+                # itself shows up in handoff_ms).
+                handoff.requeued = 1
+                self.handoff_requeued += 1
+            return False
+        now = time.perf_counter()
+        idx = self.pool.admit_prefilled(req, self.step_count,
+                                        handoff.fill, handoff.payload,
+                                        handoff.tokens)
+        slot = self.pool.slots[idx]
+        slot.n_generated = len(handoff.tokens) - len(req.prompt)
+        slot.t_first_token = now
+        self.handoffs_in += 1
+        self._handoff_bytes += handoff.payload_bytes
+        transit_ms = max((_wall() - handoff.t_out_wall) * 1e3, 0.0)
+        self._handoff_ms.append(transit_ms)
+        if self._tracer is not None:
+            self._rtrace[req.uid] = []
+        if self.sink is not None:
+            rec: Dict[str, Any] = {
+                "record": "kv_handoff", "time": _wall(),
+                "request_id": req.uid, "direction": "in",
+                "fill": handoff.fill, "blocks": slot.n_mapped,
+                "payload_bytes": handoff.payload_bytes,
+                "kv_dtype": self.pool.kv_dtype,
+                "prompt_tokens": len(req.prompt),
+                "first_token": int(handoff.tokens[-1]),
+                "handoff_ms": round(transit_ms, 3),
+                "requeued": handoff.requeued,
+                "dst": self.role}
+            if handoff.src:
+                rec["src"] = handoff.src
+            if self.run_id:
+                rec["run_id"] = self.run_id
+            self.sink.write(rec)
+        return True
 
     # ----------------------------------------------------------- trace
 
@@ -772,7 +1017,10 @@ class ServeEngine:
         duration = time.perf_counter() - self._t0
         comps = self.completions
         ok = [c for c in comps if c.status == "ok"]
-        owned = len(comps) - self.counts["drained"]
+        # Drained AND handed-off requests continue elsewhere — both sit
+        # outside the availability denominator (v12).
+        owned = len(comps) - self.counts["drained"] \
+            - self.counts["handoff"]
         pool = self.pool
         rec: Dict[str, Any] = {
             "record": "serve_summary",
@@ -808,7 +1056,24 @@ class ServeEngine:
                                                self.params),
             "kv_bytes_per_token": pool.kv_bytes_per_token(),
             "kv_bytes_per_token_bf16": pool.kv_bytes_per_token_bf16(),
+            # v12 (ISSUE 14): which part of the disaggregated topology
+            # this engine played, and under which mesh.
+            "role": self.role,
         }
+        if self.mesh is not None:
+            rec["mesh"] = f"data={self.dp},model={self.tp}"
+            rec["dp"] = self.dp
+            rec["tp"] = self.tp
+        if self.counts["handoff"]:
+            rec["handoffs_out"] = self.counts["handoff"]
+        if self.handoffs_in:
+            rec["handoffs_in"] = self.handoffs_in
+        if self.handoff_requeued:
+            rec["handoff_requeued"] = self.handoff_requeued
+        if self._handoff_bytes:
+            rec["handoff_bytes"] = self._handoff_bytes
+        if self._handoff_ms:
+            rec["handoff_ms"] = _pct_dict(self._handoff_ms)
         if self.compute_steps:
             rec["occupancy"] = round(
                 self._occupancy_sum / (self.compute_steps

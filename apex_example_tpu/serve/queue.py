@@ -56,9 +56,13 @@ _uid = itertools.count()
 # "rejected" means admission determined the request can NEVER be served
 # by this engine (prompt fills the whole cache so the output budget is
 # zero, or the worst-case block need exceeds the arena) — terminated
-# first-class at admission instead of occupying a slot to emit nothing.
+# first-class at admission instead of occupying a slot to emit nothing;
+# "handoff" means a prefill-role engine finished the prompt, sampled
+# the first token and shipped the request's KV blocks to a decode
+# worker (serve/disagg.py) — like "drained", the request continues
+# elsewhere, so it sits outside the availability denominator.
 STATUSES = ("ok", "timeout", "shed", "cancelled", "failed", "drained",
-            "rejected")
+            "rejected", "handoff")
 
 
 def _next_uid() -> str:

@@ -43,6 +43,7 @@ produces those logits.
 
 from __future__ import annotations
 
+import functools
 import math
 import time
 from collections import OrderedDict
@@ -65,6 +66,29 @@ _SCALE_LEAVES = ("cached_key_scale", "cached_value_scale")
 def _leaf_name(path) -> str:
     last = path[-1]
     return getattr(last, "key", getattr(last, "name", str(last)))
+
+
+def _path_str(path) -> str:
+    """Stable string key for one cache leaf path — the identity KV
+    handoff payloads are keyed by on both sides of the transport."""
+    return "/".join(getattr(p, "key", getattr(p, "name", str(p)))
+                    for p in path)
+
+
+@functools.lru_cache(maxsize=8)
+def _fused_block_scatter(shapes):
+    """ONE jitted scatter writing a handoff payload into every arena
+    leaf in a single dispatch (cached per geometry — ``shapes`` is the
+    arena leaf shape tuple, so every admission at one geometry reuses
+    one executable).  Out-of-range pad lanes drop."""
+    del shapes                        # cache key only; shapes ride args
+
+    @jax.jit
+    def scatter(leaves, idx, rows):
+        return tuple(l.at[idx].set(r, mode="drop")
+                     for l, r in zip(leaves, rows))
+
+    return scatter
 
 
 @dataclass
@@ -324,6 +348,36 @@ class BlockPool:
         self.cow_copies = 0
         self._shared_tokens = 0
         self._prompt_tokens = 0
+        self._mesh = None                    # set by shard(mesh)
+
+    # --------------------------------------------------------- sharding
+
+    def shard(self, mesh) -> None:
+        """TP-shard the arenas over the mesh's ``model`` axis: every
+        [NB, BS, H, D] payload leaf is placed head-sharded (the same
+        layout the dense decode cache uses under TP), scale tables
+        replicated.  The block tables, free list and admission logic
+        stay host-side and replicated — sharding is a placement of the
+        SAME geometry, so allocation/COW/refcount policy is untouched
+        and the compiled step lowers once with GSPMD shardings."""
+        self._mesh = mesh
+
+        def put(path, leaf):
+            return jax.device_put(leaf, self._leaf_sharding(path))
+
+        self.cache = jax.tree_util.tree_map_with_path(put, self.cache)
+
+    def _leaf_sharding(self, path):
+        """The NamedSharding one cache leaf gets under the registered
+        mesh: heads over 'model' for arena payloads, replicated for
+        scale tables (and anything else)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from apex_example_tpu.parallel.mesh import MODEL_AXIS
+        if _leaf_name(path) in _PAGE_LEAVES:
+            return NamedSharding(self._mesh,
+                                 P(None, None, MODEL_AXIS, None))
+        return NamedSharding(self._mesh, P())
 
     # ------------------------------------------------------------ state
 
@@ -421,6 +475,138 @@ class BlockPool:
         self.table[idx, :] = 0
         self.slots[idx] = None
         self._free.append(idx)
+
+    # ------------------------------------------------------- KV handoff
+
+    def extract_blocks(self, idx: int) -> Tuple[int, int, Dict[str, "np.ndarray"]]:
+        """Gather slot ``idx``'s mapped arena blocks for a KV handoff:
+        ``(fill, n_blocks, payload)`` where payload maps each arena
+        leaf's path string to a host ``[n_blocks, BS, ...]`` array in
+        the leaf's STORAGE dtype (int8 payload + bf16 scales under
+        kv_quant — the handoff moves low-bit bytes, never dequantizes).
+
+        The copy is deep by construction (``np.asarray`` of a device
+        gather): a payload built from COW-shared prefix blocks shares
+        nothing with the arena, so the receiver can never alias a
+        block another request still maps."""
+        slot = self.slots[idx]
+        if slot is None:
+            raise RuntimeError(f"slot {idx} is free — nothing to hand off")
+        n = slot.n_mapped
+        bids = jnp.asarray(np.ascontiguousarray(self.table[idx, :n]))
+        payload: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.cache)[0]:
+            if _leaf_name(path) in _PAGE_LEAVES + _SCALE_LEAVES:
+                # np.array (not asarray): an OWNED writable host copy —
+                # np.asarray of a jax array is a read-only view that
+                # would pin the gather buffer across the transport.
+                payload[_path_str(path)] = np.array(leaf[bids])
+        return slot.cursor, n, payload
+
+    def blocks_needed_prefilled(self, request: Request) -> int:
+        """Worst-case blocks a handed-off request needs on the RECEIVING
+        side: the full clamped sequence, no prefix sharing (the payload
+        blocks are scattered fresh)."""
+        return self.blocks_needed(request)
+
+    def can_admit_prefilled(self, request: Request) -> bool:
+        """Slot free AND the handed-off request's whole worst-case
+        block budget is coverable right now.  The deterministic-requeue
+        contract: a False here must leave NO state behind — the caller
+        retries the same handoff later."""
+        if not self._free:
+            return False
+        return self.alloc.available() - self._reserved_total \
+            >= self.blocks_needed_prefilled(request)
+
+    def admit_prefilled(self, request: Request, step: int, fill: int,
+                        payload: Dict[str, "np.ndarray"],
+                        tokens: List[int]) -> int:
+        """Admit a request whose first ``fill`` tokens of KV arrive as a
+        handoff payload: allocate the payload's blocks, scatter the
+        rows into this pool's own arenas (dtype-checked — an int8
+        payload must land in an int8 arena), seed the slot at
+        ``cursor == fill`` and reserve the rest of the worst-case
+        budget.  ``tokens`` is the full token list so far (prompt plus
+        the prefill worker's first sampled token).  The caller gates on
+        ``can_admit_prefilled`` first."""
+        if not self._free:
+            raise RuntimeError("no free slot (handoff admission must "
+                               "check can_admit_prefilled first)")
+        BS = self.block_size
+        n_pay = math.ceil(fill / BS)
+        total = self.blocks_needed_prefilled(request)
+        if n_pay > total:
+            raise ValueError(
+                f"{request.uid}: payload covers {n_pay} blocks but the "
+                f"clamped sequence only needs {total}")
+        bids = [self.alloc.alloc() for _ in range(n_pay)]
+        self._scatter_payload(bids, n_pay, payload)
+        idx = self._free.pop()
+        self.table[idx, :] = 0
+        self.table[idx, :n_pay] = bids
+        self.slots[idx] = Slot(request=request, admitted_step=step,
+                               t_admitted=time.perf_counter(),
+                               tokens=[int(t) for t in tokens],
+                               cursor=fill, shared_len=0,
+                               n_mapped=n_pay,
+                               reserved=total - n_pay,
+                               block_keys=[None] * n_pay)
+        self._reserved_total += total - n_pay
+        self._prompt_tokens += len(request.prompt)
+        return idx
+
+    def _scatter_payload(self, bids: List[int], n_pay: int,
+                         payload: Dict[str, "np.ndarray"]) -> None:
+        """Scatter handoff payload rows into this pool's arenas at the
+        freshly allocated ``bids``.  Indices and rows are padded to
+        ``max_blocks`` so ONE jitted scatter (all arena leaves fused
+        into a single dispatch — admission latency sits inside the
+        decode worker's TPOT window) serves every handoff size: pad
+        lanes index row NB and drop.  Under a registered mesh the
+        leaves are placed back on their arena shardings afterwards."""
+        pad = max(self.max_blocks, n_pay)
+        idx = np.full((pad,), self.num_blocks, np.int32)
+        idx[:n_pay] = bids
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(self.cache)
+        arena, rows_in, out = [], [], []
+        for path, leaf in leaves:
+            if _leaf_name(path) not in _PAGE_LEAVES + _SCALE_LEAVES:
+                continue
+            key = _path_str(path)
+            if key not in payload:
+                raise ValueError(
+                    f"handoff payload missing arena leaf {key!r} — "
+                    "prefill/decode geometry or kv_quant mismatch")
+            rows = payload[key]
+            if rows.shape[0] != n_pay or rows.shape[1:] != leaf.shape[1:]:
+                raise ValueError(
+                    f"handoff payload {key!r} shape {tuple(rows.shape)} "
+                    f"does not fit arena {tuple(leaf.shape)} "
+                    f"({n_pay} blocks)")
+            if str(rows.dtype) != str(leaf.dtype):
+                raise ValueError(
+                    f"handoff payload {key!r} dtype {rows.dtype} vs "
+                    f"arena {leaf.dtype} — the transport is "
+                    "storage-dtype-exact (int8 stays int8)")
+            padded = np.zeros((pad,) + tuple(rows.shape[1:]),
+                              dtype=rows.dtype)
+            padded[:n_pay] = rows
+            arena.append(leaf)
+            rows_in.append(padded)
+        new = _fused_block_scatter(tuple(a.shape for a in arena))(
+            tuple(arena), jnp.asarray(idx),
+            tuple(jnp.asarray(r) for r in rows_in))
+        it = iter(new)
+        for path, leaf in leaves:
+            if _leaf_name(path) in _PAGE_LEAVES + _SCALE_LEAVES:
+                leaf = next(it)
+                if self._mesh is not None:
+                    leaf = jax.device_put(leaf,
+                                          self._leaf_sharding(path))
+            out.append(leaf)
+        self.cache = jax.tree_util.tree_unflatten(treedef, out)
 
     def _alloc_for(self, slot: Slot) -> int:
         if slot.reserved < 1:

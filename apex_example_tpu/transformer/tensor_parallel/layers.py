@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from apex_example_tpu import _compat
 from apex_example_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from apex_example_tpu.transformer import parallel_state
 
@@ -45,8 +46,10 @@ def _manual_axes() -> frozenset:
     """Mesh axes the current trace is *manual* over (bound by an enclosing
     shard_map).  Empty outside shard_map.  Constraints must not name these:
     inside the body the arrays are per-shard slices and the axis is already
-    consumed by the shard_map's in_specs."""
-    am = jax.sharding.get_abstract_mesh()
+    consumed by the shard_map's in_specs.  (Routed through _compat: jax
+    versions without abstract meshes report no manual axes — the pure-
+    GSPMD TP paths this rig runs never have any.)"""
+    am = _compat.get_abstract_mesh()
     return frozenset(getattr(am, "manual_axes", ()) or ())
 
 
@@ -78,7 +81,7 @@ def constrain(x: jnp.ndarray, *spec) -> jnp.ndarray:
         tuple(filter(None, (live(a) for a in e))) or None
         if isinstance(e, tuple) else live(e)
         for e in spec)
-    target = jax.sharding.get_abstract_mesh() if manual else mesh
+    target = _compat.get_abstract_mesh() if manual else mesh
     return jax.lax.with_sharding_constraint(x, NamedSharding(target,
                                                              P(*spec)))
 

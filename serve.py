@@ -47,6 +47,22 @@ dtype-accurate vs bf16-equivalent per-token bytes (schema v11), and
 ``tools/ci_gate.py --quant-stream`` enforces the >= 1.9x compression
 floor over a recorded stream.
 
+Sharded + disaggregated serving (ISSUE 14; README "Sharded &
+disaggregated serving"): ``--mesh dp,tp`` registers a
+(data=dp, model=tp) device mesh and serves the Megatron-TP model —
+weights and per-layer paged-KV arenas shard over heads on 'model',
+block tables and admission stay host-side, the decode program lowers
+once with GSPMD shardings, and TP-served greedy output is
+token-identical to the dense path (int8 weights/KV included).
+``--role prefill`` chunk-prefills prompts, samples each request's
+first token and ships its KV blocks (storage-dtype-exact payloads +
+scales + fill levels) to the ``--handoff-dir`` spool; ``--role
+decode`` admits those payloads into its own arena and decodes with a
+[slots, 1]-wide step — so long prompts stop stalling decode ticks.
+Both sides emit schema-v12 ``kv_handoff`` records and
+``tools/ci_gate.py --disagg-stream`` checks a recorded pair for zero
+lost handoffs.
+
 Resilience (README "Serving resilience"; ISSUE 5): SIGTERM/SIGUSR1
 triggers a graceful drain — admission stops, queued requests are handed
 back with status "drained" (requeue-able on another replica), in-flight
@@ -187,6 +203,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "schema-v10 replica_state record (tick, queue "
                         "depth, blocks_live, pid) every S seconds on "
                         "the metrics stream")
+    p.add_argument("--mesh", default=None, metavar="DP,TP",
+                   help="serve TP-sharded: register a (data=DP, "
+                        "model=TP) device mesh — weights and per-layer "
+                        "paged-KV arenas shard over heads on 'model' "
+                        "(the training TP layout), block tables and "
+                        "admission stay host-side; the decode program "
+                        "compiles once with GSPMD shardings and greedy "
+                        "output stays token-identical to the dense "
+                        "path.  Needs DP*TP visible devices (virtual "
+                        "CPU devices via XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N)")
+    p.add_argument("--role", default="both",
+                   choices=["both", "prefill", "decode"],
+                   help="disaggregated serving (serve/disagg.py): "
+                        "'prefill' chunk-prefills prompts, samples each "
+                        "request's first token and ships its KV blocks "
+                        "to --handoff-dir; 'decode' admits those "
+                        "payloads and decodes with a [slots, 1]-wide "
+                        "step (no prefill lanes); 'both' is the classic "
+                        "interleaved engine")
+    p.add_argument("--handoff-dir", default=None, metavar="DIR",
+                   help="KV-handoff spool directory connecting a "
+                        "--role prefill process to a --role decode "
+                        "process (atomic npz files + a close sentinel)")
     p.add_argument("--weight-quant", default="none",
                    choices=["none", "int8", "fp8"],
                    help="quantize the restored weights for serving "
@@ -366,15 +406,32 @@ def run_serve(args):
 
     from apex_example_tpu import obs
     from apex_example_tpu.models.gpt import gpt_base, gpt_tiny
+    from apex_example_tpu.parallel.mesh import (parse_serve_mesh,
+                                                serve_mesh)
     from apex_example_tpu.resilience import (EX_TEMPFAIL, FaultPlan,
                                              PreemptionHandler)
     from apex_example_tpu.resilience.faults import SERVE_KINDS
-    from apex_example_tpu.serve import (Request, RequestQueue,
-                                        ServeEngine, parse_range,
+    from apex_example_tpu.serve import (FileTransport, Request,
+                                        RequestQueue, ServeEngine,
+                                        parse_range, run_decode_role,
                                         synthetic_requests)
+    from apex_example_tpu.transformer import parallel_state
     from apex_example_tpu.utils.checkpoint import restore_params
 
-    model = {"gpt_tiny": gpt_tiny, "gpt_base": gpt_base}[args.arch]()
+    mesh = None
+    dp = tp = 1
+    if args.mesh:
+        try:
+            dp, tp = parse_serve_mesh(args.mesh)
+            if dp * tp > 1:
+                mesh = serve_mesh(dp, tp)
+        except ValueError as e:
+            raise SystemExit(str(e))
+    # tp > 1 serves the Megatron-TP model (identical param tree — dense
+    # checkpoints restore unchanged; the layers' constraint points do
+    # the sharding).
+    model = {"gpt_tiny": gpt_tiny,
+             "gpt_base": gpt_base}[args.arch](tensor_parallel=tp > 1)
     max_len = args.max_len
     if max_len is None:
         max_len = min(model.max_position, 128)
@@ -410,6 +467,16 @@ def run_serve(args):
         raise SystemExit("--inbox and --outbox come together (the "
                          "fleet replica protocol: specs in, terminal "
                          "lines out)")
+    if args.role != "both" and not args.handoff_dir:
+        raise SystemExit("--role prefill/decode needs --handoff-dir "
+                         "(the KV-handoff spool both roles share)")
+    if args.handoff_dir and args.role == "both":
+        raise SystemExit("--handoff-dir only means something for a "
+                         "--role prefill or decode process")
+    if replica_mode and args.role != "both":
+        raise SystemExit("--role prefill/decode does not compose with "
+                         "the --inbox/--outbox replica protocol yet — "
+                         "front each role with its own router instead")
     if args.heartbeat_s <= 0:
         raise SystemExit(f"--heartbeat-s must be > 0, got "
                          f"{args.heartbeat_s}")
@@ -503,71 +570,110 @@ def run_serve(args):
 
     queue = RequestQueue(max_pending=args.max_pending,
                          shed_policy=args.shed_policy)
-    engine = ServeEngine(model, params, num_slots=args.slots,
-                         max_len=max_len, block_size=args.block_size,
-                         num_blocks=args.num_blocks,
-                         rng=jax.random.PRNGKey(args.seed),
-                         queue=queue, sink=sink, run_id=run_id,
-                         fault=fault,
-                         registry=emitter.registry if emitter else None,
-                         kv_quant=args.kv_quant,
-                         weight_quant=args.weight_quant)
-    outbox = feeder_stop = on_tick = None
-    idle_wait_s = 0.0
-    if replica_mode:
-        outbox = _Outbox(args.outbox)
-        feeder_stop = threading.Event()
-        threading.Thread(
-            target=_feed_inbox,
-            args=(args.inbox, queue, outbox, feeder_stop, Request),
-            name="inbox-feeder", daemon=True).start()
-        idle_wait_s = 0.004             # wall-clock producer: don't spin
+    transport = FileTransport(args.handoff_dir) if args.handoff_dir \
+        else None
+    # The mesh registers BEFORE the engine builds (construction shards
+    # the restored — possibly quantized — params and the paged arenas
+    # against it) and must STAY registered through the run: the TP
+    # layers' constrain() points read it at trace time.  The run
+    # section's finally clears it; a failure between here and that try
+    # (engine construction, replica-mode setup) clears it on the way
+    # out too, so an in-process caller (tests, supervisors) never
+    # inherits a stale mesh.
+    parallel_state.set_mesh(mesh)
+    try:
+        engine = ServeEngine(model, params, num_slots=args.slots,
+                             max_len=max_len, block_size=args.block_size,
+                             num_blocks=args.num_blocks,
+                             rng=jax.random.PRNGKey(args.seed),
+                             queue=queue, sink=sink, run_id=run_id,
+                             fault=fault,
+                             registry=emitter.registry if emitter
+                             else None,
+                             kv_quant=args.kv_quant,
+                             weight_quant=args.weight_quant,
+                             role=args.role,
+                             handoff_sink=transport.send
+                             if args.role == "prefill" else None)
+        outbox = feeder_stop = on_tick = None
+        idle_wait_s = 0.0
+        if replica_mode:
+            outbox = _Outbox(args.outbox)
+            feeder_stop = threading.Event()
+            threading.Thread(
+                target=_feed_inbox,
+                args=(args.inbox, queue, outbox, feeder_stop, Request),
+                name="inbox-feeder", daemon=True).start()
+            idle_wait_s = 0.004             # wall-clock producer: don't spin
 
-        def _beat(state: str) -> None:
-            if sink is None:
-                return
-            sink.write({"record": "replica_state", "time": time.time(),
-                        "replica": args.replica_id, "state": state,
-                        "tick": engine.step_count,
-                        "pending": engine.queue.pending(),
-                        "blocks_live": engine.pool.blocks_live(),
-                        "pid": os.getpid(), "run_id": run_id})
+            def _beat(state: str) -> None:
+                if sink is None:
+                    return
+                # v12: kv_bytes_live is the dtype-accurate gauge (int8
+                # arenas count int8 bytes + scales) — what the fleet
+                # router's least_kv policy prefers over the raw block
+                # count when replicas mix precisions.
+                sink.write({"record": "replica_state", "time": time.time(),
+                            "replica": args.replica_id, "state": state,
+                            "tick": engine.step_count,
+                            "pending": engine.queue.pending(),
+                            "blocks_live": engine.pool.blocks_live(),
+                            "kv_bytes_live": engine.pool.kv_bytes_live(),
+                            "pid": os.getpid(), "run_id": run_id})
 
-        last_beat = [0.0]
+            last_beat = [0.0]
 
-        def on_tick(eng) -> None:
-            outbox.flush_from(eng)
-            now = time.time()
-            if now - last_beat[0] >= args.heartbeat_s:
-                last_beat[0] = now
-                _beat("serving")
-    else:
-        requests = synthetic_requests(
-            args.requests, vocab_size=model.vocab_size, seed=args.seed,
-            prompt_len=prompt_len, max_new=max_new,
-            temperature=args.temperature, top_k=args.top_k,
-            eos_id=args.eos_id, stagger=args.stagger, burst=args.burst,
-            deadline_steps=args.deadline_steps,
-            deadline_s=args.deadline_s,
-            shared_prefix=args.shared_prefix,
-            seed_substream=args.seed_substream)
-        engine.queue.submit_all(requests)
-        engine.queue.close()
+            def on_tick(eng) -> None:
+                outbox.flush_from(eng)
+                now = time.time()
+                if now - last_beat[0] >= args.heartbeat_s:
+                    last_beat[0] = now
+                    _beat("serving")
+        elif args.role != "decode":
+            # A decode-role engine's intake is the handoff transport, not a
+            # workload of its own (run_decode_role closes the queue).
+            requests = synthetic_requests(
+                args.requests, vocab_size=model.vocab_size, seed=args.seed,
+                prompt_len=prompt_len, max_new=max_new,
+                temperature=args.temperature, top_k=args.top_k,
+                eos_id=args.eos_id, stagger=args.stagger, burst=args.burst,
+                deadline_steps=args.deadline_steps,
+                deadline_s=args.deadline_s,
+                shared_prefix=args.shared_prefix,
+                seed_substream=args.seed_substream)
+            engine.queue.submit_all(requests)
+            engine.queue.close()
 
-    pool = engine.pool
-    workload = f"{args.requests} request(s)" if not replica_mode \
-        else f"replica {args.replica_id} (inbox-fed)"
-    print(f"serve: {workload}  arch={args.arch}  "
-          f"slots={args.slots}  max_len={max_len}  "
-          f"blocks={pool.num_blocks}x{pool.block_size}  "
-          f"params from {source}")
+        pool = engine.pool
+        if replica_mode:
+            workload = f"replica {args.replica_id} (inbox-fed)"
+        elif args.role == "decode":
+            workload = f"decode role (handoffs from {args.handoff_dir})"
+        else:
+            workload = f"{args.requests} request(s)"
+        shard = f"  mesh=data={dp},model={tp}" if mesh is not None else ""
+        print(f"serve: {workload}  arch={args.arch}  role={args.role}  "
+              f"slots={args.slots}  max_len={max_len}  "
+              f"blocks={pool.num_blocks}x{pool.block_size}{shard}  "
+              f"params from {source}")
+    except BaseException:
+        parallel_state.set_mesh(None)
+        raise
     rc = 0
     try:
-        completions = engine.run(
-            max_steps=args.steps or None,
-            idle_wait_s=idle_wait_s,
-            stop=(lambda: preempt.preempted) if preempt else None,
-            on_tick=on_tick)
+        if args.role == "decode":
+            completions = run_decode_role(
+                engine, transport,
+                max_steps=args.steps or None,
+                idle_wait_s=0.004,
+                stop=(lambda: preempt.preempted) if preempt else None,
+                on_tick=on_tick)
+        else:
+            completions = engine.run(
+                max_steps=args.steps or None,
+                idle_wait_s=idle_wait_s,
+                stop=(lambda: preempt.preempted) if preempt else None,
+                on_tick=on_tick)
         if preempt is not None and preempt.preempted:
             if feeder_stop is not None:
                 feeder_stop.set()
@@ -582,6 +688,11 @@ def run_serve(args):
                   f"requeued={drain['requeued']}; exiting {EX_TEMPFAIL} "
                   f"(resumable)")
             rc = EX_TEMPFAIL
+        if args.role == "prefill":
+            # Close AFTER any drain: the drain's in-flight slots finish
+            # by handing off, and the sentinel's count must cover them
+            # so the decode side knows when the stream truly ends.
+            transport.close()
         if outbox is not None:
             # Everything terminal — drained requeues included — must be
             # on disk before the summary: the restart-skip set and the
@@ -611,6 +722,7 @@ def run_serve(args):
             preempt.close()
         obs.costmodel.set_default(None)
         obs.trace.set_default(None)
+        parallel_state.set_mesh(None)
         if sink is not None:
             sink.close()
 
@@ -621,6 +733,13 @@ def run_serve(args):
         # status and no outbox line, so exiting 0 would hide the loss
         # (review finding, ISSUE 12).
         stranded = engine.queue.pending() + len(engine.pool.live)
+        n_expected = len(completions) + stranded
+    elif args.role == "decode":
+        # The decode role's workload is whatever the transport fed it.
+        # A --steps cap can strand requests mid-flight AND leave
+        # un-acked handoffs in the spool (files survive — re-servable
+        # by the next worker — but THIS run did not finish them).
+        stranded = len(engine.pool.live) + transport.pending_on_disk()
         n_expected = len(completions) + stranded
     else:
         n_expected = args.requests

@@ -154,6 +154,34 @@ def test_policy_least_pending_and_least_kv_use_tailed_gauges():
     assert len(reps[2].specs) == 1      # fewest live KV blocks wins
 
 
+def test_policy_least_kv_prefers_dtype_accurate_bytes():
+    """v12 (ISSUE 14): two replicas holding the SAME block count but
+    different arena precisions — least_kv keys on the dtype-accurate
+    ``kv_bytes_live`` gauge a sharded/quantized replica heartbeats, so
+    the int8 replica (fewer real bytes, more headroom) wins; the block
+    count alone could not tell them apart."""
+    reps = [FakeReplica("bf16", blocks_live=8),
+            FakeReplica("int8", blocks_live=8)]
+    reps[0].set_state(kv_bytes_live=8 * 8 * 512)
+    reps[1].set_state(kv_bytes_live=8 * 8 * 264)
+    router = FleetRouter(reps, policy="least_kv", log=None)
+    router.poll()
+    router.submit(_spec("q0"))
+    assert len(reps[1].specs) == 1 and not reps[0].specs
+
+
+def test_proc_replica_passes_mesh_flags_through(tmp_path):
+    """ISSUE 14 satellite: a ProcReplica built with sharding serve_args
+    spawns a supervised child whose argv carries them verbatim — the
+    supervisor wrapper must not eat --mesh/--role flags."""
+    from apex_example_tpu.fleet.replica import ProcReplica
+    rep = ProcReplica("r0", str(tmp_path), REPO,
+                      serve_args=["--mesh", "1,2", "--slots", "2"])
+    argv = rep.argv()
+    assert argv[argv.index("--mesh") + 1] == "1,2"
+    assert argv.index("--mesh") > argv.index("--")   # on the CHILD side
+
+
 def test_requeue_on_drain_exactly_once(tmp_path):
     a, b = FakeReplica("a"), FakeReplica("b")
     sink = ListSink()

@@ -571,7 +571,7 @@ def test_deadline_expires_queued_request_without_admitting(
     eng = _run_engine_res(model, params, hogs + [late])
     assert eng.counts == {"ok": SLOTS, "timeout": 1, "shed": 0,
                           "cancelled": 0, "failed": 0, "drained": 0,
-                          "rejected": 0}
+                          "rejected": 0, "handoff": 0}
     comp = next(c for c in eng.completions if c.request is late)
     assert comp.status == "timeout" and comp.finish_reason == "timeout"
     assert comp.slot == -1 and comp.admitted_step == -1
@@ -855,7 +855,7 @@ def test_real_nan_params_trip_nonfinite_logits_guard(model_and_params):
                           [Request(prompt=[1, 2, 3], max_new_tokens=4)])
     assert eng.counts == {"ok": 0, "timeout": 0, "shed": 0,
                           "cancelled": 0, "failed": 1, "drained": 0,
-                          "rejected": 0}
+                          "rejected": 0, "handoff": 0}
     comp = eng.completions[0]
     assert comp.status == "failed" and comp.tokens == []
     assert "non-finite logits" in comp.error

@@ -38,6 +38,14 @@ Gates:
    ``--quant-compression-min`` (default 1.9).  Run over the checked-in
    quantized-smoke stream (tests/fixtures/quant/), this turns "the KV
    cache got smaller" into a regression-tested number.
+6. **disagg conservation** (over the ``--disagg-stream`` group): the
+   disaggregated-serving contract over ONE deployment's recorded role
+   streams (schema v12, typically a prefill + a decode stream) —
+   every record validates, exactly one ``serve_summary`` per role,
+   and every ``kv_handoff`` shipped out was admitted in and reached a
+   terminal request record: ZERO lost handoffs.  Run over the
+   checked-in pair (tests/fixtures/disagg/), this turns "prefill and
+   decode are separate workers" into a regression-tested contract.
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -174,6 +182,64 @@ def _quant_gate(stream: str, min_ratio: float) -> int:
     return rc
 
 
+def _disagg_gate(streams) -> int:
+    """The disaggregated-serving gate (ISSUE 14) over ONE deployment's
+    role streams (typically a prefill + a decode stream): every record
+    validates (schema v12), each stream closes with exactly one
+    ``serve_summary`` carrying a ``role``, no two streams claim the
+    same role, and handoffs are CONSERVED — every ``kv_handoff`` the
+    prefill side shipped (direction "out") was admitted somewhere
+    (direction "in") and reached a terminal per-request record: zero
+    lost handoffs.  Returns 0/1 (2 is the caller's unreadable-stream
+    path)."""
+    rc = 0
+    roles = []
+    out_uids = {}                        # uid -> source stream
+    in_uids = set()
+    terminal = set()
+    for stream in streams:
+        summ, records = _load_gated_stream(stream, "serve_summary")
+        if summ is None:
+            return 1
+        role = summ.get("role")
+        if role not in ("prefill", "decode", "both"):
+            print(f"{stream}: serve_summary carries no role (a disagg "
+                  "stream is a v12 role stream)", file=sys.stderr)
+            rc = 1
+        roles.append(role)
+        for r in records:
+            if r.get("record") == "kv_handoff":
+                uid = r.get("request_id", "?")
+                if r.get("direction") == "out":
+                    out_uids[uid] = stream
+                else:
+                    in_uids.add(uid)
+            elif r.get("record") in ("request_complete",
+                                     "request_failed"):
+                terminal.add(r.get("request_id", "?"))
+    dup = [r for r in set(roles) if r != "both" and roles.count(r) > 1]
+    if dup:
+        print(f"disagg gate: role(s) {sorted(dup)} claimed by more "
+              "than one stream (expected exactly one serve_summary "
+              "per role)", file=sys.stderr)
+        rc = 1
+    never_admitted = sorted(u for u in out_uids if u not in in_uids)
+    never_terminal = sorted(u for u in out_uids if u not in terminal)
+    for uid in never_admitted[:10]:
+        print(f"disagg gate: handoff {uid} (from {out_uids[uid]}) was "
+              "never admitted by a decode stream", file=sys.stderr)
+    for uid in never_terminal[:10]:
+        print(f"disagg gate: handoff {uid} never reached a terminal "
+              "request record — LOST", file=sys.stderr)
+    if never_admitted or never_terminal:
+        rc = 1
+    if not out_uids:
+        print("disagg gate: no kv_handoff records across the given "
+              "streams (nothing was disaggregated)", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="one command for every static CI gate")
@@ -196,6 +262,13 @@ def main(argv=None) -> int:
                     metavar="X",
                     help="fleet availability the --fleet-stream gate "
                          "requires (default 1.0)")
+    ap.add_argument("--disagg-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a disaggregated-serving role stream (repeat "
+                         "for the prefill + decode pair of ONE "
+                         "deployment): schema-v12 validation, exactly "
+                         "one serve_summary per role, zero lost "
+                         "handoffs across the group")
     ap.add_argument("--quant-stream", action="append", default=[],
                     metavar="JSONL",
                     help="a quantized-serving stream to run the quant "
@@ -264,6 +337,18 @@ def main(argv=None) -> int:
             return 2
         rc = _quant_gate(stream, args.quant_compression_min)
         print(f"ci_gate: quant gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
+
+    if args.disagg_stream:
+        for stream in args.disagg_stream:
+            if not os.path.isfile(stream):
+                print(f"ci_gate: no such stream: {stream}",
+                      file=sys.stderr)
+                return 2
+        rc = _disagg_gate(args.disagg_stream)
+        print(f"ci_gate: disagg gate "
+              f"{' '.join(args.disagg_stream)}: "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         worst = max(worst, rc)
 

@@ -32,7 +32,16 @@ records, ``replica_state`` health/lifecycle records — serve.py
 replica-mode heartbeats and router transitions alike — the closing
 ``fleet_summary`` with per-replica breakdown + availability + the
 zero-lost counter, and the supervisor's ``restart`` records gaining
-the exit ``classification``) all validate alongside v1 streams — each
+the exit ``classification``), v11 streams (the quantization stratum:
+``quant_event`` records announcing applied weight/KV quantization,
+serve summaries with ``kv_dtype``/``weight_dtype`` and the actual-vs-
+bf16-equivalent per-token bytes) and v12 streams (the sharded/
+disaggregated-serving stratum: ``kv_handoff`` records — one per side
+of a prefill-worker -> decode-worker KV-block transfer, with payload
+byte/block/fill accounting and the decode side's transit latency —
+plus ``role``/``mesh``/``dp``/``tp`` and the handoff counters on
+``serve_summary``, and the dtype-accurate ``kv_bytes_live`` gauge on
+``replica_state`` heartbeats) all validate alongside v1 streams — each
 version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort

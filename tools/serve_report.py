@@ -15,6 +15,15 @@ Schema v7 adds the block-paged KV line: block utilization (mean/max
 held blocks vs the arena), block-accurate ``kv_waste_pct``, the
 prefix-sharing hit rate and copy-on-write copy count.
 
+Schema v12 adds the HANDOFF line (disaggregated serving,
+serve/disagg.py): per-stream KV-transfer accounting — out/in counts,
+blocks and bytes moved, and the decode side's transit-latency
+percentiles (``kv_handoff.handoff_ms``: out-stamp -> admission, both
+wall clocks, so cross-host runs inherit NTP skew like every
+``time`` field).  A handed-off request continues on the decode role,
+so like "drained" it sits outside this server's availability
+denominator.
+
 Schema v9 adds the per-request CRITICAL-PATH table: each completed
 request's e2e latency decomposed into queue wait / prefill / decode /
 stall (the residual: eviction waits, harvest overhead), the mean share
@@ -151,6 +160,7 @@ def report(path: str, out=sys.stdout) -> int:
                   None)
     summary = next((r for r in records
                     if r.get("record") == "serve_summary"), None)
+    handoffs = [r for r in records if r.get("record") == "kv_handoff"]
     reqs = [r for r in records if r.get("record") == "request_complete"
             and all(k in r for k in ("ttft_ms", "tpot_ms",
                                      "output_tokens"))]
@@ -164,7 +174,8 @@ def report(path: str, out=sys.stdout) -> int:
               f"arch={header.get('arch', cfg.get('arch', '?'))}  "
               f"slots={cfg.get('slots', '?')}  "
               f"max_len={cfg.get('max_len', '?')}", file=out)
-    if not reqs and not failed and not shed and not drains:
+    if not reqs and not failed and not shed and not drains \
+            and not handoffs:
         print("no request records", file=out)
         return 1
 
@@ -180,9 +191,15 @@ def report(path: str, out=sys.stdout) -> int:
     requeued = sum(r.get("requeued", 0) for r in drains)
     if requeued:
         statuses["drained"] = requeued
+    handed_off = sum(1 for h in handoffs if h.get("direction") == "out")
+    if handed_off:
+        statuses["handoff"] = handed_off
     print("status: " + ", ".join(f"{k} x{v}" for k, v in
                                  sorted(statuses.items())), file=out)
-    owned = sum(v for k, v in statuses.items() if k != "drained")
+    # drained AND handed-off requests continue on another replica/role —
+    # neither belongs in this server's availability denominator.
+    owned = sum(v for k, v in statuses.items()
+                if k not in ("drained", "handoff"))
     if owned and len(statuses) > 1:
         print(f"availability {statuses.get('ok', 0) / owned:.3f}  "
               f"(ok / every status the server owned; drained requests "
@@ -211,6 +228,36 @@ def report(path: str, out=sys.stdout) -> int:
             print(f"tokens_per_sec p50 {_pct(s, 50):6.1f}  max "
                   f"{s[-1]:6.1f}  (per request)", file=out)
         _print_critical_path(out, critical_path(records))
+    if handoffs:
+        # Schema v12 (disaggregated serving): one line per stream
+        # summarizing the KV transfers it took part in.  Transit
+        # latency only exists on "in" records (the decode side stamps
+        # out-wall -> admission); a pure prefill stream reports count
+        # and bytes alone.
+        n_out = sum(1 for h in handoffs if h.get("direction") == "out")
+        n_in = sum(1 for h in handoffs if h.get("direction") == "in")
+        moved = sum(h.get("payload_bytes", 0) for h in handoffs)
+        blocks = sum(h.get("blocks", 0) for h in handoffs)
+        line = (f"HANDOFF: {n_out} out / {n_in} in  "
+                f"{blocks} block(s), {moved / 1024:.1f} KiB moved")
+        lats = sorted(h["handoff_ms"] for h in handoffs
+                      if "handoff_ms" in h)
+        if lats:
+            line += (f"  transit p50 {_pct(lats, 50):.1f}  "
+                     f"p99 {_pct(lats, 99):.1f}  max {lats[-1]:.1f} (ms)")
+        requeued = sum(h.get("requeued", 0) for h in handoffs)
+        if requeued:
+            line += f"  requeued {requeued}"
+        print(line, file=out)
+        # The REAL first-token latency of handed-off requests lives on
+        # the prefill side's out records (the decode side's
+        # request_complete only sees its own clock domain).
+        ttfts = sorted(h["ttft_ms"] for h in handoffs
+                       if h.get("direction") == "out" and "ttft_ms" in h)
+        if ttfts:
+            print(f"handoff ttft_ms (prefill-side)  p50 "
+                  f"{_pct(ttfts, 50):8.1f}  p99 {_pct(ttfts, 99):8.1f}  "
+                  f"max {ttfts[-1]:8.1f}  (ms)", file=out)
     for d in drains:
         print(f"DRAIN: {d.get('signal', '?')} at step {d.get('step', '?')}"
               f" — in_flight {d.get('in_flight', '?')}, completed "

@@ -18,7 +18,10 @@ clock_sync presence) when a ``--trace`` run recorded a timeline
 fleet stratum (schema v10): a FLEET line (replica/request totals,
 availability, lost count, route count, crash/stall transitions,
 scenario verdict) when the stream is a fleet-router's
-(tools/fleet_report.py renders the per-replica breakdown).
+(tools/fleet_report.py renders the per-replica breakdown) — and the
+disaggregated-serving stratum (schema v12): a HANDOFF line (out/in
+counts, KV bytes moved) when the stream took part in a prefill/decode
+split (tools/serve_report.py renders the latency percentiles).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -79,6 +82,7 @@ def report(path: str, out=sys.stdout) -> int:
                     if r.get("record") == "trace_event"]
     clock_syncs = [r for r in records
                    if r.get("record") == "clock_sync"]
+    handoffs = [r for r in records if r.get("record") == "kv_handoff"]
     fleet_summaries = [r for r in records
                        if r.get("record") == "fleet_summary"]
     routes = [r for r in records if r.get("record") == "route"]
@@ -138,9 +142,30 @@ def report(path: str, out=sys.stdout) -> int:
               + (" (stream truncated before run_summary)" if truncated
                  else ""), file=out)
 
+    # A serving stream closes with serve_summary, not run_summary —
+    # never an abort (the full report lives in tools/serve_report.py).
+    serve_summaries = [r for r in records
+                       if r.get("record") == "serve_summary"]
+    is_serve_stream = bool(serve_summaries or handoffs) or any(
+        r.get("record") in ("request_complete", "request_failed",
+                            "serve_drain")
+        for r in records)
     if summary is None:
         if is_fleet_stream:
             pass                        # fleet_summary is its close
+        elif is_serve_stream:
+            if serve_summaries:
+                s = serve_summaries[-1]
+                print(f"SERVE: {s.get('requests', '?')} request(s), "
+                      f"role {s.get('role', 'both')}"
+                      + (f", mesh {s['mesh']}" if "mesh" in s else "")
+                      + f", availability {s.get('availability', '?')}"
+                      "  (tools/serve_report.py for the full report)",
+                      file=out)
+            else:
+                print("TRUNCATED SERVE STREAM: ends without a "
+                      "serve_summary (run killed or still in flight)",
+                      file=out)
         elif is_supervisor_stream:
             # Supervisors have no flight recorder; a truncated stream
             # means the supervisor itself was killed mid-flight.
@@ -192,9 +217,23 @@ def report(path: str, out=sys.stdout) -> int:
         print(f"TRACE: {len(trace_events)} event(s), trace_id {tid}"
               + ("" if clock_syncs
                  else "  (NO clock_sync — not exportable)"), file=out)
+    if handoffs:
+        # Schema v12 (disaggregated serving): the per-request handoff
+        # distribution lives in tools/serve_report.py; this line says
+        # the stream took part in a prefill/decode split and on which
+        # side(s).
+        n_out = sum(1 for h in handoffs if h.get("direction") == "out")
+        n_in = len(handoffs) - n_out
+        moved = sum(h.get("payload_bytes", 0) for h in handoffs)
+        print(f"HANDOFF: {n_out} out / {n_in} in, "
+              f"{moved / 1024:.1f} KiB of KV blocks moved "
+              "(tools/serve_report.py for latency percentiles)",
+              file=out)
     if not steps:
         if is_fleet_stream:
             return 0 if fleet_summaries else 1
+        if is_serve_stream:
+            return 0 if serve_summaries else 1
         if is_supervisor_stream:
             # Supervisor streams carry no step records by design — the
             # child's stream(s) hold those.  A truncated one (no

@@ -14,6 +14,11 @@ JSON — and structurally lint them (``--check``).
     # overlay the device-side xprof trace on the same wall-clock axis
     python tools/trace_export.py train.jsonl --xprof /tmp/xprof -o t.json
 
+    # a disaggregated pair (schema v12): the prefill worker's request
+    # span joins its decode-worker continuation with a cross-stream
+    # flow arrow keyed on the handoff uid (cat "handoff")
+    python tools/trace_export.py prefill.jsonl decode.jsonl -o t.json
+
     # structural lint (the ci_gate --trace-stream gate): balanced B/E
     # per thread row, monotonic B/E timestamps, orphan parent_ids,
     # X-span containment, exactly one clock_sync
@@ -252,6 +257,13 @@ def export(streams: List[Tuple[str, List[Dict[str, Any]]]],
 
     out: List[Dict[str, Any]] = []
     flow_id = 0
+    # Cross-stream request continuations (schema v12, serve/disagg.py):
+    # every request root span, keyed by request_id — a root that
+    # terminated with status "handoff" in one stream (the prefill
+    # worker) joins its continuation root in another (the decode
+    # worker) with a flow arrow, so the two halves read as ONE request
+    # on the merged timeline.
+    req_roots: List[Dict[str, Any]] = []
     for pid0, (path, records, events, offset) in enumerate(anchored):
         pid = pid0 + 1
         out.append({"ph": "M", "name": "process_name", "pid": pid,
@@ -306,6 +318,15 @@ def export(streams: List[Tuple[str, List[Dict[str, Any]]]],
                         and e.get("parent_id") is not None:
                     queued_end[e["parent_id"]] = us(e["ts"]
                                                     + e.get("dur", 0.0))
+                if e.get("name") == "request" \
+                        and (e.get("args") or {}).get("request_id"):
+                    eargs = e["args"]
+                    req_roots.append({
+                        "rid": eargs["request_id"],
+                        "status": eargs.get("status", "?"),
+                        "pid": pid, "tid": ev["tid"],
+                        "ts": ev["ts"],
+                        "end": ev["ts"] + ev.get("dur", 0.0)})
         # Request admissions as flows: an arrow from the engine row to
         # the request row at the moment its queued span ends (= slot
         # admission), binding the scheduler's timeline to the request's.
@@ -321,6 +342,31 @@ def export(streams: List[Tuple[str, List[Dict[str, Any]]]],
                                 ts=ts))
                 out.append(dict(common, ph="f", bp="e",
                                 tid=root_ev["tid"], ts=ts))
+
+    # Prefill -> decode continuation arrows: the handoff root's end
+    # meets the continuation root's start.  Clock-sync anchoring has
+    # already placed both streams on one wall axis, so the arrow spans
+    # real transit time (including NTP skew on cross-host runs — the
+    # same caveat as every wall-clock join here).
+    by_rid: Dict[str, List[Dict[str, Any]]] = {}
+    for r in req_roots:
+        by_rid.setdefault(r["rid"], []).append(r)
+    for rid in sorted(by_rid):
+        lst = by_rid[rid]
+        handed = [r for r in lst if r["status"] == "handoff"]
+        for h in handed:
+            cont = next((c for c in lst
+                         if c is not h and c["status"] != "handoff"),
+                        None)
+            if cont is None:
+                continue
+            flow_id += 1
+            common = {"cat": "handoff", "name": "kv_handoff",
+                      "id": flow_id}
+            out.append(dict(common, ph="s", pid=h["pid"],
+                            tid=h["tid"], ts=h["end"]))
+            out.append(dict(common, ph="f", bp="e", pid=cont["pid"],
+                            tid=cont["tid"], ts=cont["ts"]))
 
     if xprof_events:
         xpid = 1001
