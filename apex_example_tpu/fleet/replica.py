@@ -143,7 +143,20 @@ class ThreadReplica:
     ``Request`` type.  ``fault`` is an optional serve ``FaultPlan``
     attached to each engine this replica builds — a plan that already
     fired stays inert across restarts, matching the supervisor's
-    drop-flag-on-restart semantics for one-shot drills.
+    drop-flag-on-restart semantics for one-shot drills.  Handoff-kind
+    plans (``handoff_crash_preack``; resilience/faults.py) fire in the
+    decode drive loop instead of the engine, like serve.py.
+
+    Roles (ISSUE 15): ``role="prefill"`` wraps a prefill-role engine —
+    queue-driven exactly like "both", its terminal events just carry
+    status "handoff" (the router parks those uids on the spool).
+    ``role="decode"`` has no queue at all: ``transport_factory()``
+    builds its leased spool consumer (serve/disagg.FileTransport with
+    ``worker=<replica name>``, supplied by the caller — this module
+    imports nothing), the drive loop polls/claims/admits/acks, and
+    ``submit`` always refuses (the router never dispatches prompts to
+    a decode worker).  A rebuilt decode replica gets a FRESH transport
+    under the same worker id, so it adopts its own pre-crash claims.
 
     The drive thread ticks the engine only when the queue or a slot
     holds work, so virtual time does not advance while idle — a
@@ -152,15 +165,46 @@ class ThreadReplica:
     crash (slot-level isolation already contained everything
     containable): the replica drains its queue and live slots into
     ``lost`` events and parks in state "crashed" until ``restart()``.
+    A DECODE crash reports as lost only the uids whose handoffs were
+    already acked (their spool files are gone for good); claimed-but-
+    unacked handoffs stay on disk, where a peer's lease reclaim — or
+    this replica's own restart — redelivers them.
     """
 
     def __init__(self, name: str, engine_factory: Callable[[], Any],
-                 make_request: Callable[[Dict[str, Any]], Any],
-                 fault=None):
+                 make_request: Optional[Callable[[Dict[str, Any]],
+                                                 Any]] = None,
+                 fault=None, role: str = "both",
+                 transport_factory: Optional[Callable[[], Any]] = None):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, "
+                             f"got {role!r}")
+        if role == "decode" and transport_factory is None:
+            raise ValueError("a decode-role ThreadReplica needs a "
+                             "transport_factory (its intake is the "
+                             "handoff spool, not the queue)")
         self.name = name
+        self.role = role
         self._factory = engine_factory
         self._make_request = make_request
-        self._fault = fault
+        self._transport_factory = transport_factory
+        # Handoff drills belong to the decode drive loop; everything
+        # else is the engine's (tick-indexed) business.  Only the
+        # ack-crash drill is expressible HERE — producer-side drills
+        # (handoff_torn/sentinel_lost) live on the transport the
+        # engine factory builds, and a silently-inert drill would
+        # score a chaos run that never happened (the serve.py stance).
+        handoff_kind = str(getattr(fault, "kind", "")).startswith(
+            ("handoff_", "sentinel_"))
+        if handoff_kind and (role != "decode"
+                             or fault.kind != "handoff_crash_preack"):
+            raise ValueError(
+                f"{name}: ThreadReplica cannot express the "
+                f"{fault.kind!r} drill (decode replicas take "
+                "handoff_crash_preack; arm producer-side drills on "
+                "the transport inside the engine factory)")
+        self._fault = None if handoff_kind else fault
+        self._handoff_fault = fault if handoff_kind else None
         self.restarts = 0
         self._lock = threading.Lock()
         self._state = "starting"                # guarded-by: _lock
@@ -172,12 +216,16 @@ class ThreadReplica:
         self._thread: Optional[threading.Thread] = None
         self._progress = time.perf_counter()
         self.engine = engine_factory()
-        if fault is not None:
-            self.engine.fault = fault
+        self.transport = transport_factory() \
+            if transport_factory is not None else None
+        if self._fault is not None:
+            self.engine.fault = self._fault
 
     # ------------------------------------------------------- contract
 
     def submit(self, spec: Dict[str, Any]) -> bool:
+        if self.role == "decode":
+            return False                # intake is the handoff spool
         with self._lock:
             if self._state not in ("starting", "healthy"):
                 return False
@@ -273,8 +321,14 @@ class ThreadReplica:
         eng = self._factory()
         if self._fault is not None:
             eng.fault = self._fault     # already-fired plans stay inert
+        transport = self._transport_factory() \
+            if self._transport_factory is not None else None
         with self._lock:
             self.engine = eng
+            # A fresh transport under the SAME worker id adopts this
+            # replica's pre-crash claims on its first poll — the
+            # restarted-worker redelivery path.
+            self.transport = transport
             self._consumed = 0
             self._interrupted = False
         self.restarts += 1
@@ -288,13 +342,22 @@ class ThreadReplica:
         comps = eng.completions
         new = comps[self._consumed:]
         self._consumed = len(comps)
-        self._emit([{
-            "uid": c.request.uid, "status": c.status,
-            "tokens": [int(t) for t in c.tokens],
-            "finish_reason": c.finish_reason, "tick": c.finished_step,
-            "replica": self.name} for c in new])
+        redelivered = getattr(eng, "handoff_redelivered", ())
+        events = []
+        for c in new:
+            ev = {"uid": c.request.uid, "status": c.status,
+                  "tokens": [int(t) for t in c.tokens],
+                  "finish_reason": c.finish_reason,
+                  "tick": c.finished_step, "replica": self.name}
+            if c.request.uid in redelivered:
+                ev["redelivered"] = True
+            events.append(ev)
+        self._emit(events)
 
     def _drive(self) -> None:
+        if self.role == "decode":
+            self._drive_decode()
+            return
         eng = self.engine
         while True:
             with self._lock:
@@ -340,6 +403,88 @@ class ThreadReplica:
                 return
             self._harvest(eng)
 
+    def _drive_decode(self) -> None:
+        """The decode-role drive loop: poll/claim the spool, admit in
+        order, ack at admission, tick while slots are live.  Exit when
+        the transport is finished (sentinel + empty spool) or the
+        replica is stopping with nothing pending.  The
+        ``handoff_crash_preack`` drill raises between the Nth admit and
+        its ack — the claim survives for redelivery."""
+        eng = self.engine
+        tx = self.transport
+        pending: List[Any] = []
+        acked: set = set()              # uids whose claim was deleted
+        admits = 0
+        while True:
+            with self._lock:
+                stopping = self._stopping
+                interrupted = self._interrupted
+            if interrupted:
+                # Decode interrupt: finish in-flight (its queue holds
+                # nothing), leave unacked claims on disk for the fresh
+                # transport / any peer, rebuild.
+                eng.drain("fleet-interrupt")
+                self._harvest(eng)
+                self._rebuild()
+                eng, tx = self.engine, self.transport
+                pending, acked = [], set()
+                with self._lock:
+                    self._state = "healthy"
+                continue
+            try:
+                pending.extend(tx.poll())
+                if pending:
+                    # Deferred admissions must not forfeit their claims
+                    # to a peer: renew the leases each tick (duck-typed
+                    # — the transport owns the mechanics).
+                    renew = getattr(tx, "renew", None)
+                    if renew is not None:
+                        renew(pending)
+                while pending and eng.admit_handoff(pending[0]):
+                    handoff = pending.pop(0)
+                    admits += 1
+                    fault = self._handoff_fault
+                    if fault is not None \
+                            and fault.kind == "handoff_crash_preack" \
+                            and fault.due(admits):
+                        fault.take()
+                        raise RuntimeError(
+                            f"injected handoff_crash_preack at admit "
+                            f"{admits} (uid {handoff.uid} admitted, "
+                            "never acked)")
+                    tx.ack(handoff)
+                    acked.add(handoff.uid)
+                if eng.pool.any_live():
+                    eng.step()
+                    self._progress = time.perf_counter()
+                else:
+                    if not pending and (stopping or tx.finished()):
+                        self._harvest(eng)
+                        with self._lock:
+                            self._state = "stopped"
+                        return
+                    self._wake.wait(0.01)
+                    self._wake.clear()
+                self._harvest(eng)
+            except BaseException as e:  # noqa: BLE001 — a crash IS the event
+                self._harvest(eng)
+                # Only acked-and-unfinished uids are lost for good (the
+                # spool file is deleted and nobody else ever saw the
+                # payload); claimed-but-unacked handoffs redeliver via
+                # the lease, so reporting them lost would double-count.
+                done = {c.request.uid for c in eng.completions}
+                lost = [eng.pool.slots[i].request.uid
+                        for i in eng.pool.live
+                        if eng.pool.slots[i].request.uid in acked
+                        and eng.pool.slots[i].request.uid not in done]
+                self._emit([{"uid": u, "status": "lost",
+                             "replica": self.name,
+                             "error": f"{type(e).__name__}: {e}"}
+                            for u in lost])
+                with self._lock:
+                    self._state = "crashed"
+                return
+
 
 # ====================================================== subprocess
 
@@ -364,19 +509,39 @@ class ProcReplica:
 
     ``serve_args`` extends the child argv (geometry, --trace, a
     ``--inject-fault`` drill for crash/straggler scenarios — the
-    supervisor strips it on restart — and sharding flags: a
+    supervisor strips it on restart, handoff drills included: a
+    restarted decode worker replays the spool from its claim set, so
+    an operation-ordinal drill would re-fire — and sharding flags: a
     ``--mesh dp,tp`` child serves TP-sharded and its heartbeats carry
     the dtype-accurate ``kv_bytes_live`` gauge ``least_kv`` prefers).
     The spawned tree joins the router's trace via the
     ``APEX_TRACE_ID`` environment handoff.
-    """
+
+    Roles (ISSUE 15): ``role="prefill"`` children run ``--role
+    prefill`` over a shared ``spool_dir`` (inbox-fed as usual — the
+    router routes prompts to them; each handoff lands in the outbox as
+    status "handoff"); ``role="decode"`` children run ``--role
+    decode`` with NO inbox — the spool is their intake — and report
+    terminals through the outbox alone, so ``submit`` always refuses
+    and ``close`` is a no-op (a decode child exits when the spool
+    closes and drains)."""
 
     def __init__(self, name: str, workdir: str, repo_root: str,
                  serve_args: Optional[List[str]] = None,
                  supervise_args: Optional[List[str]] = None,
                  python: str = sys.executable,
-                 stale_after_s: float = 30.0):
+                 stale_after_s: float = 30.0,
+                 role: str = "both",
+                 spool_dir: Optional[str] = None):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, "
+                             f"got {role!r}")
+        if role != "both" and not spool_dir:
+            raise ValueError(f"a {role}-role ProcReplica needs the "
+                             "shared spool_dir")
         self.name = name
+        self.role = role
+        self.spool_dir = spool_dir
         self.workdir = os.path.join(workdir, name)
         os.makedirs(self.workdir, exist_ok=True)
         self.repo_root = repo_root
@@ -405,15 +570,21 @@ class ProcReplica:
     def argv(self) -> List[str]:
         sup = os.path.join(self.repo_root, "tools", "supervise.py")
         srv = os.path.join(self.repo_root, "serve.py")
+        child = [self.python, srv]
+        if self.role != "decode":
+            # A decode child's intake is the spool, never an inbox.
+            child += ["--inbox", self.inbox]
+        child += ["--outbox", self.outbox,
+                  "--replica-id", self.name,
+                  "--metrics-jsonl", self.child_metrics]
+        if self.role != "both":
+            child += ["--role", self.role,
+                      "--handoff-dir", self.spool_dir]
         return ([self.python, sup, "--no-resume",
                  "--metrics-jsonl", self.sup_metrics,
                  "--drop-flag-on-restart=--inject-fault"]
                 + self.supervise_args
-                + ["--", self.python, srv,
-                   "--inbox", self.inbox, "--outbox", self.outbox,
-                   "--replica-id", self.name,
-                   "--metrics-jsonl", self.child_metrics]
-                + self.serve_args)
+                + ["--"] + child + self.serve_args)
 
     def start(self) -> "ProcReplica":
         """Spawn the supervised serve tree (idempotent while it runs).
@@ -433,6 +604,8 @@ class ProcReplica:
         return self._inbox_fh
 
     def submit(self, spec: Dict[str, Any]) -> bool:
+        if self.role == "decode":
+            return False                # intake is the handoff spool
         if self._closed or (self.proc is not None
                             and self.proc.poll() is not None):
             return False
@@ -444,7 +617,12 @@ class ProcReplica:
 
     def close(self) -> None:
         """End-of-stream sentinel: the child finishes what is queued
-        and exits 0; the supervisor sees done."""
+        and exits 0; the supervisor sees done.  A decode child has no
+        inbox — it exits once the SPOOL closes (the prefill child's
+        clean exit writes that sentinel) and drains."""
+        if self.role == "decode":
+            self._closed = True
+            return
         if not self._closed:
             fh = self._inbox()
             fh.write('{"close": true}\n')

@@ -27,6 +27,17 @@ What the router owns:
   re-dispatched while its wall-clock deadline allows and the retry
   budget lasts; past either it terminates first-class (``timeout`` /
   ``failed``) instead of spinning.
+- **Disaggregated roles** (ISSUE 15): a replica handle carrying
+  ``role="prefill"`` receives prompts like any other; one carrying
+  ``role="decode"`` is never dispatched to — its outbox reports the
+  terminals for requests the KV-handoff SPOOL fed it.  A prefill
+  replica's status-"handoff" event parks the uid on the spool (no
+  re-route: the spool is the inter-role channel); a decode worker
+  that acked a handoff and then died reports it ``lost``, and the
+  router re-routes the request through a prefill replica from
+  scratch.  The ``fleet_summary`` carries the disagg topology and
+  redelivery accounting (``prefill_replicas`` / ``decode_replicas`` /
+  ``handoffs`` / ``handoff_redelivered`` / ``in_spool``).
 - **Circuit breaking**: a crashed or stalled replica's breaker opens
   (exponential backoff), half-opens after the backoff to admit ONE
   probe request, and closes again only when the probe completes ok —
@@ -63,13 +74,14 @@ from typing import Any, Dict, List, Optional, Tuple
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) —
 # jax-free contract forbids importing it (same stance as the
 # supervisor's hard-coded records).
-SCHEMA = 10
+SCHEMA = 13
 TRACE_ID_ENV = "APEX_TRACE_ID"
 
 POLICIES = ("round_robin", "least_pending", "least_kv")
 
 # Statuses a replica can report that end a request for good at the
-# fleet level (drained and lost are re-routed instead).
+# fleet level (drained and lost are re-routed instead; "handoff" parks
+# the uid on the KV spool — a decode replica's outbox finishes it).
 _TERMINAL = ("ok", "timeout", "shed", "cancelled", "failed", "rejected")
 
 
@@ -137,6 +149,7 @@ class FleetRouter:
                  breaker_backoff_max_s: float = 5.0,
                  stall_after_s: Optional[float] = None,
                  default_deadline_s: Optional[float] = None,
+                 spool_timeout_s: Optional[float] = None,
                  trace: bool = False, log=print):
         if policy not in POLICIES:
             raise ValueError(f"policy must be one of {POLICIES}, "
@@ -149,11 +162,28 @@ class FleetRouter:
         self.breaker_backoff_max_s = float(breaker_backoff_max_s)
         self.stall_after_s = stall_after_s
         self.default_deadline_s = default_deadline_s
+        # Disagg self-healing (ISSUE 15): a uid parked on the spool
+        # longer than this is presumed eaten by a decode worker that
+        # died AFTER acking its claim (the one crash window the lease
+        # cannot redeliver — the spool file is gone and no process
+        # will ever report it) and is re-routed through a prefill
+        # replica from scratch, under the normal retry budget.  None =
+        # off; size it well past the handoff lease so live redelivery
+        # always gets first go.
+        self.spool_timeout_s = spool_timeout_s
         self.log = log
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self._stream = sink if sink is not None else _Stream(metrics_jsonl)
         self._lock = threading.Lock()
         self._order = [r.name for r in replicas]
+        # Disagg roles (ISSUE 15): prompts route only to prefill-capable
+        # replicas; decode replicas are harvested (their outbox carries
+        # the spool-fed terminals) but never dispatched to.
+        self._roles = {r.name: getattr(r, "role", "both")
+                       for r in replicas}
+        if all(role == "decode" for role in self._roles.values()):
+            raise ValueError("fleet needs at least one prefill-capable "
+                             "replica (every handle is role=decode)")
         self._replicas = {r.name: _Meta(r) for r in replicas}  # guarded-by: _lock
         self._inflight: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
         self._backlog: deque = deque()                  # guarded-by: _lock
@@ -168,6 +198,9 @@ class FleetRouter:
         self._drained_requeued = 0
         self._duplicates = 0
         self._router_terminal = 0     # timeouts/failures decided HERE
+        self._handoffs = 0            # uids parked on the KV spool
+        self._handoff_redelivered = 0  # terminals from redelivered
+        #                                handoff admissions (v13)
         self.results: Dict[str, Dict[str, Any]] = {}    # uid -> final event
         self.scenario: Optional[str] = None
         self.verdict: Optional[str] = None
@@ -302,7 +335,9 @@ class FleetRouter:
         routed back to only when it is the sole survivor); ``refused``
         is hard (it already refused this spec in this dispatch)."""
         names = [n for n in self._order
-                 if n not in refused and self._routable(metas[n], now)]
+                 if n not in refused
+                 and self._roles.get(n, "both") != "decode"
+                 and self._routable(metas[n], now)]
         preferred = [n for n in names if n not in avoid]
         names = preferred or names
         if not names:
@@ -431,6 +466,11 @@ class FleetRouter:
                 self._done[uid] = status
                 del self._inflight[uid]
                 self.results[uid] = ev
+                if ev.get("redelivered"):
+                    # v13: this terminal came from a REDELIVERED
+                    # handoff admission — the crash-safe spool finished
+                    # a request its first consumer dropped.
+                    self._handoff_redelivered += 1
                 if meta is not None:
                     meta.bump(status)
                     if entry["replica"] == src:
@@ -451,17 +491,53 @@ class FleetRouter:
                             self._open_breaker(meta)
                         meta.probe_uid = None
                 return
+            if status == "handoff":
+                # Disagg (ISSUE 15): the prefill replica cached the
+                # prompt, sampled the first token and parked the KV on
+                # the spool — its booking releases, but nothing
+                # re-routes: the spool IS the channel, and a decode
+                # replica's outbox will report the terminal status.
+                if src is not None and entry["replica"] != src:
+                    self._duplicates += 1
+                    return
+                entry["replica"] = None
+                entry["from"] = src
+                entry["stage"] = "spool"
+                entry["spooled_at"] = time.time()
+                self._handoffs += 1
+                if meta is not None:
+                    meta.inflight = max(meta.inflight - 1, 0)
+                    meta.bump("handoff")
+                    if meta.probe_uid == uid:
+                        # The probe did its prefill job; the breaker
+                        # closes on handoff like on ok.
+                        meta.breaker = "closed"
+                        meta.fail_streak = 0
+                        meta.probe_uid = None
+                return
             # drained / lost: the uid lives on — but only the replica
             # that currently holds it may hand it back (exactly-once
             # per drain: duplicate reports find the entry already
-            # moved).
-            if src is not None and entry["replica"] != src:
+            # moved).  Exception: a SPOOL-stage uid has no holding
+            # replica at all — a decode worker that acked its handoff
+            # and then died reports it lost, and the router re-routes
+            # it through a prefill replica from scratch (the spool file
+            # is gone; claimed-but-unacked handoffs redeliver via the
+            # lease instead and never reach this branch).
+            spool_lost = status == "lost" \
+                and entry.get("stage") == "spool" \
+                and entry["replica"] is None
+            if src is not None and entry["replica"] != src \
+                    and not spool_lost:
                 self._duplicates += 1
                 return
             entry["replica"] = None
             entry["from"] = src
+            entry.pop("stage", None)
+            entry.pop("spooled_at", None)
             if meta is not None:
-                meta.inflight = max(meta.inflight - 1, 0)
+                if not spool_lost:
+                    meta.inflight = max(meta.inflight - 1, 0)
                 meta.bump(status)
                 if meta.probe_uid == uid:
                     self._open_breaker(meta)
@@ -585,6 +661,25 @@ class FleetRouter:
                     expired = True
             if not expired:
                 self._dispatch(uid, "backlog")
+        # Stale-spool sweep: a uid whose handoff was acked by a worker
+        # that then died leaves NO claim to redeliver and NO process to
+        # report it lost (a crashed ThreadReplica reports its acked
+        # set; a kill -9'd proc child cannot) — presumed lost after
+        # spool_timeout_s and re-routed through prefill from scratch.
+        if self.spool_timeout_s is not None:
+            now = time.time()
+            with self._lock:
+                stale = [u for u, e in self._inflight.items()
+                         if e.get("stage") == "spool"
+                         and now - e.get("spooled_at", now)
+                         > self.spool_timeout_s]
+            for uid in stale:
+                if self.log:
+                    self.log(f"fleet: {uid} stale on the spool "
+                             f"(> {self.spool_timeout_s}s) — "
+                             "re-routing through prefill")
+                self._absorb({"uid": uid, "status": "lost",
+                              "replica": None})
         return len(events)
 
     def done(self) -> bool:
@@ -623,22 +718,36 @@ class FleetRouter:
                 per_replica[name] = dict(meta.counts)
                 per_replica[name]["dispatches"] = meta.dispatches
                 ok_r = meta.counts.get("ok", 0)
+                # A handed-off request continues on a decode replica —
+                # like a drain it leaves this replica's availability
+                # denominator (the decode side owns the outcome).
                 owned = sum(v for k, v in meta.counts.items()
-                            if k not in ("drained", "lost"))
+                            if k not in ("drained", "lost", "handoff"))
                 per_replica[name]["availability"] = round(
                     ok_r / owned, 3) if owned else 1.0
                 per_replica[name]["state"] = \
                     meta.health.get("state", "?")
+                role = self._roles.get(name, "both")
+                if role != "both":
+                    per_replica[name]["role"] = role
                 dispatches[name] = meta.dispatches
             submitted = self._submitted
             retries = self._retries
             requeued = self._drained_requeued
             dups = self._duplicates
+            handoffs = self._handoffs
+            redelivered = self._handoff_redelivered
+            in_spool = sum(1 for e in self._inflight.values()
+                           if e.get("stage") == "spool")
         ok = sum(1 for s in done.values() if s == "ok")
         terminal = len(done)
         counts = {s: sum(1 for v in done.values() if v == s)
                   for s in _TERMINAL}
-        vals = list(dispatches.values())
+        # Balance skew over DISPATCHABLE replicas only: decode workers
+        # are never routed prompts, so counting their structural zeros
+        # would read every disagg fleet as imbalanced.
+        vals = [v for n, v in dispatches.items()
+                if self._roles.get(n, "both") != "decode"]
         mean = sum(vals) / len(vals) if vals else 0.0
         skew = round(max(vals) / mean, 3) if mean else 0.0
         rec: Dict[str, Any] = {
@@ -664,6 +773,17 @@ class FleetRouter:
                         "balance_skew": skew},
             "run_id": self.run_id,
         }
+        n_prefill = sum(1 for r in self._roles.values()
+                        if r == "prefill")
+        n_decode = sum(1 for r in self._roles.values() if r == "decode")
+        if n_prefill or n_decode:
+            # v13 disagg topology fields: only a disaggregated fleet
+            # carries them, so homogeneous streams stay byte-stable.
+            rec["prefill_replicas"] = n_prefill
+            rec["decode_replicas"] = n_decode
+            rec["handoffs"] = handoffs
+            rec["handoff_redelivered"] = redelivered
+            rec["in_spool"] = in_spool
         if self.scenario:
             rec["scenario"] = self.scenario
         if self.verdict:

@@ -29,6 +29,21 @@ number instead of a claim.
                      router's stall detector (``stall_after_s``) opens
                      its breaker and rescues its in-flight requests
                      onto healthy siblings.
+``prefill_crash``    disagg (ISSUE 15): the prefill role dies mid-
+                     serve (``crash@tick``).  Requests it held come
+                     back ``lost`` and re-route once the scenario
+                     restarts it (a supervised child restarts itself);
+                     requests already on the spool keep decoding
+                     untouched — zero lost, and the handoffs that
+                     were in flight at the crash must still conserve.
+``decode_crash_midspool``  disagg (ISSUE 15): a decode worker crashes
+                     holding claimed-but-unacked handoffs (the
+                     ``handoff_crash_preack`` drill).  Nobody
+                     restarts it — a PEER decode worker must reclaim
+                     the expired leases and finish the redelivered
+                     handoffs (scored: zero lost, every uid exactly
+                     one non-drained terminal, ``handoff_redelivered``
+                     > 0 — the peer really did the work).
 ``none``             no chaos: route, serve, summarize (the baseline
                      the chaos scores are read against).
 
@@ -47,7 +62,8 @@ import random
 import time
 from typing import Any, Dict, List, Optional
 
-SCENARIOS = ("none", "rolling_restart", "crash_storm", "straggler")
+SCENARIOS = ("none", "rolling_restart", "crash_storm", "straggler",
+             "prefill_crash", "decode_crash_midspool")
 
 
 def synthetic_specs(n: int, *, vocab_size: int = 256, seed: int = 0,
@@ -118,11 +134,19 @@ def _wait_restarted(router, replica, restarts_before: int,
 
 
 def _finish(router, name: str, *, availability_min: float,
-            checks: Optional[Dict[str, bool]] = None) -> Dict[str, Any]:
+            checks: Optional[Dict[str, bool]] = None,
+            summary_checks: Optional[Dict[str, Any]] = None
+            ) -> Dict[str, Any]:
     """Score the run: verdict "pass" iff nothing was lost, fleet
     availability clears the bar, and every scenario-specific check
-    held.  Writes the fleet_summary and closes the router stream."""
+    held.  ``summary_checks`` maps check names to predicates over the
+    summary record (for invariants only computable at close, like the
+    disagg redelivery count).  Writes the fleet_summary and closes the
+    router stream."""
     summary = router.summary_record()
+    checks = dict(checks or {})
+    for key, predicate in (summary_checks or {}).items():
+        checks[key] = bool(predicate(summary))
     ok = (summary["lost"] == 0
           and summary["availability"] >= availability_min
           and all((checks or {}).values()))
@@ -282,6 +306,102 @@ def run_straggler(router, replicas, specs, *,
                            "stall_detected": stalled_seen["v"]})
 
 
+def run_prefill_crash(router, replicas, specs, *,
+                      crashed_name: str,
+                      timeout_s: float = 120.0,
+                      restart_crashed: bool = True,
+                      availability_min: float = 1.0) -> Dict[str, Any]:
+    """Disagg chaos (ISSUE 15): the PREFILL role dies mid-serve via a
+    pre-armed ``crash@tick`` drill.  Requests it held (queued or
+    mid-prefill) come back ``lost`` and the router re-routes them once
+    the replica returns (the scenario restarts the in-process replica;
+    a supervised child's supervisor does it on its own); requests
+    already handed off keep decoding untouched.  Scored on zero lost
+    plus the crash really firing and handoffs really flowing."""
+    t0 = time.perf_counter()
+    for spec in specs:
+        router.submit(spec)
+    for replica in replicas:
+        replica.start()                 # idempotent on both transports
+    observed: set = set()
+    restarted: set = set()
+
+    def crash_seen():
+        replica = next(r for r in replicas if r.name == crashed_name)
+        if crashed_name not in observed:
+            st = replica.state()
+            if st.get("state") == "crashed" \
+                    or st.get("classification") in ("crashed",
+                                                    "stall_killed"):
+                observed.add(crashed_name)
+        if restart_crashed and crashed_name in observed \
+                and crashed_name not in restarted:
+            router.trace_event("i", "scenario_restart",
+                               args={"replica": crashed_name})
+            replica.restart()
+            restarted.add(crashed_name)
+        return router.done()
+
+    done = _drive(router, crash_seen, timeout_s)
+    router.trace_event("X", "scenario:prefill_crash", ts=t0,
+                       dur=time.perf_counter() - t0)
+    return _finish(router, "prefill_crash",
+                   availability_min=availability_min,
+                   checks={"completed_in_time": done,
+                           "crash_observed": crashed_name in observed},
+                   summary_checks={
+                       "handoffs_flowed":
+                           lambda s: s.get("handoffs", 0) > 0,
+                       "spool_drained":
+                           lambda s: s.get("in_spool", 0) == 0})
+
+
+def run_decode_crash_midspool(router, replicas, specs, *,
+                              crashed_name: str,
+                              timeout_s: float = 120.0,
+                              availability_min: float = 1.0
+                              ) -> Dict[str, Any]:
+    """Disagg chaos (ISSUE 15): a decode worker crashes in the
+    ack-crash window (the caller arms ``handoff_crash_preack`` on it)
+    while holding claimed-but-unacked handoffs.  Nobody restarts it —
+    the PEER decode workers must reclaim its expired leases, admit the
+    redelivered handoffs and finish them.  Scored on zero lost, every
+    uid exactly one non-drained terminal status, the crash really
+    firing, and at least one terminal coming from a REDELIVERED
+    admission (the peer provably did the reclaimed work)."""
+    t0 = time.perf_counter()
+    for spec in specs:
+        router.submit(spec)
+    for replica in replicas:
+        replica.start()                 # idempotent on both transports
+    observed: set = set()
+
+    def crash_seen():
+        if crashed_name not in observed:
+            replica = next(r for r in replicas
+                           if r.name == crashed_name)
+            st = replica.state()
+            if st.get("state") == "crashed" \
+                    or st.get("classification") in ("crashed",
+                                                    "stall_killed"):
+                observed.add(crashed_name)
+        return router.done()
+
+    done = _drive(router, crash_seen, timeout_s)
+    router.trace_event("X", "scenario:decode_crash_midspool", ts=t0,
+                       dur=time.perf_counter() - t0)
+    return _finish(router, "decode_crash_midspool",
+                   availability_min=availability_min,
+                   checks={"completed_in_time": done,
+                           "crash_observed": crashed_name in observed},
+                   summary_checks={
+                       "peer_redelivered":
+                           lambda s: s.get("handoff_redelivered",
+                                           0) > 0,
+                       "spool_drained":
+                           lambda s: s.get("in_spool", 0) == 0})
+
+
 def run_scenario(name: str, router, replicas, specs,
                  **kw) -> Dict[str, Any]:
     """Dispatch by scenario name (the ``fleet.py --scenario`` surface)."""
@@ -291,5 +411,7 @@ def run_scenario(name: str, router, replicas, specs,
     fn = {"none": run_none,
           "rolling_restart": run_rolling_restart,
           "crash_storm": run_crash_storm,
-          "straggler": run_straggler}[name]
+          "straggler": run_straggler,
+          "prefill_crash": run_prefill_crash,
+          "decode_crash_midspool": run_decode_crash_midspool}[name]
     return fn(router, replicas, specs, **kw)

@@ -246,6 +246,24 @@ fleet router's ``least_kv`` policy prefers over the raw block count).
 v12 is once more a strict superset: every v1–v11 stream validates
 unchanged.
 
+Version 13 adds the crash-safe handoff stratum (ISSUE 15 —
+serve/disagg.py's leased spool protocol and the disagg fleet
+scenarios); no new record types:
+
+``kv_handoff`` grows the lease/redelivery story: ``direction`` gains
+the value "quarantine" (a corrupt/truncated payload parked at
+``*.bad`` — the worker stays alive; ``spool_file``/``error`` name the
+evidence), ``redelivered`` counts deliveries from a reclaimed or
+adopted lease, and ``duplicate: true`` marks an idempotent re-admission
+(the decode engine had already admitted the uid — the ack-crash window
+— so nothing was scattered twice).  ``serve_summary`` gains
+``handoff_duplicates`` / ``handoff_redelivered`` /
+``handoff_quarantined``; ``replica_state`` heartbeats gain ``role``;
+``fleet_summary`` gains the disagg topology + spool accounting
+(``prefill_replicas`` / ``decode_replicas`` / ``handoffs`` /
+``handoff_redelivered`` / ``in_spool``).  v13 is once more a strict
+superset: every v1–v12 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -257,7 +275,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -563,6 +581,11 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
                                     #   not retry attempts)
         "handoff_bytes": int,       # payload bytes moved, this role
         "handoff_ms": dict,         # decode: transit percentiles
+        # v13: the crash-safe leased-spool story (ISSUE 15)
+        "handoff_duplicates": int,   # idempotent re-admissions acked
+        "handoff_redelivered": int,  # uids admitted from a reclaimed
+                                     #   or adopted lease
+        "handoff_quarantined": int,  # corrupt payloads parked at *.bad
     },
     "preemption": {
         "run_id": str,
@@ -672,6 +695,7 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "blocks_live": int,      # KV arena blocks held (least_kv input)
         "kv_bytes_live": int,    # v12: dtype-accurate KV bytes live —
                                  #   what least_kv prefers when present
+        "role": str,             # v13: both | prefill | decode
         "pid": int,              # serve-child pid (chaos scripts signal it)
         "attempt": int,          # supervisor attempt index, when known
         "exit_code": int,        # with state crashed/restarting
@@ -707,6 +731,14 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "dst": str,
         "handoff_ms": _NUM,      # in only: out-stamp -> admission wall
         "requeued": int,         # in only: deferred-admission count
+        # v13 (ISSUE 15): the leased-spool crash-safety story
+        "redelivered": int,      # in only: delivery came from a
+                                 #   reclaimed/adopted lease
+        "duplicate": bool,       # in only: uid already admitted — the
+                                 #   ack-crash window closing (acked,
+                                 #   nothing scattered twice)
+        "spool_file": str,       # quarantine only: the parked payload
+        "error": str,            # quarantine only: why it failed
     },
     "fleet_summary": {
         "run_id": str,
@@ -726,6 +758,14 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "lost": int,              # uids with NO terminal status (must be 0)
         "per_replica": dict,      # name -> per-status breakdown
         "routing": dict,          # dispatch counts + balance skew
+        # v13 (ISSUE 15): disagg topology + leased-spool accounting
+        "prefill_replicas": int,  # role=prefill handles in the fleet
+        "decode_replicas": int,   # role=decode handles in the fleet
+        "handoffs": int,          # uids parked on the KV spool
+        "handoff_redelivered": int,  # terminals from redelivered
+                                     #   handoff admissions
+        "in_spool": int,          # uids still on the spool at close
+                                  #   (counted in lost; must be 0)
     },
 }
 

@@ -22,17 +22,21 @@ state, not the exception — three pillars turn "observe the failure" into
   end-to-end in tier-1.  The serve path (serve.py; ISSUE 5) accepts the
   same kinds plus ``slot_fail`` (``SERVE_KINDS``) at engine-tick
   granularity — sigterm drives the graceful drain, slot_fail the
-  slot-isolation path.
+  slot-isolation path — and the disagg handoff drills
+  (``HANDOFF_KINDS``, ISSUE 15) at send/admit granularity: torn
+  payloads, the ack-crash window, duplicate delivery, a lost close
+  sentinel.
 
 ``supervisor`` is importable here for in-package callers, but the CLI
 loads it by file path (the package ``__init__`` pulls jax).
 """
 
-from apex_example_tpu.resilience.faults import (KINDS, SERVE_KINDS,
+from apex_example_tpu.resilience.faults import (HANDOFF_KINDS, KINDS,
+                                                SERVE_KINDS,
                                                 FaultInjected, FaultPlan)
 from apex_example_tpu.resilience.preemption import (EX_TEMPFAIL,
                                                     PreemptionHandler)
 from apex_example_tpu.resilience.supervisor import Supervisor
 
-__all__ = ["EX_TEMPFAIL", "FaultInjected", "FaultPlan", "KINDS",
-           "PreemptionHandler", "SERVE_KINDS", "Supervisor"]
+__all__ = ["EX_TEMPFAIL", "FaultInjected", "FaultPlan", "HANDOFF_KINDS",
+           "KINDS", "PreemptionHandler", "SERVE_KINDS", "Supervisor"]

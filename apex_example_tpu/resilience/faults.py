@@ -34,6 +34,32 @@ rejecting it):
                (``request_failed`` record), every other request is
                token-identical to a fault-free run.
 
+Handoff kinds (``HANDOFF_KINDS``, ISSUE 15; serve.py routes them to the
+disaggregated-serving handoff path — serve/disagg.py — instead of the
+engine, and the step is the 1-based ordinal of the named OPERATION, not
+an engine tick):
+
+``handoff_torn``          prefill side: the Nth ``FileTransport.send``
+                          writes a truncated spool payload — the decode
+                          worker must QUARANTINE it (``*.bad`` + a
+                          ``kv_handoff`` direction "quarantine" record)
+                          and keep ticking.
+``handoff_crash_preack``  decode side: crash between the Nth successful
+                          ``admit_handoff`` and its ack — the ack-crash
+                          window.  The claim stays on disk, so the
+                          restarted worker (or a lease-expiry peer)
+                          redelivers; the engine's seen-set detects the
+                          duplicate and acks it without a second
+                          scatter.
+``handoff_dup``           decode side: redeliver the Nth admitted
+                          handoff a second time — the pure duplicate-
+                          delivery drill (seen-set path, no crash).
+``sentinel_lost``         prefill side: ``FileTransport.close`` never
+                          writes the ``close.json`` sentinel — the
+                          producer-died shape a decode worker's
+                          ``--handoff-idle-timeout`` must resolve
+                          instead of spinning forever.
+
 Steps are 1-based **global** steps (engine ticks on the serve path) and
 fire exactly once — on equality for the training kinds (a resumed run
 whose restored step is already past the fault step never re-fires,
@@ -42,7 +68,12 @@ testable), and at the first tick ``>=`` the target for the
 caller-handled serve kinds (``due()``/``take()``: a slot-level drill
 landing on a tick that cannot express it — idle, or every slot still
 prefilling — defers rather than vanishing; the serve path has no
-resume, so late-firing never double-fires).
+resume, so late-firing never double-fires).  Handoff drills on a
+supervised decode worker MUST be stripped from restart attempts
+(``tools/supervise.py --drop-flag-on-restart=--inject-fault``): the
+restarted worker replays the spool from its claim set, so an
+operation-ordinal drill would re-fire every attempt, exactly like the
+exact-tick serve drills.
 """
 
 from __future__ import annotations
@@ -52,9 +83,15 @@ import signal
 import time
 
 KINDS = ("crash", "sigterm", "hang", "nan")
-# serve.py additionally accepts slot_fail (slot-level failure isolation);
-# train.py keeps validating against the training KINDS.
-SERVE_KINDS = KINDS + ("slot_fail",)
+# Disagg handoff drills (ISSUE 15): fired by the handoff transport /
+# decode drive loop at the Nth send/admit (serve/disagg.py), never by
+# the engine tick loop.
+HANDOFF_KINDS = ("handoff_torn", "handoff_crash_preack", "handoff_dup",
+                 "sentinel_lost")
+# serve.py additionally accepts slot_fail (slot-level failure isolation)
+# and the handoff drills; train.py keeps validating against the
+# training KINDS.
+SERVE_KINDS = KINDS + ("slot_fail",) + HANDOFF_KINDS
 
 # Long enough that a hung step is indistinguishable from a real wedge to
 # every consumer (watchdog, supervisor), bounded so an unsupervised run

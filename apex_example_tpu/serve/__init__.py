@@ -21,7 +21,12 @@ parallel_state mesh the engine TP-shards the weights and per-layer KV
 arenas over heads on the ``model`` axis (block tables and admission
 stay host-side and replicated), and ``serve/disagg.py`` splits prefill
 and decode into separate roles connected by a KV-block handoff
-transport — long prompts stop stalling decode ticks.
+transport — long prompts stop stalling decode ticks.  The file spool
+is CRASH-SAFE (ISSUE 15): leased claims by atomic rename,
+ack-by-delete at admission, redelivery of a dead worker's claims via
+lease reclaim or own-claim adoption, idempotent admission on handoff
+uid (the engine's seen-set duplicate-acks the ack-crash window), and
+quarantine for corrupt payloads — N decode workers per spool.
 
 ``serve.py`` at the repo root is the CLI driver (checkpoint restore or
 random init, synthetic stream, schema-v5 JSONL serving records, SIGTERM

@@ -32,14 +32,30 @@ Transports:
   comparison test and :func:`run_disagg` drive;
 - :class:`FileTransport` — a spool directory of ``handoff-*.npz``
   files written atomically (tmp + rename) plus a ``close.json``
-  sentinel, connecting a ``serve.py --role prefill`` process to a
-  ``--role decode`` process with no shared memory.  Files survive on
-  disk until the consumer ACKS them at admission, so a decode worker
-  stopped at a --steps cap (or before admitting) leaves its
-  unadmitted handoffs re-servable; a worker that dies between ack and
-  terminal status still loses those in-flight requests (the fleet
-  stratum's exactly-once machinery is the inbox/outbox protocol, not
-  this spool — compose them by fronting each role with a router).
+  sentinel, connecting a ``serve.py --role prefill`` process to one or
+  more ``--role decode`` processes with no shared memory.
+
+The file spool speaks a LEASED, crash-safe protocol (ISSUE 15):
+
+- **claim by atomic rename** — a consumer takes a spool file by
+  renaming ``handoff-*.npz`` to ``*.npz.claim-<worker>-<deadline>``;
+  the loser of a rename race simply moves on, so N decode workers can
+  share one spool without coordination;
+- **wall-clock lease** — the claim name carries an epoch deadline.  An
+  EXPIRED claim is reclaimed by renaming it back to the spool name, so
+  ANY worker can redeliver a dead peer's claimed-but-unacked handoffs;
+  a worker that comes back under its own id adopts its pre-crash
+  claims immediately (no lease wait) — both paths mark the next
+  delivery ``redelivered``;
+- **ack-by-delete at admission** — the consumer deletes the claim file
+  once ``admit_handoff`` consumed the payload.  A worker that dies
+  between admit and ack leaves the claim on disk; the redelivery is
+  detected against the decode engine's seen-set (idempotent admission
+  on handoff uid) and acked without a second scatter;
+- **quarantine, never crash** — a corrupt/truncated payload renames to
+  ``*.bad`` and surfaces through ``on_quarantine`` (serve.py writes a
+  ``kv_handoff`` direction "quarantine" record) while the worker keeps
+  ticking.
 
 Determinism: handoffs are sequence-numbered at send time and admitted
 in that order; a payload that exceeds the decode worker's free blocks
@@ -47,10 +63,13 @@ is REQUEUED at the head (``admit_handoff`` returns False leaving no
 state behind) and retried after evictions free capacity — never
 dropped, never a crash.
 
-Both sides emit schema-v12 ``kv_handoff`` records (direction out/in);
-``tools/ci_gate.py --disagg-stream`` checks a recorded pair of role
-streams for conservation (zero lost handoffs) and
-``tools/serve_report.py`` renders the HANDOFF latency line.
+Both sides emit schema-v13 ``kv_handoff`` records (direction
+out/in/quarantine, with ``redelivered``/``duplicate`` provenance);
+``tools/ci_gate.py --disagg-stream`` checks a deployment's recorded
+role streams for conservation — redelivery episodes tolerated, but
+exactly one EFFECTIVE admission and exactly one terminal status per
+handoff uid — and ``tools/serve_report.py`` renders the HANDOFF and
+REDELIVERY lines.
 """
 
 from __future__ import annotations
@@ -64,6 +83,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from apex_example_tpu.resilience.faults import FaultInjected
 from apex_example_tpu.serve.queue import Completion, Request
 
 
@@ -88,6 +108,11 @@ class KvHandoff:
     t_out_wall: float
     src: str = ""
     requeued: int = 0       # deferred-admission episodes, decode side
+    # Delivery provenance (ISSUE 15): nonzero when this delivery came
+    # from a reclaimed/adopted lease rather than a fresh spool file —
+    # the decode side's kv_handoff record and the fleet scenario
+    # checks key on it.
+    redelivered: int = 0
     # prefill-side latency trail (wall-independent, for the kv_handoff
     # record): the request's measured TTFT/queue wait up to handoff.
     ttft_ms: Optional[float] = None
@@ -119,6 +144,9 @@ class QueueTransport:
         """Admission consumed the handoff (no-op in process: nothing
         outlives the deque)."""
 
+    def renew(self, handoffs) -> None:
+        """Lease renewal (no-op in process: no leases)."""
+
     def close(self) -> None:
         self._closed = True
 
@@ -128,31 +156,80 @@ class QueueTransport:
 
 
 class FileTransport:
-    """File-spool handoff channel between role processes.
+    """Leased file-spool handoff channel between role processes.
 
     The prefill side writes ``handoff-<seq>-<uid>.npz`` (payload arrays
     plus a JSON meta member) via tmp-file + atomic rename, then a
-    ``close.json`` sentinel carrying the total count.  The decode side
-    polls the directory, loads files in sequence order exactly once and
-    deletes them.  Single producer, single consumer."""
+    ``close.json`` sentinel carrying the total count.  A decode side
+    CLAIMS files by atomic rename (``*.npz`` ->
+    ``*.npz.claim-<worker>-<deadline>``), loads them in sequence order
+    and acks-by-delete at admission; expired claims rename back to the
+    spool name so any peer redelivers them, and a worker returning
+    under the same ``worker`` id adopts its own pre-crash claims
+    without waiting out the lease.  Single producer, ANY number of
+    consumers (one live instance per ``worker`` id)."""
 
     SENTINEL = "close.json"
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, worker: Optional[str] = None,
+                 lease_s: float = 30.0, fault=None, on_quarantine=None):
         self.path = path
         os.makedirs(path, exist_ok=True)
-        self._seq = 0
+        # Restart-safe sequence numbers: a producer that comes back
+        # mid-stream must not clobber (or re-order under) the files its
+        # predecessor already spooled.
+        self._seq = 1 + max(
+            (self._seq_of(n) for n in os.listdir(path)), default=-1)
+        self.worker = worker or f"w{os.getpid()}"
+        if "/" in self.worker or ".claim-" in self.worker:
+            raise ValueError(f"bad worker id {self.worker!r}")
+        self.lease_s = float(lease_s)
+        # A handoff-kind resilience FaultPlan (handoff_torn /
+        # sentinel_lost fire here on the producer side; the decode-side
+        # kinds live in run_decode_role).
+        self.fault = fault
+        # on_quarantine(uid, spool_name, error, nbytes): called once per
+        # corrupt payload parked at *.bad (serve.py writes the warn
+        # record); quarantine never raises out of poll().
+        self.on_quarantine = on_quarantine
         self.sent = 0
+        self.quarantined = 0
+        self.reclaimed = 0              # expired claims we renamed back
         self._expected: Optional[int] = None
         self._consumed = 0
-        self._loaded: set = set()
+        self._mine: set = set()         # claim names THIS instance holds
+        self._redelivered: set = set()  # spool names whose next delivery
+        #                                 is a redelivery (reclaim/adopt)
+
+    @staticmethod
+    def _seq_of(name: str) -> int:
+        if name.startswith("handoff-"):
+            try:
+                return int(name.split("-", 2)[1])
+            except (IndexError, ValueError):
+                return -1
+        return -1
+
+    @staticmethod
+    def _uid_of(spool_name: str) -> str:
+        """The request uid embedded in ``handoff-<seq>-<uid>.npz``."""
+        stem = spool_name[:-len(".npz")] if spool_name.endswith(".npz") \
+            else spool_name
+        return stem.split("-", 2)[-1]
 
     def pending_on_disk(self) -> int:
-        """Spool files not yet acked — what a stopped decode worker
-        leaves behind for the next one (serve.py counts these as
-        stranded at a --steps cap)."""
-        return sum(1 for n in os.listdir(self.path)
-                   if n.startswith("handoff-") and n.endswith(".npz"))
+        """Spool files not yet acked — unclaimed files plus live claims
+        (quarantined ``*.bad`` files are a disposition, not a
+        backlog).  What a stopped decode worker leaves behind for the
+        next one; serve.py counts these as stranded at a --steps cap."""
+        n = 0
+        for name in os.listdir(self.path):
+            if name.startswith(".tmp-") or name.endswith(".bad"):
+                continue
+            if (name.startswith("handoff-") and name.endswith(".npz")) \
+                    or ".claim-" in name:
+                n += 1
+        return n
 
     # ------------------------------------------------------ prefill side
 
@@ -192,44 +269,165 @@ class FileTransport:
         with open(tmp, "wb") as fh:
             np.savez(fh, meta=np.frombuffer(
                 json.dumps(meta).encode(), np.uint8), **arrays)
+        if self.fault is not None and self.fault.kind == "handoff_torn" \
+                and self.fault.due(self.sent + 1):
+            # The torn-payload drill: ship only the first half of the
+            # bytes.  The rename below is still atomic — this is a
+            # CORRUPT payload (a producer died mid-serialize to a
+            # non-atomic medium, bit rot in transit), not a torn
+            # rename; the consumer must quarantine it, not crash.
+            self.fault.take()
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as fh:
+                fh.truncate(max(size // 2, 1))
         os.replace(tmp, os.path.join(self.path, name))
         self.sent += 1
 
     def close(self) -> None:
+        if self.fault is not None and self.fault.kind == "sentinel_lost" \
+                and self.fault.due(1):
+            # The producer-died drill: the stream's end never announces
+            # itself.  A decode worker sized with --handoff-idle-timeout
+            # finishes what is spooled and exits instead of spinning.
+            self.fault.take()
+            return
         tmp = os.path.join(self.path, ".tmp-" + self.SENTINEL)
         with open(tmp, "w") as fh:
-            json.dump({"handoffs": self.sent, "time": time.time()}, fh)
+            json.dump({"handoffs": self.sent, "worker": self.worker,
+                       "time": time.time()}, fh)
         os.replace(tmp, os.path.join(self.path, self.SENTINEL))
 
     # ------------------------------------------------------- decode side
 
     def poll(self) -> List[KvHandoff]:
-        """Load every not-yet-loaded spool file, in sequence order.
-        Files stay ON DISK until the consumer acks them (admission
-        succeeded or the handoff terminated) — a decode worker stopped
-        at a --steps cap leaves its unadmitted handoffs in the spool,
-        re-servable by the next worker, instead of silently discarding
-        them.  A torn write is impossible (atomic rename); a broken
-        file is a real bug and raises."""
-        out = []
-        names = sorted(n for n in os.listdir(self.path)
-                       if n.startswith("handoff-") and n.endswith(".npz")
-                       and n not in self._loaded)
+        """Claim and load every claimable spool file, in sequence
+        order.  Three passes over one directory listing:
+
+        1. **reclaim/adopt** — a claim whose lease deadline passed (its
+           holder is presumed dead), or ANY claim carrying our own
+           ``worker`` id that this instance did not create (our
+           predecessor's, pre-crash), renames back to the spool name;
+           its next delivery is marked ``redelivered``.
+        2. **claim** — every unclaimed spool file renames to
+           ``*.claim-<worker>-<deadline>``; losing the rename race to a
+           peer just skips the file.
+        3. **load** — claimed files parse into :class:`KvHandoff`; a
+           corrupt/truncated payload renames to ``*.bad`` and surfaces
+           through ``on_quarantine`` instead of raising.
+
+        Claimed files stay ON DISK until :meth:`ack` (admission
+        consumed the handoff) — a worker that dies between poll and
+        ack, or between admit and ack, strands nothing: the lease
+        expires and a peer (or its own restart) redelivers."""
+        now = time.time()
+        out: List[KvHandoff] = []
+        try:
+            names = os.listdir(self.path)
+        except OSError:  # pragma: no cover
+            return out
+        claimable = [n for n in names
+                     if n.startswith("handoff-") and n.endswith(".npz")]
         for name in names:
-            out.append(self._load(os.path.join(self.path, name)))
-            out[-1].spool_file = name
-            self._loaded.add(name)
+            if ".claim-" not in name or name.endswith(".bad") \
+                    or name in self._mine:
+                continue
+            base, _, rest = name.partition(".claim-")
+            holder, _, deadline_s = rest.rpartition("-")
+            try:
+                expired = float(deadline_s) <= now
+            except ValueError:
+                expired = True          # malformed deadline: treat dead
+            if holder != self.worker and not expired:
+                continue                # a live peer's lease
+            try:
+                os.rename(os.path.join(self.path, name),
+                          os.path.join(self.path, base))
+            except OSError:
+                continue                # raced another reclaimer
+            self._redelivered.add(base)
+            self.reclaimed += 1
+            claimable.append(base)
+        for base in sorted(set(claimable), key=self._seq_of):
+            claim = f"{base}.claim-{self.worker}-{now + self.lease_s:.3f}"
+            src = os.path.join(self.path, base)
+            dst = os.path.join(self.path, claim)
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue                # a peer won the claim race
+            self._mine.add(claim)
+            try:
+                handoff = self._load(dst)
+            except Exception as e:  # noqa: BLE001 — quarantine, never crash
+                self._quarantine(base, claim, e)
+                continue
+            handoff.spool_file = claim
+            handoff.redelivered = 1 if base in self._redelivered else 0
+            out.append(handoff)
         return out
 
+    def _quarantine(self, base: str, claim: str, error: Exception) -> None:
+        """Park a corrupt payload at ``<spool-name>.bad`` (a recorded
+        disposition, outside every future claim scan) and tell the
+        caller — the worker stays alive."""
+        bad = os.path.join(self.path, base + ".bad")
+        nbytes = 0
+        try:
+            nbytes = os.path.getsize(os.path.join(self.path, claim))
+            os.replace(os.path.join(self.path, claim), bad)
+        except OSError:  # pragma: no cover — raced a reclaim
+            pass
+        self._mine.discard(claim)
+        self.quarantined += 1
+        self._consumed += 1
+        if self.on_quarantine is not None:
+            self.on_quarantine(self._uid_of(base), base, error, nbytes)
+
+    def renew(self, handoffs) -> None:
+        """Extend the lease on claims THIS worker still holds (polled
+        but not yet admitted — the deterministic-requeue wait when the
+        pool is full).  Call once per drive-loop tick: without renewal
+        a deferred admission outliving the lease would be reclaimed by
+        a live peer and double-served.  Renewal is the same atomic
+        rename as a claim; losing the race (a peer already reclaimed
+        after a REAL expiry) is tolerated — the redelivery lands on
+        whichever engine's seen-set wins."""
+        now = time.time()
+        for handoff in handoffs:
+            name = getattr(handoff, "spool_file", None)
+            if not name or name not in self._mine:
+                continue
+            base, _, rest = name.partition(".claim-")
+            deadline_s = rest.rpartition("-")[2]
+            try:
+                deadline = float(deadline_s)
+            except ValueError:
+                deadline = now
+            if deadline - now > self.lease_s / 2:
+                continue                # plenty of lease left
+            fresh = f"{base}.claim-{self.worker}-{now + self.lease_s:.3f}"
+            try:
+                os.rename(os.path.join(self.path, name),
+                          os.path.join(self.path, fresh))
+            except OSError:
+                continue                # lost the lease for real
+            self._mine.discard(name)
+            self._mine.add(fresh)
+            handoff.spool_file = fresh
+
     def ack(self, handoff: KvHandoff) -> None:
-        """The consumer owns the handoff now (admitted or terminally
-        rejected): drop its spool file."""
+        """The consumer owns the handoff now (admitted, duplicate-
+        detected, or terminally rejected): delete its claim file.  A
+        FileNotFoundError means our lease expired and a peer reclaimed
+        the file mid-decode — tolerated (the seen-set on whichever
+        engine admits the redelivery keeps admission idempotent)."""
         name = handoff.spool_file
         if name:
             try:
                 os.remove(os.path.join(self.path, name))
             except FileNotFoundError:
                 pass
+            self._mine.discard(name)
             handoff.spool_file = None
         self._consumed += 1
 
@@ -261,12 +459,20 @@ class FileTransport:
             src=meta.get("src", ""))
 
     def finished(self) -> bool:
+        """No more handoffs will ever arrive for ANY worker: the
+        producer closed the stream (sentinel on disk) and the spool is
+        empty — no unclaimed files, no live claims.  Defined on the
+        DIRECTORY rather than this instance's consumed count so N
+        workers sharing one spool each exit exactly when the last
+        file is acked, wherever it was acked."""
         sentinel = os.path.join(self.path, self.SENTINEL)
         if self._expected is None and os.path.exists(sentinel):
-            with open(sentinel) as fh:
-                self._expected = int(json.load(fh)["handoffs"])
-        return self._expected is not None \
-            and self._consumed >= self._expected
+            try:
+                with open(sentinel) as fh:
+                    self._expected = int(json.load(fh)["handoffs"])
+            except (OSError, ValueError, KeyError):
+                self._expected = -1     # unreadable sentinel still closes
+        return self._expected is not None and self.pending_on_disk() == 0
 
 
 # ------------------------------------------------------------ drive loops
@@ -287,28 +493,72 @@ def run_prefill_role(engine, transport, max_steps: Optional[int] = None,
 
 def run_decode_role(engine, transport, max_steps: Optional[int] = None,
                     idle_wait_s: float = 0.0, stop=None,
-                    on_tick=None) -> List[Completion]:
+                    on_tick=None, fault=None,
+                    idle_timeout_s: Optional[float] = None
+                    ) -> List[Completion]:
     """Drive a decode-role engine off a transport: poll for handoffs,
     admit them IN ORDER (a handoff the pool cannot fit yet stays at the
     head and is retried next tick — deterministic requeue, never a
     drop), tick while there is work, exit once the transport is
-    finished and every admitted request terminated."""
+    finished and every admitted request terminated.
+
+    ``fault`` takes the decode-side handoff drills (ISSUE 15):
+    ``handoff_crash_preack`` raises between the Nth successful admit
+    and its ack — the claim survives on disk for redelivery — and
+    ``handoff_dup`` redelivers the Nth admitted handoff once more (the
+    engine's seen-set detects it and it is acked without a second
+    scatter).  ``idle_timeout_s`` bounds how long an idle worker waits
+    for an unfinished transport — the sentinel_lost shape: when the
+    producer died without closing the stream, finish what is spooled
+    and exit instead of spinning forever."""
     engine.queue.close()               # decode-role intake is the transport
     pending: deque = deque()
+    admits = 0
+    last_progress = time.time()
     while max_steps is None or engine.step_count < max_steps:
         if stop is not None and stop():
             break
-        pending.extend(transport.poll())
+        polled = transport.poll()
+        if polled:
+            pending.extend(polled)
+            last_progress = time.time()
+        if pending:
+            # Keep our claims alive while admissions are deferred (a
+            # full pool must not silently forfeit work to a peer).
+            transport.renew(pending)
         while pending and engine.admit_handoff(pending[0]):
-            transport.ack(pending.popleft())
+            handoff = pending.popleft()
+            admits += 1
+            if fault is not None and fault.kind == "handoff_crash_preack" \
+                    and fault.due(admits):
+                # The ack-crash window, deterministically: the handoff
+                # is ADMITTED (scattered, recorded) but its claim file
+                # survives — redelivery must find the engine's seen-set.
+                fault.take()
+                raise FaultInjected(
+                    f"injected handoff_crash_preack at admit {admits} "
+                    f"(uid {handoff.uid} admitted, never acked)")
+            transport.ack(handoff)
+            if fault is not None and fault.kind == "handoff_dup" \
+                    and fault.due(admits):
+                # Duplicate-delivery drill: the same payload arrives
+                # again (a peer double-claim after lease skew) — queued
+                # at the tail so the admit loop meets it as a fresh
+                # delivery.
+                fault.take()
+                pending.append(handoff)
         has_work = engine.pool.any_live()
         if has_work:
             engine.step()
+            last_progress = time.time()
         if on_tick is not None:
             on_tick(engine)
         if not has_work:
             if transport.finished() and not pending:
                 break
+            if idle_timeout_s is not None and not pending \
+                    and time.time() - last_progress > idle_timeout_s:
+                break                  # producer died sentinel-less
             if idle_wait_s:
                 time.sleep(idle_wait_s)
     return engine.completions

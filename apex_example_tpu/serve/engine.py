@@ -328,6 +328,16 @@ class ServeEngine:
         self.handoff_requeued = 0
         self._handoff_bytes = 0
         self._handoff_ms: List[float] = []
+        # Idempotent admission (ISSUE 15): uids this engine already
+        # admitted — a redelivered claim (worker died between admit and
+        # ack; duplicate delivery after lease skew) is detected here
+        # and acked WITHOUT a second scatter.  A restarted fleet
+        # replica seeds it from its outbox so a handoff completed just
+        # before the crash is never served twice (serve.py).
+        self.handoff_seen: set = set()
+        self.handoff_redelivered: set = set()   # uids admitted from a
+        #                                         reclaimed/adopted lease
+        self.handoff_duplicates = 0
         # Mesh awareness: under a registered parallel_state mesh the
         # weights and per-layer KV arenas shard over heads on the
         # 'model' axis (the bert/gpt constraint points from the TP
@@ -819,8 +829,31 @@ class ServeEngine:
         the caller requeues the same handoff deterministically and
         retries after evictions free capacity.  A handoff this engine
         could NEVER serve terminates first-class as "rejected" and
-        returns True (consumed)."""
+        returns True (consumed).  A handoff whose uid this engine
+        ALREADY admitted — a redelivery of a claim that was never
+        acked, or a duplicate delivery — is consumed idempotently: a
+        ``kv_handoff`` record with ``duplicate: true`` lands, nothing
+        is scattered, and True tells the caller to ack it."""
         req = handoff.request
+        if req.uid in self.handoff_seen:
+            # The ack-crash window closes here: admitted before, so the
+            # payload (and possibly the finished request) already lives
+            # in this engine — ack the redelivery, never scatter twice.
+            self.handoff_duplicates += 1
+            if self.sink is not None:
+                rec: Dict[str, Any] = {
+                    "record": "kv_handoff", "time": _wall(),
+                    "request_id": req.uid, "direction": "in",
+                    "fill": handoff.fill, "blocks": 0,
+                    "payload_bytes": handoff.payload_bytes,
+                    "kv_dtype": self.pool.kv_dtype,
+                    "duplicate": True,
+                    "redelivered": int(handoff.redelivered),
+                    "dst": self.role}
+                if self.run_id:
+                    rec["run_id"] = self.run_id
+                self.sink.write(rec)
+            return True
         if self.draining:
             return False             # drain stopped admission (requeue)
         if handoff.block_size != self.pool.block_size:
@@ -847,13 +880,16 @@ class ServeEngine:
         slot.n_generated = len(handoff.tokens) - len(req.prompt)
         slot.t_first_token = now
         self.handoffs_in += 1
+        self.handoff_seen.add(req.uid)
+        if handoff.redelivered:
+            self.handoff_redelivered.add(req.uid)
         self._handoff_bytes += handoff.payload_bytes
         transit_ms = max((_wall() - handoff.t_out_wall) * 1e3, 0.0)
         self._handoff_ms.append(transit_ms)
         if self._tracer is not None:
             self._rtrace[req.uid] = []
         if self.sink is not None:
-            rec: Dict[str, Any] = {
+            rec = {
                 "record": "kv_handoff", "time": _wall(),
                 "request_id": req.uid, "direction": "in",
                 "fill": handoff.fill, "blocks": slot.n_mapped,
@@ -864,6 +900,8 @@ class ServeEngine:
                 "handoff_ms": round(transit_ms, 3),
                 "requeued": handoff.requeued,
                 "dst": self.role}
+            if handoff.redelivered:
+                rec["redelivered"] = int(handoff.redelivered)
             if handoff.src:
                 rec["src"] = handoff.src
             if self.run_id:
@@ -1070,6 +1108,10 @@ class ServeEngine:
             rec["handoffs_in"] = self.handoffs_in
         if self.handoff_requeued:
             rec["handoff_requeued"] = self.handoff_requeued
+        if self.handoff_duplicates:
+            rec["handoff_duplicates"] = self.handoff_duplicates
+        if self.handoff_redelivered:
+            rec["handoff_redelivered"] = len(self.handoff_redelivered)
         if self._handoff_bytes:
             rec["handoff_bytes"] = self._handoff_bytes
         if self._handoff_ms:

@@ -45,6 +45,22 @@ required), ``crash_storm`` (``--crash-replicas`` k die at
 ``--fault-tick``), ``straggler`` (one replica hangs; the router's
 stall detector rescues its requests).  The run exits 0 only when the
 scenario verdict is "pass".
+
+Disaggregated fleets (ISSUE 15): ``--decode-replicas K`` runs the
+last K replicas as ``--role decode`` workers off one shared leased
+KV-handoff spool (never routed prompts; their outboxes report the
+spool-fed terminals) with the rest as ``--role prefill``.  Two disagg
+chaos scenarios ride the same verdict machinery:
+``decode_crash_midspool`` (a decode worker dies in the ack-crash
+window holding claimed-but-unacked handoffs; peers must reclaim the
+expired leases and finish the redelivered work) and ``prefill_crash``
+(the prefill role dies mid-serve; its queued requests re-route on
+restart while spooled requests keep decoding).
+
+    # 1 prefill + 2 decode, kill one decode worker mid-spool:
+    python fleet.py --replicas 3 --decode-replicas 2 \\
+        --transport proc --scenario decode_crash_midspool \\
+        --requests 10 --handoff-lease 1.0 --metrics-jsonl fleet.jsonl
 """
 
 from __future__ import annotations
@@ -53,6 +69,7 @@ import argparse
 import importlib.util
 import os
 import sys
+import tempfile
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -86,9 +103,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dispatch policy (fleet/router.py)")
     p.add_argument("--scenario", default="none",
                    choices=["none", "rolling_restart", "crash_storm",
-                            "straggler"],
+                            "straggler", "prefill_crash",
+                            "decode_crash_midspool"],
                    help="scripted chaos scenario, scored into "
-                        "fleet_summary (fleet/scenarios.py)")
+                        "fleet_summary (fleet/scenarios.py; the "
+                        "*_crash* disagg scenarios need "
+                        "--decode-replicas)")
+    p.add_argument("--decode-replicas", type=int, default=0,
+                   metavar="K",
+                   help="disaggregated fleet (ISSUE 15): the LAST K "
+                        "replicas run --role decode off a shared "
+                        "KV-handoff spool and are never routed prompts "
+                        "(their outboxes report the spool-fed "
+                        "terminals); the rest run --role prefill.  "
+                        "0 = classic homogeneous fleet")
+    p.add_argument("--handoff-lease", type=float, default=2.0,
+                   metavar="S",
+                   help="disagg fleet: wall-clock lease on claimed "
+                        "spool files — a dead worker's claims are "
+                        "reclaimed by peers after S seconds "
+                        "(default 2)")
     p.add_argument("--requests", type=int, default=16,
                    help="workload size (synthetic specs)")
     p.add_argument("--prompt-len", default="3:8",
@@ -162,6 +196,18 @@ def run_fleet(args):
         raise SystemExit("crash_storm needs at least one surviving "
                          f"replica (--crash-replicas {args.crash_replicas}"
                          f" vs --replicas {args.replicas})")
+    if not 0 <= args.decode_replicas < args.replicas:
+        raise SystemExit("--decode-replicas must leave at least one "
+                         f"prefill replica (got {args.decode_replicas} "
+                         f"of {args.replicas})")
+    if args.scenario in ("prefill_crash", "decode_crash_midspool") \
+            and args.decode_replicas < 1:
+        raise SystemExit(f"--scenario {args.scenario} is a disagg "
+                         "scenario: set --decode-replicas >= 1")
+    if args.scenario == "decode_crash_midspool" \
+            and args.decode_replicas < 2:
+        raise SystemExit("decode_crash_midspool needs a surviving peer "
+                         "decode worker: set --decode-replicas >= 2")
     stall_after = args.stall_after
     if stall_after is None and args.scenario == "straggler":
         stall_after = 0.75
@@ -179,16 +225,40 @@ def run_fleet(args):
     prompt_len = lohi(args.prompt_len, "prompt-len")
     max_new = lohi(args.max_new, "max-new")
 
+    # Topology: the last --decode-replicas names run role "decode" off
+    # a shared spool, the rest "prefill" (or everything "both" in the
+    # classic homogeneous fleet).
     names = [f"r{i}" for i in range(args.replicas)]
+    n_decode = args.decode_replicas
+    if n_decode:
+        roles = {name: ("decode" if i >= args.replicas - n_decode
+                        else "prefill")
+                 for i, name in enumerate(names)}
+    else:
+        roles = {name: "both" for name in names}
+    prefill_names = [n for n in names if roles[n] != "decode"]
+    decode_names = [n for n in names if roles[n] == "decode"]
     crashed_names = names[:args.crash_replicas] \
         if args.scenario == "crash_storm" else []
+    if args.scenario == "prefill_crash":
+        crashed_names = [prefill_names[0]]
+    elif args.scenario == "decode_crash_midspool":
+        crashed_names = [decode_names[0]]
     straggler_name = names[0] if args.scenario == "straggler" else None
 
+    # Lazy: only the proc transport and a disagg spool need scratch
+    # space — a plain thread fleet must not litter /tmp.
+    workdir = args.workdir
+    if workdir is None and (n_decode or args.transport == "proc"):
+        workdir = (os.path.join(os.path.dirname(args.metrics_jsonl)
+                                or ".", "fleet_work")
+                   if args.metrics_jsonl
+                   else tempfile.mkdtemp(prefix="apex_fleet_"))
+    spool = os.path.join(workdir, "spool") if n_decode else None
+    if spool:
+        os.makedirs(spool, exist_ok=True)
+
     if args.transport == "proc":
-        workdir = args.workdir or (
-            os.path.join(os.path.dirname(args.metrics_jsonl) or ".",
-                         "fleet_work") if args.metrics_jsonl
-            else "/tmp/apex_fleet_work")
         replicas = []
         for name in names:
             serve_args = ["--slots", str(args.slots),
@@ -197,9 +267,18 @@ def run_fleet(args):
                 serve_args += ["--max-len", str(args.max_len)]
             if args.trace:
                 serve_args += ["--trace"]
+            if roles[name] == "decode":
+                serve_args += ["--handoff-lease",
+                               str(args.handoff_lease)]
             if name in crashed_names:
-                serve_args += ["--inject-fault",
-                               f"crash@{args.fault_tick}"]
+                drill = f"crash@{args.fault_tick}"
+                if args.scenario == "decode_crash_midspool":
+                    # The ack-crash window, on the first admit: the
+                    # supervisor strips the drill from the restart
+                    # attempt (a decode worker replays the spool from
+                    # its claim set, so it would re-fire).
+                    drill = "handoff_crash_preack@1"
+                serve_args += ["--inject-fault", drill]
             sup_args = ["--max-restarts", str(args.max_restarts),
                         "--backoff", "0.2"]
             if name == straggler_name:
@@ -210,7 +289,8 @@ def run_fleet(args):
                 sup_args += ["--stall-kill", "10"]
             replicas.append(replica_mod.ProcReplica(
                 name, workdir, REPO, serve_args=serve_args,
-                supervise_args=sup_args))
+                supervise_args=sup_args, role=roles[name],
+                spool_dir=spool))
         vocab = args.vocab_size
     else:
         import jax
@@ -235,6 +315,35 @@ def run_fleet(args):
                                block_size=args.block_size,
                                rng=jax.random.PRNGKey(args.seed))
 
+        def role_factories(name):
+            # Disagg roles over one shared spool: a prefill engine
+            # ships handoffs through its own producer-side transport; a
+            # decode replica gets a consumer transport under ITS name,
+            # so a rebuilt instance adopts its own pre-crash claims.
+            from apex_example_tpu.serve import FileTransport
+
+            def prefill_engine():
+                tx = FileTransport(spool, worker=f"{name}.tx")
+                return ServeEngine(model, params, num_slots=args.slots,
+                                   max_len=max_len,
+                                   block_size=args.block_size,
+                                   rng=jax.random.PRNGKey(args.seed),
+                                   role="prefill",
+                                   handoff_sink=tx.send)
+
+            def decode_engine():
+                return ServeEngine(model, params, num_slots=args.slots,
+                                   max_len=max_len,
+                                   block_size=args.block_size,
+                                   rng=jax.random.PRNGKey(args.seed),
+                                   role="decode")
+
+            def decode_transport():
+                return FileTransport(spool, worker=name,
+                                     lease_s=args.handoff_lease)
+
+            return prefill_engine, decode_engine, decode_transport
+
         def make_request(spec):
             return Request(prompt=spec["prompt"],
                            max_new_tokens=int(spec["max_new_tokens"]),
@@ -248,13 +357,28 @@ def run_fleet(args):
         for name in names:
             fault = None
             if name in crashed_names:
-                fault = FaultPlan("crash", args.fault_tick,
-                                  kinds=SERVE_KINDS)
+                kind = "handoff_crash_preack" \
+                    if args.scenario == "decode_crash_midspool" \
+                    else "crash"
+                tick = 1 if kind == "handoff_crash_preack" \
+                    else args.fault_tick
+                fault = FaultPlan(kind, tick, kinds=SERVE_KINDS)
             elif name == straggler_name:
                 fault = FaultPlan("hang", args.fault_tick,
                                   kinds=SERVE_KINDS)
-            replicas.append(replica_mod.ThreadReplica(
-                name, factory, make_request, fault=fault))
+            if roles[name] == "both":
+                replicas.append(replica_mod.ThreadReplica(
+                    name, factory, make_request, fault=fault))
+            else:
+                pre, dec, tx_factory = role_factories(name)
+                if roles[name] == "prefill":
+                    replicas.append(replica_mod.ThreadReplica(
+                        name, pre, make_request, fault=fault,
+                        role="prefill"))
+                else:
+                    replicas.append(replica_mod.ThreadReplica(
+                        name, dec, fault=fault, role="decode",
+                        transport_factory=tx_factory))
 
     specs = scen_mod.synthetic_specs(
         args.requests, vocab_size=vocab, seed=args.seed,
@@ -268,6 +392,10 @@ def run_fleet(args):
         breaker_backoff_s=args.breaker_backoff,
         stall_after_s=stall_after,
         default_deadline_s=args.deadline_s,
+        # Disagg self-healing: well past the lease, so live
+        # redelivery always gets first go at a dead worker's claims.
+        spool_timeout_s=max(4.0 * args.handoff_lease, 5.0)
+        if n_decode else None,
         trace=args.trace)
     print(f"fleet: {args.replicas} x {args.transport} replica(s)  "
           f"policy={args.policy}  scenario={args.scenario}  "
@@ -280,6 +408,11 @@ def run_fleet(args):
         kw["restart_crashed"] = args.transport == "thread"
     elif args.scenario == "straggler":
         kw["straggler_name"] = straggler_name
+    elif args.scenario == "prefill_crash":
+        kw["crashed_name"] = crashed_names[0]
+        kw["restart_crashed"] = args.transport == "thread"
+    elif args.scenario == "decode_crash_midspool":
+        kw["crashed_name"] = crashed_names[0]
     try:
         summary = scen_mod.run_scenario(args.scenario, router, replicas,
                                         specs, **kw)

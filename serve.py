@@ -59,9 +59,24 @@ first token and ships its KV blocks (storage-dtype-exact payloads +
 scales + fill levels) to the ``--handoff-dir`` spool; ``--role
 decode`` admits those payloads into its own arena and decodes with a
 [slots, 1]-wide step — so long prompts stop stalling decode ticks.
-Both sides emit schema-v12 ``kv_handoff`` records and
-``tools/ci_gate.py --disagg-stream`` checks a recorded pair for zero
-lost handoffs.
+
+The spool speaks a LEASED crash-safe protocol (ISSUE 15; README
+"Disaggregated serving resilience"): decode workers claim files by
+atomic rename and hold a ``--handoff-lease`` wall-clock lease,
+ack-by-delete at admission, reclaim a dead peer's expired claims (or
+adopt their own pre-crash claims on restart) so handoffs REDELIVER
+instead of stranding, detect redeliveries of already-admitted uids
+against the engine's seen-set (acked as duplicates, never scattered
+twice), quarantine corrupt payloads to ``*.bad`` instead of dying,
+and bound the wait for a producer that died sentinel-less
+(``--handoff-idle-timeout``).  N decode workers can share one spool.
+Both sides emit schema-v13 ``kv_handoff`` records (with
+redelivered/duplicate/quarantine provenance) and ``tools/ci_gate.py
+--disagg-stream`` checks a recorded deployment for conservation —
+redelivery tolerated, exactly-once admission and terminal per uid.
+A decode worker composes with the fleet protocol via ``--outbox``
+alone (no ``--inbox`` — the spool is its intake); a prefill worker
+takes the full inbox/outbox pair.
 
 Resilience (README "Serving resilience"; ISSUE 5): SIGTERM/SIGUSR1
 triggers a graceful drain — admission stops, queued requests are handed
@@ -225,8 +240,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "interleaved engine")
     p.add_argument("--handoff-dir", default=None, metavar="DIR",
                    help="KV-handoff spool directory connecting a "
-                        "--role prefill process to a --role decode "
-                        "process (atomic npz files + a close sentinel)")
+                        "--role prefill process to one or more --role "
+                        "decode processes (atomic npz files claimed by "
+                        "lease + a close sentinel; serve/disagg.py)")
+    p.add_argument("--handoff-lease", type=float, default=30.0,
+                   metavar="S",
+                   help="decode role: wall-clock lease on each claimed "
+                        "spool file — a claim whose holder dies is "
+                        "reclaimed by any peer after S seconds and the "
+                        "handoff redelivered (default 30)")
+    p.add_argument("--handoff-idle-timeout", type=float, default=None,
+                   metavar="S",
+                   help="decode role: exit after S idle seconds when "
+                        "the spool never closes (the producer died "
+                        "before writing the sentinel) instead of "
+                        "waiting forever (default: wait)")
     p.add_argument("--weight-quant", default="none",
                    choices=["none", "int8", "fp8"],
                    help="quantize the restored weights for serving "
@@ -265,7 +293,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "1-based engine tick: crash | sigterm | hang | "
                         "nan | slot_fail (resilience/faults.py; sigterm "
                         "exercises the drain path, slot_fail the "
-                        "slot-isolation path)")
+                        "slot-isolation path).  Handoff drills (the "
+                        "disagg resilience path, @N = the Nth "
+                        "send/admit): handoff_torn | sentinel_lost on "
+                        "a --role prefill process, "
+                        "handoff_crash_preack | handoff_dup on a "
+                        "--role decode process")
     p.add_argument("--flight-recorder", action="store_true",
                    help="arm crash forensics (obs/flight.py): abnormal "
                         "exits write a crash_dump + aborted summary to "
@@ -332,13 +365,18 @@ class _Outbox:
 
     def flush_from(self, engine) -> None:
         comps = engine.completions
+        # Redelivery provenance rides the outbox (ISSUE 15): the fleet
+        # router's disagg accounting keys on which terminals came from
+        # a redelivered handoff admission.
+        redelivered = getattr(engine, "handoff_redelivered", ())
         for c in comps[self._consumed:]:
-            self._fh.write(json.dumps(
-                {"uid": c.request.uid, "status": c.status,
-                 "finish_reason": c.finish_reason,
-                 "tokens": [int(t) for t in c.tokens],
-                 "tick": c.finished_step},
-                separators=(",", ":")) + "\n")
+            ev = {"uid": c.request.uid, "status": c.status,
+                  "finish_reason": c.finish_reason,
+                  "tokens": [int(t) for t in c.tokens],
+                  "tick": c.finished_step}
+            if c.request.uid in redelivered:
+                ev["redelivered"] = True
+            self._fh.write(json.dumps(ev, separators=(",", ":")) + "\n")
         self._consumed = len(comps)
         self._fh.flush()
 
@@ -410,7 +448,8 @@ def run_serve(args):
                                                 serve_mesh)
     from apex_example_tpu.resilience import (EX_TEMPFAIL, FaultPlan,
                                              PreemptionHandler)
-    from apex_example_tpu.resilience.faults import SERVE_KINDS
+    from apex_example_tpu.resilience.faults import (HANDOFF_KINDS,
+                                                    SERVE_KINDS)
     from apex_example_tpu.serve import (FileTransport, Request,
                                         RequestQueue, ServeEngine,
                                         parse_range, run_decode_role,
@@ -463,7 +502,15 @@ def run_serve(args):
         raise SystemExit("--trace requires --metrics-jsonl (the "
                          "trace_event records ride the metrics stream)")
     replica_mode = bool(args.inbox or args.outbox)
-    if replica_mode and not (args.inbox and args.outbox):
+    if args.role == "decode":
+        # A decode worker's intake is the --handoff-dir spool, never an
+        # inbox; its fleet surface is the outbox alone (terminal lines
+        # out, so a router can harvest what the spool fed it).
+        if args.inbox:
+            raise SystemExit("--role decode takes no --inbox (its "
+                             "intake is the --handoff-dir spool); give "
+                             "it --outbox alone for the fleet protocol")
+    elif replica_mode and not (args.inbox and args.outbox):
         raise SystemExit("--inbox and --outbox come together (the "
                          "fleet replica protocol: specs in, terminal "
                          "lines out)")
@@ -473,10 +520,9 @@ def run_serve(args):
     if args.handoff_dir and args.role == "both":
         raise SystemExit("--handoff-dir only means something for a "
                          "--role prefill or decode process")
-    if replica_mode and args.role != "both":
-        raise SystemExit("--role prefill/decode does not compose with "
-                         "the --inbox/--outbox replica protocol yet — "
-                         "front each role with its own router instead")
+    if args.handoff_lease <= 0:
+        raise SystemExit(f"--handoff-lease must be > 0, got "
+                         f"{args.handoff_lease}")
     if args.heartbeat_s <= 0:
         raise SystemExit(f"--heartbeat-s must be > 0, got "
                          f"{args.heartbeat_s}")
@@ -486,6 +532,19 @@ def run_serve(args):
             fault = FaultPlan.parse(args.inject_fault, kinds=SERVE_KINDS)
         except ValueError as e:
             raise SystemExit(str(e))
+    # Handoff drills fire inside the transport / decode drive loop, not
+    # the engine tick loop — route the plan there, and reject a drill
+    # the process's role could never express (a silently-inert drill is
+    # worse than an error).
+    handoff_fault = None
+    if fault is not None and fault.kind in HANDOFF_KINDS:
+        need = "prefill" if fault.kind in ("handoff_torn",
+                                           "sentinel_lost") else "decode"
+        if args.role != need:
+            raise SystemExit(f"--inject-fault {fault.kind} is a "
+                             f"{need}-side drill (this process is "
+                             f"--role {args.role})")
+        handoff_fault, fault = fault, None
 
     if args.checkpoint_dir:
         params = restore_params(args.checkpoint_dir, args.checkpoint_step)
@@ -570,8 +629,30 @@ def run_serve(args):
 
     queue = RequestQueue(max_pending=args.max_pending,
                          shed_policy=args.shed_policy)
-    transport = FileTransport(args.handoff_dir) if args.handoff_dir \
-        else None
+
+    def on_quarantine(uid, spool_name, error, nbytes):
+        # A corrupt/truncated payload was parked at *.bad — the worker
+        # keeps ticking; the stream records the disposition (schema
+        # v13: kv_handoff direction "quarantine").
+        print(f"WARNING: quarantined corrupt handoff {uid} "
+              f"({spool_name}): {error}", file=sys.stderr)
+        if sink is None:
+            return
+        sink.write({"record": "kv_handoff", "time": time.time(),
+                    "request_id": uid, "direction": "quarantine",
+                    "fill": 0, "blocks": 0,
+                    "payload_bytes": int(nbytes),
+                    "spool_file": spool_name,
+                    "error": str(error)[:500], "run_id": run_id})
+
+    transport = None
+    if args.handoff_dir:
+        transport = FileTransport(
+            args.handoff_dir, worker=args.replica_id,
+            lease_s=args.handoff_lease,
+            fault=handoff_fault if args.role == "prefill" else None,
+            on_quarantine=on_quarantine if args.role == "decode"
+            else None)
     # The mesh registers BEFORE the engine builds (construction shards
     # the restored — possibly quantized — params and the paged arenas
     # against it) and must STAY registered through the run: the TP
@@ -599,11 +680,21 @@ def run_serve(args):
         idle_wait_s = 0.0
         if replica_mode:
             outbox = _Outbox(args.outbox)
-            feeder_stop = threading.Event()
-            threading.Thread(
-                target=_feed_inbox,
-                args=(args.inbox, queue, outbox, feeder_stop, Request),
-                name="inbox-feeder", daemon=True).start()
+            if args.role == "decode":
+                # Crash-safe exactly-once across restarts: uids already
+                # terminal in the outbox must never be served again —
+                # the restarted worker replays the spool from its claim
+                # set, and a handoff completed just before the crash
+                # (terminal on disk, claim never acked) comes back as a
+                # redelivery the seen-set turns into a duplicate-ack.
+                engine.handoff_seen.update(outbox.done)
+            else:
+                feeder_stop = threading.Event()
+                threading.Thread(
+                    target=_feed_inbox,
+                    args=(args.inbox, queue, outbox, feeder_stop,
+                          Request),
+                    name="inbox-feeder", daemon=True).start()
             idle_wait_s = 0.004             # wall-clock producer: don't spin
 
             def _beat(state: str) -> None:
@@ -612,9 +703,12 @@ def run_serve(args):
                 # v12: kv_bytes_live is the dtype-accurate gauge (int8
                 # arenas count int8 bytes + scales) — what the fleet
                 # router's least_kv policy prefers over the raw block
-                # count when replicas mix precisions.
+                # count when replicas mix precisions.  v13: the role
+                # rides along so fleet tooling can tell a prefill
+                # heartbeat from a decode one.
                 sink.write({"record": "replica_state", "time": time.time(),
                             "replica": args.replica_id, "state": state,
+                            "role": args.role,
                             "tick": engine.step_count,
                             "pending": engine.queue.pending(),
                             "blocks_live": engine.pool.blocks_live(),
@@ -645,10 +739,10 @@ def run_serve(args):
             engine.queue.close()
 
         pool = engine.pool
-        if replica_mode:
-            workload = f"replica {args.replica_id} (inbox-fed)"
-        elif args.role == "decode":
+        if args.role == "decode":
             workload = f"decode role (handoffs from {args.handoff_dir})"
+        elif replica_mode:
+            workload = f"replica {args.replica_id} (inbox-fed)"
         else:
             workload = f"{args.requests} request(s)"
         shard = f"  mesh=data={dp},model={tp}" if mesh is not None else ""
@@ -667,7 +761,8 @@ def run_serve(args):
                 max_steps=args.steps or None,
                 idle_wait_s=0.004,
                 stop=(lambda: preempt.preempted) if preempt else None,
-                on_tick=on_tick)
+                on_tick=on_tick, fault=handoff_fault,
+                idle_timeout_s=args.handoff_idle_timeout)
         else:
             completions = engine.run(
                 max_steps=args.steps or None,
@@ -688,10 +783,13 @@ def run_serve(args):
                   f"requeued={drain['requeued']}; exiting {EX_TEMPFAIL} "
                   f"(resumable)")
             rc = EX_TEMPFAIL
-        if args.role == "prefill":
+        if args.role == "prefill" and rc == 0:
             # Close AFTER any drain: the drain's in-flight slots finish
-            # by handing off, and the sentinel's count must cover them
-            # so the decode side knows when the stream truly ends.
+            # by handing off, and the sentinel's count must cover them.
+            # A DRAINED prefill (rc 75) writes no sentinel — the
+            # supervisor restarts it to finish the stream, and an early
+            # sentinel would let an idle decode worker exit while the
+            # spool is only momentarily empty.
             transport.close()
         if outbox is not None:
             # Everything terminal — drained requeues included — must be
@@ -699,6 +797,8 @@ def run_serve(args):
             # router's completion feed both read from here.
             outbox.flush_from(engine)
         summary = engine.summary_record()
+        if transport is not None and transport.quarantined:
+            summary["handoff_quarantined"] = transport.quarantined
         if sink is not None:
             sink.write(summary)
     finally:
@@ -727,19 +827,21 @@ def run_serve(args):
             sink.close()
 
     counts = engine.counts
-    if replica_mode:
+    if args.role == "decode":
+        # The decode role's workload is whatever the transport fed it
+        # (replica mode included — its inbox IS the spool).  A --steps
+        # cap can strand requests mid-flight AND leave un-acked
+        # handoffs in the spool (claims and files survive —
+        # re-servable by the next worker — but THIS run did not finish
+        # them).
+        stranded = len(engine.pool.live) + transport.pending_on_disk()
+        n_expected = len(completions) + stranded
+    elif replica_mode:
         # A --steps-capped replica can run out of ticks with inbox
         # requests still queued or mid-decode; they reached no terminal
         # status and no outbox line, so exiting 0 would hide the loss
         # (review finding, ISSUE 12).
         stranded = engine.queue.pending() + len(engine.pool.live)
-        n_expected = len(completions) + stranded
-    elif args.role == "decode":
-        # The decode role's workload is whatever the transport fed it.
-        # A --steps cap can strand requests mid-flight AND leave
-        # un-acked handoffs in the spool (files survive — re-servable
-        # by the next worker — but THIS run did not finish them).
-        stranded = len(engine.pool.live) + transport.pending_on_disk()
         n_expected = len(completions) + stranded
     else:
         n_expected = args.requests

@@ -34,6 +34,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -47,6 +48,8 @@ from apex_example_tpu.obs import schema as obs_schema
 from apex_example_tpu.obs import trace as trace_lib
 from apex_example_tpu.obs.metrics import nearest_rank
 from apex_example_tpu.parallel.mesh import parse_serve_mesh, serve_mesh
+from apex_example_tpu.resilience.faults import (SERVE_KINDS,
+                                                FaultInjected, FaultPlan)
 from apex_example_tpu.serve import (FileTransport, KvHandoff,
                                     QueueTransport, Request, ServeEngine,
                                     run_decode_role, run_disagg,
@@ -669,6 +672,360 @@ def test_metrics_lint_fixture_streams():
     for name in ("prefill.jsonl", "decode.jsonl"):
         code, errors = lint.lint(os.path.join(FIXTURES, name))
         assert code == 0, errors
+
+
+# ------------------------------------- leased handoff crash safety
+
+
+def _reqs(n, seed, max_new=5):
+    rs = np.random.RandomState(seed)
+    return [Request(prompt=[int(t) for t in rs.randint(0, 256,
+                                                       4 + i % 4)],
+                    max_new_tokens=max_new) for i in range(n)]
+
+
+def _spool_prefill(model, params, spool, reqs, sink=None, fault=None):
+    """Chunk-prefill ``reqs`` into ``spool`` (sentinel written unless
+    the fault eats it); returns the prefill engine."""
+    tx = FileTransport(spool, worker="prefill", fault=fault)
+    pe = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                     role="prefill", handoff_sink=tx.send, sink=sink,
+                     rng=jax.random.PRNGKey(0))
+    pe.queue.submit_all(reqs)
+    pe.queue.close()
+    run_prefill_role(pe, tx, max_steps=500)
+    return pe
+
+
+def _decode_engine(model, params, sink=None):
+    return ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                       role="decode", sink=sink,
+                       rng=jax.random.PRNGKey(0))
+
+
+def _header(sink):
+    obs.TelemetryEmitter(sink).run_header(
+        config={"slots": SLOTS, "max_len": MAX_LEN}, argv=["serve.py"],
+        arch="gpt_tiny")
+
+
+def test_lease_claim_reclaim_and_adopt(model_and_params, tmp_path):
+    """The lease protocol at the transport level: claims are exclusive
+    while the lease holds, an expired claim is reclaimed by ANY peer
+    (redelivered=1), and ack-by-delete drains the spool for the
+    directory-wide finished()."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    _spool_prefill(model, params, spool, _reqs(2, seed=31))
+    a = FileTransport(spool, worker="a", lease_s=0.05)
+    got = a.poll()
+    assert len(got) == 2 and all(h.redelivered == 0 for h in got)
+    assert a.pending_on_disk() == 2         # claims still on disk
+    b = FileTransport(spool, worker="b", lease_s=30.0)
+    assert b.poll() == []                   # a's lease still holds
+    time.sleep(0.08)                        # ...until it expires
+    got_b = b.poll()
+    assert len(got_b) == 2 and all(h.redelivered == 1 for h in got_b)
+    assert b.reclaimed == 2
+    for h in got_b:
+        b.ack(h)
+    assert b.pending_on_disk() == 0 and b.finished()
+
+
+def test_lease_renewal_keeps_deferred_claims(model_and_params,
+                                             tmp_path):
+    """Review fix (ISSUE 15): a live worker whose admissions are
+    deferred past the lease must RENEW its claims — without renewal a
+    peer would reclaim and double-serve work the holder still owns."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    _spool_prefill(model, params, spool, _reqs(2, seed=41))
+    a = FileTransport(spool, worker="a", lease_s=0.1)
+    pending = a.poll()
+    assert len(pending) == 2
+    b = FileTransport(spool, worker="b", lease_s=30.0)
+    for _ in range(4):                  # well past the original lease
+        time.sleep(0.06)
+        a.renew(pending)                # the drive loop's per-tick call
+        assert b.poll() == []           # the peer never gets them
+    for h in pending:
+        a.ack(h)                        # renewal tracked the renamed
+    assert a.pending_on_disk() == 0     #   claim files correctly
+
+
+def test_lease_adopts_own_claims_without_wait(model_and_params,
+                                              tmp_path):
+    """A worker coming back under its OWN id (supervised restart)
+    adopts its pre-crash claims immediately — no lease wait."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    _spool_prefill(model, params, spool, _reqs(1, seed=32))
+    a1 = FileTransport(spool, worker="a", lease_s=60.0)
+    assert len(a1.poll()) == 1              # claimed, never acked
+    a2 = FileTransport(spool, worker="a", lease_s=60.0)
+    got = a2.poll()
+    assert len(got) == 1 and got[0].redelivered == 1
+
+
+def test_ack_crash_window_exactly_once(model_and_params, tmp_path):
+    """Satellite (ISSUE 15): kill the decode worker between
+    ``admit_handoff`` and ``ack``.  The claim survives on disk; the
+    restarted worker adopts it, the engine's seen-set detects the
+    redelivery as a duplicate (acked, nothing scattered twice), and
+    every request completes exactly once with tokens identical to the
+    fault-free run — the recorded pair passing the v13
+    ci_gate --disagg-stream."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    p_path = str(tmp_path / "prefill.jsonl")
+    d_path = str(tmp_path / "decode.jsonl")
+    reqs = _reqs(5, seed=33)
+    p_sink = obs.JsonlSink(p_path, rank=0)
+    _header(p_sink)
+    pe = _spool_prefill(model, params, spool, reqs, sink=p_sink)
+    p_sink.write(pe.summary_record())
+    p_sink.close()
+
+    d_sink = obs.JsonlSink(d_path, rank=0)
+    _header(d_sink)
+    de = _decode_engine(model, params, sink=d_sink)
+    rx = FileTransport(spool, worker="d0")
+    fault = FaultPlan("handoff_crash_preack", 2, kinds=SERVE_KINDS)
+    with pytest.raises(FaultInjected):
+        run_decode_role(de, rx, max_steps=500, fault=fault)
+    assert rx.pending_on_disk() >= 1        # the unacked claim survived
+
+    rx2 = FileTransport(spool, worker="d0")  # the restarted worker
+    comps = run_decode_role(de, rx2, max_steps=500)
+    assert len(comps) == len(reqs)
+    assert {c.status for c in comps} == {"ok"}
+    uids = [c.request.uid for c in comps]
+    assert len(set(uids)) == len(reqs)      # exactly once, every uid
+    assert de.handoff_duplicates == 1       # the redelivered admit-2
+    assert de.handoffs_in == len(reqs)      # dup not double-counted
+    _assert_ref_tokens(model, params, comps, err="ack-crash")
+    summ = de.summary_record()
+    assert summ["handoff_duplicates"] == 1
+    assert summ.get("handoff_redelivered", 0) >= 1
+    assert not obs_schema.validate_record(summ)
+    d_sink.write(summ)
+    d_sink.close()
+    assert rx2.finished()                   # spool fully drained
+    ci_gate = _load_tool("ci_gate")
+    assert ci_gate.main(["--disagg-stream", p_path,
+                         "--disagg-stream", d_path]) == 0
+
+
+def test_torn_payload_quarantined_worker_alive(model_and_params,
+                                               tmp_path, capsys):
+    """Satellite bugfix (ISSUE 15): a truncated/corrupt spool payload
+    must quarantine to *.bad with a warn record — the decode worker
+    keeps ticking and finishes everything else; the stream stays
+    v13-valid and passes the disagg gate."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    p_path = str(tmp_path / "prefill.jsonl")
+    d_path = str(tmp_path / "decode.jsonl")
+    reqs = _reqs(4, seed=34)
+    p_sink = obs.JsonlSink(p_path, rank=0)
+    _header(p_sink)
+    fault = FaultPlan("handoff_torn", 2, kinds=SERVE_KINDS)
+    pe = _spool_prefill(model, params, spool, reqs, sink=p_sink,
+                        fault=fault)
+    p_sink.write(pe.summary_record())
+    p_sink.close()
+
+    d_sink = obs.JsonlSink(d_path, rank=0)
+    _header(d_sink)
+    de = _decode_engine(model, params, sink=d_sink)
+    quarantined = []
+
+    def on_quarantine(uid, spool_name, error, nbytes):
+        # The serve.py wiring, in miniature: record the disposition.
+        quarantined.append(uid)
+        d_sink.write({"record": "kv_handoff", "time": time.time(),
+                      "request_id": uid, "direction": "quarantine",
+                      "fill": 0, "blocks": 0,
+                      "payload_bytes": int(nbytes),
+                      "spool_file": spool_name,
+                      "error": str(error)[:200]})
+
+    rx = FileTransport(spool, worker="d0", on_quarantine=on_quarantine)
+    comps = run_decode_role(de, rx, max_steps=500)
+    assert len(comps) == len(reqs) - 1      # the torn one never admits
+    assert {c.status for c in comps} == {"ok"}
+    assert rx.quarantined == 1 and len(quarantined) == 1
+    assert any(n.endswith(".bad") for n in os.listdir(spool))
+    assert rx.finished()                    # .bad is a disposition
+    _assert_ref_tokens(model, params, comps, err="torn")
+    summ = de.summary_record()
+    summ["handoff_quarantined"] = rx.quarantined   # the serve.py merge
+    assert not obs_schema.validate_record(summ)
+    d_sink.write(summ)
+    d_sink.close()
+    ci_gate = _load_tool("ci_gate")
+    assert ci_gate.main(["--disagg-stream", p_path,
+                         "--disagg-stream", d_path]) == 0
+    serve_report = _load_tool("serve_report")
+    assert serve_report.main([d_path]) == 0
+    out = capsys.readouterr().out
+    assert "REDELIVERY:" in out and "1 payload(s) quarantined" in out
+
+
+def test_duplicate_delivery_drill(model_and_params, tmp_path):
+    """The handoff_dup drill: the same payload delivered twice is
+    detected against the seen-set, acked without a second scatter, and
+    the request still completes exactly once."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    reqs = _reqs(3, seed=35)
+    _spool_prefill(model, params, spool, reqs)
+    de = _decode_engine(model, params)
+    rx = FileTransport(spool, worker="d0")
+    fault = FaultPlan("handoff_dup", 1, kinds=SERVE_KINDS)
+    comps = run_decode_role(de, rx, max_steps=500, fault=fault)
+    assert len(comps) == len(reqs)
+    assert {c.status for c in comps} == {"ok"}
+    assert de.handoff_duplicates == 1
+    assert de.handoffs_in == len(reqs)
+    assert rx.finished()
+    _assert_ref_tokens(model, params, comps, err="dup")
+
+
+def test_sentinel_lost_idle_timeout(model_and_params, tmp_path):
+    """The sentinel_lost drill: the producer dies without closing the
+    stream.  A decode worker with an idle timeout finishes what is
+    spooled and exits instead of spinning forever."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    reqs = _reqs(3, seed=36)
+    fault = FaultPlan("sentinel_lost", 1, kinds=SERVE_KINDS)
+    _spool_prefill(model, params, spool, reqs, fault=fault)
+    assert not os.path.exists(os.path.join(spool,
+                                           FileTransport.SENTINEL))
+    de = _decode_engine(model, params)
+    rx = FileTransport(spool, worker="d0")
+    comps = run_decode_role(de, rx, max_steps=2000,
+                            idle_wait_s=0.01, idle_timeout_s=0.3)
+    assert len(comps) == len(reqs)          # everything spooled served
+    assert {c.status for c in comps} == {"ok"}
+    assert not rx.finished()                # the stream never closed
+
+
+def test_handoff_drill_requires_matching_role(tmp_path):
+    """serve.py rejects a handoff drill on the wrong role (a silently
+    inert drill is worse than an error)."""
+    import serve as serve_cli
+    args = serve_cli.build_parser().parse_args(
+        ["--role", "decode", "--handoff-dir", str(tmp_path / "s"),
+         "--inject-fault", "handoff_torn@1"])
+    with pytest.raises(SystemExit, match="prefill-side"):
+        serve_cli.run_serve(args)
+    args = serve_cli.build_parser().parse_args(
+        ["--role", "decode", "--handoff-dir", str(tmp_path / "s"),
+         "--inbox", str(tmp_path / "in.jsonl")])
+    with pytest.raises(SystemExit, match="no --inbox"):
+        serve_cli.run_serve(args)
+
+
+def test_schema_v13_records_validate():
+    assert obs_schema.SCHEMA_VERSION >= 13
+    good = [
+        {"record": "kv_handoff", "time": 1.0, "request_id": "r1",
+         "direction": "in", "fill": 24, "blocks": 3,
+         "payload_bytes": 9216, "handoff_ms": 1.0, "requeued": 0,
+         "redelivered": 1, "dst": "decode"},
+        {"record": "kv_handoff", "time": 1.0, "request_id": "r1",
+         "direction": "in", "fill": 24, "blocks": 0,
+         "payload_bytes": 9216, "duplicate": True, "redelivered": 1,
+         "dst": "decode"},
+        {"record": "kv_handoff", "time": 1.0, "request_id": "r2",
+         "direction": "quarantine", "fill": 0, "blocks": 0,
+         "payload_bytes": 123, "spool_file": "handoff-000002-r2.npz",
+         "error": "corrupt npz"},
+        {"record": "serve_summary", "time": 1.0, "requests": 4,
+         "output_tokens": 40, "tokens_per_sec": 10.0, "role": "decode",
+         "handoffs_in": 4, "handoff_duplicates": 1,
+         "handoff_redelivered": 2, "handoff_quarantined": 1},
+        {"record": "replica_state", "time": 1.0, "replica": "d0",
+         "state": "serving", "role": "decode", "kv_bytes_live": 64},
+        {"record": "fleet_summary", "time": 1.0, "replicas": 3,
+         "requests": 10, "availability": 1.0, "prefill_replicas": 1,
+         "decode_replicas": 2, "handoffs": 10,
+         "handoff_redelivered": 1, "in_spool": 0},
+    ]
+    for rec in good:
+        assert not obs_schema.validate_record(rec), rec
+    bad = [
+        {"record": "kv_handoff", "time": 1.0, "request_id": "r1",
+         "direction": "in", "fill": 1, "blocks": 1,
+         "payload_bytes": 2, "redelivered": "yes"},   # wrong type
+        {"record": "fleet_summary", "time": 1.0, "replicas": 1,
+         "requests": 1, "availability": 1.0, "spool_leak": 1},
+    ]
+    for rec in bad:
+        assert obs_schema.validate_record(rec), rec
+
+
+def test_ci_gate_rejects_unflagged_double_admission(tmp_path):
+    """The v13 conservation rule: redelivery episodes are tolerated,
+    but two PLAIN admissions of one uid (no redelivered/duplicate
+    provenance) mean two workers silently double-served it — the gate
+    must fail."""
+    ci_gate = _load_tool("ci_gate")
+    records = _read_fixture("decode.jsonl")
+    plain = next(r for r in records
+                 if r.get("record") == "kv_handoff"
+                 and r.get("direction") == "in"
+                 and not r.get("duplicate") and not r.get("redelivered"))
+    doubled = []
+    for r in records:
+        doubled.append(r)
+        if r is plain:
+            doubled.append(dict(plain))     # a second plain admission
+    bad = str(tmp_path / "decode_double.jsonl")
+    with open(bad, "w") as fh:
+        for r in doubled:
+            fh.write(json.dumps(r) + "\n")
+    pre = os.path.join(FIXTURES, "prefill.jsonl")
+    assert ci_gate.main(["--disagg-stream", pre,
+                         "--disagg-stream", bad]) == 1
+
+
+def test_fixture_pair_records_a_redelivery():
+    """The checked-in pair IS a recorded redelivery episode: the
+    decode stream carries redelivered admissions and a duplicate-ack,
+    and still passes the gate (test_ci_gate_disagg_fixture_pair)."""
+    records = _read_fixture("decode.jsonl")
+    ins = [r for r in records if r.get("record") == "kv_handoff"
+           and r.get("direction") == "in"]
+    assert any(r.get("redelivered") and not r.get("duplicate")
+               for r in ins)
+    assert any(r.get("duplicate") for r in ins)
+    summ = next(r for r in records
+                if r.get("record") == "serve_summary")
+    assert summ["handoff_duplicates"] == 1
+    assert summ["handoff_redelivered"] >= 1
+
+
+def test_supervisor_strips_handoff_drills_on_restart():
+    """Satellite (ISSUE 15): --drop-flag-on-restart=--inject-fault
+    strips handoff_*@N drills from restart attempts exactly like
+    exact-tick serve drills — a restarted decode worker replays the
+    spool from its claim set, so the drill would re-fire."""
+    spec = importlib.util.spec_from_file_location(
+        "apex_sup_test", os.path.join(REPO, "apex_example_tpu",
+                                      "resilience", "supervisor.py"))
+    sup = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sup)
+    argv = ["python", "serve.py", "--role", "decode",
+            "--inject-fault", "handoff_crash_preack@1", "--slots", "4"]
+    out = sup._strip_flag(argv, "--inject-fault")
+    assert out == ["python", "serve.py", "--role", "decode",
+                   "--slots", "4"]
+    out = sup._strip_flag(["x", "--inject-fault=handoff_torn@2", "y"],
+                          "--inject-fault")
+    assert out == ["x", "y"]
 
 
 # --------------------------------------------------- subprocess e2e

@@ -727,6 +727,219 @@ def test_rolling_restart_inprocess(model_and_params):
     assert all(r.restarts == 1 for r in replicas)
 
 
+# ===================================== disagg fleet chaos (ISSUE 15)
+
+
+def test_router_spool_stale_sweep_reroutes_through_prefill():
+    """The one crash window the lease cannot redeliver: a decode
+    worker acked its claim (spool file gone) then died before any
+    terminal reached its outbox — nothing will ever report the uid.
+    With spool_timeout_s armed the router presumes it lost and
+    re-routes it through a prefill replica from scratch."""
+    pre = FakeReplica("p0")
+    pre.role = "prefill"
+    dec = FakeReplica("d0")
+    dec.role = "decode"
+    router = FleetRouter([pre, dec], spool_timeout_s=0.05, log=None)
+    router.submit(_spec("u1"))
+    assert [s["uid"] for s in pre.specs] == ["u1"]   # never to decode
+    pre.report("u1", "handoff")
+    router.poll()
+    assert not router.done()                # parked on the spool
+    time.sleep(0.08)
+    router.poll()                           # stale sweep fires
+    assert [s["uid"] for s in pre.specs] == ["u1", "u1"]  # re-prefilled
+    pre.report("u1", "ok", tokens=[1])
+    router.poll()
+    assert router.done()
+    summary = router.summary_record()
+    assert summary["lost"] == 0 and summary["retries"] == 1
+    assert summary["handoffs"] == 1 and summary["in_spool"] == 0
+
+
+def test_thread_replica_rejects_inert_handoff_drills(model_and_params):
+    """A drill the transport/drive loop can never express must be a
+    construction error, not a silently-clean chaos run."""
+    model, params = model_and_params
+
+    def factory():
+        return ServeEngine(model, params, num_slots=SLOTS,
+                           max_len=MAX_LEN, role="decode")
+
+    for kind in ("handoff_dup", "handoff_torn", "sentinel_lost"):
+        with pytest.raises(ValueError, match="cannot express"):
+            ThreadReplica("d0", factory, role="decode",
+                          transport_factory=lambda: None,
+                          fault=FaultPlan(kind, 1, kinds=SERVE_KINDS))
+    with pytest.raises(ValueError, match="cannot express"):
+        ThreadReplica("p0", factory, lambda s: s, role="prefill",
+                      fault=FaultPlan("handoff_crash_preack", 1,
+                                      kinds=SERVE_KINDS))
+
+
+def _disagg_thread_fleet(model, params, spool, lease_s=0.3,
+                         crash_decode=None, crash_prefill_tick=None):
+    """1 prefill + 2 decode ThreadReplicas over one leased FileTransport
+    spool — every engine rides the session's compiled programs (the
+    [4, 8] prefill step shared with test_serve, the [4, 1] decode step
+    shared with test_disagg): zero new compiles."""
+    from apex_example_tpu.serve import FileTransport
+
+    def make_request(spec):
+        return Request(prompt=spec["prompt"],
+                       max_new_tokens=int(spec["max_new_tokens"]),
+                       temperature=float(spec.get("temperature", 0.0)),
+                       top_k=int(spec.get("top_k", 0)),
+                       eos_id=spec.get("eos_id"),
+                       deadline_s=spec.get("deadline_s"),
+                       uid=spec["uid"])
+
+    def prefill_factory():
+        tx = FileTransport(spool, worker="p0.tx")
+        return ServeEngine(model, params, num_slots=SLOTS,
+                           max_len=MAX_LEN, rng=jax.random.PRNGKey(0),
+                           role="prefill", handoff_sink=tx.send)
+
+    def decode_factory():
+        return ServeEngine(model, params, num_slots=SLOTS,
+                           max_len=MAX_LEN, rng=jax.random.PRNGKey(0),
+                           role="decode")
+
+    pre_fault = FaultPlan("crash", crash_prefill_tick,
+                          kinds=SERVE_KINDS) if crash_prefill_tick \
+        else None
+    replicas = [ThreadReplica("p0", prefill_factory, make_request,
+                              fault=pre_fault, role="prefill")]
+    for name in ("d0", "d1"):
+        fault = FaultPlan("handoff_crash_preack", 1,
+                          kinds=SERVE_KINDS) \
+            if name == crash_decode else None
+
+        def tx_factory(worker=name):
+            return FileTransport(spool, worker=worker, lease_s=lease_s)
+
+        replicas.append(ThreadReplica(name, decode_factory, fault=fault,
+                                      role="decode",
+                                      transport_factory=tx_factory))
+    return replicas
+
+
+def _midspool_once(model, params, specs, spool):
+    replicas = _disagg_thread_fleet(model, params, spool,
+                                    crash_decode="d0")
+    router = FleetRouter(replicas, log=None)
+    summary = run_scenario("decode_crash_midspool", router, replicas,
+                           specs, crashed_name="d0", timeout_s=90)
+    results = dict(router.results)
+    for r in replicas:
+        r.stop(timeout_s=5.0)
+    # The INVARIANT score: everything here is a pure function of the
+    # workload (which uids exist, that they all complete, that nothing
+    # leaks) — handoff_redelivered is deliberately excluded: HOW MANY
+    # claims the dead worker held when it died depends on claim-race
+    # timing, only that the peer finished them does not.
+    score = {k: summary[k] for k in
+             ("completed", "failed", "timed_out", "lost",
+              "availability", "verdict", "requests", "handoffs",
+              "in_spool", "prefill_replicas", "decode_replicas")}
+    return score, summary, results
+
+
+def test_disagg_fleet_decode_crash_midspool_deterministic(
+        model_and_params, tmp_path):
+    """THE ISSUE 15 chaos acceptance: a 1-prefill + 2-decode fleet;
+    decode worker d0 dies in the ack-crash window holding claimed-but-
+    unacked handoffs; nobody restarts it — the PEER reclaims the
+    expired leases and finishes the redelivered handoffs.  Zero lost,
+    exactly-once per uid, redelivery really happened, survivors'
+    outputs token-identical to generate(), and the invariant score is
+    bit-identical across two runs."""
+    model, params = model_and_params
+    specs = synthetic_specs(10, vocab_size=model.vocab_size, seed=8,
+                            prompt_len=(3, 8), max_new=(3, 8))
+    first, summary, results = _midspool_once(
+        model, params, specs, str(tmp_path / "spool_a"))
+    assert first["verdict"] == "pass"
+    assert first["completed"] == 10 and first["lost"] == 0
+    assert first["availability"] == 1.0
+    assert first["handoffs"] == 10 and first["in_spool"] == 0
+    assert first["prefill_replicas"] == 1
+    assert first["decode_replicas"] == 2
+    assert summary["handoff_redelivered"] >= 1   # the peer did work
+    # every uid exactly once, token-identical to one-shot generate()
+    assert len(results) == 10
+    for spec in specs:
+        ev = results[spec["uid"]]
+        assert ev["status"] == "ok", (spec["uid"], ev)
+        P = len(spec["prompt"])
+        n = len(ev["tokens"])
+        ref = generate(model, params,
+                       jnp.asarray([spec["prompt"]], jnp.int32),
+                       max_len=MAX_LEN)
+        np.testing.assert_array_equal(
+            np.asarray(ref)[0, P:P + n],
+            np.asarray(ev["tokens"], np.int32), err_msg=spec["uid"])
+    second, _, _ = _midspool_once(model, params, specs,
+                                  str(tmp_path / "spool_b"))
+    assert second == first              # deterministic chaos score
+
+
+def test_disagg_fleet_prefill_crash(model_and_params, tmp_path):
+    """The prefill role dies mid-serve: requests it held come back
+    lost and re-route once the scenario restarts it; requests already
+    on the spool keep decoding untouched — zero lost, spool drained."""
+    model, params = model_and_params
+    spool = str(tmp_path / "spool")
+    # tick 1: the first admitted wave hands off within its first tick
+    # (one-chunk prompts sample their first token in the same tick),
+    # so a later crash would find an empty queue and prove nothing —
+    # crash while 6 of 10 requests are still queued behind the slots.
+    replicas = _disagg_thread_fleet(model, params, spool,
+                                    crash_prefill_tick=1)
+    router = FleetRouter(replicas, breaker_backoff_s=0.1, log=None)
+    specs = synthetic_specs(10, vocab_size=model.vocab_size, seed=9,
+                            prompt_len=(3, 8), max_new=(3, 8))
+    summary = run_scenario("prefill_crash", router, replicas, specs,
+                           crashed_name="p0", timeout_s=90)
+    for r in replicas:
+        r.stop(timeout_s=5.0)
+    assert summary["verdict"] == "pass"
+    assert summary["completed"] == 10 and summary["lost"] == 0
+    assert summary["availability"] == 1.0
+    assert summary["retries"] >= 1          # the crash really cost work
+    assert summary["handoffs"] >= 10        # every uid crossed the spool
+    assert summary["in_spool"] == 0
+    assert replicas[0].restarts == 1
+
+
+def test_proc_replica_disagg_argv(tmp_path):
+    """Role plumbing for supervised children: a decode ProcReplica
+    spawns serve.py with NO --inbox (the spool is its intake), the
+    role/spool flags, and the drill-stripping drop flag; submit()
+    always refuses on it."""
+    from apex_example_tpu.fleet.replica import ProcReplica
+    spool = str(tmp_path / "spool")
+    dec = ProcReplica("d0", str(tmp_path), REPO, role="decode",
+                      spool_dir=spool)
+    argv = dec.argv()
+    sup_side = argv[:argv.index("--")]
+    child = argv[argv.index("--") + 1:]
+    assert "--inbox" not in child
+    assert child[child.index("--role") + 1] == "decode"
+    assert child[child.index("--handoff-dir") + 1] == spool
+    assert "--outbox" in child
+    assert "--drop-flag-on-restart=--inject-fault" in sup_side
+    assert dec.submit({"uid": "x"}) is False
+    assert dec.role == "decode"
+    pre = ProcReplica("p0", str(tmp_path), REPO, role="prefill",
+                      spool_dir=spool)
+    child = pre.argv()[pre.argv().index("--") + 1:]
+    assert "--inbox" in child
+    assert child[child.index("--role") + 1] == "prefill"
+    with pytest.raises(ValueError, match="spool_dir"):
+        ProcReplica("x0", str(tmp_path), REPO, role="decode")
+
+
 # ================================= tools over the checked-in scenario
 
 def test_ci_gate_fleet_stream_over_checked_in_scenario(tmp_path,
@@ -945,3 +1158,70 @@ def test_rolling_restart_supervised_e2e(tmp_path):
     assert ci_gate.main(["--fleet-stream", fleet_jsonl]) == 0
     report = _load_tool("fleet_report")
     assert report.main([fleet_jsonl]) == 0
+
+
+def test_disagg_proc_decode_crash_e2e(tmp_path, capsys):
+    """THE ISSUE 15 subprocess chaos e2e: a 1-prefill + 2-decode
+    supervised serve.py fleet over one leased spool; decode child r1
+    crashes in the ack-crash window at its first admit
+    (handoff_crash_preack@1), its supervisor restarts it with the
+    drill STRIPPED (the drop-flag satellite, live), its adopted claims
+    redeliver, and the scenario scores verdict pass — zero lost,
+    exactly one non-drained terminal per uid across the decode
+    outboxes, fleet gate + report green with the DISAGG line."""
+    import fleet as fleet_cli
+
+    fleet_jsonl = str(tmp_path / "fleet.jsonl")
+    workdir = str(tmp_path / "work")
+    argv = ["--replicas", "3", "--decode-replicas", "2",
+            "--transport", "proc",
+            "--scenario", "decode_crash_midspool",
+            "--requests", "10", "--slots", "2", "--max-len", "16",
+            "--handoff-lease", "1.0",
+            "--metrics-jsonl", fleet_jsonl, "--workdir", workdir,
+            "--timeout", "150"]
+    rc = fleet_cli.main(argv)
+    assert rc == 0
+
+    records = obs.read_jsonl(fleet_jsonl)
+    assert obs_schema.validate_stream(records) == []
+    summary = records[-1]
+    assert summary["record"] == "fleet_summary"
+    assert summary["scenario"] == "decode_crash_midspool"
+    assert summary["verdict"] == "pass"
+    assert summary["lost"] == 0 and summary["availability"] == 1.0
+    assert summary["prefill_replicas"] == 1
+    assert summary["decode_replicas"] == 2
+    assert summary["handoffs"] == 10 and summary["in_spool"] == 0
+    assert summary["handoff_redelivered"] >= 1
+
+    # the crashed decode child was classified + restarted, and the
+    # restart attempt's argv lost the drill (otherwise it would
+    # re-fire on the replayed claim set and flap until the budget ran
+    # out)
+    sup = obs.read_jsonl(os.path.join(workdir, "r1", "sup.jsonl"))
+    restarts = [r for r in sup if r["record"] == "restart"]
+    assert restarts and restarts[0]["classification"] == "crashed"
+    assert len(restarts) == 1               # the stripped drill stayed dead
+
+    # exactly-once at the uid level across the decode outboxes
+    terminal = {}
+    for name in ("r1", "r2"):
+        path = os.path.join(workdir, name, "outbox.jsonl")
+        if os.path.exists(path):
+            with open(path) as fh:
+                for line in fh:
+                    ev = json.loads(line)
+                    if ev.get("status") != "drained":
+                        terminal[ev["uid"]] = \
+                            terminal.get(ev["uid"], 0) + 1
+    assert len(terminal) == 10
+    assert set(terminal.values()) == {1}
+
+    ci_gate = _load_tool("ci_gate")
+    assert ci_gate.main(["--fleet-stream", fleet_jsonl]) == 0
+    report = _load_tool("fleet_report")
+    capsys.readouterr()
+    assert report.main([fleet_jsonl]) == 0
+    out = capsys.readouterr().out
+    assert "DISAGG: 1 prefill + 2 decode" in out

@@ -40,12 +40,17 @@ Gates:
    cache got smaller" into a regression-tested number.
 6. **disagg conservation** (over the ``--disagg-stream`` group): the
    disaggregated-serving contract over ONE deployment's recorded role
-   streams (schema v12, typically a prefill + a decode stream) —
-   every record validates, exactly one ``serve_summary`` per role,
-   and every ``kv_handoff`` shipped out was admitted in and reached a
-   terminal request record: ZERO lost handoffs.  Run over the
-   checked-in pair (tests/fixtures/disagg/), this turns "prefill and
-   decode are separate workers" into a regression-tested contract.
+   streams (schema v13: a prefill stream plus one per decode worker)
+   — every record validates, one ``serve_summary`` per stream (the
+   prefill role claimed once; decode workers pool), and every
+   ``kv_handoff`` shipped out was quarantined or admitted with
+   EXACTLY one terminal request record.  Redelivery episodes (the
+   leased-spool crash-safety protocol, ISSUE 15) are tolerated, but
+   at most one admission per uid may lack redelivered/duplicate
+   provenance — anything else is a silent double-serve.  Run over the
+   checked-in redelivery pair (tests/fixtures/disagg/), this turns
+   "a decode worker can die between poll and ack and lose nothing"
+   into a regression-tested contract.
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -183,20 +188,26 @@ def _quant_gate(stream: str, min_ratio: float) -> int:
 
 
 def _disagg_gate(streams) -> int:
-    """The disaggregated-serving gate (ISSUE 14) over ONE deployment's
-    role streams (typically a prefill + a decode stream): every record
-    validates (schema v12), each stream closes with exactly one
-    ``serve_summary`` carrying a ``role``, no two streams claim the
-    same role, and handoffs are CONSERVED — every ``kv_handoff`` the
-    prefill side shipped (direction "out") was admitted somewhere
-    (direction "in") and reached a terminal per-request record: zero
-    lost handoffs.  Returns 0/1 (2 is the caller's unreadable-stream
-    path)."""
+    """The disaggregated-serving gate (ISSUE 14, crash-safe since
+    ISSUE 15) over ONE deployment's role streams (a prefill stream
+    plus one stream per decode worker): every record validates (schema
+    v13), each stream closes with exactly one ``serve_summary``
+    carrying a ``role`` (multiple DECODE streams are one spool's
+    worker pool; a duplicated prefill role is still an error), and
+    handoffs are CONSERVED under the leased redelivery protocol —
+    every ``kv_handoff`` shipped out was either quarantined (a
+    recorded disposition) or admitted and finished with EXACTLY one
+    terminal request record; redelivery episodes are tolerated, but
+    per uid at most one admission may be a plain first delivery
+    (every extra must carry ``redelivered``/``duplicate`` provenance,
+    else two workers silently double-served it).  Returns 0/1 (2 is
+    the caller's unreadable-stream path)."""
     rc = 0
     roles = []
     out_uids = {}                        # uid -> source stream
-    in_uids = set()
-    terminal = set()
+    in_events = {}                       # uid -> [in records]
+    terminal = {}                        # uid -> terminal-record count
+    quarantined = set()
     for stream in streams:
         summ, records = _load_gated_stream(stream, "serve_summary")
         if summ is None:
@@ -204,7 +215,7 @@ def _disagg_gate(streams) -> int:
         role = summ.get("role")
         if role not in ("prefill", "decode", "both"):
             print(f"{stream}: serve_summary carries no role (a disagg "
-                  "stream is a v12 role stream)", file=sys.stderr)
+                  "stream is a v12+ role stream)", file=sys.stderr)
             rc = 1
         roles.append(role)
         for r in records:
@@ -212,26 +223,51 @@ def _disagg_gate(streams) -> int:
                 uid = r.get("request_id", "?")
                 if r.get("direction") == "out":
                     out_uids[uid] = stream
+                elif r.get("direction") == "quarantine":
+                    quarantined.add(uid)
                 else:
-                    in_uids.add(uid)
+                    in_events.setdefault(uid, []).append(r)
             elif r.get("record") in ("request_complete",
                                      "request_failed"):
-                terminal.add(r.get("request_id", "?"))
-    dup = [r for r in set(roles) if r != "both" and roles.count(r) > 1]
+                uid = r.get("request_id", "?")
+                terminal[uid] = terminal.get(uid, 0) + 1
+    dup = [r for r in set(roles)
+           if r in ("prefill", "both") and roles.count(r) > 1]
     if dup:
         print(f"disagg gate: role(s) {sorted(dup)} claimed by more "
-              "than one stream (expected exactly one serve_summary "
-              "per role)", file=sys.stderr)
+              "than one stream (one producer per spool; only decode "
+              "workers pool)", file=sys.stderr)
         rc = 1
-    never_admitted = sorted(u for u in out_uids if u not in in_uids)
-    never_terminal = sorted(u for u in out_uids if u not in terminal)
+    never_admitted = sorted(u for u in out_uids
+                            if u not in in_events
+                            and u not in quarantined)
+    never_terminal = sorted(u for u in out_uids
+                            if terminal.get(u, 0) == 0
+                            and u not in quarantined)
+    multi_terminal = sorted(u for u in out_uids
+                            if terminal.get(u, 0) > 1)
+    double_served = []
+    for uid, evs in sorted(in_events.items()):
+        fresh = [r for r in evs
+                 if not r.get("duplicate") and not r.get("redelivered")]
+        if len(fresh) > 1:
+            double_served.append(uid)
     for uid in never_admitted[:10]:
         print(f"disagg gate: handoff {uid} (from {out_uids[uid]}) was "
               "never admitted by a decode stream", file=sys.stderr)
     for uid in never_terminal[:10]:
         print(f"disagg gate: handoff {uid} never reached a terminal "
               "request record — LOST", file=sys.stderr)
-    if never_admitted or never_terminal:
+    for uid in multi_terminal[:10]:
+        print(f"disagg gate: handoff {uid} reached "
+              f"{terminal[uid]} terminal records — exactly-once "
+              "admission violated (double-served)", file=sys.stderr)
+    for uid in double_served[:10]:
+        print(f"disagg gate: handoff {uid} admitted more than once "
+              "with no redelivered/duplicate provenance — two workers "
+              "double-claimed it", file=sys.stderr)
+    if never_admitted or never_terminal or multi_terminal \
+            or double_served:
         rc = 1
     if not out_uids:
         print("disagg gate: no kv_handoff records across the given "

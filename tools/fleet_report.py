@@ -30,9 +30,12 @@ instead — detected automatically by the records present:
 
 Per-replica availability, routing-balance skew (max dispatches over the
 mean — ``--skew-factor`` flags imbalance), replica lifecycle anomalies
-(crashes/stalls, with the supervisor's v10 exit classification), and
-the scenario verdict line.  Still jax-free — same thin-client contract,
-proved by graftlint's import rule.
+(crashes/stalls, with the supervisor's v10 exit classification), the
+scenario verdict line, and — on a v13 disaggregated fleet — the DISAGG
+line (prefill/decode topology, handoff count, redelivered admissions,
+uids stuck in the spool at close: a spool leak is flagged as its own
+anomaly).  Still jax-free — same thin-client contract, proved by
+graftlint's import rule.
 
 Train-rank checks:
 - per-rank status: aborted (crash_dump / aborted summary / no summary),
@@ -337,6 +340,24 @@ def analyze_fleet(records: List[dict], skew_factor: float,
         print(f"recovery: {requeued} drain-requeue(s), {retries} "
               f"crash-retry(s), {summary.get('duplicates', 0)} "
               "duplicate report(s) ignored", file=out)
+
+    # v13 disagg topology (ISSUE 15): a fleet split into prefill and
+    # decode roles over a leased KV spool reports its handoff story —
+    # uids still IN the spool at close never got decoded, which the
+    # lost counter also caught, but naming the spool points at the
+    # right subsystem.
+    if "prefill_replicas" in summary or "decode_replicas" in summary:
+        print(f"DISAGG: {summary.get('prefill_replicas', 0)} prefill + "
+              f"{summary.get('decode_replicas', 0)} decode replica(s)  "
+              f"{summary.get('handoffs', 0)} handoff(s)  "
+              f"{summary.get('handoff_redelivered', 0)} redelivered  "
+              f"{summary.get('in_spool', 0)} in spool at close",
+              file=out)
+        if summary.get("in_spool", 0):
+            anomalies += 1
+            print(f"SPOOL LEAK: {summary['in_spool']} uid(s) still on "
+                  "the KV spool at close — no decode worker finished "
+                  "them", file=out)
 
     avail = summary["availability"]
     verdict = summary.get("verdict")

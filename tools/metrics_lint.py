@@ -41,8 +41,18 @@ of a prefill-worker -> decode-worker KV-block transfer, with payload
 byte/block/fill accounting and the decode side's transit latency —
 plus ``role``/``mesh``/``dp``/``tp`` and the handoff counters on
 ``serve_summary``, and the dtype-accurate ``kv_bytes_live`` gauge on
-``replica_state`` heartbeats) all validate alongside v1 streams — each
-version's tables are a strict superset of the last.
+``replica_state`` heartbeats) and v13 streams (the crash-safe handoff
+stratum: ``kv_handoff`` gains the lease/redelivery provenance —
+direction "quarantine" for corrupt payloads parked at ``*.bad``,
+``redelivered`` for deliveries from a reclaimed/adopted lease,
+``duplicate`` for idempotent re-admissions acked without a second
+scatter — serve summaries gain ``handoff_duplicates`` /
+``handoff_redelivered`` / ``handoff_quarantined``, replica heartbeats
+gain ``role``, and ``fleet_summary`` gains the disagg topology +
+spool accounting: ``prefill_replicas`` / ``decode_replicas`` /
+``handoffs`` / ``handoff_redelivered`` / ``in_spool``) all validate
+alongside v1 streams — each version's tables are a strict superset of
+the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort
 exits 2.

@@ -24,6 +24,12 @@ wall clocks, so cross-host runs inherit NTP skew like every
 so like "drained" it sits outside this server's availability
 denominator.
 
+Schema v13 adds the REDELIVERY line (the leased-spool crash-safety
+protocol, ISSUE 15): redelivered admissions — a reclaimed or adopted
+lease finishing work its first consumer dropped — duplicates acked
+without a second scatter (the ack-crash window), and corrupt payloads
+quarantined at ``*.bad`` (each listed with its spool file and error).
+
 Schema v9 adds the per-request CRITICAL-PATH table: each completed
 request's e2e latency decomposed into queue wait / prefill / decode /
 stall (the residual: eviction waits, harvest overhead), the mean share
@@ -233,10 +239,14 @@ def report(path: str, out=sys.stdout) -> int:
         # summarizing the KV transfers it took part in.  Transit
         # latency only exists on "in" records (the decode side stamps
         # out-wall -> admission); a pure prefill stream reports count
-        # and bytes alone.
+        # and bytes alone.  v13 adds quarantines (direction
+        # "quarantine" — corrupt payloads parked, worker alive) and
+        # the REDELIVERY line below.
         n_out = sum(1 for h in handoffs if h.get("direction") == "out")
-        n_in = sum(1 for h in handoffs if h.get("direction") == "in")
-        moved = sum(h.get("payload_bytes", 0) for h in handoffs)
+        n_in = sum(1 for h in handoffs if h.get("direction") == "in"
+                   and not h.get("duplicate"))
+        moved = sum(h.get("payload_bytes", 0) for h in handoffs
+                    if h.get("direction") != "quarantine")
         blocks = sum(h.get("blocks", 0) for h in handoffs)
         line = (f"HANDOFF: {n_out} out / {n_in} in  "
                 f"{blocks} block(s), {moved / 1024:.1f} KiB moved")
@@ -249,6 +259,27 @@ def report(path: str, out=sys.stdout) -> int:
         if requeued:
             line += f"  requeued {requeued}"
         print(line, file=out)
+        # v13 (ISSUE 15): the leased-spool crash-safety accounting —
+        # redelivered admissions (a reclaimed/adopted lease finished
+        # work its first consumer dropped), duplicates acked without a
+        # second scatter (the ack-crash window closing), and
+        # quarantined corrupt payloads.
+        n_redeliv = sum(1 for h in handoffs
+                        if h.get("direction") == "in"
+                        and h.get("redelivered")
+                        and not h.get("duplicate"))
+        n_dup = sum(1 for h in handoffs if h.get("duplicate"))
+        n_quar = sum(1 for h in handoffs
+                     if h.get("direction") == "quarantine")
+        if n_redeliv or n_dup or n_quar:
+            print(f"REDELIVERY: {n_redeliv} redelivered admission(s)  "
+                  f"{n_dup} duplicate(s) acked without scatter  "
+                  f"{n_quar} payload(s) quarantined", file=out)
+            for h in handoffs:
+                if h.get("direction") == "quarantine":
+                    print(f"  quarantined {h.get('request_id', '?')} "
+                          f"({h.get('spool_file', '?')}): "
+                          f"{h.get('error', '?')}", file=out)
         # The REAL first-token latency of handed-off requests lives on
         # the prefill side's out records (the decode side's
         # request_complete only sees its own clock domain).

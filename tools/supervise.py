@@ -21,7 +21,11 @@ Everything after ``--`` is the child command, launched verbatim except:
   ``PATH.attempt<K>``, preserving each attempt's stream intact;
 - ``--drop-flag-on-restart FLAG`` (repeatable) strips ``FLAG`` and its
   value from restart attempts — one-shot ``--inject-fault`` drills must
-  not re-fire on a child that restarts from tick 0.
+  not re-fire on a child that restarts from tick 0.  This covers the
+  disagg handoff drills (``--inject-fault handoff_*@N``, ISSUE 15) the
+  same way: a restarted decode worker replays the spool from its claim
+  set, so an operation-ordinal drill would re-fire every attempt
+  exactly like an exact-tick serve drill.
 
 Child exit contract: 0 = done; 75 (EX_TEMPFAIL — train.py's
 ``--preempt-grace`` path and serve.py's SIGTERM drain alike) = graceful,

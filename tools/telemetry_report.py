@@ -21,7 +21,10 @@ scenario verdict) when the stream is a fleet-router's
 (tools/fleet_report.py renders the per-replica breakdown) — and the
 disaggregated-serving stratum (schema v12): a HANDOFF line (out/in
 counts, KV bytes moved) when the stream took part in a prefill/decode
-split (tools/serve_report.py renders the latency percentiles).
+split (tools/serve_report.py renders the latency percentiles) — with
+the v13 crash-safety counters appended (redelivered admissions,
+duplicates acked without a second scatter, quarantined payloads) when
+the leased-spool protocol had to recover anything.
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -223,11 +226,22 @@ def report(path: str, out=sys.stdout) -> int:
         # the stream took part in a prefill/decode split and on which
         # side(s).
         n_out = sum(1 for h in handoffs if h.get("direction") == "out")
-        n_in = len(handoffs) - n_out
-        moved = sum(h.get("payload_bytes", 0) for h in handoffs)
-        print(f"HANDOFF: {n_out} out / {n_in} in, "
-              f"{moved / 1024:.1f} KiB of KV blocks moved "
-              "(tools/serve_report.py for latency percentiles)",
+        n_in = sum(1 for h in handoffs if h.get("direction") == "in"
+                   and not h.get("duplicate"))
+        moved = sum(h.get("payload_bytes", 0) for h in handoffs
+                    if h.get("direction") != "quarantine")
+        line = (f"HANDOFF: {n_out} out / {n_in} in, "
+                f"{moved / 1024:.1f} KiB of KV blocks moved")
+        # v13: the crash-safety counters, only when something recovered
+        n_redeliv = sum(1 for h in handoffs if h.get("redelivered")
+                        and not h.get("duplicate"))
+        n_dup = sum(1 for h in handoffs if h.get("duplicate"))
+        n_quar = sum(1 for h in handoffs
+                     if h.get("direction") == "quarantine")
+        if n_redeliv or n_dup or n_quar:
+            line += (f" ({n_redeliv} redelivered, {n_dup} duplicate, "
+                     f"{n_quar} quarantined)")
+        print(line + " (tools/serve_report.py for latency percentiles)",
               file=out)
     if not steps:
         if is_fleet_stream:
