@@ -246,7 +246,7 @@ class ThreadReplica:
         with self._lock:
             st = self._state
             eng = self.engine
-        return {
+        out = {
             "name": self.name,
             "state": st,
             "tick": eng.step_count,
@@ -264,6 +264,14 @@ class ThreadReplica:
             "pid": os.getpid(),
             "restarts": self.restarts,
         }
+        # v14: cumulative SLO latency sketches (duck-typed — only a
+        # --slo-armed ServeEngine grows them); the router merges these
+        # into fleet_rollup records.
+        sketch_fn = getattr(eng, "slo_sketch", None)
+        sk = sketch_fn() if sketch_fn is not None else None
+        if sk is not None:
+            out["slo_sketch"] = sk
+        return out
 
     # ------------------------------------------------------ lifecycle
 
@@ -348,7 +356,15 @@ class ThreadReplica:
             ev = {"uid": c.request.uid, "status": c.status,
                   "tokens": [int(t) for t in c.tokens],
                   "finish_reason": c.finish_reason,
-                  "tick": c.finished_step, "replica": self.name}
+                  "tick": c.finished_step, "replica": self.name,
+                  # v14: per-request latencies ride every terminal
+                  # event (None-safe) — the router's SLO plane scores
+                  # them against --slo targets without re-deriving
+                  # timing from the engine.
+                  "ttft_ms": None if c.ttft_s is None
+                  else c.ttft_s * 1e3,
+                  "tpot_ms": None if c.tpot_s is None
+                  else c.tpot_s * 1e3}
             if c.request.uid in redelivered:
                 ev["redelivered"] = True
             events.append(ev)
@@ -767,4 +783,9 @@ class ProcReplica:
         if restarts:
             out["classification"] = restarts[-1].get("classification")
             out["exit_code"] = restarts[-1].get("exit_code")
+        # v14: a --slo child's heartbeats carry its cumulative latency
+        # sketches; absent on pre-v14 (or unarmed) children — never
+        # synthesized, so the router's rollup only merges real data.
+        if "slo_sketch" in beat:
+            out["slo_sketch"] = beat["slo_sketch"]
         return out

@@ -42,6 +42,15 @@ What the router owns:
   (exponential backoff), half-opens after the backoff to admit ONE
   probe request, and closes again only when the probe completes ok —
   the classic pattern, deterministic enough to unit-test.
+- **SLO plane** (ISSUE 16): armed with an ``slo`` spec, every
+  fleet-terminal event is scored good/bad against the latency targets
+  (latencies ride the v14 outbox/harvest events) and folded into
+  event-count tumbling windows — one schema-v14 ``slo_window`` record
+  per ``slo_window`` terminals (plus ``slo_breach`` past burn 1.0);
+  replica heartbeat sketches merge into periodic ``fleet_rollup``
+  records (fleet percentiles + per-replica p50 skew/straggler), and
+  the ``fleet_summary`` carries ``slo_verdict`` / worst-window burn —
+  what chaos scenarios fold into their pass/fail.
 
 Every decision lands in the router's own schema-v10 stream: one
 ``route`` record per dispatch (policy, attempt, reason), a
@@ -63,6 +72,7 @@ guarded by ``_lock`` — annotated for graftlint's lock-discipline rule.
 
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 import threading
@@ -74,7 +84,7 @@ from typing import Any, Dict, List, Optional, Tuple
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) —
 # jax-free contract forbids importing it (same stance as the
 # supervisor's hard-coded records).
-SCHEMA = 13
+SCHEMA = 14
 TRACE_ID_ENV = "APEX_TRACE_ID"
 
 POLICIES = ("round_robin", "least_pending", "least_kv")
@@ -83,6 +93,25 @@ POLICIES = ("round_robin", "least_pending", "least_kv")
 # fleet level (drained and lost are re-routed instead; "handoff" parks
 # the uid on the KV spool — a decode replica's outbox finishes it).
 _TERMINAL = ("ok", "timeout", "shed", "cancelled", "failed", "rejected")
+
+_SLO_MOD = None
+
+
+def _load_slo():
+    """obs/slo.py loaded by FILE PATH (cached): the module is stdlib
+    self-contained by contract, so this never executes the jax-carrying
+    package ``__init__`` chain — the metrics_lint _load_schema pattern.
+    Loaded lazily, only when a router is armed with an --slo spec."""
+    global _SLO_MOD
+    if _SLO_MOD is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "obs", "slo.py")
+        spec = importlib.util.spec_from_file_location("_fleet_slo", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _SLO_MOD = mod
+    return _SLO_MOD
 
 
 class _Stream:
@@ -150,6 +179,8 @@ class FleetRouter:
                  stall_after_s: Optional[float] = None,
                  default_deadline_s: Optional[float] = None,
                  spool_timeout_s: Optional[float] = None,
+                 slo=None, slo_window: int = 16,
+                 slo_rollup_s: float = 2.0,
                  trace: bool = False, log=print):
         if policy not in POLICIES:
             raise ValueError(f"policy must be one of {POLICIES}, "
@@ -174,7 +205,10 @@ class FleetRouter:
         self.log = log
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self._stream = sink if sink is not None else _Stream(metrics_jsonl)
-        self._lock = threading.Lock()
+        # Reentrant: the SLO fold helpers (_slo_absorb /
+        # _slo_close_window) take the lock themselves so the guard is
+        # lexical, and their callers already hold it.
+        self._lock = threading.RLock()
         self._order = [r.name for r in replicas]
         # Disagg roles (ISSUE 15): prompts route only to prefill-capable
         # replicas; decode replicas are harvested (their outbox carries
@@ -202,6 +236,26 @@ class FleetRouter:
         self._handoff_redelivered = 0  # terminals from redelivered
         #                                handoff admissions (v13)
         self.results: Dict[str, Dict[str, Any]] = {}    # uid -> final event
+        # SLO plane (ISSUE 16): with a spec armed, every fleet-terminal
+        # event is scored good/bad; verdicts accumulate in _slo_scored
+        # (the PURE input summary_record's windows/verdict are computed
+        # from — two summary calls agree bit-for-bit) while the window
+        # fold in _slo_w backs the emitted slo_window/slo_breach
+        # records at every slo_window-event boundary.
+        self._slo = None
+        self._slo_mod = None
+        self.slo_window = int(slo_window)
+        self.slo_rollup_s = float(slo_rollup_s)
+        self._slo_scored: List[Optional[bool]] = []     # guarded-by: _lock
+        self._slo_w: Optional[Dict[str, Any]] = None    # guarded-by: _lock
+        self._slo_emitted = 0                           # guarded-by: _lock
+        self._slo_last_rollup = time.time()
+        if slo:
+            if self.slo_window < 1:
+                raise ValueError(f"slo_window must be >= 1, "
+                                 f"got {slo_window}")
+            self._slo_mod = _load_slo()
+            self._slo = self._slo_mod._normalize_spec(slo)
         self.scenario: Optional[str] = None
         self.verdict: Optional[str] = None
         self._t0 = time.perf_counter()
@@ -224,16 +278,24 @@ class FleetRouter:
     # --------------------------------------------------------- records
 
     def _header(self) -> None:
+        config: Dict[str, Any] = {
+            "policy": self.policy,
+            "replicas": list(self._order),
+            "max_retries": self.max_retries,
+            "breaker_backoff_s": self.breaker_backoff_s,
+            "stall_after_s": self.stall_after_s,
+            "default_deadline_s": self.default_deadline_s}
+        if self._slo is not None:
+            # The SPEC announcement ci_gate --slo-stream keys on: a
+            # stream with slo_window records but no announced spec (or
+            # two) cannot be checked for verdict consistency.
+            config["slo"] = dict(self._slo)
+            config["slo_window"] = self.slo_window
         self._stream.write({
             "record": "run_header", "schema": SCHEMA, "time": time.time(),
             "run_id": self.run_id, "num_devices": 0, "process_index": 0,
             "platform": "fleet-router",
-            "config": {"policy": self.policy,
-                       "replicas": list(self._order),
-                       "max_retries": self.max_retries,
-                       "breaker_backoff_s": self.breaker_backoff_s,
-                       "stall_after_s": self.stall_after_s,
-                       "default_deadline_s": self.default_deadline_s}})
+            "config": config})
 
     def _route_rec(self, uid: str, replica: str, attempt: int,
                    reason: str, from_replica: Optional[str]) -> None:
@@ -466,6 +528,8 @@ class FleetRouter:
                 self._done[uid] = status
                 del self._inflight[uid]
                 self.results[uid] = ev
+                if self._slo is not None:
+                    self._slo_absorb(status, ev)
                 if ev.get("redelivered"):
                     # v13: this terminal came from a REDELIVERED
                     # handoff admission — the crash-safe spool finished
@@ -573,6 +637,137 @@ class FleetRouter:
         self._router_terminal += 1
         self.results[uid] = {"uid": uid, "status": status,
                              "replica": src, "router_decided": True}
+        if self._slo is not None:
+            # Router-decided terminals (deadline timeout / retry budget
+            # exhausted) are fleet failures too — scored bad like any
+            # replica-reported non-ok.
+            self._slo_absorb(status, {})
+
+    # ------------------------------------------------------------- slo
+
+    def _slo_absorb(self, status: str, ev: Dict[str, Any]) -> None:
+        """Score one fleet-terminal event against the armed SLO spec
+        and fold it into the current tumbling window.  Takes ``_lock``
+        (reentrant — callers already inside the absorb critical section
+        just re-enter).  Latencies ride the replica events themselves
+        (``ttft_ms``/``tpot_ms``, v14 outbox/harvest fields); a
+        router-decided terminal carries none and scores bad."""
+        mod = self._slo_mod
+        verdict = mod.score_event(self._slo, status,
+                                  ttft_ms=ev.get("ttft_ms"),
+                                  tpot_ms=ev.get("tpot_ms"))
+        with self._lock:
+            self._slo_scored.append(verdict)
+            w = self._slo_w
+            if w is None:
+                w = self._slo_w = {
+                    "requests": 0, "good": 0, "bad": 0, "counts": {},
+                    "ttft": mod.sketch_new(mod.DEFAULT_ALPHA),
+                    "tpot": mod.sketch_new(mod.DEFAULT_ALPHA)}
+            w["requests"] += 1
+            w["counts"][status] = w["counts"].get(status, 0) + 1
+            if verdict is True:
+                w["good"] += 1
+            elif verdict is False:
+                w["bad"] += 1
+            if status == "ok":
+                if ev.get("ttft_ms") is not None:
+                    mod.sketch_add(w["ttft"], ev["ttft_ms"])
+                if ev.get("tpot_ms") is not None:
+                    mod.sketch_add(w["tpot"], ev["tpot_ms"])
+            if w["requests"] >= self.slo_window:
+                self._slo_close_window()
+
+    def _slo_close_window(self) -> None:
+        """Emit the current window as an ``slo_window`` record (plus an
+        ``slo_breach`` past burn 1.0).  Takes ``_lock`` (reentrant; the
+        stream's internal lock never takes ours, so writing here cannot
+        deadlock).  Windows are event-count tumbling (every
+        ``slo_window`` fleet-terminal events) — deterministic for a
+        fixed workload, unlike wall-clock windows."""
+        mod = self._slo_mod
+        with self._lock:
+            w = self._slo_w
+            if w is None or w["requests"] == 0:
+                return
+            self._slo_w = None
+            idx = self._slo_emitted
+            self._slo_emitted += 1
+        burn = mod.burn_rate(w["good"], w["bad"],
+                             self._slo["availability"])
+        rec: Dict[str, Any] = {
+            "record": "slo_window", "time": time.time(),
+            "window": idx, "requests": w["requests"],
+            "good": w["good"], "bad": w["bad"], "burn_rate": burn,
+            "counts": dict(w["counts"]), "run_id": self.run_id}
+        if w["ttft"]["count"]:
+            rec["ttft_ms"] = mod.sketch_summary(w["ttft"])
+        if w["tpot"]["count"]:
+            rec["tpot_ms"] = mod.sketch_summary(w["tpot"])
+        self._stream.write(rec)
+        if burn > 1.0:
+            self._stream.write({
+                "record": "slo_breach", "time": time.time(),
+                "window": idx, "burn_rate": burn,
+                "requests": w["requests"], "good": w["good"],
+                "bad": w["bad"],
+                "budget": 1.0 - self._slo["availability"],
+                "run_id": self.run_id})
+
+    def _slo_rollup(self, force: bool = False) -> None:
+        """Merge the replicas' heartbeat latency sketches
+        (``replica_state.slo_sketch``, tailed into each meta's health
+        snapshot) into one fleet-level ``fleet_rollup`` record —
+        cross-replica percentiles without re-pooling raw samples, plus
+        per-replica p50 skew and the straggler's name.  Wall-clock
+        rate-limited to ``slo_rollup_s`` (``force`` bypasses the
+        limiter — the close-time last-chance rollup); emitted only when
+        at least one replica contributed data (determinism tests
+        compare score dicts, never rollup timing)."""
+        now = time.time()
+        if not force and now - self._slo_last_rollup < self.slo_rollup_s:
+            return
+        self._slo_last_rollup = now
+        mod = self._slo_mod
+        with self._lock:
+            snaps = [(n, self._replicas[n].health.get("slo_sketch"))
+                     for n in self._order]
+        merged: Dict[str, Any] = {}
+        per_replica: Dict[str, Any] = {}
+        for name, sk in snaps:
+            if not isinstance(sk, dict):
+                continue
+            for key in ("ttft_ms", "tpot_ms"):
+                s = sk.get(key)
+                if not isinstance(s, dict) or not s.get("count"):
+                    continue
+                if key in merged and merged[key]["alpha"] != s["alpha"]:
+                    continue        # unmergeable error bounds: skip
+                merged[key] = mod.sketch_merge(merged[key], s) \
+                    if key in merged \
+                    else dict(s, buckets=dict(s["buckets"]))
+                if key == "ttft_ms":
+                    per_replica[name] = {
+                        "count": int(s["count"]),
+                        "p50": mod.sketch_percentile(s, 50)}
+        total = sum(v["count"] for v in per_replica.values())
+        if total == 0:
+            return
+        rec: Dict[str, Any] = {
+            "record": "fleet_rollup", "time": now,
+            "replicas": len(per_replica), "count": total,
+            "per_replica": per_replica, "run_id": self.run_id}
+        if "ttft_ms" in merged:
+            rec["ttft_ms"] = mod.sketch_summary(merged["ttft_ms"])
+        if "tpot_ms" in merged:
+            rec["tpot_ms"] = mod.sketch_summary(merged["tpot_ms"])
+        if len(per_replica) >= 2:
+            p50s = sorted((v["p50"], n) for n, v in per_replica.items())
+            med = p50s[len(p50s) // 2][0]
+            if med > 0:
+                rec["skew"] = round(p50s[-1][0] / med, 3)
+                rec["straggler"] = p50s[-1][1]
+        self._stream.write(rec)
 
     # ----------------------------------------------------------- poll
 
@@ -632,6 +827,8 @@ class FleetRouter:
         requeue/retry, drain the backlog.  Returns the number of
         events absorbed."""
         self._refresh_health()
+        if self._slo is not None:
+            self._slo_rollup()
         with self._lock:
             handles = [(n, self._replicas[n].handle)
                        for n in self._order]
@@ -739,6 +936,7 @@ class FleetRouter:
             redelivered = self._handoff_redelivered
             in_spool = sum(1 for e in self._inflight.values()
                            if e.get("stage") == "spool")
+            slo_scored = list(self._slo_scored)
         ok = sum(1 for s in done.values() if s == "ok")
         terminal = len(done)
         counts = {s: sum(1 for v in done.values() if v == s)
@@ -784,6 +982,22 @@ class FleetRouter:
             rec["handoffs"] = handoffs
             rec["handoff_redelivered"] = redelivered
             rec["in_spool"] = in_spool
+        if self._slo is not None:
+            # v14 SLO verdict: computed PURELY from the scored-event
+            # list (score_windows chunks it exactly as the emission
+            # windows did), so the two summary_record calls in
+            # close()'s path agree and match the emitted records.
+            mod = self._slo_mod
+            wins = mod.score_windows(slo_scored, self.slo_window,
+                                     self._slo["availability"])
+            breaches = sum(1 for w in wins if w["burn_rate"] > 1.0)
+            wi, wb = mod.worst_window(wins)
+            rec["slo_verdict"] = "fail" if breaches else "pass"
+            rec["slo_windows"] = len(wins)
+            rec["slo_breaches"] = breaches
+            rec["slo_worst_burn"] = wb
+            if wi is not None:
+                rec["slo_worst_window"] = wi
         if self.scenario:
             rec["scenario"] = self.scenario
         if self.verdict:
@@ -793,6 +1007,33 @@ class FleetRouter:
     def close(self) -> Dict[str, Any]:
         """Write the fleet_summary and close the stream; returns the
         summary record."""
+        if self._slo is not None:
+            # Last-chance rollup: a short run's final heartbeat (the
+            # one carrying nonzero sketches) often lands AFTER the
+            # last poll, so re-snapshot just the sketches and merge
+            # them now, bypassing the rate limiter — every armed run
+            # with completions gets at least one fleet_rollup.  Only
+            # the slo_sketch key is refreshed: close-time is not the
+            # place to act on state transitions.
+            with self._lock:
+                handles = [(n, self._replicas[n].handle)
+                           for n in self._order]
+            for name, handle in handles:
+                try:
+                    snap = handle.state()
+                except Exception:
+                    continue
+                if isinstance(snap, dict) and "slo_sketch" in snap:
+                    with self._lock:
+                        meta = self._replicas[name]
+                        meta.health = dict(
+                            meta.health, slo_sketch=snap["slo_sketch"])
+            self._slo_rollup(force=True)
+            # Trailing partial window: emitted before the summary so
+            # the stream's slo_window count matches the summary's
+            # windows field (score_windows includes the partial too).
+            with self._lock:
+                self._slo_close_window()
         rec = self.summary_record()
         self._stream.write(rec)
         self._stream.close()

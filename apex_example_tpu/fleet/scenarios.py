@@ -147,8 +147,13 @@ def _finish(router, name: str, *, availability_min: float,
     checks = dict(checks or {})
     for key, predicate in (summary_checks or {}).items():
         checks[key] = bool(predicate(summary))
+    # v14: an armed SLO spec folds into the scenario score — a chaos
+    # run that "passed" on conservation but burned through its error
+    # budget in some window is a fail (absent without --slo, so
+    # unarmed scenarios score exactly as before).
     ok = (summary["lost"] == 0
           and summary["availability"] >= availability_min
+          and summary.get("slo_verdict") != "fail"
           and all((checks or {}).values()))
     router.scenario = name
     router.verdict = "pass" if ok else "fail"

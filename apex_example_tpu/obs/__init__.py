@@ -49,6 +49,18 @@ The trace stratum (per-request/per-tick timelines, schema v9):
   ``trace_event`` records on the metrics stream, exported to
   Chrome/Perfetto by ``tools/trace_export.py``.
 
+The streaming-SLO stratum (windowed online percentiles, schema v14):
+
+- :mod:`~apex_example_tpu.obs.slo`  mergeable log-bucket quantile
+  sketches (DDSketch-style, bounded relative error), SLO spec parsing,
+  error-budget burn-rate scoring, and the :class:`SloTracker` the
+  serve engine folds per-request latencies into — ``--slo`` on
+  serve.py / fleet.py arms it; ``tools/slo_report.py`` renders the
+  window timeline.  Pure stdlib (jax-free by contract, like schema) so
+  the router and the report tools can load it by file path.
+  :class:`~apex_example_tpu.obs.metrics.LogBucketHistogram` is the
+  registry-side face over the same sketch.
+
 The JSONL schema itself lives in :mod:`~apex_example_tpu.obs.schema`
 (pure stdlib — tools can validate without importing jax).
 """
@@ -59,7 +71,8 @@ from apex_example_tpu.obs.trace import Tracer
 from apex_example_tpu.obs.flight import FlightRecorder, format_thread_stacks
 from apex_example_tpu.obs.logging import get_logger, rank_print
 from apex_example_tpu.obs.metrics import (Counter, Gauge, Histogram,
-                                          JsonlSink, MetricsRegistry,
+                                          JsonlSink, LogBucketHistogram,
+                                          MetricsRegistry,
                                           TensorBoardAdapter, nearest_rank,
                                           read_jsonl)
 from apex_example_tpu.obs.numerics import NumericsMonitor, module_grad_stats
@@ -77,7 +90,8 @@ from apex_example_tpu.obs.watchdog import StallWatchdog
 __all__ = [
     "CostModel", "Counter", "DEFAULT_TRACE_DIR", "FlightRecorder", "Gauge",
     "Histogram",
-    "JsonlSink", "MetricsRegistry", "NumericsMonitor", "PHASES",
+    "JsonlSink", "LogBucketHistogram", "MetricsRegistry",
+    "NumericsMonitor", "PHASES",
     "ProfilerWindow", "SCHEMA_VERSION", "StallWatchdog", "TelemetryEmitter",
     "TensorBoardAdapter", "Tracer", "current_span", "device_memory_stats",
     "device_span", "format_thread_stacks", "get_logger",

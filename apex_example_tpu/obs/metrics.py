@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from apex_example_tpu.obs import slo as _slo
 from apex_example_tpu.obs.schema import SCHEMA_VERSION  # noqa: F401
 
 
@@ -109,6 +110,85 @@ class Histogram:
         return {"count": self.count, "mean": self.mean, "sum": self.sum,
                 "min": self.min, "max": self.max, "p50": self.percentile(50),
                 "p95": self.percentile(95)}
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold another histogram's observations into this one (ISSUE
+        16): count/sum/min/max stay exact; the bounded sample pools
+        both trails, stride-subsampled deterministically when the pool
+        exceeds max_samples.  While the pooled trail fits the bound the
+        merged percentiles EQUAL those of one histogram fed both
+        streams — the ground truth fleet_report re-pools raw trails
+        for."""
+        if other.count == 0:
+            return self
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        pooled = self._samples + other._samples
+        if len(pooled) > self._max_samples:
+            step = len(pooled) / self._max_samples
+            pooled = [pooled[int(i * step)]
+                      for i in range(self._max_samples)]
+        self._samples = pooled
+        return self
+
+
+class LogBucketHistogram:
+    """Mergeable streaming quantile sketch (DDSketch-style fixed log
+    boundaries, ISSUE 16) — the cross-replica counterpart of the exact
+    ``Histogram``: percentiles carry a declared RELATIVE-error bound
+    ``alpha`` instead of a bounded trailing sample, and two sketches
+    merge by bucket-count addition, so replica sketches aggregate into
+    a fleet percentile no re-pooled raw trail is needed for.
+
+    Thin class face over the dict-sketch helpers in ``obs/slo.py`` (the
+    canonical math — stdlib-only so the jax-free router and tools load
+    it by file path); ``to_dict()``/``from_dict()`` expose the same
+    JSON-native serialized form replica heartbeats carry."""
+
+    def __init__(self, name: str, alpha: float = _slo.DEFAULT_ALPHA):
+        self.name = name
+        self._sk = _slo.sketch_new(alpha)
+
+    @property
+    def alpha(self) -> float:
+        return self._sk["alpha"]
+
+    @property
+    def count(self) -> int:
+        return self._sk["count"]
+
+    def observe(self, value) -> None:
+        _slo.sketch_add(self._sk, value)
+
+    def merge(self, other) -> "LogBucketHistogram":
+        """Fold another sketch in (a LogBucketHistogram or a serialized
+        dict); alphas must match."""
+        sk = other._sk if isinstance(other, LogBucketHistogram) else other
+        self._sk = _slo.sketch_merge(self._sk, sk)
+        return self
+
+    def percentile(self, q: float) -> float:
+        return _slo.sketch_percentile(self._sk, q)
+
+    def summary(self) -> Dict[str, float]:
+        return _slo.sketch_summary(self._sk)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"alpha": self._sk["alpha"], "count": self._sk["count"],
+                "zero": self._sk["zero"],
+                "buckets": dict(self._sk["buckets"]),
+                "min": self._sk["min"], "max": self._sk["max"]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any],
+                  name: str = "") -> "LogBucketHistogram":
+        h = cls(name, alpha=d["alpha"])
+        h._sk = {"alpha": d["alpha"], "count": d["count"],
+                 "zero": d["zero"], "buckets": dict(d["buckets"]),
+                 "min": d["min"], "max": d["max"]}
+        return h
 
 
 class MetricsRegistry:

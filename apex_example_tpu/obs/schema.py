@@ -264,6 +264,42 @@ adopted lease, and ``duplicate: true`` marks an idempotent re-admission
 ``handoff_redelivered`` / ``in_spool``).  v13 is once more a strict
 superset: every v1–v12 stream validates unchanged.
 
+Version 14 adds the streaming SLO stratum (obs/slo.py; ``--slo`` on
+serve.py / fleet.py — README "SLO monitoring"):
+
+``slo_window``   one per closed tumbling window (every
+                 ``--slo-window-s`` wall seconds or
+                 ``--slo-window-ticks`` engine ticks on serve.py; every
+                 ``--slo-window`` terminal events on the fleet router)
+                 — good/bad event counts scored against the ``--slo``
+                 spec, the window's error-budget ``burn_rate``
+                 (bad fraction / (1 - availability)), per-status
+                 counts, TTFT/TPOT/queue-wait percentile estimates
+                 from the window's log-bucket sketch (relative-error
+                 bound ``alpha``), and the latest
+                 blocks_live/kv_bytes_live/occupancy gauge snapshot.
+``slo_breach``   one per window whose burn rate exceeds 1.0 — the
+                 window spent more than its whole error budget; names
+                 the window and its burn/good/bad/budget numbers so an
+                 alerting tail never needs the full stream.
+``fleet_rollup``  the router's live cross-replica aggregation, one per
+                 rollup interval: replica heartbeat sketches
+                 (``replica_state.slo_sketch``) merged by bucket-count
+                 addition into fleet-wide TTFT/TPOT percentiles, plus
+                 the per-replica p50 breakdown, the max/median p50
+                 ``skew`` and the worst replica's name (``straggler``)
+                 — the live form of what fleet_report finds post-hoc.
+
+plus ``slo_sketch`` on ``replica_state`` heartbeats (the compact
+serialized cumulative sketch the rollup merges), an ``slo`` dict on
+``serve_summary`` (spec, window/breach totals, worst burn, cumulative
+sketch percentiles), and the fleet verdict fields on ``fleet_summary``
+(``slo_verdict`` pass|fail, ``slo_windows`` / ``slo_breaches`` /
+``slo_worst_burn`` / ``slo_worst_window``) the chaos scenarios score.
+Without ``--slo`` none of these are emitted — streams are
+byte-identical to v13 runs.  v14 is once more a strict superset: every
+v1–v13 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -275,7 +311,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -453,6 +489,30 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "blocks": int,          # arena blocks in the payload
         "payload_bytes": int,   # payload + scale bytes, dtype-accurate
     },
+    # --- schema v14: streaming SLO records (obs/slo.py; --slo) ---
+    "slo_window": {
+        "record": str,
+        "time": _NUM,
+        "window": int,          # tumbling-window ordinal, 0-based
+        "requests": int,        # terminal events folded this window
+        "good": int,            # ok AND every spec'd latency in target
+        "bad": int,             # everything else the server owned
+        "burn_rate": _NUM,      # bad fraction / (1 - availability)
+    },
+    "slo_breach": {
+        "record": str,
+        "time": _NUM,
+        "window": int,          # the slo_window that overspent
+        "burn_rate": _NUM,      # > 1.0 by definition
+        "requests": int,
+        "bad": int,
+    },
+    "fleet_rollup": {
+        "record": str,
+        "time": _NUM,
+        "replicas": int,        # replicas contributing a sketch
+        "count": int,           # merged TTFT observations, fleet-wide
+    },
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -586,6 +646,10 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "handoff_redelivered": int,  # uids admitted from a reclaimed
                                      #   or adopted lease
         "handoff_quarantined": int,  # corrupt payloads parked at *.bad
+        # v14: the streaming SLO fold (obs/slo.py; --slo) — spec,
+        # window/breach totals, worst burn, cumulative sketch
+        # percentiles.  Absent without --slo.
+        "slo": dict,
     },
     "preemption": {
         "run_id": str,
@@ -701,6 +765,9 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "exit_code": int,        # with state crashed/restarting
         "classification": str,   # preempted | crashed | stall_killed
         "detail": str,
+        "slo_sketch": dict,      # v14: compact serialized cumulative
+                                 #   TTFT/TPOT sketches (--slo armed) —
+                                 #   what fleet_rollup merges
     },
     # --- schema v11: quantization records (apex_example_tpu/quant/) ---
     "quant_event": {
@@ -766,6 +833,40 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
                                      #   handoff admissions
         "in_spool": int,          # uids still on the spool at close
                                   #   (counted in lost; must be 0)
+        # v14 (ISSUE 16): the fleet SLO verdict — event-count tumbling
+        # windows over the router's terminal feed, scored against the
+        # --slo spec.  Absent without --slo.
+        "slo_verdict": str,       # pass | fail (any breached window)
+        "slo_windows": int,       # windows scored (trailing partial in)
+        "slo_breaches": int,      # windows with burn_rate > 1.0
+        "slo_worst_burn": _NUM,   # max window burn rate
+        "slo_worst_window": int,  # its 0-based index (first on ties)
+    },
+    # --- schema v14: streaming SLO records (obs/slo.py; --slo) ---
+    "slo_window": {
+        "run_id": str,
+        "counts": dict,          # terminal counts by status (drained
+                                 #   included — outside good/bad)
+        "ttft_ms": dict,         # window sketch percentile estimates
+        "tpot_ms": dict,         #   ({count,p50,p90,p99,min,max}),
+        "queue_wait_ms": dict,   #   ok completions only
+        "ticks": int,            # engine ticks folded (serve side)
+        "occupancy": _NUM,       # mean live-slot fraction over ticks
+        "blocks_live": int,      # latest KV gauge snapshot in-window
+        "kv_bytes_live": int,
+    },
+    "slo_breach": {
+        "run_id": str,
+        "good": int,
+        "budget": _NUM,          # the error budget (1 - availability)
+    },
+    "fleet_rollup": {
+        "run_id": str,
+        "ttft_ms": dict,         # merged-sketch percentile estimates
+        "tpot_ms": dict,
+        "per_replica": dict,     # name -> {count, p50}
+        "skew": _NUM,            # max p50 / median p50 (>= 2 replicas)
+        "straggler": str,        # the max-p50 replica's name
     },
 }
 

@@ -1,0 +1,434 @@
+"""Streaming SLO plane — pure stdlib, importable without jax.
+
+The live counterpart of serve_report/fleet_report's offline percentiles
+(ISSUE 16): a mergeable DDSketch-style log-bucket quantile sketch, the
+``--slo`` spec parser, per-event good/bad scoring against an error
+budget, and the tumbling-window tracker the serve engine folds requests
+and gauges into.
+
+Self-contained BY CONTRACT (the obs/schema.py pattern): this module
+imports nothing but the stdlib, so the jax-free fleet router, fleet.py
+and the thin report tools load it by FILE PATH
+(``importlib.util.spec_from_file_location``) without executing the
+jax-carrying package ``__init__`` chain.  graftlint's jax-free rule
+names it in CONTRACT_FILES; keep it that way.
+
+The sketch
+----------
+Fixed log-boundary buckets with relative-error bound ``alpha``: for
+``gamma = (1 + alpha) / (1 - alpha)``, a value ``v > 0`` lands in bucket
+``ceil(log_gamma(v))`` and is estimated back as
+``2 * gamma**i / (gamma + 1)`` — within a factor ``(1 +- alpha)`` of
+every value the bucket holds, so any percentile estimate is within
+relative error ``alpha`` of the exact sample percentile.  Values
+``<= 0`` share one zero bucket estimated as 0.0.  The serialized form is
+a plain JSON object (bucket index -> count, string keys), so merging
+across replicas is bucket-count addition — associative, commutative,
+and possible on hosts that only have the JSONL.
+
+Windows and burn rate
+---------------------
+A window scores each terminal request GOOD (status ok AND every spec'd
+latency within target) or BAD (everything else the server owned);
+``drained`` requeues belong to the next server and stay outside the
+denominator.  The error budget is ``1 - availability``; the burn rate
+is ``bad_fraction / budget`` — burn 1.0 spends the budget exactly,
+burn > 1.0 is a breach and emits an ``slo_breach`` record.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+DEFAULT_ALPHA = 0.01
+
+# The availability an --slo spec gets when it names none: three nines,
+# i.e. a 0.001 error budget.
+DEFAULT_AVAILABILITY = 0.999
+
+# Terminal statuses outside the good/bad denominator: a drained request
+# was handed back for requeueing — its fate belongs to whoever serves
+# it next, and counting it against THIS server's budget would make
+# every graceful drain look like an outage.
+EXCLUDED_STATUSES = frozenset({"drained"})
+
+_SLO_KEYS = ("ttft_ms", "tpot_ms", "availability")
+
+
+# --------------------------------------------------------------- sketch
+
+def _gamma(alpha: float) -> float:
+    return (1.0 + alpha) / (1.0 - alpha)
+
+
+def sketch_new(alpha: float = DEFAULT_ALPHA) -> Dict[str, Any]:
+    """A fresh empty sketch (the JSON-native dict form)."""
+    if not 0.0 < alpha < 1.0:
+        raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+    return {"alpha": alpha, "count": 0, "zero": 0, "buckets": {},
+            "min": None, "max": None}
+
+
+def sketch_add(sk: Dict[str, Any], value, n: int = 1) -> Dict[str, Any]:
+    """Fold ``n`` observations of ``value`` into ``sk`` (in place)."""
+    v = float(value)
+    sk["count"] += n
+    sk["min"] = v if sk["min"] is None else min(sk["min"], v)
+    sk["max"] = v if sk["max"] is None else max(sk["max"], v)
+    if v <= 0.0:
+        sk["zero"] += n
+        return sk
+    idx = math.ceil(math.log(v) / math.log(_gamma(sk["alpha"])))
+    key = str(idx)
+    sk["buckets"][key] = sk["buckets"].get(key, 0) + n
+    return sk
+
+
+def sketch_merge(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """A new sketch holding a's and b's observations.  Associative and
+    commutative (bucket-count addition); alphas must match — merging
+    across error bounds would silently inherit the looser one."""
+    if a["alpha"] != b["alpha"]:
+        raise ValueError(f"cannot merge sketches with different alphas "
+                         f"({a['alpha']} vs {b['alpha']})")
+    mins = [m for m in (a["min"], b["min"]) if m is not None]
+    maxs = [m for m in (a["max"], b["max"]) if m is not None]
+    out = {"alpha": a["alpha"], "count": a["count"] + b["count"],
+           "zero": a["zero"] + b["zero"], "buckets": dict(a["buckets"]),
+           "min": min(mins) if mins else None,
+           "max": max(maxs) if maxs else None}
+    for key, n in b["buckets"].items():
+        out["buckets"][key] = out["buckets"].get(key, 0) + n
+    return out
+
+
+def sketch_percentile(sk: Dict[str, Any], q: float) -> float:
+    """Nearest-rank percentile estimate: the bucket holding the
+    ``ceil(q/100 * n)``-th observation, estimated at its log-midpoint —
+    within relative error ``alpha`` of the exact sample percentile.
+    Empty sketch -> 0.0; ranks inside the zero bucket -> 0.0."""
+    n = sk["count"]
+    if n == 0:
+        return 0.0
+    rank = min(max(math.ceil(q / 100.0 * n), 1), n)
+    if rank <= sk["zero"]:
+        return 0.0
+    seen = sk["zero"]
+    g = _gamma(sk["alpha"])
+    for idx in sorted(int(k) for k in sk["buckets"]):
+        seen += sk["buckets"][str(idx)]
+        if seen >= rank:
+            return 2.0 * (g ** idx) / (g + 1.0)
+    return sk["max"] if sk["max"] is not None else 0.0
+
+
+def sketch_summary(sk: Dict[str, Any]) -> Dict[str, Any]:
+    """The percentile dict windows/summaries embed (JSON-safe)."""
+    return {"count": sk["count"],
+            "p50": sketch_percentile(sk, 50),
+            "p90": sketch_percentile(sk, 90),
+            "p99": sketch_percentile(sk, 99),
+            "min": sk["min"] if sk["min"] is not None else 0.0,
+            "max": sk["max"] if sk["max"] is not None else 0.0}
+
+
+# ------------------------------------------------------- spec + scoring
+
+def parse_slo(spec: str) -> Dict[str, Any]:
+    """Parse an ``--slo`` flag: ``ttft_ms=500,tpot_ms=50,
+    availability=0.99``.  At least one latency target is required;
+    ``availability`` defaults to 0.999 and must leave a nonzero error
+    budget (< 1.0).  Raises ValueError with a usable message."""
+    out: Dict[str, Any] = {"ttft_ms": None, "tpot_ms": None,
+                           "availability": DEFAULT_AVAILABILITY}
+    parts = [p.strip() for p in str(spec).split(",") if p.strip()]
+    if not parts:
+        raise ValueError("empty --slo spec (expected e.g. "
+                         "ttft_ms=500,tpot_ms=50,availability=0.99)")
+    seen = set()
+    for part in parts:
+        key, eq, val = part.partition("=")
+        key = key.strip()
+        if not eq or key not in _SLO_KEYS:
+            raise ValueError(f"bad --slo entry {part!r} (expected "
+                             f"key=value with key in {_SLO_KEYS})")
+        if key in seen:
+            raise ValueError(f"duplicate --slo key {key!r}")
+        seen.add(key)
+        try:
+            x = float(val)
+        except ValueError:
+            raise ValueError(f"--slo {key} is not a number: {val!r}")
+        if key == "availability":
+            if not 0.0 < x < 1.0:
+                raise ValueError(f"--slo availability must be in (0, 1) "
+                                 f"— 1.0 leaves a zero error budget, "
+                                 f"got {val}")
+        elif x <= 0.0:
+            raise ValueError(f"--slo {key} must be > 0, got {val}")
+        out[key] = x
+    if out["ttft_ms"] is None and out["tpot_ms"] is None:
+        raise ValueError("--slo needs at least one latency target "
+                         "(ttft_ms= and/or tpot_ms=)")
+    return out
+
+
+def _normalize_spec(spec) -> Dict[str, Any]:
+    if isinstance(spec, str):
+        return parse_slo(spec)
+    return {"ttft_ms": spec.get("ttft_ms"),
+            "tpot_ms": spec.get("tpot_ms"),
+            "availability": spec.get("availability",
+                                     DEFAULT_AVAILABILITY)}
+
+
+def score_event(spec: Dict[str, Any], status: str, *,
+                ttft_ms=None, tpot_ms=None) -> Optional[bool]:
+    """True = good, False = bad, None = outside the denominator.
+
+    Good means the server delivered: status ok AND every latency the
+    spec names is present and within target (an ok completion MISSING a
+    spec'd latency counts bad — an unmeasured target is not a met one).
+    """
+    if status in EXCLUDED_STATUSES:
+        return None
+    if status != "ok":
+        return False
+    if spec.get("ttft_ms") is not None and (
+            ttft_ms is None or ttft_ms > spec["ttft_ms"]):
+        return False
+    if spec.get("tpot_ms") is not None and (
+            tpot_ms is None or tpot_ms > spec["tpot_ms"]):
+        return False
+    return True
+
+
+def burn_rate(good: int, bad: int, availability: float) -> float:
+    """bad_fraction / error_budget over one window.  burn 1.0 spends
+    the window's budget exactly; > 1.0 is a breach.  An empty window
+    burns nothing."""
+    total = good + bad
+    if total == 0:
+        return 0.0
+    return (bad / total) / (1.0 - availability)
+
+
+def score_windows(scored: List[Optional[bool]], window_size: int,
+                  availability: float) -> List[Dict[str, Any]]:
+    """Tumbling event-count windows over a scored event sequence (True/
+    False/None per terminal event, arrival order) — the PURE function
+    the fleet router's summary verdict is computed from, so two calls
+    over the same events agree bit-for-bit.  The trailing partial
+    window is included."""
+    out: List[Dict[str, Any]] = []
+    for i in range(0, len(scored), window_size):
+        chunk = scored[i:i + window_size]
+        good = sum(1 for s in chunk if s is True)
+        bad = sum(1 for s in chunk if s is False)
+        out.append({"window": len(out), "requests": len(chunk),
+                    "good": good, "bad": bad,
+                    "burn_rate": burn_rate(good, bad, availability)})
+    return out
+
+
+def worst_window(windows: List[Dict[str, Any]]):
+    """(index, burn) of the max-burn window, first on ties; (None, 0.0)
+    when there are no windows."""
+    idx, worst = None, 0.0
+    for w in windows:
+        if idx is None or w["burn_rate"] > worst:
+            idx, worst = w["window"], w["burn_rate"]
+    return idx, worst
+
+
+# ------------------------------------------------------------- tracker
+
+class SloTracker:
+    """The serve engine's windowed SLO fold — pure host-side state.
+
+    Terminal requests and per-tick gauges accumulate into the current
+    tumbling window; at each boundary the window closes into one
+    ``slo_window`` record (plus an ``slo_breach`` when its burn rate
+    exceeds 1.0), emitted through the ``emit`` callback (a JsonlSink
+    .write, or None to keep records off).  Windows close every
+    ``window_ticks`` engine ticks when set (the deterministic mode
+    tests pin), else every ``window_s`` wall seconds.  Windows with no
+    terminal events are skipped, not emitted — an idle engine writes
+    nothing.
+
+    Cumulative (never-reset) latency sketches back ``summary()`` (the
+    serve_summary ``slo`` dict) and ``sketch_state()`` (the compact
+    serialized form replica heartbeats carry for the fleet rollup).
+    Latency sketches fold status-ok completions only — the same
+    population ``request_complete`` records cover, so the ci_gate
+    sketch-vs-exact check compares like with like.
+    """
+
+    def __init__(self, spec, *, alpha: float = DEFAULT_ALPHA,
+                 window_s: float = 1.0, window_ticks: int = 0,
+                 emit: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                 run_id: Optional[str] = None, clock=None):
+        self.spec = _normalize_spec(spec)
+        self.alpha = alpha
+        self.window_s = float(window_s)
+        self.window_ticks = int(window_ticks or 0)
+        self.emit = emit
+        self.run_id = run_id
+        self._clock = clock or time.time
+        self.budget = 1.0 - self.spec["availability"]
+        # cumulative
+        self.good = 0
+        self.bad = 0
+        self.windows = 0
+        self.breaches = 0
+        self.worst_burn = 0.0
+        self.worst_window: Optional[int] = None
+        self.ttft = sketch_new(alpha)
+        self.tpot = sketch_new(alpha)
+        self.queue_wait = sketch_new(alpha)
+        # current window
+        self._reset_window()
+        self._window_started = self._clock()
+
+    def _reset_window(self) -> None:
+        self._w_counts: Dict[str, int] = {}
+        self._w_good = 0
+        self._w_bad = 0
+        self._w_ttft = sketch_new(self.alpha)
+        self._w_tpot = sketch_new(self.alpha)
+        self._w_queue = sketch_new(self.alpha)
+        self._w_ticks = 0
+        self._w_occ_sum = 0.0
+        self._w_blocks_live: Optional[int] = None
+        self._w_kv_bytes_live: Optional[int] = None
+
+    def observe_request(self, status: str, *, ttft_ms=None, tpot_ms=None,
+                        queue_wait_ms=None) -> None:
+        """Fold one terminal request into the current window."""
+        self._w_counts[status] = self._w_counts.get(status, 0) + 1
+        verdict = score_event(self.spec, status, ttft_ms=ttft_ms,
+                              tpot_ms=tpot_ms)
+        if verdict is True:
+            self.good += 1
+            self._w_good += 1
+        elif verdict is False:
+            self.bad += 1
+            self._w_bad += 1
+        if status == "ok":
+            if ttft_ms is not None:
+                sketch_add(self.ttft, ttft_ms)
+                sketch_add(self._w_ttft, ttft_ms)
+            if tpot_ms is not None:
+                sketch_add(self.tpot, tpot_ms)
+                sketch_add(self._w_tpot, tpot_ms)
+            if queue_wait_ms is not None:
+                sketch_add(self.queue_wait, queue_wait_ms)
+                sketch_add(self._w_queue, queue_wait_ms)
+        if self.window_ticks <= 0:
+            self._maybe_roll()
+
+    def observe_tick(self, *, live_slots=None, num_slots=None,
+                     blocks_live=None, kv_bytes_live=None) -> None:
+        """Fold one engine tick's gauges; closes the window at a tick
+        boundary (tick mode) or past the wall deadline (wall mode)."""
+        self._w_ticks += 1
+        if live_slots is not None and num_slots:
+            self._w_occ_sum += live_slots / num_slots
+        if blocks_live is not None:
+            self._w_blocks_live = int(blocks_live)
+        if kv_bytes_live is not None:
+            self._w_kv_bytes_live = int(kv_bytes_live)
+        if self.window_ticks > 0:
+            if self._w_ticks >= self.window_ticks:
+                self._close_window()
+        else:
+            self._maybe_roll()
+
+    def flush(self) -> None:
+        """Close the trailing partial window (idempotent) — call before
+        reading ``summary()`` for a closing record."""
+        self._close_window()
+
+    def _maybe_roll(self) -> None:
+        if self._clock() - self._window_started >= self.window_s:
+            self._close_window()
+
+    def _close_window(self) -> None:
+        n = sum(self._w_counts.values())
+        if n == 0:
+            # Nothing terminal this window: restart the clock, carry no
+            # record — gauges without requests score nothing.
+            self._reset_window()
+            self._window_started = self._clock()
+            return
+        burn = burn_rate(self._w_good, self._w_bad,
+                         self.spec["availability"])
+        idx = self.windows
+        self.windows += 1
+        if self.worst_window is None or burn > self.worst_burn:
+            self.worst_burn, self.worst_window = burn, idx
+        rec = {"record": "slo_window", "time": self._clock(),
+               "window": idx, "requests": n, "good": self._w_good,
+               "bad": self._w_bad, "burn_rate": burn,
+               "counts": dict(self._w_counts)}
+        if self._w_ttft["count"]:
+            rec["ttft_ms"] = sketch_summary(self._w_ttft)
+        if self._w_tpot["count"]:
+            rec["tpot_ms"] = sketch_summary(self._w_tpot)
+        if self._w_queue["count"]:
+            rec["queue_wait_ms"] = sketch_summary(self._w_queue)
+        if self._w_ticks:
+            rec["ticks"] = self._w_ticks
+            rec["occupancy"] = self._w_occ_sum / self._w_ticks
+        if self._w_blocks_live is not None:
+            rec["blocks_live"] = self._w_blocks_live
+        if self._w_kv_bytes_live is not None:
+            rec["kv_bytes_live"] = self._w_kv_bytes_live
+        if self.run_id is not None:
+            rec["run_id"] = self.run_id
+        if self.emit is not None:
+            self.emit(rec)
+        if burn > 1.0:
+            self.breaches += 1
+            brec = {"record": "slo_breach", "time": self._clock(),
+                    "window": idx, "burn_rate": burn, "requests": n,
+                    "good": self._w_good, "bad": self._w_bad,
+                    "budget": self.budget}
+            if self.run_id is not None:
+                brec["run_id"] = self.run_id
+            if self.emit is not None:
+                self.emit(brec)
+        self._reset_window()
+        self._window_started = self._clock()
+
+    def summary(self) -> Dict[str, Any]:
+        """The serve_summary ``slo`` dict (call ``flush()`` first so
+        the trailing partial window is scored)."""
+        return {"spec": dict(self.spec), "alpha": self.alpha,
+                "good": self.good, "bad": self.bad,
+                "windows": self.windows, "breaches": self.breaches,
+                "worst_burn": self.worst_burn,
+                "worst_window": self.worst_window,
+                "verdict": "fail" if self.breaches else "pass",
+                "ttft_ms": sketch_summary(self.ttft),
+                "tpot_ms": sketch_summary(self.tpot),
+                "queue_wait_ms": sketch_summary(self.queue_wait)}
+
+    def sketch_state(self) -> Dict[str, Any]:
+        """The compact serialized cumulative sketches a replica
+        heartbeat carries (``replica_state.slo_sketch``) — JSON-safe,
+        mergeable by any host holding this file."""
+        return {"ttft_ms": {"alpha": self.ttft["alpha"],
+                            "count": self.ttft["count"],
+                            "zero": self.ttft["zero"],
+                            "buckets": dict(self.ttft["buckets"]),
+                            "min": self.ttft["min"],
+                            "max": self.ttft["max"]},
+                "tpot_ms": {"alpha": self.tpot["alpha"],
+                            "count": self.tpot["count"],
+                            "zero": self.tpot["zero"],
+                            "buckets": dict(self.tpot["buckets"]),
+                            "min": self.tpot["min"],
+                            "max": self.tpot["max"]}}

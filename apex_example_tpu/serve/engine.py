@@ -86,6 +86,7 @@ from apex_example_tpu.models.gpt import sample_tokens
 from apex_example_tpu.obs import costmodel as costmodel_lib
 from apex_example_tpu.obs import trace as trace_lib
 from apex_example_tpu.obs.metrics import Histogram, nearest_rank
+from apex_example_tpu.obs.slo import SloTracker
 from apex_example_tpu.resilience.faults import FaultInjected
 from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
                                           RequestQueue)
@@ -294,7 +295,9 @@ class ServeEngine:
                  sink=None, run_id: Optional[str] = None,
                  fault=None, registry=None, kv_quant: bool = False,
                  weight_quant: str = "none", role: str = "both",
-                 handoff_sink=None):
+                 handoff_sink=None, slo=None,
+                 slo_window_s: Optional[float] = None,
+                 slo_window_ticks: int = 0):
         if weight_quant not in ("none", "int8", "fp8"):
             raise ValueError(f"weight_quant must be none|int8|fp8, got "
                              f"{weight_quant!r}")
@@ -406,6 +409,21 @@ class ServeEngine:
         # leaving an unbalanced span behind).
         self._tracer = trace_lib.get_default()
         self._rtrace: Dict[str, List] = {}
+        # --slo (obs/slo.py, ISSUE 16): the streaming SLO fold — pure
+        # host-side state the terminal funnel and the per-tick gauge
+        # block feed; windows close on wall time (slo_window_s) or
+        # engine ticks (slo_window_ticks, the deterministic mode) and
+        # emit slo_window/slo_breach records through the same sink.
+        # The compiled step is untouched: arming --slo adds ZERO
+        # compiled programs (the cost-model test asserts it).
+        self.slo: Optional[SloTracker] = None
+        if slo:
+            self.slo = SloTracker(
+                slo,
+                window_s=slo_window_s if slo_window_s else 1.0,
+                window_ticks=slo_window_ticks or 0,
+                emit=sink.write if sink is not None else None,
+                run_id=run_id)
 
     # ---------------------------------------------------------- intake
 
@@ -675,6 +693,11 @@ class ServeEngine:
             self.registry.gauge("serve.slots_live").set(live_slots)
             self.registry.gauge("serve.kv_bytes_live").set(kv_live)
             self.registry.gauge("serve.blocks_live").set(blocks_live)
+        if self.slo is not None:
+            self.slo.observe_tick(live_slots=live_slots,
+                                  num_slots=self.pool.num_slots,
+                                  blocks_live=blocks_live,
+                                  kv_bytes_live=kv_live)
         if tracer is not None:
             t_end = time.perf_counter()
             tracer.complete("harvest", t_dispatch_end,
@@ -730,6 +753,17 @@ class ServeEngine:
             error=digest)
         self.completions.append(comp)
         self.counts[status] += 1
+        if self.slo is not None and status != "handoff":
+            # A handoff continues elsewhere — the decode side owns its
+            # terminal; scoring it here would double-count the uid.
+            self.slo.observe_request(
+                status,
+                ttft_ms=None if comp.ttft_s is None
+                else comp.ttft_s * 1e3,
+                tpot_ms=None if comp.tpot_s is None
+                else comp.tpot_s * 1e3,
+                queue_wait_ms=None if comp.queue_wait_s is None
+                else comp.queue_wait_s * 1e3)
         self._trace_request(comp, slot_blocks=slot.n_mapped)
         self.pool.evict(idx)
         if self.sink is not None and status != "handoff":
@@ -755,6 +789,10 @@ class ServeEngine:
             status=status)
         self.completions.append(comp)
         self.counts[status] += 1
+        if self.slo is not None:
+            # Never admitted: no latencies to fold — still scored
+            # (bad unless drained) so overload shows up in the burn.
+            self.slo.observe_request(status)
         self._trace_request(comp)
         if self.sink is None:
             return
@@ -1145,6 +1183,19 @@ class ServeEngine:
             rec["tpot_ms"] = _pct_dict([c.tpot_s * 1e3 for c in ok])
             rec["queue_wait_ms"] = _pct_dict(
                 [c.queue_wait_s * 1e3 for c in ok])
+        if self.slo is not None:
+            # v14 (ISSUE 16): score the trailing partial window first,
+            # then embed the cumulative fold — spec, window/breach
+            # totals, worst burn, sketch percentiles (the ci_gate
+            # sketch-vs-exact check compares these against the exact
+            # ttft_ms/tpot_ms dicts above).
+            self.slo.flush()
+            rec["slo"] = self.slo.summary()
         if self.run_id:
             rec["run_id"] = self.run_id
         return rec
+
+    def slo_sketch(self) -> Optional[Dict[str, Any]]:
+        """Compact serialized cumulative SLO sketches for a replica
+        heartbeat (``replica_state.slo_sketch``); None without --slo."""
+        return None if self.slo is None else self.slo.sketch_state()

@@ -46,6 +46,18 @@ required), ``crash_storm`` (``--crash-replicas`` k die at
 stall detector rescues its requests).  The run exits 0 only when the
 scenario verdict is "pass".
 
+SLO plane (ISSUE 16; README "SLO monitoring"): ``--slo
+'ttft_ms=250,tpot_ms=40,availability=0.999'`` makes the router score
+every fleet-terminal event against the targets, emit one schema-v14
+``slo_window`` record per ``--slo-window`` terminals (an
+``slo_breach`` when a window's error-budget burn rate exceeds 1.0)
+and periodic ``fleet_rollup`` records merging the replicas'
+heartbeat latency sketches (fleet-wide p50/p90/p99 + per-replica
+skew/straggler), and fold an ``slo_verdict`` into ``fleet_summary``
+— a chaos scenario whose windows burn past budget FAILS even when
+nothing was lost.  ``tools/slo_report.py`` renders the stream;
+``tools/ci_gate.py --slo-stream`` checks it.
+
 Disaggregated fleets (ISSUE 15): ``--decode-replicas K`` runs the
 last K replicas as ``--role decode`` workers off one shared leased
 KV-handoff spool (never routed prompts; their outboxes report the
@@ -165,6 +177,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--availability-min", type=float, default=1.0,
                    help="fleet availability the verdict requires "
                         "(default 1.0)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="arm the fleet SLO plane (ISSUE 16): e.g. "
+                        "'ttft_ms=250,tpot_ms=40,availability=0.999'. "
+                        "The router scores every fleet-terminal event "
+                        "good/bad, emits one schema-v14 slo_window "
+                        "record per --slo-window terminals (slo_breach "
+                        "past burn 1.0) plus periodic fleet_rollup "
+                        "records merged from replica heartbeat "
+                        "sketches, and the scenario verdict fails when "
+                        "any window breaches its error budget")
+    p.add_argument("--slo-window", type=int, default=16, metavar="N",
+                   help="router SLO window size in fleet-terminal "
+                        "events (default 16; event-count windows keep "
+                        "chaos scores deterministic)")
+    p.add_argument("--slo-rollup-s", type=float, default=2.0,
+                   metavar="S",
+                   help="period of the router's fleet_rollup records "
+                        "(merged replica sketches; default 2)")
     p.add_argument("--workdir", default=None,
                    help="proc transport scratch dir (inbox/outbox/"
                         "metrics per replica; default: alongside "
@@ -211,6 +241,20 @@ def run_fleet(args):
     stall_after = args.stall_after
     if stall_after is None and args.scenario == "straggler":
         stall_after = 0.75
+    slo_spec = None
+    if args.slo:
+        if args.slo_window < 1:
+            raise SystemExit(f"--slo-window must be >= 1, got "
+                             f"{args.slo_window}")
+        if args.slo_rollup_s <= 0:
+            raise SystemExit(f"--slo-rollup-s must be > 0, got "
+                             f"{args.slo_rollup_s}")
+        # Validate the spec HERE (jax-free path load — obs/slo.py is
+        # stdlib self-contained) so a typo dies before replicas spawn.
+        try:
+            slo_spec = router_mod._load_slo().parse_slo(args.slo)
+        except ValueError as e:
+            raise SystemExit(f"--slo: {e}")
 
     def lohi(spec, name):
         parts = spec.split(":")
@@ -267,6 +311,11 @@ def run_fleet(args):
                 serve_args += ["--max-len", str(args.max_len)]
             if args.trace:
                 serve_args += ["--trace"]
+            if slo_spec is not None:
+                # Children score their own windows (wall-clock mode)
+                # and heartbeat cumulative sketches the router's
+                # fleet_rollup merges.
+                serve_args += ["--slo", args.slo]
             if roles[name] == "decode":
                 serve_args += ["--handoff-lease",
                                str(args.handoff_lease)]
@@ -309,11 +358,16 @@ def run_fleet(args):
 
         def factory():
             # Every replica's engine clones the same module config, so
-            # the jitted decode step is built ONCE and shared.
+            # the jitted decode step is built ONCE and shared.  With
+            # --slo the engine grows a tracker whose cumulative
+            # sketches surface through state() into the router's
+            # fleet_rollup (no sink here, so per-engine window records
+            # stay off — the ROUTER's stream carries the fleet ones).
             return ServeEngine(model, params, num_slots=args.slots,
                                max_len=max_len,
                                block_size=args.block_size,
-                               rng=jax.random.PRNGKey(args.seed))
+                               rng=jax.random.PRNGKey(args.seed),
+                               slo=slo_spec)
 
         def role_factories(name):
             # Disagg roles over one shared spool: a prefill engine
@@ -329,14 +383,16 @@ def run_fleet(args):
                                    block_size=args.block_size,
                                    rng=jax.random.PRNGKey(args.seed),
                                    role="prefill",
-                                   handoff_sink=tx.send)
+                                   handoff_sink=tx.send,
+                                   slo=slo_spec)
 
             def decode_engine():
                 return ServeEngine(model, params, num_slots=args.slots,
                                    max_len=max_len,
                                    block_size=args.block_size,
                                    rng=jax.random.PRNGKey(args.seed),
-                                   role="decode")
+                                   role="decode",
+                                   slo=slo_spec)
 
             def decode_transport():
                 return FileTransport(spool, worker=name,
@@ -396,6 +452,8 @@ def run_fleet(args):
         # redelivery always gets first go at a dead worker's claims.
         spool_timeout_s=max(4.0 * args.handoff_lease, 5.0)
         if n_decode else None,
+        slo=slo_spec, slo_window=args.slo_window,
+        slo_rollup_s=args.slo_rollup_s,
         trace=args.trace)
     print(f"fleet: {args.replicas} x {args.transport} replica(s)  "
           f"policy={args.policy}  scenario={args.scenario}  "
@@ -442,6 +500,13 @@ def run_fleet(args):
           f"skew={summary['routing']['balance_skew']}"
           + (f"  verdict={summary['verdict']}"
              if "verdict" in summary else ""))
+    if "slo_verdict" in summary:
+        print(f"slo: verdict={summary['slo_verdict']}  "
+              f"windows={summary['slo_windows']}  "
+              f"breaches={summary['slo_breaches']}  "
+              f"worst_burn={round(summary['slo_worst_burn'], 3)}"
+              + (f"  worst_window={summary['slo_worst_window']}"
+                 if "slo_worst_window" in summary else ""))
     rc = 0 if summary.get("verdict") == "pass" else 1
     return summary, rc
 

@@ -288,6 +288,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "cost_model records (per-tick decode flops/HBM "
                         "bytes + roofline verdict; obs/costmodel.py — "
                         "the decode program still compiles exactly once)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="with --metrics-jsonl: arm the streaming SLO "
+                        "plane (ISSUE 16) — a comma list like "
+                        "'ttft_ms=250,tpot_ms=40,availability=0.999'. "
+                        "Terminal requests are scored good/bad against "
+                        "the latency targets, folded into online "
+                        "quantile sketches and tumbling windows, and "
+                        "each window emits a schema-v14 slo_window "
+                        "record (p50/p90/p99, counts, error-budget "
+                        "burn rate) plus an slo_breach record when the "
+                        "burn rate exceeds 1.0; serve_summary carries "
+                        "the cumulative verdict (README 'SLO "
+                        "monitoring').  Host-only: the compiled decode "
+                        "step is untouched")
+    p.add_argument("--slo-window-s", type=float, default=None,
+                   metavar="S",
+                   help="tumbling SLO window length in wall-clock "
+                        "seconds (default 1.0); windows with no "
+                        "terminal events are skipped, not emitted")
+    p.add_argument("--slo-window-ticks", type=int, default=0,
+                   metavar="N",
+                   help="close SLO windows every N engine ticks "
+                        "instead of on wall-clock — the deterministic "
+                        "mode tests and recorded fixtures use "
+                        "(0 = wall-clock windows)")
     p.add_argument("--inject-fault", default="", metavar="KIND@TICK",
                    help="deterministic serve-path fault drill at a "
                         "1-based engine tick: crash | sigterm | hang | "
@@ -373,7 +398,11 @@ class _Outbox:
             ev = {"uid": c.request.uid, "status": c.status,
                   "finish_reason": c.finish_reason,
                   "tokens": [int(t) for t in c.tokens],
-                  "tick": c.finished_step}
+                  "tick": c.finished_step,
+                  "ttft_ms": None if c.ttft_s is None
+                  else c.ttft_s * 1e3,
+                  "tpot_ms": None if c.tpot_s is None
+                  else c.tpot_s * 1e3}
             if c.request.uid in redelivered:
                 ev["redelivered"] = True
             self._fh.write(json.dumps(ev, separators=(",", ":")) + "\n")
@@ -501,6 +530,23 @@ def run_serve(args):
     if args.trace and not args.metrics_jsonl:
         raise SystemExit("--trace requires --metrics-jsonl (the "
                          "trace_event records ride the metrics stream)")
+    slo_spec = None
+    if args.slo:
+        if not args.metrics_jsonl:
+            raise SystemExit("--slo requires --metrics-jsonl (the "
+                             "slo_window/slo_breach records ride the "
+                             "metrics stream)")
+        from apex_example_tpu.obs.slo import parse_slo
+        try:
+            slo_spec = parse_slo(args.slo)
+        except ValueError as e:
+            raise SystemExit(f"--slo: {e}")
+    if args.slo_window_s is not None and args.slo_window_s <= 0:
+        raise SystemExit(f"--slo-window-s must be > 0, got "
+                         f"{args.slo_window_s}")
+    if args.slo_window_ticks < 0:
+        raise SystemExit(f"--slo-window-ticks must be >= 0, got "
+                         f"{args.slo_window_ticks}")
     replica_mode = bool(args.inbox or args.outbox)
     if args.role == "decode":
         # A decode worker's intake is the --handoff-dir spool, never an
@@ -675,7 +721,10 @@ def run_serve(args):
                              weight_quant=args.weight_quant,
                              role=args.role,
                              handoff_sink=transport.send
-                             if args.role == "prefill" else None)
+                             if args.role == "prefill" else None,
+                             slo=slo_spec,
+                             slo_window_s=args.slo_window_s,
+                             slo_window_ticks=args.slo_window_ticks)
         outbox = feeder_stop = on_tick = None
         idle_wait_s = 0.0
         if replica_mode:
@@ -706,20 +755,37 @@ def run_serve(args):
                 # count when replicas mix precisions.  v13: the role
                 # rides along so fleet tooling can tell a prefill
                 # heartbeat from a decode one.
-                sink.write({"record": "replica_state", "time": time.time(),
-                            "replica": args.replica_id, "state": state,
-                            "role": args.role,
-                            "tick": engine.step_count,
-                            "pending": engine.queue.pending(),
-                            "blocks_live": engine.pool.blocks_live(),
-                            "kv_bytes_live": engine.pool.kv_bytes_live(),
-                            "pid": os.getpid(), "run_id": run_id})
+                rec = {"record": "replica_state", "time": time.time(),
+                       "replica": args.replica_id, "state": state,
+                       "role": args.role,
+                       "tick": engine.step_count,
+                       "pending": engine.queue.pending(),
+                       "blocks_live": engine.pool.blocks_live(),
+                       "kv_bytes_live": engine.pool.kv_bytes_live(),
+                       "pid": os.getpid(), "run_id": run_id}
+                # v14: with --slo the cumulative latency sketches ride
+                # the heartbeat — the fleet router merges them into
+                # fleet_rollup records (live cross-replica percentiles
+                # without re-pooling raw samples).
+                sk = engine.slo_sketch()
+                if sk is not None:
+                    rec["slo_sketch"] = sk
+                sink.write(rec)
 
             last_beat = [0.0]
 
             def on_tick(eng) -> None:
-                outbox.flush_from(eng)
+                # With --slo, heartbeat BEFORE flushing new terminals:
+                # the sketches already cover them (folded at slot
+                # eviction), so the router can never tail the last
+                # terminal without the matching sketch on disk — the
+                # close-time fleet_rollup cannot race the child's exit.
                 now = time.time()
+                if (eng.slo is not None
+                        and len(eng.completions) > outbox._consumed):
+                    last_beat[0] = now
+                    _beat("serving")
+                outbox.flush_from(eng)
                 if now - last_beat[0] >= args.heartbeat_s:
                     last_beat[0] = now
                     _beat("serving")
@@ -796,6 +862,11 @@ def run_serve(args):
             # on disk before the summary: the restart-skip set and the
             # router's completion feed both read from here.
             outbox.flush_from(engine)
+            # One last heartbeat AFTER the final terminals: the
+            # cumulative SLO sketches and closing gauges land on disk
+            # even when the run is shorter than the heartbeat cadence,
+            # so the router's close-time fleet_rollup sees real data.
+            _beat("serving")
         summary = engine.summary_record()
         if transport is not None and transport.quarantined:
             summary["handoff_quarantined"] = transport.quarantined

@@ -140,6 +140,11 @@ def test_jax_free_contract_covers_the_retired_runtime_guard_set():
                      "tools/supervise.py", "tools/cost_report.py",
                      "tools/ci_gate.py", "tools/trace_export.py",
                      "tools/trace_top.py",
+                     # ISSUE 16: the SLO sketches must merge and report
+                     # on hosts that only have the JSONL (slo.py is
+                     # loaded by file path by the router and fleet.py).
+                     "tools/slo_report.py",
+                     "apex_example_tpu/obs/slo.py",
                      "apex_example_tpu/resilience/supervisor.py",
                      "apex_example_tpu/obs/schema.py",
                      # ISSUE 12: the fleet stratum carries the same
@@ -400,6 +405,50 @@ def emit(sink, ts):
     assert any("unknown record type 'span_event'" in m for m in msgs)
     assert any("never sets required field 'name'" in m for m in msgs)
     assert any("never sets required field 'ts'" in m for m in msgs)
+
+
+def test_schema_emission_picks_up_v14_slo_tables():
+    """ISSUE 16: the streaming-SLO record types reach the AST rule —
+    a well-formed emitter of each new type stays quiet, and an
+    undeclared field on ANY of the three fires statically (a new field
+    can never ship without a schema bump, pinned per record type)."""
+    with open(os.path.join(REPO, "apex_example_tpu", "obs",
+                           "schema.py")) as fh:
+        real_schema = fh.read()
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": real_schema,
+        "pkg/emit.py": """
+def emit(sink, t):
+    sink.write({"record": "slo_window", "time": t, "window": 0,
+                "requests": 16, "good": 15, "bad": 1,
+                "burn_rate": 0.5})
+    sink.write({"record": "slo_breach", "time": t, "window": 1,
+                "burn_rate": 2.0, "requests": 16, "bad": 4})
+    sink.write({"record": "fleet_rollup", "time": t, "replicas": 2,
+                "count": 32})
+"""})
+    assert schema_rules.check(tree) == []       # valid emitters: quiet
+    for rectype, literal in (
+            ("slo_window", '{"record": "slo_window", "time": t, '
+                           '"window": 0, "requests": 1, "good": 1, '
+                           '"bad": 0, "burn_rate": 0.0}'),
+            ("slo_breach", '{"record": "slo_breach", "time": t, '
+                           '"window": 0, "burn_rate": 2.0, '
+                           '"requests": 1, "bad": 1}'),
+            ("fleet_rollup", '{"record": "fleet_rollup", "time": t, '
+                             '"replicas": 1, "count": 1}')):
+        tree = tree_from_sources({
+            "apex_example_tpu/obs/schema.py": real_schema,
+            "pkg/emit.py": f"""
+def emit(sink, t):
+    rec = {literal}
+    rec["undeclared_{rectype}"] = 1
+    sink.write(rec)
+"""})
+        msgs = [f.message for f in schema_rules.check(tree)]
+        assert any(f"'{rectype}' emits field 'undeclared_{rectype}'"
+                   in m and "bump the schema" in m for m in msgs), \
+            (rectype, msgs)
 
 
 def test_schema_emission_dynamic_builders_skip_missing_check_only():

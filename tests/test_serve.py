@@ -462,7 +462,14 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
 
     --trace rides along (ISSUE 11): tracing is host-only, so the ONE
     compile_event is also the proof that arming the tracer adds ZERO
-    compiled programs — the decode step is untouched."""
+    compiled programs — the decode step is untouched.
+
+    --slo rides along too (ISSUE 16): the streaming SLO plane is the
+    same kind of host-only fold, so the ONE compile_event doubles as
+    its zero-new-programs proof — and the summary's ONLINE sketch
+    percentiles are checked against the EXACT percentiles recomputed
+    from the raw request_complete records (the declared relative-error
+    bound, asserted on the tier-1 smoke)."""
     from apex_example_tpu.obs import costmodel
     from apex_example_tpu.obs import trace as trace_lib
     model, params = model_and_params
@@ -481,7 +488,10 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
         eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
                           rng=jax.random.PRNGKey(0), sink=sink,
                           run_id=emitter.run_id,
-                          registry=emitter.registry)
+                          registry=emitter.registry,
+                          slo={"ttft_ms": 60_000.0, "tpot_ms": 60_000.0,
+                               "availability": 0.5},
+                          slo_window_ticks=8)
         eng.queue.submit_all(reqs)
         eng.queue.close()
         comps = eng.run(max_steps=2000)
@@ -534,6 +544,29 @@ def test_cost_model_decode_compiles_once_and_kv_gauges(
     assert snap["serve.slots_live"] == 0
     assert snap["serve.kv_bytes_live"] == 0
     assert snap["serve.blocks_live"] == 0
+    # v14 SLO plane: every terminal landed in some tumbling window
+    # (the trailing partial closes at summary time), the generous spec
+    # passes, and the online sketch is honest — each percentile within
+    # the declared relative-error bound alpha of the exact nearest-rank
+    # percentile over the raw per-request records (same rank
+    # convention; +0.01 ms absolute slack for the records' 3-decimal
+    # rounding).
+    slo_windows = [r for r in records if r["record"] == "slo_window"]
+    assert slo_windows and all(w["requests"] >= 1 for w in slo_windows)
+    assert sum(w["requests"] for w in slo_windows) == 6
+    slo = summary["slo"]
+    assert slo["verdict"] == "pass" and slo["breaches"] == 0
+    assert slo["good"] == 6 and slo["bad"] == 0
+    assert slo["windows"] == len(slo_windows)
+    assert not any(r["record"] == "slo_breach" for r in records)
+    exact = sorted(r["ttft_ms"] for r in records
+                   if r["record"] == "request_complete")
+    sk = slo["ttft_ms"]
+    assert sk["count"] == len(exact) == 6
+    for q in (50, 90, 99):
+        rank = min(max(-(-q * len(exact) // 100), 1), len(exact))
+        ex = exact[rank - 1]
+        assert abs(sk[f"p{q}"] - ex) <= slo["alpha"] * ex + 0.01, q
 
 
 # ==================== serving resilience (ISSUE 5) ====================

@@ -1,0 +1,730 @@
+"""Streaming SLO plane (obs/slo.py, schema v14; ISSUE 16):
+
+- sketch correctness: the DDSketch-style log-bucket sketch's declared
+  relative-error bound holds against exact numpy percentiles across
+  magnitudes and alphas; merging is associative, commutative, and
+  equals the pooled sketch bit-for-bit; the JSON-serialized form
+  round-trips,
+- spec parsing + burn-rate scoring edges (drained outside the
+  denominator, missing spec'd latency counts bad, empty windows burn
+  nothing, trailing partials included),
+- SloTracker windows on a fake clock: tick and wall modes, breach
+  emission, empty windows skipped, every emitted record schema-valid,
+- Histogram.merge regression vs pooled ground truth (exact while the
+  pooled trail fits the bound) and the LogBucketHistogram face,
+- router SLO on no-jax FakeReplicas: windows/breaches on the stream,
+  spec announced in the header, summary verdict PURE (two calls
+  agree and match the emitted records), fleet_rollup sketch merges
+  with conserved counts + straggler detection,
+- chaos verdicts on the in-process thread fleet (the session's
+  SLOTS=4/MAX_LEN=32 compiled program, zero new compiles): an
+  unsatisfiable spec fails the scenario with the breached window
+  identified, a lax spec passes, both bit-reproducible on double-run,
+- ci_gate --slo-stream + slo_report + the telemetry_report SLO line
+  over the checked-in recorded fixtures (tests/fixtures/slo/),
+  tamper and torn-tail cases included.
+"""
+
+import importlib.util
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import obs
+from apex_example_tpu.fleet import (FleetRouter, ThreadReplica,
+                                    run_scenario, synthetic_specs)
+from apex_example_tpu.models.gpt import gpt_tiny
+from apex_example_tpu.obs import schema as obs_schema
+from apex_example_tpu.obs import slo
+from apex_example_tpu.serve import Request, ServeEngine
+
+pytestmark = pytest.mark.slo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_FIXTURE = os.path.join(REPO, "tests", "fixtures", "slo",
+                             "serve_slo.jsonl")
+FLEET_FIXTURE = os.path.join(REPO, "tests", "fixtures", "slo",
+                             "fleet_slo.jsonl")
+SLOTS, MAX_LEN = 4, 32          # the session-shared decode geometry
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _exact_pct(sorted_vals, q):
+    """Nearest-rank ground truth, same rank convention as the sketch
+    (and tools/metrics_lint.pct): the ceil(q/100 * n)-th value."""
+    rank = min(max(math.ceil(q / 100.0 * len(sorted_vals)), 1),
+               len(sorted_vals))
+    return float(sorted_vals[rank - 1])
+
+
+# ======================================================= sketch math
+
+def _samples():
+    rng = np.random.default_rng(0)
+    return np.concatenate([
+        rng.lognormal(mean=0.0, sigma=2.0, size=400),   # spans decades
+        rng.uniform(0.001, 5.0, size=200),
+        rng.uniform(100.0, 1e6, size=200)])
+
+
+def test_sketch_relative_error_bound_across_magnitudes_and_alphas():
+    """The sketch's one promise: every percentile estimate within
+    relative error alpha of the exact sample percentile — checked
+    against numpy ground truth over samples spanning nine decades, at
+    both the default and a coarse alpha."""
+    vals = _samples()
+    srt = np.sort(vals)
+    for alpha in (slo.DEFAULT_ALPHA, 0.05):
+        sk = slo.sketch_new(alpha)
+        for v in vals:
+            slo.sketch_add(sk, float(v))
+        assert sk["count"] == len(vals)
+        for q in (1, 10, 25, 50, 75, 90, 95, 99, 99.9):
+            ex = _exact_pct(srt, q)
+            est = slo.sketch_percentile(sk, q)
+            # the bucket-midpoint estimate attains the bound at bucket
+            # boundaries; the 1e-9 term is float slack only
+            assert abs(est - ex) <= alpha * ex + 1e-9 * ex, (alpha, q)
+    # min/max are tracked exactly, not bucket-estimated
+    assert sk["min"] == float(srt[0]) and sk["max"] == float(srt[-1])
+
+
+def test_sketch_merge_equals_pooled_and_is_assoc_commutative():
+    vals = _samples()
+    a, b, c = np.array_split(vals, 3)
+
+    def fold(part):
+        sk = slo.sketch_new()
+        for v in part:
+            slo.sketch_add(sk, float(v))
+        return sk
+
+    sa, sb, sc = fold(a), fold(b), fold(c)
+    pooled = fold(vals)
+    left = slo.sketch_merge(slo.sketch_merge(sa, sb), sc)
+    right = slo.sketch_merge(sa, slo.sketch_merge(sb, sc))
+    assert left == right == pooled              # associative, == pooled
+    assert slo.sketch_merge(sa, sb) == slo.sketch_merge(sb, sa)
+    # merge is out-of-place: the inputs are untouched
+    assert sa["count"] == len(a) and sb["count"] == len(b)
+    # alphas must match — silently inheriting the looser bound is the
+    # failure mode this guards
+    with pytest.raises(ValueError, match="alpha"):
+        slo.sketch_merge(sa, slo.sketch_new(0.05))
+
+
+def test_sketch_serde_roundtrip_is_lossless():
+    sk = slo.sketch_new()
+    for v in (0.5, 3.0, 3.0, 250.0, 9e5):
+        slo.sketch_add(sk, v)
+    back = json.loads(json.dumps(sk))
+    assert back == sk                       # JSON-native: keys already str
+    for q in (50, 90, 99):
+        assert slo.sketch_percentile(back, q) == \
+            slo.sketch_percentile(sk, q)
+    # and a deserialized sketch merges like a live one
+    merged = slo.sketch_merge(back, sk)
+    assert merged["count"] == 2 * sk["count"]
+
+
+def test_sketch_edge_cases():
+    sk = slo.sketch_new()
+    assert slo.sketch_percentile(sk, 50) == 0.0     # empty -> 0.0
+    assert slo.sketch_summary(sk)["count"] == 0
+    slo.sketch_add(sk, 42.0)
+    for q in (0, 50, 100):                          # one sample: all ranks
+        assert abs(slo.sketch_percentile(sk, q) - 42.0) \
+            <= slo.DEFAULT_ALPHA * 42.0
+    # zeros and negatives share the zero bucket, estimated 0.0
+    zk = slo.sketch_new()
+    slo.sketch_add(zk, 0.0)
+    slo.sketch_add(zk, -3.0)
+    slo.sketch_add(zk, 10.0)
+    assert zk["zero"] == 2 and zk["min"] == -3.0
+    assert slo.sketch_percentile(zk, 50) == 0.0
+    assert slo.sketch_percentile(zk, 99) > 0.0
+    with pytest.raises(ValueError, match="alpha"):
+        slo.sketch_new(1.0)
+    # counted adds (n>1) weight the bucket, not just the value
+    nk = slo.sketch_new()
+    slo.sketch_add(nk, 5.0, n=10)
+    assert nk["count"] == 10
+
+
+# ================================================= spec + burn scoring
+
+def test_parse_slo_specs_and_errors():
+    spec = slo.parse_slo("ttft_ms=500,tpot_ms=50,availability=0.99")
+    assert spec == {"ttft_ms": 500.0, "tpot_ms": 50.0,
+                    "availability": 0.99}
+    # availability defaults to three nines; single-target specs are fine
+    assert slo.parse_slo("tpot_ms=40") == {
+        "ttft_ms": None, "tpot_ms": 40.0,
+        "availability": slo.DEFAULT_AVAILABILITY}
+    for bad in ("", "ttft_ms", "p50=3", "ttft_ms=abc",
+                "ttft_ms=500,ttft_ms=300", "ttft_ms=0",
+                "availability=0.9",             # no latency target
+                "ttft_ms=5,availability=1.0",   # zero error budget
+                "ttft_ms=5,availability=0"):
+        with pytest.raises(ValueError):
+            slo.parse_slo(bad)
+
+
+def test_score_event_and_burn_rate():
+    spec = slo.parse_slo("ttft_ms=100,tpot_ms=10")
+    assert slo.score_event(spec, "ok", ttft_ms=50.0, tpot_ms=5.0) is True
+    assert slo.score_event(spec, "ok", ttft_ms=150.0, tpot_ms=5.0) is False
+    assert slo.score_event(spec, "ok", ttft_ms=50.0, tpot_ms=15.0) is False
+    # an ok completion MISSING a spec'd latency is bad, not good — an
+    # unmeasured target is not a met one
+    assert slo.score_event(spec, "ok", ttft_ms=None, tpot_ms=5.0) is False
+    assert slo.score_event(spec, "failed") is False
+    assert slo.score_event(spec, "timeout") is False
+    # drained leaves the denominator (requeued elsewhere)
+    assert slo.score_event(spec, "drained") is None
+    # a spec with no ttft target doesn't judge ttft
+    tp_only = slo.parse_slo("tpot_ms=10")
+    assert slo.score_event(tp_only, "ok", ttft_ms=None,
+                           tpot_ms=5.0) is True
+
+    assert slo.burn_rate(0, 0, 0.999) == 0.0        # empty burns nothing
+    assert slo.burn_rate(99, 1, 0.99) == pytest.approx(1.0)
+    assert slo.burn_rate(98, 2, 0.99) == pytest.approx(2.0)
+    assert slo.burn_rate(10, 0, 0.99) == 0.0
+
+
+def test_score_windows_and_worst_window():
+    scored = [True] * 4 + [False] * 4 + [True, None, True]
+    wins = slo.score_windows(scored, 4, availability=0.9)
+    assert [w["requests"] for w in wins] == [4, 4, 3]   # trailing partial
+    assert [w["good"] for w in wins] == [4, 0, 2]
+    assert [w["bad"] for w in wins] == [0, 4, 0]        # None not counted
+    assert wins[1]["burn_rate"] == pytest.approx(10.0)
+    idx, burn = slo.worst_window(wins)
+    assert idx == 1 and burn == pytest.approx(10.0)
+    assert slo.worst_window([]) == (None, 0.0)
+    # ties go to the FIRST window (stable across re-scoring)
+    tie = slo.score_windows([False, False], 1, availability=0.9)
+    assert slo.worst_window(tie)[0] == 0
+
+
+# ============================================================ tracker
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_tracker_tick_windows_breach_and_schema():
+    clock, emitted = FakeClock(), []
+    tr = slo.SloTracker({"ttft_ms": 100.0, "availability": 0.9},
+                        window_ticks=2, emit=emitted.append,
+                        run_id="t1", clock=clock)
+    tr.observe_request("ok", ttft_ms=50.0, tpot_ms=5.0,
+                       queue_wait_ms=1.0)
+    tr.observe_request("ok", ttft_ms=500.0, tpot_ms=5.0)   # over target
+    tr.observe_tick(live_slots=2, num_slots=4)
+    assert emitted == []                    # window closes at tick 2
+    tr.observe_tick(live_slots=4, num_slots=4, blocks_live=3,
+                    kv_bytes_live=4096)
+    kinds = [r["record"] for r in emitted]
+    assert kinds == ["slo_window", "slo_breach"]
+    w, b = emitted
+    assert w["window"] == 0 and w["requests"] == 2
+    assert w["good"] == 1 and w["bad"] == 1
+    assert w["burn_rate"] == pytest.approx(5.0)     # 0.5 bad / 0.1 budget
+    assert w["counts"] == {"ok": 2}
+    assert w["ticks"] == 2 and w["occupancy"] == pytest.approx(0.75)
+    assert w["blocks_live"] == 3 and w["kv_bytes_live"] == 4096
+    assert w["ttft_ms"]["count"] == 2 and w["queue_wait_ms"]["count"] == 1
+    assert b["window"] == 0 and b["burn_rate"] == w["burn_rate"]
+    assert b["budget"] == pytest.approx(0.1)
+    for rec in emitted:                     # every emission schema-valid
+        assert obs_schema.validate_record(rec) == [], rec
+    # empty windows are skipped, not emitted
+    tr.observe_tick()
+    tr.observe_tick()
+    assert len(emitted) == 2
+    # flush closes the trailing partial exactly once (idempotent)
+    tr.observe_request("drained")           # outside the denominator
+    tr.flush()
+    tr.flush()
+    assert [r["record"] for r in emitted] == \
+        ["slo_window", "slo_breach", "slo_window"]
+    assert emitted[-1]["requests"] == 1 and emitted[-1]["bad"] == 0
+    assert emitted[-1]["burn_rate"] == 0.0
+    s = tr.summary()
+    assert s["verdict"] == "fail" and s["breaches"] == 1
+    assert s["windows"] == 2                # matches the emitted records
+    assert s["worst_window"] == 0 and s["worst_burn"] == \
+        pytest.approx(5.0)
+    assert s["good"] == 1 and s["bad"] == 1
+    assert obs_schema.validate_record(
+        {"record": "serve_summary", "time": 0.0, "requests": 2,
+         "output_tokens": 4, "tokens_per_sec": 1.0, "slo": s}) == []
+
+
+def test_tracker_wall_windows_roll_on_the_clock():
+    clock, emitted = FakeClock(), []
+    tr = slo.SloTracker("ttft_ms=100", window_s=1.0,
+                        emit=emitted.append, clock=clock)
+    tr.observe_request("ok", ttft_ms=10.0)
+    assert emitted == []                    # deadline not reached
+    clock.t += 1.5
+    tr.observe_tick()                       # ticks roll wall windows too
+    assert len(emitted) == 1 and emitted[0]["requests"] == 1
+    tr.observe_request("ok", ttft_ms=20.0)
+    clock.t += 1.5
+    tr.observe_request("ok", ttft_ms=30.0)  # folds, THEN rolls: both land
+    assert len(emitted) == 2 and emitted[1]["requests"] == 2
+
+
+# ============================================ metrics faces (satellite)
+
+def test_histogram_merge_matches_pooled_ground_truth():
+    a, b, pooled = (obs.Histogram("t") for _ in range(3))
+    # integer-valued floats: sums stay exact regardless of fold order,
+    # so merged-vs-pooled equality is bitwise, not approximate
+    rng = np.random.default_rng(1)
+    xs = [float(v) for v in rng.integers(1, 1000, 90)]
+    ys = [float(v) for v in rng.integers(500, 5000, 60)]
+    for v in xs:
+        a.observe(v)
+        pooled.observe(v)
+    for v in ys:
+        b.observe(v)
+        pooled.observe(v)
+    a.merge(b)
+    # while the pooled trail fits max_samples the merge is EXACT: the
+    # ground truth fleet_report re-pools raw trails for
+    assert a.count == pooled.count == 150
+    assert a.sum == pooled.sum
+    assert a.min == pooled.min and a.max == pooled.max
+    for q in (50, 90, 95, 99):
+        assert a.percentile(q) == pooled.percentile(q)
+    assert a.summary() == pooled.summary()
+    # merging an empty histogram is the identity
+    before = a.summary()
+    a.merge(obs.Histogram("empty"))
+    assert a.summary() == before
+    # past the bound the subsample keeps count/sum/min/max exact
+    small = obs.Histogram("s", max_samples=16)
+    other = obs.Histogram("s", max_samples=16)
+    for v in xs:
+        small.observe(v)
+    for v in ys:
+        other.observe(v)
+    small.merge(other)
+    assert small.count == 150 and small.sum == pooled.sum
+    assert len(small._samples) == 16
+    assert small.min == pooled.min and small.max == pooled.max
+
+
+def test_log_bucket_histogram_face_and_serde():
+    h = obs.LogBucketHistogram("ttft_ms")
+    vals = [3.0, 7.0, 7.0, 120.0, 4000.0]
+    for v in vals:
+        h.observe(v)
+    assert h.count == 5 and h.alpha == slo.DEFAULT_ALPHA
+    srt = sorted(vals)
+    for q in (50, 99):
+        ex = _exact_pct(srt, q)
+        assert abs(h.percentile(q) - ex) <= h.alpha * ex
+    assert h.summary()["count"] == 5
+    # serde round-trips through the SAME dict form replica heartbeats
+    # carry, and a serialized dict merges directly
+    d = h.to_dict()
+    assert obs.LogBucketHistogram.from_dict(d).summary() == h.summary()
+    h2 = obs.LogBucketHistogram("ttft_ms")
+    h2.observe(9.0)
+    h2.merge(d)
+    assert h2.count == 6
+    with pytest.raises(ValueError, match="alpha"):
+        h2.merge(obs.LogBucketHistogram("other", alpha=0.05))
+
+
+# ================================================ router SLO (no jax)
+
+class FakeReplica:
+    """The replica contract, scripted (the test_fleet pattern): no
+    engine, no thread, no jax — sub-second router tests."""
+
+    def __init__(self, name, pending=0):
+        self.name = name
+        self.specs = []
+        self.events = []
+        self._state = {"state": "healthy", "pending": pending,
+                       "blocks_live": 0, "progress_age_s": 0.0,
+                       "pid": None, "restarts": 0}
+
+    def submit(self, spec):
+        self.specs.append(spec)
+        return True
+
+    def poll(self):
+        out, self.events = self.events, []
+        return out
+
+    def state(self):
+        return dict(self._state, name=self.name)
+
+    def set_state(self, **kw):
+        self._state.update(kw)
+
+    def report(self, uid, status, **kw):
+        self.events.append(dict({"uid": uid, "status": status,
+                                 "replica": self.name}, **kw))
+
+    def start(self):
+        return self
+
+    def stop(self, *a, **k):
+        pass
+
+
+class ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+    def close(self):
+        pass
+
+
+def _spec(uid):
+    return {"uid": uid, "prompt": [1, 2, 3], "max_new_tokens": 4}
+
+
+def test_router_slo_windows_breaches_and_pure_summary():
+    reps = [FakeReplica("a"), FakeReplica("b")]
+    sink = ListSink()
+    router = FleetRouter(reps, policy="round_robin", sink=sink, log=None,
+                         slo={"ttft_ms": 100.0, "availability": 0.9},
+                         slo_window=4)
+    header = sink.records[0]
+    assert header["record"] == "run_header"
+    assert header["config"]["slo"]["ttft_ms"] == 100.0
+    assert header["config"]["slo_window"] == 4
+    for i in range(8):
+        router.submit(_spec(f"u{i}"))
+    # each replica holds 4 uids; 2 fast + 2 slow each -> every window
+    # (events absorb replica-by-replica) is 2 good / 2 bad
+    for rep in reps:
+        for j, s in enumerate(rep.specs):
+            ttft = 50.0 if j < 2 else 500.0
+            rep.report(s["uid"], "ok", tokens=[7], ttft_ms=ttft,
+                       tpot_ms=5.0)
+    router.poll()
+    assert router.done()
+    # summary is PURE: two calls agree bit-for-bit
+    s1 = router.summary_record()
+    s2 = router.summary_record()
+    slo_keys = ("slo_verdict", "slo_windows", "slo_breaches",
+                "slo_worst_burn", "slo_worst_window")
+    assert {k: s1.get(k) for k in slo_keys} == \
+        {k: s2.get(k) for k in slo_keys}
+    summary = router.close()
+    windows = [r for r in sink.records if r["record"] == "slo_window"]
+    breaches = [r for r in sink.records if r["record"] == "slo_breach"]
+    assert len(windows) == 2 and len(breaches) == 2
+    for w in windows:
+        assert w["requests"] == 4 and w["good"] == 2 and w["bad"] == 2
+        assert w["burn_rate"] == pytest.approx(5.0)    # 0.5 / 0.1
+        assert w["ttft_ms"]["count"] == 4
+    assert summary["slo_verdict"] == "fail"
+    assert summary["slo_windows"] == 2
+    assert summary["slo_breaches"] == 2
+    assert summary["slo_worst_burn"] == pytest.approx(5.0)
+    assert summary["slo_worst_window"] == 0            # first on ties
+    assert obs_schema.validate_stream(sink.records) == []
+
+
+def test_router_slo_unarmed_stream_is_byte_identical_to_v13_shape():
+    """No --slo: no slo_* summary fields, no slo_window records, no
+    spec in the header — the plane is pay-for-what-you-arm."""
+    reps = [FakeReplica("a")]
+    sink = ListSink()
+    router = FleetRouter(reps, sink=sink, log=None)
+    router.submit(_spec("u0"))
+    reps[0].report("u0", "ok", tokens=[1], ttft_ms=10.0, tpot_ms=1.0)
+    router.poll()
+    summary = router.close()
+    assert "slo" not in sink.records[0]["config"]
+    assert not any(r["record"].startswith("slo_")
+                   or r["record"] == "fleet_rollup"
+                   for r in sink.records)
+    assert not any(k.startswith("slo_") for k in summary)
+
+
+def test_router_fleet_rollup_merges_sketches_and_names_straggler():
+    mod = slo                       # same math the router path-loads
+    fast = mod.sketch_new()
+    for _ in range(20):
+        mod.sketch_add(fast, 10.0)
+    slow = mod.sketch_new()
+    for _ in range(10):
+        mod.sketch_add(slow, 100.0)
+    reps = [FakeReplica("r0"), FakeReplica("r1"), FakeReplica("r2")]
+    reps[0].set_state(slo_sketch={"ttft_ms": fast,
+                                  "tpot_ms": mod.sketch_new()})
+    reps[1].set_state(slo_sketch={"ttft_ms": json.loads(
+        json.dumps(fast)), "tpot_ms": mod.sketch_new()})
+    reps[2].set_state(slo_sketch={"ttft_ms": slow,
+                                  "tpot_ms": mod.sketch_new()})
+    sink = ListSink()
+    router = FleetRouter(reps, sink=sink, log=None,
+                         slo={"ttft_ms": 100.0}, slo_rollup_s=0.0)
+    router.poll()
+    rollups = [r for r in sink.records if r["record"] == "fleet_rollup"]
+    assert rollups
+    r = rollups[-1]
+    assert r["replicas"] == 3 and r["count"] == 50
+    # count conservation — what ci_gate --slo-stream re-checks
+    assert r["count"] == sum(v["count"]
+                             for v in r["per_replica"].values())
+    assert r["ttft_ms"]["count"] == 50
+    # merged p50 is the fast cohort's (40 of 50 samples at ~10ms)
+    assert abs(r["ttft_ms"]["p50"] - 10.0) <= mod.DEFAULT_ALPHA * 10.0
+    # r2's p50 is ~10x the fleet median: named straggler
+    assert r["straggler"] == "r2"
+    assert r["skew"] == pytest.approx(10.0, rel=0.05)
+    assert obs_schema.validate_record(r) == []
+    router.close()
+
+
+def test_router_rollup_skips_unmergeable_alpha_but_conserves_counts():
+    coarse = slo.sketch_new(0.05)
+    slo.sketch_add(coarse, 10.0)
+    fine = slo.sketch_new()
+    for _ in range(5):
+        slo.sketch_add(fine, 20.0)
+    reps = [FakeReplica("fine"), FakeReplica("coarse")]
+    reps[0].set_state(slo_sketch={"ttft_ms": fine})
+    reps[1].set_state(slo_sketch={"ttft_ms": coarse})
+    sink = ListSink()
+    router = FleetRouter(reps, sink=sink, log=None,
+                         slo={"ttft_ms": 100.0}, slo_rollup_s=0.0)
+    router.poll()
+    r = [x for x in sink.records if x["record"] == "fleet_rollup"][-1]
+    # the mismatched-alpha sketch is skipped, not silently merged into
+    # a looser bound — and the record's count stays conserved
+    assert r["replicas"] == 1 and r["count"] == 5
+    assert r["count"] == sum(v["count"]
+                             for v in r["per_replica"].values())
+    router.close()
+
+
+# =========================== chaos verdicts (shared compiled program)
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _slo_fleet_once(model, params, specs, slo_spec):
+    def factory():
+        return ServeEngine(model, params, num_slots=SLOTS,
+                           max_len=MAX_LEN,
+                           rng=jax.random.PRNGKey(0))
+
+    def make_request(spec):
+        return Request(prompt=spec["prompt"],
+                       max_new_tokens=int(spec["max_new_tokens"]),
+                       uid=spec["uid"])
+
+    replicas = [ThreadReplica(f"r{i}", factory, make_request)
+                for i in range(2)]
+    router = FleetRouter(replicas, log=None, slo=slo_spec, slo_window=4)
+    summary = run_scenario("none", router, replicas, specs,
+                           timeout_s=90)
+    for r in replicas:
+        r.stop(timeout_s=2.0)
+    return {k: summary.get(k) for k in
+            ("verdict", "completed", "lost", "slo_verdict",
+             "slo_windows", "slo_breaches", "slo_worst_burn",
+             "slo_worst_window")}
+
+
+def test_chaos_slo_verdicts_deterministic(model_and_params):
+    """Satellite 2 acceptance: an unsatisfiable SLO fails the scenario
+    (every request served fine — the SLO is what failed) with the
+    breached window identified; a lax SLO passes; both score dicts are
+    bit-reproducible on a double run.  All-good/all-bad specs make the
+    windows order-independent, so thread scheduling cannot perturb the
+    score."""
+    model, params = model_and_params
+    specs = synthetic_specs(10, vocab_size=model.vocab_size, seed=4,
+                            prompt_len=(3, 6), max_new=(3, 8))
+    tight = {"ttft_ms": 1e-4, "availability": 0.99}    # unsatisfiable
+    first = _slo_fleet_once(model, params, specs, tight)
+    assert first["completed"] == 10 and first["lost"] == 0
+    assert first["verdict"] == "fail"          # the scenario folds it in
+    assert first["slo_verdict"] == "fail"
+    assert first["slo_windows"] == 3           # ceil(10 / 4)
+    assert first["slo_breaches"] == 3          # every window all-bad
+    assert first["slo_worst_window"] == 0      # first on ties
+    assert first["slo_worst_burn"] == pytest.approx(100.0, rel=1e-9)
+    second = _slo_fleet_once(model, params, specs, tight)
+    assert second == first                     # deterministic verdict
+    lax = {"ttft_ms": 1e9, "tpot_ms": 1e9, "availability": 0.5}
+    ok_first = _slo_fleet_once(model, params, specs, lax)
+    assert ok_first["verdict"] == "pass"
+    assert ok_first["slo_verdict"] == "pass"
+    assert ok_first["slo_breaches"] == 0
+    assert ok_first["slo_worst_burn"] == 0.0
+    ok_second = _slo_fleet_once(model, params, specs, lax)
+    assert ok_second == ok_first
+
+
+# ============================== gates + reports over recorded fixtures
+
+def _fixture_records(path):
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def test_slo_fixtures_validate_and_announce_the_spec():
+    for path in (SERVE_FIXTURE, FLEET_FIXTURE):
+        records = _fixture_records(path)
+        assert obs_schema.validate_stream(records) == [], path
+        header = records[0]
+        assert header["record"] == "run_header"
+        assert header["config"].get("slo"), path
+        assert any(r["record"] == "slo_window" for r in records), path
+    # the fleet fixture also recorded at least one sketch rollup
+    assert any(r["record"] == "fleet_rollup"
+               for r in _fixture_records(FLEET_FIXTURE))
+
+
+def test_ci_gate_slo_stream_passes_on_fixtures(capsys):
+    ci_gate = _load_tool("ci_gate")
+    assert ci_gate.main(["--slo-stream", SERVE_FIXTURE,
+                         "--slo-stream", FLEET_FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert f"ci_gate: slo gate {SERVE_FIXTURE}: PASS" in out
+    assert f"ci_gate: slo gate {FLEET_FIXTURE}: PASS" in out
+    assert ci_gate.main(
+        ["--slo-stream", SERVE_FIXTURE + ".missing"]) == 2
+
+
+def test_ci_gate_slo_stream_fails_on_tamper(tmp_path, capsys):
+    """The gate actually checks something: a summary claiming fewer
+    breaches than the stream carries, and a breach record whose window
+    disagrees, both fail."""
+    ci_gate = _load_tool("ci_gate")
+    records = _fixture_records(SERVE_FIXTURE)
+
+    def rewrite(mutate):
+        out = []
+        for rec in records:
+            rec = dict(rec)
+            mutate(rec)
+            out.append(rec)
+        p = tmp_path / "tampered.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in out))
+        return str(p)
+
+    def hide_breaches(rec):
+        if rec["record"] == "serve_summary":
+            rec["slo"] = dict(rec["slo"], breaches=0, verdict="pass")
+
+    def tear_burn(rec):
+        if rec["record"] == "slo_breach":
+            rec["burn_rate"] = 0.5      # contradicts its window record
+
+    assert ci_gate.main(["--slo-stream", rewrite(hide_breaches)]) == 1
+    assert "breach" in capsys.readouterr().err
+    assert ci_gate.main(["--slo-stream", rewrite(tear_burn)]) == 1
+    # and a sketch that lies about its percentiles is caught by the
+    # sketch-vs-exact honesty bound
+
+    def inflate_p99(rec):
+        if rec["record"] == "serve_summary":
+            tt = dict(rec["slo"]["ttft_ms"])
+            tt["p99"] = tt["p99"] * 10 + 100.0
+            rec["slo"] = dict(rec["slo"], ttft_ms=tt)
+
+    assert ci_gate.main(["--slo-stream", rewrite(inflate_p99)]) == 1
+    assert "relative-error" in capsys.readouterr().err
+
+
+def test_slo_report_renders_breaches_and_verdicts(capsys):
+    slo_report = _load_tool("slo_report")
+    # the serve fixture was recorded with a tight spec: its compile-
+    # slow first window breached, so the report fails it
+    assert slo_report.main([SERVE_FIXTURE]) == 1
+    out = capsys.readouterr().out
+    assert "slo spec:" in out and "burn trajectory:" in out
+    assert "BREACH" in out and "verdict: FAIL" in out
+    # the fleet fixture's lax spec passes, rollups rendered
+    assert slo_report.main([FLEET_FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert "verdict: PASS" in out and "fleet rollups:" in out
+
+
+def test_slo_report_torn_tail_is_not_read_as_healthy(tmp_path, capsys):
+    """A stream killed right after a breaching window (no summary, no
+    breach record yet) must FAIL the report — satellite 4's 'breach-
+    ending streams not misread as healthy'."""
+    records = _fixture_records(SERVE_FIXTURE)
+    breached = next(r for r in records if r["record"] == "slo_window"
+                    and r["burn_rate"] > 1.0)
+    torn = [r for r in records
+            if r["record"] in ("run_header", "request_complete")
+            or (r["record"] == "slo_window"
+                and r["window"] <= breached["window"])]
+    p = tmp_path / "torn.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in torn))
+    slo_report = _load_tool("slo_report")
+    assert slo_report.main([str(p)]) == 1
+    out = capsys.readouterr().out
+    assert "NO SUMMARY" in out
+    assert "BREACH" in out
+    # no SLO content at all is unusable input, not a pass
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps(
+        {"record": "run_header", "schema": 14, "time": 0.0,
+         "run_id": "x", "num_devices": 1, "process_index": 0,
+         "platform": "cpu", "config": {}}) + "\n")
+    assert slo_report.main([str(empty)]) == 2
+
+
+def test_telemetry_report_slo_line(tmp_path, capsys):
+    telemetry_report = _load_tool("telemetry_report")
+    assert telemetry_report.report(SERVE_FIXTURE) == 0
+    out = capsys.readouterr().out
+    assert "SLO:" in out and "breach(es)" in out
+    # a breach-ending truncated stream says BREACHED, not healthy
+    records = _fixture_records(SERVE_FIXTURE)
+    breached = next(r for r in records if r["record"] == "slo_window"
+                    and r["burn_rate"] > 1.0)
+    torn = [r for r in records
+            if r["record"] == "run_header"
+            or (r["record"] == "slo_window"
+                and r["window"] <= breached["window"])]
+    p = tmp_path / "torn.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in torn))
+    telemetry_report.report(str(p))
+    out = capsys.readouterr().out
+    assert "BREACHED" in out

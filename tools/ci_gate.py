@@ -9,6 +9,8 @@
 
     python tools/ci_gate.py --fleet-stream fleet.jsonl   # + fleet gate
 
+    python tools/ci_gate.py --slo-stream slo.jsonl       # + SLO gate
+
 Gates:
 
 1. **graftlint --fail-on-new** (tools/graftlint): the two-stratum
@@ -51,6 +53,20 @@ Gates:
    checked-in redelivery pair (tests/fixtures/disagg/), this turns
    "a decode worker can die between poll and ack and lose nothing"
    into a regression-tested contract.
+7. **slo gate** (per ``--slo-stream``): the streaming-SLO contract
+   over one recorded ``--slo`` stream (schema v14) — every record
+   validates, exactly one run_header announces the spec, the
+   ``slo_window`` / ``slo_breach`` records agree with each other
+   (every breach is burn > 1.0 and mirrors its window; every window
+   past 1.0 has a breach record) and with the summary's windows /
+   breaches / verdict; on a serve stream the summary's latency
+   sketches are additionally checked against the EXACT nearest-rank
+   percentiles recomputed from the raw ``request_complete`` records
+   (within the sketch's declared relative-error bound alpha); on a
+   fleet-router stream at least one ``fleet_rollup`` must have merged
+   the replicas' sketches with a conserved sample count.  Run over the
+   checked-in SLO streams (tests/fixtures/slo/), this turns "the
+   online percentiles are honest" into a regression-tested bound.
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -276,6 +292,156 @@ def _disagg_gate(streams) -> int:
     return rc
 
 
+def _slo_gate(stream: str) -> int:
+    """The streaming-SLO gate (ISSUE 16) over one recorded ``--slo``
+    stream — a serve.py replica stream (``serve_summary`` with its
+    ``slo`` dict) or a fleet.py router stream (``fleet_summary`` with
+    the flat ``slo_*`` fields).  Schema-v14 validation, exactly one
+    announced spec, window/breach/summary agreement, and (serve
+    streams) the sketch-vs-exact honesty bound: the summary's online
+    percentiles must sit within the declared relative error alpha of
+    the exact nearest-rank percentiles recomputed from the raw
+    ``request_complete`` records.  Returns 0/1 (2 is the caller's
+    unreadable-stream path)."""
+    kind = "serve_summary"
+    with open(stream) as fh:
+        for line in fh:
+            if '"fleet_summary"' in line:
+                kind = "fleet_summary"
+                break
+    summ, records = _load_gated_stream(stream, kind)
+    if summ is None:
+        return 1
+    rc = 0
+    announced = [r for r in records
+                 if r.get("record") == "run_header"
+                 and isinstance(r.get("config"), dict)
+                 and r["config"].get("slo")]
+    if len(announced) != 1:
+        print(f"{stream}: {len(announced)} run_header(s) announce an "
+              "SLO spec (expected exactly 1 — an --slo stream declares "
+              "its targets up front)", file=sys.stderr)
+        rc = 1
+    windows = [r for r in records if r.get("record") == "slo_window"]
+    breaches = [r for r in records if r.get("record") == "slo_breach"]
+    if not windows:
+        print(f"{stream}: no slo_window records (nothing was scored — "
+              "was the run armed with --slo?)", file=sys.stderr)
+        return 1
+    wmap = {w["window"]: w for w in windows}
+    for b in breaches:
+        w = wmap.get(b.get("window"))
+        if w is None:
+            print(f"{stream}: slo_breach for window {b.get('window')} "
+                  "has no matching slo_window record", file=sys.stderr)
+            rc = 1
+        elif b["burn_rate"] <= 1.0 or b["burn_rate"] != w["burn_rate"]:
+            print(f"{stream}: slo_breach window {b['window']} burn "
+                  f"{b['burn_rate']} inconsistent with its window "
+                  f"record (window says {w['burn_rate']}; a breach is "
+                  "burn > 1.0)", file=sys.stderr)
+            rc = 1
+    breached = {b.get("window") for b in breaches}
+    silent = [w["window"] for w in windows
+              if w["burn_rate"] > 1.0 and w["window"] not in breached]
+    for wi in silent[:10]:
+        print(f"{stream}: window {wi} burned past 1.0 with no "
+              "slo_breach record", file=sys.stderr)
+    if silent:
+        rc = 1
+
+    if kind == "serve_summary":
+        slo = summ.get("slo")
+        if not isinstance(slo, dict):
+            print(f"{stream}: serve_summary carries no slo dict "
+                  "(the armed engine must fold its verdict into the "
+                  "summary)", file=sys.stderr)
+            return 1
+        if slo.get("windows") != len(windows):
+            print(f"{stream}: summary says {slo.get('windows')} "
+                  f"window(s), stream carries {len(windows)} "
+                  "slo_window record(s)", file=sys.stderr)
+            rc = 1
+        if slo.get("breaches") != len(breaches):
+            print(f"{stream}: summary says {slo.get('breaches')} "
+                  f"breach(es), stream carries {len(breaches)} "
+                  "slo_breach record(s)", file=sys.stderr)
+            rc = 1
+        if (slo.get("verdict") == "fail") != bool(breaches):
+            print(f"{stream}: verdict {slo.get('verdict')!r} "
+                  f"contradicts {len(breaches)} breach record(s)",
+                  file=sys.stderr)
+            rc = 1
+        # The honesty bound: the summary's ONLINE percentiles vs the
+        # exact nearest-rank percentiles over the raw completion
+        # records (same rank convention — metrics_lint.pct).  The
+        # record values are rounded to 3 decimals, hence the small
+        # absolute slack on top of the relative bound.
+        metrics_lint = _load_tool("metrics_lint")
+        alpha = slo.get("alpha", 0.01)
+        for key in ("ttft_ms", "tpot_ms"):
+            sk = slo.get(key)
+            if not isinstance(sk, dict) or not sk.get("count"):
+                continue
+            exact = sorted(r[key] for r in records
+                           if r.get("record") == "request_complete"
+                           and isinstance(r.get(key), (int, float)))
+            if sk["count"] != len(exact):
+                print(f"{stream}: {key} sketch folded {sk['count']} "
+                      f"sample(s) but the stream carries {len(exact)} "
+                      "ok request_complete record(s)", file=sys.stderr)
+                rc = 1
+                continue
+            for q in (50, 90, 99):
+                ex = metrics_lint.pct(exact, q)
+                est = sk.get(f"p{q}", 0.0)
+                if abs(est - ex) > alpha * abs(ex) + 0.01:
+                    print(f"{stream}: {key} p{q} sketch {est:.3f} vs "
+                          f"exact {ex:.3f} — outside the declared "
+                          f"relative-error bound alpha={alpha}",
+                          file=sys.stderr)
+                    rc = 1
+    else:
+        if "slo_verdict" not in summ:
+            print(f"{stream}: fleet_summary carries no slo_verdict "
+                  "(the armed router must fold its verdict into the "
+                  "summary)", file=sys.stderr)
+            return 1
+        if summ.get("slo_windows") != len(windows):
+            print(f"{stream}: summary says {summ.get('slo_windows')} "
+                  f"window(s), stream carries {len(windows)} "
+                  "slo_window record(s)", file=sys.stderr)
+            rc = 1
+        if summ.get("slo_breaches") != len(breaches):
+            print(f"{stream}: summary says {summ.get('slo_breaches')} "
+                  f"breach(es), stream carries {len(breaches)} "
+                  "slo_breach record(s)", file=sys.stderr)
+            rc = 1
+        if (summ["slo_verdict"] == "fail") != bool(breaches):
+            print(f"{stream}: slo_verdict {summ['slo_verdict']!r} "
+                  f"contradicts {len(breaches)} breach record(s)",
+                  file=sys.stderr)
+            rc = 1
+        rollups = [r for r in records
+                   if r.get("record") == "fleet_rollup"]
+        if not rollups:
+            print(f"{stream}: no fleet_rollup record (the replicas' "
+                  "sketches never merged — rollup cadence longer than "
+                  "the run?)", file=sys.stderr)
+            rc = 1
+        for r in rollups:
+            per = r.get("per_replica")
+            if isinstance(per, dict) and per:
+                total = sum(v.get("count", 0) for v in per.values())
+                if total != r.get("count"):
+                    print(f"{stream}: fleet_rollup count "
+                          f"{r.get('count')} != {total} summed over "
+                          "per_replica — merge lost samples",
+                          file=sys.stderr)
+                    rc = 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="one command for every static CI gate")
@@ -312,6 +478,14 @@ def main(argv=None) -> int:
                          "serve_summary, int8 kv_dtype + quant_event, "
                          "and kv_bytes_committed <= bf16-equivalent / "
                          "--quant-compression-min (repeatable)")
+    ap.add_argument("--slo-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="an --slo-armed stream (serve.py replica or "
+                         "fleet.py router) to run the SLO gate over: "
+                         "schema-v14 validation, one announced spec, "
+                         "window/breach/summary agreement, and the "
+                         "sketch-vs-exact relative-error bound "
+                         "(repeatable)")
     ap.add_argument("--quant-compression-min", type=float, default=1.9,
                     metavar="X",
                     help="KV compression ratio the --quant-stream gate "
@@ -363,6 +537,16 @@ def main(argv=None) -> int:
             return 2
         rc = _fleet_gate(stream, args.fleet_availability_min)
         print(f"ci_gate: fleet gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
+
+    for stream in args.slo_stream:
+        if not os.path.isfile(stream):
+            print(f"ci_gate: no such stream: {stream}",
+                  file=sys.stderr)
+            return 2
+        rc = _slo_gate(stream)
+        print(f"ci_gate: slo gate {stream}: "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         worst = max(worst, rc)
 

@@ -53,6 +53,7 @@ JAX_ROOTS = {"jax", "jaxlib", "flax", "optax", "orbax", "chex"}
 CONTRACT_FILES = (
     "apex_example_tpu/resilience/supervisor.py",
     "apex_example_tpu/obs/schema.py",
+    "apex_example_tpu/obs/slo.py",
     "apex_example_tpu/fleet/replica.py",
     "apex_example_tpu/fleet/router.py",
     "apex_example_tpu/fleet/scenarios.py",

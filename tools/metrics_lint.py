@@ -50,9 +50,16 @@ scatter — serve summaries gain ``handoff_duplicates`` /
 ``handoff_redelivered`` / ``handoff_quarantined``, replica heartbeats
 gain ``role``, and ``fleet_summary`` gains the disagg topology +
 spool accounting: ``prefill_replicas`` / ``decode_replicas`` /
-``handoffs`` / ``handoff_redelivered`` / ``in_spool``) all validate
-alongside v1 streams — each version's tables are a strict superset of
-the last.
+``handoffs`` / ``handoff_redelivered`` / ``in_spool``) and v14
+streams (the streaming-SLO stratum from --slo runs: ``slo_window``
+tumbling-window scoreboards with good/bad counts, the error-budget
+``burn_rate`` and mergeable log-bucket latency sketches, ``slo_breach``
+records the moment a window burns past 1.0, ``fleet_rollup`` records
+merging the replicas' heartbeat sketches — ``replica_state`` gains
+``slo_sketch``, ``serve_summary`` gains the ``slo`` verdict dict, and
+``fleet_summary`` gains the flat ``slo_verdict``/``slo_windows``/
+``slo_breaches``/``slo_worst_burn`` fields) all validate alongside v1
+streams — each version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort
 exits 2.

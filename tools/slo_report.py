@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""SLO report: render a --slo-armed stream's windows, burn trajectory,
+breaches and fleet rollups (ISSUE 16; README "SLO monitoring").
+
+Works on either SLO-carrying stream — a serve.py replica stream
+(``slo_window``/``slo_breach`` records + the ``serve_summary`` ``slo``
+dict) or a fleet.py router stream (the same window records plus
+``fleet_rollup`` merges and the ``fleet_summary`` ``slo_*`` fields):
+
+    python serve.py --requests 32 --metrics-jsonl serve.jsonl \\
+        --slo ttft_ms=250,tpot_ms=40,availability=0.99
+    python tools/slo_report.py serve.jsonl
+    #   slo spec: ttft_ms<=250.0 tpot_ms<=40.0 availability 0.99
+    #   window  requests  good  bad  burn    ttft_p50  ttft_p99
+    #   0       16        16    0    0.0     38.2      61.0
+    #   ...
+    #   burn trajectory: 0.00 0.00 1.25! 0.00
+    #   BREACH: window 2 burn 1.25 (bad 2/16, budget 0.01)
+    #   verdict: FAIL (1 breach in 4 windows, worst burn 1.25 @ window 2)
+
+The burn trajectory marks breached windows with ``!`` — burn 1.0
+spends a window's error budget exactly, anything past it is a breach.
+A stream that ENDS on a breach is reported as failing even without a
+summary record (a killed run's last window must not read as healthy).
+
+jax-free by the thin-client contract (graftlint's import rule proves
+it).  Exit codes: 0 = armed and passing, 1 = breaches / fail verdict /
+schema errors, 2 = unusable input (no SLO records in the stream).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from metrics_lint import validate_stream  # noqa: E402  (sibling import)
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue            # killed runs truncate the tail
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError as e:
+        print(f"ERROR: {path}: {e}", file=sys.stderr)
+    return records
+
+
+def _spec_line(spec: Dict[str, Any]) -> str:
+    parts = []
+    for key in ("ttft_ms", "tpot_ms"):
+        if spec.get(key) is not None:
+            parts.append(f"{key}<={spec[key]}")
+    parts.append(f"availability {spec.get('availability', '?')}")
+    return " ".join(parts)
+
+
+def report(path: str, out=sys.stdout) -> int:
+    records = load_records(path)
+    if not records:
+        print(f"{path}: no records", file=sys.stderr)
+        return 2
+    for err in validate_stream(records):
+        print(f"WARNING: {err}", file=sys.stderr)
+
+    header = next((r for r in records
+                   if r.get("record") == "run_header"), None)
+    windows = [r for r in records if r.get("record") == "slo_window"]
+    breaches = [r for r in records if r.get("record") == "slo_breach"]
+    rollups = [r for r in records if r.get("record") == "fleet_rollup"]
+    serve_summary = next((r for r in records
+                          if r.get("record") == "serve_summary"), None)
+    fleet_summary = next((r for r in records
+                          if r.get("record") == "fleet_summary"), None)
+
+    spec = None
+    if header is not None:
+        cfg = header.get("config")
+        if isinstance(cfg, dict) and isinstance(cfg.get("slo"), dict):
+            spec = cfg["slo"]
+        elif isinstance(cfg, dict) and isinstance(cfg.get("slo"), str):
+            spec = {"raw": cfg["slo"]}
+
+    if not windows and not rollups and spec is None \
+            and (serve_summary is None or "slo" not in serve_summary) \
+            and (fleet_summary is None
+                 or "slo_verdict" not in fleet_summary):
+        print(f"{path}: no SLO records (run with --slo to arm the "
+              "streaming SLO plane)", file=sys.stderr)
+        return 2
+
+    if spec is not None:
+        if "raw" in spec:
+            print(f"slo spec: {spec['raw']}", file=out)
+        else:
+            print(f"slo spec: {_spec_line(spec)}", file=out)
+
+    # ---- window timeline --------------------------------------------
+    rc = 0
+    if windows:
+        print("window  requests  good  bad   burn     ttft_p50  "
+              "ttft_p99", file=out)
+        for w in windows:
+            t = w.get("ttft_ms") or {}
+            print(f"{w['window']:<7} {w['requests']:<9} "
+                  f"{w['good']:<5} {w['bad']:<5} "
+                  f"{w['burn_rate']:<8.3g} "
+                  f"{t.get('p50', 0.0):<9.1f} "
+                  f"{t.get('p99', 0.0):<8.1f}", file=out)
+        traj = " ".join(
+            f"{w['burn_rate']:.2f}" + ("!" if w["burn_rate"] > 1.0
+                                       else "")
+            for w in windows)
+        print(f"burn trajectory: {traj}", file=out)
+
+    # ---- breach table -----------------------------------------------
+    for b in breaches:
+        rc = 1
+        print(f"BREACH: window {b['window']} burn "
+              f"{b['burn_rate']:.3g} (bad {b['bad']}/{b['requests']}"
+              + (f", budget {b['budget']:.3g}" if "budget" in b else "")
+              + ")", file=out)
+    # Windows past burn 1.0 whose breach record is missing (torn tail)
+    # still count — the stream must not read healthier than its data.
+    breached_windows = {b.get("window") for b in breaches}
+    for w in windows:
+        if w["burn_rate"] > 1.0 and w["window"] not in breached_windows:
+            rc = 1
+            print(f"BREACH (no slo_breach record — torn tail?): window "
+                  f"{w['window']} burn {w['burn_rate']:.3g}", file=out)
+
+    # ---- fleet rollups ----------------------------------------------
+    if rollups:
+        last = rollups[-1]
+        t = last.get("ttft_ms") or {}
+        print(f"fleet rollups: {len(rollups)} record(s); last merges "
+              f"{last['replicas']} replica(s), {last['count']} "
+              f"sample(s), ttft p50 {t.get('p50', 0.0):.1f} "
+              f"p99 {t.get('p99', 0.0):.1f}", file=out)
+        for r in rollups:
+            if r.get("straggler"):
+                print(f"STRAGGLER: {r['straggler']} p50 = "
+                      f"{r.get('skew', 0.0)}x the fleet median "
+                      "(rollup)", file=out)
+                break
+
+    # ---- verdict ----------------------------------------------------
+    slo = (serve_summary or {}).get("slo")
+    if isinstance(slo, dict):
+        n_b = slo.get("breaches", 0)
+        verdict = slo.get("verdict", "fail" if n_b else "pass")
+        line = (f"verdict: {verdict.upper()} ({n_b} breach(es) in "
+                f"{slo.get('windows', 0)} window(s)")
+        if slo.get("worst_window") is not None:
+            line += (f", worst burn {slo.get('worst_burn', 0.0):.3g} "
+                     f"@ window {slo['worst_window']}")
+        print(line + ")", file=out)
+        if verdict != "pass":
+            rc = 1
+    elif fleet_summary is not None \
+            and "slo_verdict" in fleet_summary:
+        verdict = fleet_summary["slo_verdict"]
+        line = (f"verdict: {verdict.upper()} "
+                f"({fleet_summary.get('slo_breaches', 0)} breach(es) "
+                f"in {fleet_summary.get('slo_windows', 0)} window(s)")
+        if "slo_worst_window" in fleet_summary:
+            line += (f", worst burn "
+                     f"{fleet_summary.get('slo_worst_burn', 0.0):.3g} "
+                     f"@ window {fleet_summary['slo_worst_window']}")
+        print(line + ")", file=out)
+        if verdict != "pass":
+            rc = 1
+    else:
+        # No summary at all: a killed run.  The window data above is
+        # the whole story — say so, and fail if it ended badly.
+        print("verdict: NO SUMMARY (stream truncated? judged on "
+              "window records alone)", file=out)
+        if windows and windows[-1]["burn_rate"] > 1.0:
+            rc = 1
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render an --slo-armed stream: window timeline, "
+                    "burn-rate trajectory, breaches, fleet rollups")
+    ap.add_argument("path", help="a serve.py or fleet.py --metrics-jsonl "
+                                 "stream recorded with --slo")
+    args = ap.parse_args(argv)
+    return report(args.path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

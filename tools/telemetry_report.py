@@ -24,7 +24,12 @@ counts, KV bytes moved) when the stream took part in a prefill/decode
 split (tools/serve_report.py renders the latency percentiles) — with
 the v13 crash-safety counters appended (redelivered admissions,
 duplicates acked without a second scatter, quarantined payloads) when
-the leased-spool protocol had to recover anything.
+the leased-spool protocol had to recover anything — and the streaming-
+SLO stratum (schema v14): an SLO line (windows scored, breaches, burn
+verdict) when the run was armed with ``--slo``; a stream that ENDS on
+a breaching ``slo_window`` without a summary is flagged as BREACHED,
+never read as healthy (tools/slo_report.py renders the window
+timeline and burn trajectory).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -242,6 +247,30 @@ def report(path: str, out=sys.stdout) -> int:
             line += (f" ({n_redeliv} redelivered, {n_dup} duplicate, "
                      f"{n_quar} quarantined)")
         print(line + " (tools/serve_report.py for latency percentiles)",
+              file=out)
+    slo_windows = [r for r in records if r.get("record") == "slo_window"]
+    slo_breaches = [r for r in records
+                    if r.get("record") == "slo_breach"]
+    if slo_windows or slo_breaches:
+        # Schema v14 (--slo): the window timeline and burn trajectory
+        # live in tools/slo_report.py; this line says the run was
+        # scored and how it ended.  The verdict comes from whichever
+        # summary the stream carries; a stream that ends on a breaching
+        # window WITHOUT a summary must not read as healthy.
+        s_slo = (serve_summaries[-1].get("slo")
+                 if serve_summaries else None)
+        f_last = fleet_summaries[-1] if fleet_summaries else None
+        if isinstance(s_slo, dict):
+            verdict = s_slo.get("verdict", "?")
+        elif f_last is not None and "slo_verdict" in f_last:
+            verdict = f_last["slo_verdict"]
+        elif slo_windows and slo_windows[-1].get("burn_rate", 0) > 1.0:
+            verdict = "last window BREACHED, no summary (truncated?)"
+        else:
+            verdict = "no summary (truncated?)"
+        print(f"SLO: {len(slo_windows)} window(s), "
+              f"{len(slo_breaches)} breach(es), verdict {verdict}"
+              "  (tools/slo_report.py for the burn trajectory)",
               file=out)
     if not steps:
         if is_fleet_stream:
