@@ -271,6 +271,13 @@ class ThreadReplica:
         sk = sketch_fn() if sketch_fn is not None else None
         if sk is not None:
             out["slo_sketch"] = sk
+        # v15: cumulative host-overhead fraction (duck-typed the same
+        # way — only a --tick-profile-armed engine reports one);
+        # fleet_report ranks replicas by it.
+        frac_fn = getattr(eng, "host_overhead_frac", None)
+        frac = frac_fn() if frac_fn is not None else None
+        if frac is not None:
+            out["host_overhead_frac"] = frac
         return out
 
     # ------------------------------------------------------ lifecycle
@@ -788,4 +795,8 @@ class ProcReplica:
         # synthesized, so the router's rollup only merges real data.
         if "slo_sketch" in beat:
             out["slo_sketch"] = beat["slo_sketch"]
+        # v15: same passthrough for the host-overhead fraction a
+        # --tick-profile child advertises.
+        if "host_overhead_frac" in beat:
+            out["host_overhead_frac"] = beat["host_overhead_frac"]
         return out

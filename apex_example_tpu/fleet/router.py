@@ -84,7 +84,7 @@ from typing import Any, Dict, List, Optional, Tuple
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) —
 # jax-free contract forbids importing it (same stance as the
 # supervisor's hard-coded records).
-SCHEMA = 14
+SCHEMA = 15
 TRACE_ID_ENV = "APEX_TRACE_ID"
 
 POLICIES = ("round_robin", "least_pending", "least_kv")
@@ -324,6 +324,12 @@ class FleetRouter:
                 rec["classification"] = str(health["classification"])
             if health.get("exit_code") is not None:
                 rec["exit_code"] = int(health["exit_code"])
+            # v15: re-emit the host-overhead fraction a --tick-profile
+            # replica advertises, so fleet streams carry it even when
+            # the children's own streams are not collected.
+            if health.get("host_overhead_frac") is not None:
+                rec["host_overhead_frac"] = float(
+                    health["host_overhead_frac"])
         if detail:
             rec["detail"] = detail
         self._stream.write(rec)
@@ -1007,27 +1013,38 @@ class FleetRouter:
     def close(self) -> Dict[str, Any]:
         """Write the fleet_summary and close the stream; returns the
         summary record."""
+        # Last-chance re-snapshot: a short run's final heartbeat (the
+        # one carrying nonzero sketches / a settled overhead fraction)
+        # often lands AFTER the last poll, so poll state() once more
+        # now.  Only the slo_sketch key is folded back into health and
+        # only profiler-armed replicas get a closing replica_state —
+        # close-time is not the place to act on state transitions, and
+        # an unarmed fleet's stream is byte-shaped as before.
+        with self._lock:
+            handles = [(n, self._replicas[n].handle)
+                       for n in self._order]
+        for name, handle in handles:
+            try:
+                snap = handle.state()
+            except Exception:
+                continue
+            if not isinstance(snap, dict):
+                continue
+            if self._slo is not None and "slo_sketch" in snap:
+                with self._lock:
+                    meta = self._replicas[name]
+                    meta.health = dict(
+                        meta.health, slo_sketch=snap["slo_sketch"])
+            if snap.get("host_overhead_frac") is not None:
+                # v15: the cumulative fraction is only meaningful once
+                # the run is over — state transitions rarely fire late
+                # enough to re-emit it, so the closing record is what
+                # fleet_report and perf_ledger actually rank on.
+                with self._lock:
+                    state = self._replicas[name].emitted_state \
+                        or "healthy"
+                self._state_rec(name, state, snap)
         if self._slo is not None:
-            # Last-chance rollup: a short run's final heartbeat (the
-            # one carrying nonzero sketches) often lands AFTER the
-            # last poll, so re-snapshot just the sketches and merge
-            # them now, bypassing the rate limiter — every armed run
-            # with completions gets at least one fleet_rollup.  Only
-            # the slo_sketch key is refreshed: close-time is not the
-            # place to act on state transitions.
-            with self._lock:
-                handles = [(n, self._replicas[n].handle)
-                           for n in self._order]
-            for name, handle in handles:
-                try:
-                    snap = handle.state()
-                except Exception:
-                    continue
-                if isinstance(snap, dict) and "slo_sketch" in snap:
-                    with self._lock:
-                        meta = self._replicas[name]
-                        meta.health = dict(
-                            meta.health, slo_sketch=snap["slo_sketch"])
             self._slo_rollup(force=True)
             # Trailing partial window: emitted before the summary so
             # the stream's slo_window count matches the summary's

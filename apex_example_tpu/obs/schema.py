@@ -300,6 +300,34 @@ Without ``--slo`` none of these are emitted — streams are
 byte-identical to v13 runs.  v14 is once more a strict superset: every
 v1–v13 stream validates unchanged.
 
+Version 15 adds the hot-path overhead stratum (obs/tickprof.py;
+``--tick-profile`` on serve.py / train.py — README "Hot-path
+profiling"):
+
+``tick_profile``      one per sampled tick/step (every
+                      ``--tick-profile-every``-th) — the tick's phase
+                      decomposition in milliseconds (serve: admit /
+                      dispatch_enqueue / device_wait / harvest /
+                      spool_io / telemetry; train: data_wait /
+                      dispatch / device / checkpoint / telemetry), the
+                      measured wall time, and ``host_gap_ms`` = wall
+                      minus the device phase.  Carries a perf_counter
+                      ``ts`` so trace_export renders a host-gap
+                      counter track.
+``overhead_summary``  one per run — per-phase cumulative totals +
+                      log-bucket sketch summaries, cumulative wall /
+                      device / host-gap milliseconds and the
+                      ``host_overhead_frac`` tools/perf_ledger.py
+                      regression-gates against PERF_BASELINE.json.
+
+plus idle-spin accounting on ``serve_summary`` (``idle_ticks`` /
+``idle_wait_ms`` — producer-driven runs that sleep in ``engine.run``
+now show how much wall time was idle) and ``host_overhead_frac`` on
+``serve_summary`` and ``replica_state`` heartbeats (fleet_report names
+the worst-overhead replica).  Without ``--tick-profile`` only the idle
+counters are new; v15 is once more a strict superset: every v1–v14
+stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -311,7 +339,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -513,6 +541,28 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "replicas": int,        # replicas contributing a sketch
         "count": int,           # merged TTFT observations, fleet-wide
     },
+    # --- schema v15: hot-path overhead records (obs/tickprof.py) ---
+    "tick_profile": {
+        "record": str,
+        "time": _NUM,
+        "ts": _NUM,             # perf_counter at tick start (trace
+        "kind": str,            #   clock domain); serve | train
+        "tick": int,            # engine tick / train step ordinal
+        "wall_ms": _NUM,        # independently measured tick wall time
+        "host_gap_ms": _NUM,    # wall - device phase
+        "phases": dict,         # phase -> milliseconds (sum == wall
+    },                          #   within 1%; perf_ledger enforces)
+    "overhead_summary": {
+        "record": str,
+        "time": _NUM,
+        "kind": str,            # serve | train
+        "ticks": int,           # ticks folded (every tick, not sampled)
+        "wall_ms": _NUM,        # cumulative
+        "device_ms": _NUM,      # cumulative device-phase time
+        "host_gap_ms": _NUM,    # wall_ms - device_ms
+        "host_overhead_frac": _NUM,   # host_gap_ms / wall_ms
+        "phases": dict,         # phase -> {count,p50,p90,p99,min,max,
+    },                          #   total_ms} sketch summaries
 }
 
 OPTIONAL: Dict[str, Dict[str, Any]] = {
@@ -650,6 +700,12 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         # window/breach totals, worst burn, cumulative sketch
         # percentiles.  Absent without --slo.
         "slo": dict,
+        # v15: idle-spin accounting (engine.run idle_wait_s sleeps are
+        # now observed) + the cumulative host-overhead fraction from
+        # the armed tick profiler (absent without --tick-profile).
+        "idle_ticks": int,          # step() calls with nothing live
+        "idle_wait_ms": _NUM,       # wall time slept between them
+        "host_overhead_frac": _NUM,  # (wall - device) / wall, run-wide
     },
     "preemption": {
         "run_id": str,
@@ -768,6 +824,10 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "slo_sketch": dict,      # v14: compact serialized cumulative
                                  #   TTFT/TPOT sketches (--slo armed) —
                                  #   what fleet_rollup merges
+        "host_overhead_frac": _NUM,  # v15: the replica's cumulative
+                                     #   host-overhead fraction
+                                     #   (--tick-profile armed) —
+                                     #   fleet_report ranks these
     },
     # --- schema v11: quantization records (apex_example_tpu/quant/) ---
     "quant_event": {
@@ -867,6 +927,17 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "per_replica": dict,     # name -> {count, p50}
         "skew": _NUM,            # max p50 / median p50 (>= 2 replicas)
         "straggler": str,        # the max-p50 replica's name
+    },
+    # --- schema v15: hot-path overhead records (obs/tickprof.py) ---
+    "tick_profile": {
+        "run_id": str,
+    },
+    "overhead_summary": {
+        "run_id": str,
+        "sample_every": int,     # tick_profile sampling stride
+        "sampled": int,          # tick_profile records emitted
+        "wall": dict,            # per-tick wall-time sketch summary
+        "host_gap": dict,        # per-tick host-gap sketch summary
     },
 }
 

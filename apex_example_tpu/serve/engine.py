@@ -297,7 +297,7 @@ class ServeEngine:
                  weight_quant: str = "none", role: str = "both",
                  handoff_sink=None, slo=None,
                  slo_window_s: Optional[float] = None,
-                 slo_window_ticks: int = 0):
+                 slo_window_ticks: int = 0, tick_profiler=None):
         if weight_quant not in ("none", "int8", "fp8"):
             raise ValueError(f"weight_quant must be none|int8|fp8, got "
                              f"{weight_quant!r}")
@@ -424,6 +424,18 @@ class ServeEngine:
                 window_ticks=slo_window_ticks or 0,
                 emit=sink.write if sink is not None else None,
                 run_id=run_id)
+        # --tick-profile (obs/tickprof.py, ISSUE 17): per-tick phase
+        # decomposition.  Armed, the step inserts ONE extra
+        # block_until_ready at the enqueue/device boundary — a
+        # value-preserving host sync on outputs the tick was about to
+        # block on anyway (np.asarray), so greedy outputs stay
+        # token-identical and NO new program compiles.  Unarmed, the
+        # tick path is unchanged.  Idle-spin accounting (idle_ticks /
+        # idle_wait_ms) is always on: it is free.
+        self.tickprof = tick_profiler
+        self.idle_ticks = 0
+        self.idle_wait_ms = 0.0
+        self._spool_ms = 0.0
 
     # ---------------------------------------------------------- intake
 
@@ -457,6 +469,7 @@ class ServeEngine:
         step = self.step_count
         tick1 = step + 1            # 1-based, for --inject-fault kind@tick
         now = time.perf_counter()
+        t_tick_start = now          # ``now`` is re-taken post-dispatch
         if not self.draining:
             self.queue.mature(step)
             # Expire BEFORE evaluating the bound: requests already dead
@@ -505,6 +518,7 @@ class ServeEngine:
                     self._rtrace[req.uid] = []   # prefill-chunk buffer
         live = pool.live
         if not live:
+            self.idle_ticks += 1
             self.step_count += 1
             if self.fault is not None:
                 # Engine-level kinds are defined on TICKS, not decode
@@ -515,15 +529,19 @@ class ServeEngine:
             return False
 
         tracer = self._tracer
+        prof = self.tickprof
         tick_sid = None
         t_admit_end = now
+        if tracer is not None or prof is not None:
+            # Admit-phase boundary: taken once, shared by the tracer
+            # span and the profiler's phase fold.
+            t_admit_end = time.perf_counter()
         if tracer is not None:
             # The tick span opens retroactively at the tick boundary
             # (``now``, taken before expire/admit ran) so the admit
             # phase is inside it; idle ticks emit nothing — a
             # wall-clock producer's idle spin must not flood the
             # stream.
-            t_admit_end = time.perf_counter()
             tick_sid = tracer.begin("tick", tid="engine", ts=now,
                                     cat="tick",
                                     args={"tick": step,
@@ -580,6 +598,20 @@ class ServeEngine:
                 jnp.asarray(n_new), jnp.asarray(cow_src),
                 jnp.asarray(cow_dst), key,
                 jnp.asarray(temps), jnp.asarray(ks))
+        t_enqueue_end = t_device_end = 0.0
+        if prof is not None:
+            # The dispatch/device boundary ISSUE 17 exists to draw:
+            # the compiled call has returned (enqueue cost paid) but
+            # its outputs may still be computing.  Blocking HERE — on
+            # values the np.asarray sync below was about to block on
+            # anyway — splits enqueue from device execution without
+            # changing any value or compiling anything new.  (On CPU
+            # jax dispatch is synchronous, so device_wait reads ~0 and
+            # the device time hides in dispatch_enqueue; see README.)
+            t_enqueue_end = time.perf_counter()
+            jax.block_until_ready((pool.cache, nxt, finite))
+            t_device_end = time.perf_counter()
+            self._spool_ms = 0.0
         nxt = np.asarray(nxt)          # the scheduler's host sync
         finite = np.asarray(finite)
         now = time.perf_counter()
@@ -677,6 +709,7 @@ class ServeEngine:
                 self._handoff_slot(i, now)
         self.compute_steps += 1
         self._occupancy_sum += len(live)
+        t_harvest_end = time.perf_counter() if prof is not None else 0.0
         # Gauge the tick AFTER harvest: what is RESIDENT at the tick
         # boundary (a finished slot's blocks were just unref'd — the
         # reclamation the dense layout could never express).
@@ -707,6 +740,23 @@ class ServeEngine:
                                   "blocks": blocks_live})
             tracer.end("tick", tid="engine", ts=t_end)
         self.step_count += 1
+        if prof is not None:
+            # Contiguous boundaries telescope: the six phases sum to
+            # the measured wall EXACTLY (modulo float rounding), which
+            # is what perf_ledger's 1% consistency gate verifies.  The
+            # profiler's own record emit happens after t_tick_end and
+            # never pollutes the measurement.
+            t_tick_end = time.perf_counter()
+            spool = self._spool_ms
+            prof.observe_tick(
+                t_tick_start,
+                (t_tick_end - t_tick_start) * 1e3,
+                admit=(t_admit_end - t_tick_start) * 1e3,
+                dispatch_enqueue=(t_enqueue_end - t_admit_end) * 1e3,
+                device_wait=(t_device_end - t_enqueue_end) * 1e3,
+                harvest=(t_harvest_end - t_device_end) * 1e3 - spool,
+                spool_io=spool,
+                telemetry=(t_tick_end - t_harvest_end) * 1e3)
         if fault is not None:
             # crash/sigterm/hang fire AFTER the tick's harvest (matching
             # the training loops: forensics hold the last good tick).
@@ -856,7 +906,15 @@ class ServeEngine:
                 rec["run_id"] = self.run_id
             self.sink.write(rec)
         self._evict_terminal(idx, "handoff", "handoff", now)
-        self.handoff_sink(handoff)
+        if self.tickprof is not None:
+            # Spool IO attribution: the sink call is filesystem work
+            # (serve/disagg.py spool write + fsync), not scheduler
+            # cost — measured here, subtracted from harvest.
+            t0 = time.perf_counter()
+            self.handoff_sink(handoff)
+            self._spool_ms += (time.perf_counter() - t0) * 1e3
+        else:
+            self.handoff_sink(handoff)
 
     def admit_handoff(self, handoff) -> bool:
         """Decode-role intake: admit a prefill worker's KV handoff into
@@ -1022,7 +1080,12 @@ class ServeEngine:
             if on_tick is not None:
                 on_tick(self)
             if not ran and idle_wait_s:
+                # v15 idle accounting: the sleep the summary used to
+                # lose — idle_wait_ms measures what was actually slept
+                # (the scheduler may overshoot idle_wait_s).
+                t0 = time.perf_counter()
                 time.sleep(idle_wait_s)
+                self.idle_wait_ms += (time.perf_counter() - t0) * 1e3
         return self.completions
 
     # ----------------------------------------------------------- drain
@@ -1191,6 +1254,14 @@ class ServeEngine:
             # ttft_ms/tpot_ms dicts above).
             self.slo.flush()
             rec["slo"] = self.slo.summary()
+        # v15 (ISSUE 17): idle-spin accounting (always on — a
+        # producer-driven run's sleeps are no longer invisible) + the
+        # cumulative host-overhead fraction when the profiler is armed.
+        rec["idle_ticks"] = self.idle_ticks
+        rec["idle_wait_ms"] = round(self.idle_wait_ms, 3)
+        if self.tickprof is not None and self.tickprof.ticks:
+            rec["host_overhead_frac"] = round(
+                self.tickprof.host_overhead_frac(), 6)
         if self.run_id:
             rec["run_id"] = self.run_id
         return rec
@@ -1199,3 +1270,11 @@ class ServeEngine:
         """Compact serialized cumulative SLO sketches for a replica
         heartbeat (``replica_state.slo_sketch``); None without --slo."""
         return None if self.slo is None else self.slo.sketch_state()
+
+    def host_overhead_frac(self) -> Optional[float]:
+        """Cumulative (wall - device) / wall for a replica heartbeat
+        (``replica_state.host_overhead_frac``); None without an armed
+        --tick-profile profiler (or before its first compute tick)."""
+        if self.tickprof is None or not self.tickprof.ticks:
+            return None
+        return self.tickprof.host_overhead_frac()

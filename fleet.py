@@ -195,6 +195,19 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="S",
                    help="period of the router's fleet_rollup records "
                         "(merged replica sketches; default 2)")
+    p.add_argument("--tick-profile", action="store_true",
+                   help="arm every replica's hot-path profiler "
+                        "(ISSUE 17): heartbeats advertise the "
+                        "cumulative host_overhead_frac, the router "
+                        "re-emits it on replica_state records, and "
+                        "fleet_report names the worst-host-overhead "
+                        "replica.  Proc children additionally emit "
+                        "schema-v15 tick_profile/overhead_summary "
+                        "records into their own streams")
+    p.add_argument("--tick-profile-every", type=int, default=16,
+                   metavar="N",
+                   help="proc children's tick_profile sampling period "
+                        "(default 16)")
     p.add_argument("--workdir", default=None,
                    help="proc transport scratch dir (inbox/outbox/"
                         "metrics per replica; default: alongside "
@@ -255,6 +268,9 @@ def run_fleet(args):
             slo_spec = router_mod._load_slo().parse_slo(args.slo)
         except ValueError as e:
             raise SystemExit(f"--slo: {e}")
+    if args.tick_profile_every < 1:
+        raise SystemExit(f"--tick-profile-every must be >= 1, got "
+                         f"{args.tick_profile_every}")
 
     def lohi(spec, name):
         parts = spec.split(":")
@@ -316,6 +332,11 @@ def run_fleet(args):
                 # and heartbeat cumulative sketches the router's
                 # fleet_rollup merges.
                 serve_args += ["--slo", args.slo]
+            if args.tick_profile:
+                # Children decompose their own ticks (v15 records in
+                # their streams) and heartbeat host_overhead_frac.
+                serve_args += ["--tick-profile", "--tick-profile-every",
+                               str(args.tick_profile_every)]
             if roles[name] == "decode":
                 serve_args += ["--handoff-lease",
                                str(args.handoff_lease)]
@@ -356,6 +377,17 @@ def run_fleet(args):
         vocab = int(model.vocab_size)
         max_len = args.max_len or min(model.max_position, 128)
 
+        def make_profiler():
+            # Thread replicas have no per-engine sink, so the profiler
+            # only ACCUMULATES (emit=None): host_overhead_frac reaches
+            # the router through state() heartbeats, and no v15 records
+            # land anywhere — the router's stream stays fleet-only.
+            if not args.tick_profile:
+                return None
+            from apex_example_tpu.obs.tickprof import TickProfiler
+            return TickProfiler(kind="serve",
+                                sample_every=args.tick_profile_every)
+
         def factory():
             # Every replica's engine clones the same module config, so
             # the jitted decode step is built ONCE and shared.  With
@@ -367,7 +399,8 @@ def run_fleet(args):
                                max_len=max_len,
                                block_size=args.block_size,
                                rng=jax.random.PRNGKey(args.seed),
-                               slo=slo_spec)
+                               slo=slo_spec,
+                               tick_profiler=make_profiler())
 
         def role_factories(name):
             # Disagg roles over one shared spool: a prefill engine
@@ -384,7 +417,8 @@ def run_fleet(args):
                                    rng=jax.random.PRNGKey(args.seed),
                                    role="prefill",
                                    handoff_sink=tx.send,
-                                   slo=slo_spec)
+                                   slo=slo_spec,
+                                   tick_profiler=make_profiler())
 
             def decode_engine():
                 return ServeEngine(model, params, num_slots=args.slots,
@@ -392,7 +426,8 @@ def run_fleet(args):
                                    block_size=args.block_size,
                                    rng=jax.random.PRNGKey(args.seed),
                                    role="decode",
-                                   slo=slo_spec)
+                                   slo=slo_spec,
+                                   tick_profiler=make_profiler())
 
             def decode_transport():
                 return FileTransport(spool, worker=name,

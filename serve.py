@@ -313,6 +313,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "instead of on wall-clock — the deterministic "
                         "mode tests and recorded fixtures use "
                         "(0 = wall-clock windows)")
+    p.add_argument("--tick-profile", action="store_true",
+                   help="with --metrics-jsonl: arm the hot-path tick "
+                        "profiler (obs/tickprof.py, ISSUE 17) — every "
+                        "compute tick decomposes into admit / "
+                        "dispatch_enqueue / device_wait (an explicit "
+                        "block-until-ready boundary, the first time "
+                        "enqueue cost and device execution are "
+                        "separable) / harvest / spool_io / telemetry, "
+                        "folded into online quantile sketches; every "
+                        "Nth tick emits a schema-v15 tick_profile "
+                        "record and the run closes with an "
+                        "overhead_summary (host_gap_ms, per-phase "
+                        "percentiles, host_overhead_frac — what "
+                        "tools/perf_ledger.py regression-gates).  "
+                        "Value-preserving and compile-free: greedy "
+                        "outputs stay token-identical and no new "
+                        "program compiles (README 'Hot-path "
+                        "profiling')")
+    p.add_argument("--tick-profile-every", type=int, default=16,
+                   metavar="N",
+                   help="emit a tick_profile record every N compute "
+                        "ticks (default 16; the cumulative "
+                        "overhead_summary always folds EVERY tick)")
     p.add_argument("--inject-fault", default="", metavar="KIND@TICK",
                    help="deterministic serve-path fault drill at a "
                         "1-based engine tick: crash | sigterm | hang | "
@@ -547,6 +570,13 @@ def run_serve(args):
     if args.slo_window_ticks < 0:
         raise SystemExit(f"--slo-window-ticks must be >= 0, got "
                          f"{args.slo_window_ticks}")
+    if args.tick_profile and not args.metrics_jsonl:
+        raise SystemExit("--tick-profile requires --metrics-jsonl (the "
+                         "tick_profile/overhead_summary records ride "
+                         "the metrics stream)")
+    if args.tick_profile_every < 1:
+        raise SystemExit(f"--tick-profile-every must be >= 1, got "
+                         f"{args.tick_profile_every}")
     replica_mode = bool(args.inbox or args.outbox)
     if args.role == "decode":
         # A decode worker's intake is the --handoff-dir spool, never an
@@ -707,6 +737,14 @@ def run_serve(args):
     # (engine construction, replica-mode setup) clears it on the way
     # out too, so an in-process caller (tests, supervisors) never
     # inherits a stale mesh.
+    tickprof = None
+    if args.tick_profile:
+        from apex_example_tpu.obs.tickprof import TickProfiler
+        tickprof = TickProfiler(kind="serve",
+                                sample_every=args.tick_profile_every,
+                                emit=sink.write if sink is not None
+                                else None,
+                                run_id=run_id)
     parallel_state.set_mesh(mesh)
     try:
         engine = ServeEngine(model, params, num_slots=args.slots,
@@ -724,7 +762,8 @@ def run_serve(args):
                              if args.role == "prefill" else None,
                              slo=slo_spec,
                              slo_window_s=args.slo_window_s,
-                             slo_window_ticks=args.slo_window_ticks)
+                             slo_window_ticks=args.slo_window_ticks,
+                             tick_profiler=tickprof)
         outbox = feeder_stop = on_tick = None
         idle_wait_s = 0.0
         if replica_mode:
@@ -770,6 +809,12 @@ def run_serve(args):
                 sk = engine.slo_sketch()
                 if sk is not None:
                     rec["slo_sketch"] = sk
+                # v15: with --tick-profile the cumulative host-overhead
+                # fraction rides along — fleet_report ranks replicas by
+                # it and names the worst.
+                frac = engine.host_overhead_frac()
+                if frac is not None:
+                    rec["host_overhead_frac"] = round(frac, 6)
                 sink.write(rec)
 
             last_beat = [0.0]
@@ -867,6 +912,11 @@ def run_serve(args):
             # even when the run is shorter than the heartbeat cadence,
             # so the router's close-time fleet_rollup sees real data.
             _beat("serving")
+        if tickprof is not None and sink is not None and tickprof.ticks:
+            # The cumulative overhead fold closes just before the
+            # serve_summary (same ordering contract as the SLO flush:
+            # report tools read the stream tail).
+            sink.write(tickprof.summary_record())
         summary = engine.summary_record()
         if transport is not None and transport.quarantined:
             summary["handoff_quarantined"] = transport.quarantined

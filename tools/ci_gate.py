@@ -11,6 +11,9 @@
 
     python tools/ci_gate.py --slo-stream slo.jsonl       # + SLO gate
 
+    python tools/ci_gate.py --perf-stream perf.jsonl \\
+        --perf-baseline PERF_BASELINE.json               # + perf gate
+
 Gates:
 
 1. **graftlint --fail-on-new** (tools/graftlint): the two-stratum
@@ -67,6 +70,19 @@ Gates:
    the replicas' sketches with a conserved sample count.  Run over the
    checked-in SLO streams (tests/fixtures/slo/), this turns "the
    online percentiles are honest" into a regression-tested bound.
+8. **perf gate** (per ``--perf-stream``): the hot-path overhead
+   contract over one recorded ``--tick-profile`` stream (schema v15)
+   — every record validates, an ``overhead_summary`` is present (the
+   run was armed), and perf_ledger's consistency checks hold: every
+   ``tick_profile``'s phase components sum to its wall time within
+   1%, and the summary's ``host_gap_ms`` / ``host_overhead_frac`` /
+   per-phase totals agree with each other (an edited host fraction —
+   the tamper fixture — fails here).  With ``--perf-baseline``, the
+   stream's normalized snapshot is additionally diffed against the
+   checked-in ``PERF_BASELINE.json`` within its per-metric noise
+   bands.  Run over the checked-in perf fixtures (tests/fixtures/
+   perf/), this turns "host overhead stayed put" into a regression-
+   tested number.
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -442,6 +458,58 @@ def _slo_gate(stream: str) -> int:
     return rc
 
 
+def _perf_gate(stream: str, baseline_path) -> int:
+    """The hot-path overhead gate (ISSUE 17) over one recorded
+    ``--tick-profile`` stream: schema-v15 validation, an armed run
+    (``overhead_summary`` present), perf_ledger's internal-consistency
+    checks (phase components sum to wall within 1%; the summary's
+    gap / fraction / phase totals agree — the tamper gate), and, when
+    ``baseline_path`` is given, the normalized snapshot within the
+    baseline's per-metric noise bands.  Returns 0/1 (2 is the caller's
+    unreadable-stream path)."""
+    import json
+
+    perf_ledger = _load_tool("perf_ledger")
+    metrics_lint = _load_tool("metrics_lint")
+    try:
+        records = perf_ledger.load_records(stream)
+    except ValueError as e:
+        print(f"{stream}: {e}", file=sys.stderr)
+        return 1
+    rc = 0
+    for e in metrics_lint.validate_stream(records):
+        print(f"{stream}: {e}", file=sys.stderr)
+        rc = 1
+    if not any(isinstance(r, dict)
+               and r.get("record") == "overhead_summary"
+               for r in records):
+        print(f"{stream}: no overhead_summary record (was the run "
+              "armed with --tick-profile?)", file=sys.stderr)
+        rc = 1
+    for e in perf_ledger.consistency_errors(records):
+        print(f"{stream}: {e}", file=sys.stderr)
+        rc = 1
+    snap = perf_ledger.snapshot(records, stream)
+    if snap is None:
+        print(f"{stream}: no serve_summary/run_summary/fleet_summary "
+              "— not a perf stream", file=sys.stderr)
+        return 1
+    if baseline_path:
+        with open(baseline_path) as fh:
+            baseline = json.load(fh)
+        # One stream per gate call: hold it only to ITS kind's slice
+        # of the baseline (the other kinds are other --perf-stream
+        # invocations).
+        sub = {"streams": {k: v
+                           for k, v in baseline.get("streams",
+                                                    {}).items()
+                           if k == snap["kind"]}}
+        for f in perf_ledger.compare([snap], sub):
+            print(f"{stream}: {f}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="one command for every static CI gate")
@@ -486,6 +554,18 @@ def main(argv=None) -> int:
                          "window/breach/summary agreement, and the "
                          "sketch-vs-exact relative-error bound "
                          "(repeatable)")
+    ap.add_argument("--perf-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a --tick-profile-armed telemetry stream to "
+                         "run the perf gate over: schema-v15 "
+                         "validation, an overhead_summary present, "
+                         "and perf_ledger's consistency checks — "
+                         "phase components sum to wall within 1%%, "
+                         "gap/fraction/totals agree (repeatable)")
+    ap.add_argument("--perf-baseline", default=None, metavar="JSON",
+                    help="PERF_BASELINE.json to additionally diff "
+                         "every --perf-stream snapshot against "
+                         "(per-metric noise bands)")
     ap.add_argument("--quant-compression-min", type=float, default=1.9,
                     metavar="X",
                     help="KV compression ratio the --quant-stream gate "
@@ -547,6 +627,21 @@ def main(argv=None) -> int:
             return 2
         rc = _slo_gate(stream)
         print(f"ci_gate: slo gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
+
+    if args.perf_stream and args.perf_baseline \
+            and not os.path.isfile(args.perf_baseline):
+        print(f"ci_gate: no such baseline: {args.perf_baseline}",
+              file=sys.stderr)
+        return 2
+    for stream in args.perf_stream:
+        if not os.path.isfile(stream):
+            print(f"ci_gate: no such stream: {stream}",
+                  file=sys.stderr)
+            return 2
+        rc = _perf_gate(stream, args.perf_baseline)
+        print(f"ci_gate: perf gate {stream}: "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         worst = max(worst, rc)
 

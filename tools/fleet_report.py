@@ -318,6 +318,24 @@ def analyze_fleet(records: List[dict], skew_factor: float,
               f"(> {skew_factor}x) — one replica is soaking the "
               "fleet", file=out)
 
+    # v15 hot-path attribution (ISSUE 17): replicas armed with
+    # --tick-profile advertise their host-overhead fraction on every
+    # heartbeat; name the worst one so a fleet-wide perf question
+    # ("who is burning host time?") has a one-line answer.  Pre-v15
+    # streams carry no fraction and skip the line.
+    fracs: Dict[str, float] = {}
+    for rec in states:
+        f = rec.get("host_overhead_frac")
+        if isinstance(f, (int, float)) and not isinstance(f, bool):
+            name = rec.get("replica", "?")
+            if name not in fracs or f > fracs[name]:
+                fracs[name] = float(f)
+    if fracs:
+        worst = max(fracs, key=lambda n: fracs[n])
+        print(f"host overhead: worst replica {worst} at "
+              f"{fracs[worst]:.4f} "
+              f"({len(fracs)} replica(s) reporting)", file=out)
+
     # Lifecycle anomalies the router recorded (crash/stall transitions
     # carry the supervisor's v10 exit classification when known).
     for rec in states:

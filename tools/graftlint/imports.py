@@ -54,6 +54,7 @@ CONTRACT_FILES = (
     "apex_example_tpu/resilience/supervisor.py",
     "apex_example_tpu/obs/schema.py",
     "apex_example_tpu/obs/slo.py",
+    "apex_example_tpu/obs/tickprof.py",
     "apex_example_tpu/fleet/replica.py",
     "apex_example_tpu/fleet/router.py",
     "apex_example_tpu/fleet/scenarios.py",

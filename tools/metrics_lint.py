@@ -58,7 +58,17 @@ records the moment a window burns past 1.0, ``fleet_rollup`` records
 merging the replicas' heartbeat sketches — ``replica_state`` gains
 ``slo_sketch``, ``serve_summary`` gains the ``slo`` verdict dict, and
 ``fleet_summary`` gains the flat ``slo_verdict``/``slo_windows``/
-``slo_breaches``/``slo_worst_burn`` fields) all validate alongside v1
+``slo_breaches``/``slo_worst_burn`` fields) and v15 streams (the
+hot-path overhead stratum from --tick-profile runs: sampled
+``tick_profile`` records carrying the per-tick phase decomposition —
+serve ticks into admit / dispatch_enqueue / device_wait / harvest /
+spool_io / telemetry, train steps into data_wait / dispatch / device /
+checkpoint / telemetry — plus the closing ``overhead_summary`` with
+per-phase sketch summaries, ``host_gap_ms`` and the
+``host_overhead_frac`` perf_ledger gates on; ``serve_summary`` gains
+the idle-spin counters ``idle_ticks``/``idle_wait_ms`` and
+``host_overhead_frac``, and ``replica_state`` heartbeats gain
+``host_overhead_frac``) all validate alongside v1
 streams — each version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort

@@ -1,0 +1,443 @@
+#!/usr/bin/env python
+"""perf_ledger: normalized perf snapshots + the regression baseline.
+
+    python tools/perf_ledger.py serve.jsonl
+    python tools/perf_ledger.py serve.jsonl train.jsonl --json
+    python tools/perf_ledger.py serve.jsonl train.jsonl \
+        --write-baseline PERF_BASELINE.json
+    python tools/perf_ledger.py serve.jsonl train.jsonl \
+        --compare PERF_BASELINE.json
+
+The BENCH trajectory's missing ledger (ISSUE 17): ingest any serve /
+train / fleet telemetry stream into a flat, normalized perf snapshot —
+tokens/tick, throughput, TPOT, the per-phase tick decomposition from
+``--tick-profile`` runs (ms/tick per phase: what each tick-millisecond
+was spent on) and the ``host_overhead_frac`` ROADMAP item 5 will be
+judged on — then diff it against a checked-in ``PERF_BASELINE.json``
+with per-metric noise bands.  ``ci_gate --perf-stream`` wires this
+into CI, so a perf claim is a regression-tested number instead of a
+README sentence.
+
+Consistency checks (always on, independent of any baseline): every
+``tick_profile`` record's phase components must sum to its wall time
+within 1%, and every ``overhead_summary`` must be self-consistent —
+``host_gap_ms == wall_ms - device_ms``, ``host_overhead_frac ==
+host_gap_ms / wall_ms``, the device phase's cumulative total must
+match ``device_ms``, and the per-phase totals must sum to ``wall_ms``
+within 1%.  An edited host fraction (the tamper fixture) fails here
+no matter how wide the noise bands are.
+
+Baseline shape::
+
+    {"schema": 15,
+     "streams": {"serve": {"source": "serve_perf.jsonl",
+                           "metrics": {"tokens_per_tick":
+                                       {"value": 3.2, "noise_pct": 2.0},
+                                       ...}}}}
+
+``--write-baseline`` derives one from the given streams with default
+noise bands (exact for counters, tight for structural ratios, wide for
+wall-clock-derived numbers); ``--compare`` re-snapshots the streams
+and demands every baseline metric within its band.  Millisecond-scale
+metrics additionally get a 0.1 ms absolute floor — a relative band on
+a sub-0.1ms phase flags scheduler jitter, not regressions.  Comparing the
+checked-in fixtures against the baseline derived from them is exact,
+so the gate is deterministic at HEAD.
+
+Exit status: 0 clean; 1 consistency violation or baseline regression;
+2 unusable input (missing/corrupt stream or baseline).
+
+Thin-client contract: NO jax import, direct or transitive — the phase
+vocabulary comes from obs/tickprof.py loaded by FILE PATH (the
+metrics_lint pattern), so this runs on the bare CI host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _load_tickprof():
+    """obs/tickprof.py by file path: the phase vocabulary's single
+    source of truth, without the jax-carrying package __init__."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "apex_example_tpu", "obs", "tickprof.py")
+    spec = importlib.util.spec_from_file_location("_ledger_tickprof",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_tickprof = _load_tickprof()
+DEVICE_PHASE = _tickprof.DEVICE_PHASE
+
+# Components must sum to wall within this relative tolerance (the
+# ISSUE 17 acceptance bound), with a small absolute slack for
+# sub-millisecond ticks where float noise dominates.
+SUM_TOL_REL = 0.01
+SUM_TOL_ABS_MS = 1e-6
+FRAC_TOL = 1e-3
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """Parsed records, or raises ValueError naming the bad line."""
+    records = []
+    with open(path) as fh:
+        for n, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"line {n + 1}: not JSON ({e})")
+    return records
+
+
+# ------------------------------------------------------- consistency
+
+def consistency_errors(records: List[Dict[str, Any]]) -> List[str]:
+    """The tamper gate: internal agreement of every tick_profile and
+    overhead_summary record (empty list == consistent)."""
+    errors: List[str] = []
+    for i, r in enumerate(records):
+        if not isinstance(r, dict):
+            continue
+        kind = r.get("record")
+        if kind == "tick_profile":
+            errors.extend(_tick_errors(i, r))
+        elif kind == "overhead_summary":
+            errors.extend(_summary_errors(i, r))
+    return errors
+
+
+def _tol(wall_ms: float) -> float:
+    return max(SUM_TOL_REL * abs(wall_ms), SUM_TOL_ABS_MS)
+
+
+def _tick_errors(i: int, r: Dict[str, Any]) -> List[str]:
+    out: List[str] = []
+    wall = r.get("wall_ms", 0.0)
+    phases = r.get("phases")
+    if not isinstance(phases, dict):
+        return [f"record {i + 1}: tick_profile without a phases dict"]
+    total = sum(v for v in phases.values()
+                if isinstance(v, (int, float)))
+    if abs(total - wall) > _tol(wall):
+        out.append(f"record {i + 1}: tick_profile tick "
+                   f"{r.get('tick')}: phases sum {total:.4f} ms vs "
+                   f"wall {wall:.4f} ms — components must sum to wall "
+                   f"within {SUM_TOL_REL:.0%}")
+    dev = phases.get(DEVICE_PHASE.get(r.get("kind", ""), ""), 0.0)
+    if not isinstance(dev, (int, float)) or isinstance(dev, bool):
+        return out + [f"record {i + 1}: tick_profile device phase is "
+                      "not a number (malformed phases dict)"]
+    gap = r.get("host_gap_ms", 0.0)
+    if abs(gap - (wall - dev)) > _tol(wall):
+        out.append(f"record {i + 1}: tick_profile tick "
+                   f"{r.get('tick')}: host_gap_ms {gap:.4f} != wall "
+                   f"{wall:.4f} - device {dev:.4f}")
+    return out
+
+
+def _summary_errors(i: int, r: Dict[str, Any]) -> List[str]:
+    out: List[str] = []
+    wall = r.get("wall_ms", 0.0)
+    device = r.get("device_ms", 0.0)
+    gap = r.get("host_gap_ms", 0.0)
+    frac = r.get("host_overhead_frac", 0.0)
+    phases = r.get("phases")
+    if abs(gap - (wall - device)) > _tol(wall):
+        out.append(f"record {i + 1}: overhead_summary host_gap_ms "
+                   f"{gap:.4f} != wall_ms {wall:.4f} - device_ms "
+                   f"{device:.4f}")
+    if wall > 0 and abs(frac - gap / wall) > FRAC_TOL:
+        out.append(f"record {i + 1}: overhead_summary "
+                   f"host_overhead_frac {frac:.6f} != host_gap_ms / "
+                   f"wall_ms = {gap / wall:.6f} — tampered or "
+                   "mis-folded")
+    if isinstance(phases, dict):
+        total = sum(p.get("total_ms", 0.0) for p in phases.values()
+                    if isinstance(p, dict))
+        if abs(total - wall) > _tol(wall):
+            out.append(f"record {i + 1}: overhead_summary phase "
+                       f"totals sum {total:.4f} ms vs wall_ms "
+                       f"{wall:.4f} — components must sum to wall "
+                       f"within {SUM_TOL_REL:.0%}")
+        devp = phases.get(DEVICE_PHASE.get(r.get("kind", ""), ""))
+        if isinstance(devp, dict) \
+                and abs(devp.get("total_ms", 0.0) - device) > _tol(wall):
+            out.append(f"record {i + 1}: overhead_summary device_ms "
+                       f"{device:.4f} != device phase total "
+                       f"{devp.get('total_ms', 0.0):.4f}")
+    return out
+
+
+# ---------------------------------------------------------- snapshot
+
+def _find(records, kind) -> Optional[Dict[str, Any]]:
+    found = [r for r in records if isinstance(r, dict)
+             and r.get("record") == kind]
+    return found[-1] if found else None
+
+
+def snapshot(records: List[Dict[str, Any]],
+             source: str) -> Optional[Dict[str, Any]]:
+    """One stream -> {"kind", "source", "metrics": {flat scalars}};
+    None when the stream carries no recognizable summary."""
+    fleet = _find(records, "fleet_summary")
+    serve = _find(records, "serve_summary")
+    train = _find(records, "run_summary")
+    overhead = _find(records, "overhead_summary")
+    metrics: Dict[str, float] = {}
+    if fleet is not None:
+        kind = "fleet"
+        metrics["replicas"] = fleet.get("replicas", 0)
+        metrics["requests"] = fleet.get("requests", 0)
+        metrics["availability"] = fleet.get("availability", 0.0)
+        worst = worst_overhead_replica(records)
+        if worst is not None:
+            metrics["worst_host_overhead_frac"] = worst[1]
+    elif serve is not None:
+        kind = "serve"
+        metrics["requests"] = serve.get("requests", 0)
+        metrics["output_tokens"] = serve.get("output_tokens", 0)
+        metrics["compute_steps"] = serve.get("compute_steps", 0)
+        metrics["tokens_per_sec"] = serve.get("tokens_per_sec", 0.0)
+        if serve.get("compute_steps"):
+            metrics["tokens_per_tick"] = round(
+                serve["output_tokens"] / serve["compute_steps"], 4)
+        if isinstance(serve.get("tpot_ms"), dict):
+            metrics["tpot_p50_ms"] = serve["tpot_ms"].get("p50", 0.0)
+        if isinstance(serve.get("ttft_ms"), dict):
+            metrics["ttft_p50_ms"] = serve["ttft_ms"].get("p50", 0.0)
+        if "availability" in serve:
+            metrics["availability"] = serve["availability"]
+        if "idle_ticks" in serve:
+            metrics["idle_ticks"] = serve["idle_ticks"]
+        if "idle_wait_ms" in serve:
+            metrics["idle_wait_ms"] = serve["idle_wait_ms"]
+    elif train is not None or (overhead is not None
+                               and overhead.get("kind") == "train"):
+        kind = "train"
+        if train is not None:
+            metrics["steps"] = train.get("steps", 0)
+            if "items_per_sec" in train:
+                metrics["items_per_sec"] = train["items_per_sec"]
+            if "steady_step_ms" in train:
+                metrics["steady_step_ms"] = train["steady_step_ms"]
+    else:
+        return None
+    if overhead is not None:
+        metrics["ticks"] = overhead.get("ticks", 0)
+        metrics["host_overhead_frac"] = overhead.get(
+            "host_overhead_frac", 0.0)
+        ticks = overhead.get("ticks") or 0
+        if ticks:
+            # The TPOT decomposition: mean milliseconds each phase
+            # contributes to one tick — what each tick-ms was spent on.
+            metrics["wall_ms_per_tick"] = round(
+                overhead.get("wall_ms", 0.0) / ticks, 4)
+            metrics["host_gap_ms_per_tick"] = round(
+                overhead.get("host_gap_ms", 0.0) / ticks, 4)
+            phases = overhead.get("phases")
+            if isinstance(phases, dict):
+                for name, p in sorted(phases.items()):
+                    if isinstance(p, dict):
+                        metrics[f"phase_{name}_ms_per_tick"] = round(
+                            p.get("total_ms", 0.0) / ticks, 4)
+    return {"kind": kind, "source": os.path.basename(source),
+            "metrics": metrics}
+
+
+def worst_overhead_replica(records) -> Optional[tuple]:
+    """(replica, frac) with the highest advertised host_overhead_frac
+    across replica_state heartbeats; None when no heartbeat carries
+    one.  Shared with fleet_report."""
+    best: Optional[tuple] = None
+    for r in records:
+        if not isinstance(r, dict) \
+                or r.get("record") != "replica_state":
+            continue
+        frac = r.get("host_overhead_frac")
+        if isinstance(frac, (int, float)) and not isinstance(frac, bool):
+            if best is None or frac > best[1]:
+                best = (r.get("replica", "?"), float(frac))
+    return best
+
+
+# ---------------------------------------------------------- baseline
+
+def default_noise_pct(name: str) -> float:
+    """Per-metric noise band: counters are exact, structural ratios
+    tight, wall-clock-derived numbers wide (a CI host's clock is not a
+    benchmark rig)."""
+    if name in ("requests", "output_tokens", "compute_steps", "steps",
+                "ticks", "replicas", "idle_ticks"):
+        return 0.0
+    if name.endswith("_frac") or name == "availability":
+        return 10.0
+    if name == "tokens_per_tick":
+        return 2.0
+    return 50.0
+
+
+# Absolute noise floor for millisecond-scale metrics.  A relative band
+# alone is meaningless on a sub-0.1ms phase (device_wait on the CPU rig
+# sits at ~0.03 ms/tick): doubling it is pure scheduler jitter on a
+# loaded host, not a regression.  Counters, fracs and rates keep the
+# purely relative band.
+ABS_FLOOR_MS = 0.1
+
+
+def _abs_floor(name: str) -> float:
+    return ABS_FLOOR_MS if name.endswith("_ms_per_tick") or \
+        name.endswith("_ms") else 0.0
+
+
+def make_baseline(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+    streams: Dict[str, Any] = {}
+    for snap in snapshots:
+        streams[snap["kind"]] = {
+            "source": snap["source"],
+            "metrics": {
+                name: {"value": value,
+                       "noise_pct": default_noise_pct(name)}
+                for name, value in sorted(snap["metrics"].items())
+            },
+        }
+    return {"schema": 15, "streams": streams}
+
+
+def compare(snapshots: List[Dict[str, Any]],
+            baseline: Dict[str, Any]) -> List[str]:
+    """Regressions of ``snapshots`` against ``baseline`` (empty list ==
+    within every band).  Every baseline stream kind must be present and
+    every baseline metric within value +- noise_pct%."""
+    failures: List[str] = []
+    by_kind = {s["kind"]: s for s in snapshots}
+    for kind, spec in sorted(baseline.get("streams", {}).items()):
+        snap = by_kind.get(kind)
+        if snap is None:
+            failures.append(f"{kind}: baseline stream kind missing "
+                            "from the given streams")
+            continue
+        for name, m in sorted(spec.get("metrics", {}).items()):
+            base, band = m.get("value"), m.get("noise_pct", 0.0)
+            got = snap["metrics"].get(name)
+            if got is None:
+                failures.append(f"{kind}: metric {name!r} missing "
+                                f"(baseline {base})")
+                continue
+            tol = abs(base) * band / 100.0 + _abs_floor(name) + 1e-9
+            if abs(got - base) > tol:
+                failures.append(
+                    f"{kind}: {name} = {got} vs baseline {base} "
+                    f"(noise band {band}%) — regression")
+    return failures
+
+
+# --------------------------------------------------------------- cli
+
+def _print_snapshot(snap: Dict[str, Any]) -> None:
+    m = snap["metrics"]
+    head = f"perf_ledger: {snap['kind']} {snap['source']}:"
+    parts = []
+    for key in ("tokens_per_tick", "tokens_per_sec", "items_per_sec",
+                "tpot_p50_ms", "steady_step_ms", "availability",
+                "host_overhead_frac", "worst_host_overhead_frac"):
+        if key in m:
+            parts.append(f"{key}={m[key]}")
+    print(head + " " + "  ".join(parts) if parts else head)
+    decomp = {k: v for k, v in sorted(m.items())
+              if k.startswith("phase_")}
+    if decomp:
+        inner = "  ".join(
+            f"{k[len('phase_'):-len('_ms_per_tick')]}={v}"
+            for k, v in decomp.items())
+        print(f"  decomposition (ms/tick): {inner}  "
+              f"wall={m.get('wall_ms_per_tick')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="normalized perf snapshots + regression baseline")
+    ap.add_argument("streams", nargs="+", metavar="JSONL",
+                    help="serve/train/fleet telemetry stream(s)")
+    ap.add_argument("--compare", default=None, metavar="BASELINE",
+                    help="diff the snapshots against this "
+                         "PERF_BASELINE.json (exit 1 outside any "
+                         "noise band)")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="write a baseline derived from the given "
+                         "streams (default noise bands)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the snapshots as JSON instead of the "
+                         "report lines")
+    args = ap.parse_args(argv)
+
+    snapshots = []
+    rc = 0
+    for path in args.streams:
+        if not os.path.isfile(path):
+            print(f"perf_ledger: no such stream: {path}",
+                  file=sys.stderr)
+            return 2
+        try:
+            records = load_records(path)
+        except ValueError as e:
+            print(f"perf_ledger: {path}: {e}", file=sys.stderr)
+            return 2
+        for e in consistency_errors(records):
+            print(f"perf_ledger: {path}: {e}", file=sys.stderr)
+            rc = 1
+        snap = snapshot(records, path)
+        if snap is None:
+            print(f"perf_ledger: {path}: no serve_summary/run_summary/"
+                  "fleet_summary — not a perf stream", file=sys.stderr)
+            return 2
+        snapshots.append(snap)
+
+    if args.json:
+        print(json.dumps(snapshots, indent=2, sort_keys=True))
+    else:
+        for snap in snapshots:
+            _print_snapshot(snap)
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as fh:
+            json.dump(make_baseline(snapshots), fh, indent=2,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"perf_ledger: baseline written to "
+              f"{args.write_baseline}")
+
+    if args.compare:
+        if not os.path.isfile(args.compare):
+            print(f"perf_ledger: no such baseline: {args.compare}",
+                  file=sys.stderr)
+            return 2
+        try:
+            with open(args.compare) as fh:
+                baseline = json.load(fh)
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"perf_ledger: {args.compare}: {e}", file=sys.stderr)
+            return 2
+        failures = compare(snapshots, baseline)
+        for f in failures:
+            print(f"perf_ledger: {f}", file=sys.stderr)
+        if failures:
+            rc = 1
+        print(f"perf_ledger: compare vs {args.compare}: "
+              f"{'PASS' if not failures else 'FAIL'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

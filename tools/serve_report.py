@@ -39,6 +39,13 @@ that dominates the p99-latency request.  Derived from the
 traced stream additionally surfaces the loadgen->queue handoff span
 (``Request.t_submit``) as its own component.
 
+Schema v15 adds the OVERHEAD lines (hot-path attribution, ISSUE 17):
+on a ``--tick-profile`` stream, the host-overhead fraction and the
+per-phase p50/p99 tick decomposition (admit / dispatch_enqueue /
+device_wait / harvest / spool_io / telemetry) from the stream's
+``overhead_summary``, plus the idle-spin accounting the summary now
+carries.  Pre-v15 streams degrade gracefully (no line).
+
 Thin client of the obs schema (obs/schema.py):
 
     python tools/serve_report.py serve.jsonl
@@ -337,6 +344,33 @@ def report(path: str, out=sys.stdout) -> int:
         if "availability" in summary:
             print(f"serve_summary availability: "
                   f"{summary['availability']}", file=out)
+        # schema v15 OVERHEAD lines (ISSUE 17), only when the run was
+        # armed with --tick-profile: the host/device decomposition of
+        # the serve tick — per-phase p50/p99 from the profiler's
+        # online sketches and the host-overhead fraction (wall minus
+        # device-wait, over wall).  Pre-v15 streams simply carry no
+        # overhead_summary and skip this block.
+        overhead = next((r for r in records
+                         if r.get("record") == "overhead_summary"),
+                        None)
+        if overhead is not None:
+            wall = overhead.get("wall", {})
+            print(f"OVERHEAD: host_overhead_frac "
+                  f"{overhead.get('host_overhead_frac', 0.0):.4f}  "
+                  f"(host_gap {overhead.get('host_gap_ms', 0.0):.1f} ms"
+                  f" of {overhead.get('wall_ms', 0.0):.1f} ms wall over"
+                  f" {overhead.get('ticks', 0)} tick(s), wall p50 "
+                  f"{wall.get('p50', 0.0):.2f} ms)", file=out)
+            phases = overhead.get("phases") or {}
+            parts = "  ".join(
+                f"{name} {p.get('p50', 0.0):.2f}/{p.get('p99', 0.0):.2f}"
+                for name, p in phases.items() if isinstance(p, dict))
+            if parts:
+                print(f"  phases (p50/p99 ms): {parts}", file=out)
+        if "idle_ticks" in summary:
+            print(f"idle: {summary['idle_ticks']} idle tick(s), "
+                  f"{summary.get('idle_wait_ms', 0.0)} ms waited",
+                  file=out)
         if summary.get("aborted"):
             print(f"ABORTED RUN: {summary.get('abort_reason', '?')}",
                   file=out)

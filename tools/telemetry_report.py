@@ -29,7 +29,10 @@ SLO stratum (schema v14): an SLO line (windows scored, breaches, burn
 verdict) when the run was armed with ``--slo``; a stream that ENDS on
 a breaching ``slo_window`` without a summary is flagged as BREACHED,
 never read as healthy (tools/slo_report.py renders the window
-timeline and burn trajectory).
+timeline and burn trajectory) — and the hot-path stratum (schema
+v15): an OVERHEAD line (host-overhead fraction, per-phase p50/p99
+tick decomposition) when the run was armed with ``--tick-profile``
+(tools/perf_ledger.py turns it into the regression snapshot).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -272,6 +275,27 @@ def report(path: str, out=sys.stdout) -> int:
               f"{len(slo_breaches)} breach(es), verdict {verdict}"
               "  (tools/slo_report.py for the burn trajectory)",
               file=out)
+    overheads = [r for r in records
+                 if r.get("record") == "overhead_summary"]
+    if overheads:
+        # Schema v15 (--tick-profile): the hot-path decomposition —
+        # host-overhead fraction plus per-phase p50/p99 from the
+        # profiler's online sketches.  tools/perf_ledger.py turns this
+        # into the regression snapshot; pre-v15 streams carry no
+        # overhead_summary and skip the line.
+        ov = overheads[-1]
+        print(f"OVERHEAD: kind {ov.get('kind', '?')}  "
+              f"host_overhead_frac "
+              f"{ov.get('host_overhead_frac', 0.0):.4f}  "
+              f"(host_gap {ov.get('host_gap_ms', 0.0):.1f} ms of "
+              f"{ov.get('wall_ms', 0.0):.1f} ms wall over "
+              f"{ov.get('ticks', 0)} tick(s))", file=out)
+        parts = "  ".join(
+            f"{name} {p.get('p50', 0.0):.2f}/{p.get('p99', 0.0):.2f}"
+            for name, p in (ov.get("phases") or {}).items()
+            if isinstance(p, dict))
+        if parts:
+            print(f"  phases (p50/p99 ms): {parts}", file=out)
     if not steps:
         if is_fleet_stream:
             return 0 if fleet_summaries else 1

@@ -14,6 +14,10 @@ JSON — and structurally lint them (``--check``).
     # overlay the device-side xprof trace on the same wall-clock axis
     python tools/trace_export.py train.jsonl --xprof /tmp/xprof -o t.json
 
+    # a --trace + --tick-profile stream additionally renders the
+    # sampled host_gap_ms as a counter track (ph "C") on the stream's
+    # process row — the hot-path overhead at a glance (schema v15)
+
     # a disaggregated pair (schema v12): the prefill worker's request
     # span joins its decode-worker continuation with a cross-stream
     # flow arrow keyed on the handoff uid (cat "handoff")
@@ -327,6 +331,24 @@ def export(streams: List[Tuple[str, List[Dict[str, Any]]]],
                         "pid": pid, "tid": ev["tid"],
                         "ts": ev["ts"],
                         "end": ev["ts"] + ev.get("dur", 0.0)})
+        # Host-gap counter track (schema v15): every sampled
+        # tick_profile record lands as a Chrome counter sample, so the
+        # Perfetto view carries the host-side overhead gap as its own
+        # track under this stream's process row — the dispatch-gap
+        # view the ISSUE 17 decomposition exists for.  tick_profile
+        # ``ts`` is the same perf_counter domain as trace_event, so
+        # the clock_sync anchor places the samples correctly.
+        for r in records:
+            if r.get("record") != "tick_profile":
+                continue
+            ts = r.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            out.append({"ph": "C", "name": "host_gap_ms", "pid": pid,
+                        "tid": 0, "ts": us(ts),
+                        "args": {"host_gap_ms":
+                                 round(r.get("host_gap_ms", 0.0), 4)}})
+
         # Request admissions as flows: an arrow from the engine row to
         # the request row at the moment its queued span ends (= slot
         # admission), binding the scheduler's timeline to the request's.

@@ -236,6 +236,28 @@ def parse_args(argv=None):
                         "timeline exportable to Perfetto via "
                         "tools/trace_export.py; histograms and stdout "
                         "unchanged (README 'Request tracing')")
+    p.add_argument("--tick-profile", action="store_true",
+                   help="with --metrics-jsonl: arm the hot-path step "
+                        "profiler (obs/tickprof.py, ISSUE 17) — every "
+                        "image-loop training step decomposes into "
+                        "data_wait / dispatch / device (an explicit "
+                        "block-until-ready boundary separating enqueue "
+                        "cost from device execution) / telemetry / "
+                        "checkpoint, folded into online quantile "
+                        "sketches; every Nth step emits a schema-v15 "
+                        "tick_profile record and the run closes with an "
+                        "overhead_summary (host_gap_ms, per-phase "
+                        "percentiles, host_overhead_frac — what "
+                        "tools/perf_ledger.py regression-gates).  The "
+                        "boundary sync trades host/device overlap for "
+                        "attribution, so keep it off for BENCH numbers; "
+                        "LM loops are not decomposed (README 'Hot-path "
+                        "profiling')")
+    p.add_argument("--tick-profile-every", type=int, default=16,
+                   metavar="N",
+                   help="emit a tick_profile record every N steps "
+                        "(default 16; the cumulative overhead_summary "
+                        "always folds EVERY step)")
     # diagnostics stratum (obs/flight.py, obs/watchdog.py, obs/numerics.py;
     # README "Diagnostics") — all write to the --metrics-jsonl sink
     p.add_argument("--flight-recorder", action="store_true",
@@ -383,6 +405,28 @@ def make_telemetry(args):
             emitter.add_observer(monitor.on_record)
     return emitter, make_profiler_window(args.profile_window or None), \
         recorder, watchdog
+
+
+def make_tickprof(args, emitter):
+    """--tick-profile wiring (ISSUE 17): the hot-path step profiler,
+    sharing the emitter's sink and run id.  The image loop feeds it one
+    data_wait/dispatch/device/telemetry/checkpoint decomposition per
+    step; arming it costs one block_until_ready per step at the
+    enqueue/device boundary — attribution in exchange for host/device
+    overlap (README 'Hot-path profiling')."""
+    if not getattr(args, "tick_profile", False):
+        return None
+    if emitter is None:
+        raise SystemExit("--tick-profile requires --metrics-jsonl (the "
+                         "tick_profile/overhead_summary records ride "
+                         "the metrics stream)")
+    if args.tick_profile_every < 1:
+        raise SystemExit(f"--tick-profile-every must be >= 1, got "
+                         f"{args.tick_profile_every}")
+    from apex_example_tpu.obs.tickprof import TickProfiler
+    return TickProfiler(kind="train",
+                        sample_every=args.tick_profile_every,
+                        emit=emitter.sink.write, run_id=emitter.run_id)
 
 
 def close_telemetry(emitter, profwin, recorder=None, watchdog=None):
@@ -745,6 +789,7 @@ def main(argv=None):
     writer = make_writer(args)
     tb = TensorBoardAdapter(writer)
     emitter, profwin, recorder, watchdog = make_telemetry(args)
+    tickprof = make_tickprof(args, emitter)
     preempt, fault = make_resilience(args, recorder)
     # --cost-model: re-route the step through the AOT path so its one
     # compilation is harvested (compile_event + cost_model records); a
@@ -809,16 +854,30 @@ def main(argv=None):
             for i in range(start_i if epoch == start_epoch else 0,
                            args.steps_per_epoch):
                 run_step += 1
+                t_tick_start = time.perf_counter() \
+                    if tickprof is not None else 0.0
                 if profwin is not None:
                     profwin.on_step_start(run_step)
                 with span("data"):
                     batch = batch_fn(global_step)
                 if fault is not None:
                     batch = fault.maybe_poison(global_step + 1, batch)
+                t_data_end = time.perf_counter() \
+                    if tickprof is not None else 0.0
                 t0 = time.perf_counter()
                 with span("step"):
                     state, metrics = step_fn(state, batch)
                     global_step += 1
+                    if tickprof is not None:
+                        # The dispatch/device boundary (ISSUE 17): the
+                        # jitted call has returned, its outputs may
+                        # still be computing — block HERE so enqueue
+                        # cost and device time separate.  Value-
+                        # preserving: on_step's metric fetch was about
+                        # to block on the same values anyway.
+                        t_enqueue_end = time.perf_counter()
+                        jax.block_until_ready((state, metrics))
+                        t_device_end = time.perf_counter()
                     if emitter is not None:
                         # Inside the span: the blocking metric fetch is
                         # part of what "step" means when telemetry is on
@@ -842,6 +901,8 @@ def main(argv=None):
                                 "train/top1": top1s.val,
                                 "train/img_per_sec": thr.rate},
                                global_step)
+                t_tel_end = time.perf_counter() \
+                    if tickprof is not None else 0.0
                 if args.save_every_steps and mgr is not None \
                         and is_main_process() \
                         and global_step % args.save_every_steps == 0:
@@ -851,6 +912,20 @@ def main(argv=None):
                                                             global_step))
                     last_saved = global_step
                     rank_print(f"saved checkpoint at step {global_step}")
+                if tickprof is not None:
+                    # Contiguous boundaries: the five phases telescope
+                    # to the measured wall (perf_ledger's 1% gate).
+                    # checkpoint covers the save-every-steps window and
+                    # is 0.0 on steps that skip it.
+                    t_tick_end = time.perf_counter()
+                    tickprof.observe_tick(
+                        t_tick_start,
+                        (t_tick_end - t_tick_start) * 1e3,
+                        data_wait=(t_data_end - t_tick_start) * 1e3,
+                        dispatch=(t_enqueue_end - t_data_end) * 1e3,
+                        device=(t_device_end - t_enqueue_end) * 1e3,
+                        telemetry=(t_tel_end - t_device_end) * 1e3,
+                        checkpoint=(t_tick_end - t_tel_end) * 1e3)
                 if fault is not None:
                     # After the step's telemetry AND any interval save
                     # landed: forensics hold the last good step, and a
@@ -898,6 +973,11 @@ def main(argv=None):
             return graceful_preempt_exit(args, mgr, state, preempt,
                                          emitter, global_step,
                                          last_saved=last_saved)
+        if tickprof is not None and tickprof.ticks:
+            # Clean-exit close: the cumulative overhead fold lands
+            # before close_telemetry's run_summary, so report tools
+            # find it ahead of the stream tail.
+            emitter.sink.write(tickprof.summary_record())
     finally:
         if preempt is not None:
             preempt.close()
@@ -1555,6 +1635,12 @@ def _lm_main_impl(args, policy, scaler):
     writer = make_writer(args)
     tb = TensorBoardAdapter(writer)
     emitter, profwin, recorder, watchdog = make_telemetry(args)
+    if getattr(args, "tick_profile", False):
+        # The LM builders end in jitted callables with workload-specific
+        # shapes (DDP shard_map, GSPMD TP, PP microbatching); the
+        # decomposition is wired into the image loop only.
+        rank_print("WARNING: --tick-profile instruments the image loop "
+                   "only; LM steps are not decomposed")
     preempt, fault = make_resilience(args, recorder)
     # --cost-model hookup: see the image loop.  One call site covers
     # every LM step builder above (single-device, DDP shard_map, GSPMD
