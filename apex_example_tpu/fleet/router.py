@@ -84,7 +84,7 @@ from typing import Any, Dict, List, Optional, Tuple
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) —
 # jax-free contract forbids importing it (same stance as the
 # supervisor's hard-coded records).
-SCHEMA = 15
+SCHEMA = 16
 TRACE_ID_ENV = "APEX_TRACE_ID"
 
 POLICIES = ("round_robin", "least_pending", "least_kv")

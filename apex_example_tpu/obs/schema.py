@@ -328,6 +328,21 @@ the worst-overhead replica).  Without ``--tick-profile`` only the idle
 counters are new; v15 is once more a strict superset: every v1–v14
 stream validates unchanged.
 
+Version 16 adds the speculative-decoding ledger on ``serve_summary``
+(apex_example_tpu/spec/; ``--speculate K`` on serve.py — README
+"Speculative decoding"): ``speculate_k`` / ``draft_kind`` name the
+armed configuration, ``tokens_drafted`` / ``tokens_accepted`` /
+``tokens_sampled`` count draft lanes fed, draft lanes verified-and-kept
+and model-sampled tokens (bonus lanes + plain-path samples), and
+``acceptance_rate`` / ``tokens_per_tick`` are the derived headline
+ratios (accepted/drafted; output_tokens/compute_steps — the decode-side
+metric that breaks the one-token-per-tick wall).  Conservation is
+checkable from the summary alone: ``tokens_accepted <= tokens_drafted``
+and ``output_tokens == tokens_accepted + tokens_sampled`` (ci_gate
+``--spec-stream``).  Emitted ONLY when speculation is armed — an
+unarmed run's stream is byte-identical to v15 output, and v16 is once
+more a strict superset: every v1–v15 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -339,7 +354,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 15
+SCHEMA_VERSION = 16
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -706,6 +721,18 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "idle_ticks": int,          # step() calls with nothing live
         "idle_wait_ms": _NUM,       # wall time slept between them
         "host_overhead_frac": _NUM,  # (wall - device) / wall, run-wide
+        # v16: the speculative-decoding ledger (spec/; --speculate K).
+        # Absent unless speculation armed — unarmed streams stay
+        # byte-identical to v15.  Conservation: accepted <= drafted and
+        # output_tokens == tokens_accepted + tokens_sampled.
+        "speculate_k": int,         # armed draft depth K
+        "draft_kind": str,          # proposer name (ngram | none | ...)
+        "tokens_drafted": int,      # draft lanes fed for verification
+        "tokens_accepted": int,     # draft lanes verified and kept
+        "tokens_sampled": int,      # model-sampled tokens (bonus lanes
+                                    #   + plain/sampled-path tokens)
+        "acceptance_rate": _NUM,    # accepted / drafted (0.0 if none)
+        "tokens_per_tick": _NUM,    # output_tokens / compute_steps
     },
     "preemption": {
         "run_id": str,

@@ -154,6 +154,52 @@ def _slot_step(dec, dequant_weights: bool = False):
     return step
 
 
+@functools.lru_cache(maxsize=8)
+def _slot_step_spec(dec, dequant_weights: bool = False):
+    """The speculative variant of _slot_step (ISSUE 18): identical
+    multi-lane dispatch — [SLOTS, C] tokens, per-slot n_new lane counts,
+    COW + scatter + live mask all inside the one program — plus two
+    extra outputs the accept/reject harvest needs host-side:
+
+      * ``lane_greedy`` [SLOTS, C]: argmax over every lane's logits.
+        Lane j's logits condition on lanes 0..j (causal live mask), so
+        lane_greedy[s, j] is the model's greedy continuation after the
+        j-th fed token — comparing it against the NEXT draft lane is
+        the whole accept rule, and it reuses the same all-lane logits
+        the chunked-prefill path already computes and discards.
+      * ``lane_finite`` [SLOTS, C]: per-lane logits-finiteness, so NaN
+        fallout in ANY verified lane poisons the slot, not just the
+        last one.
+
+    ``nxt`` still samples from the last REAL lane exactly like
+    _slot_step, so sampled-temperature slots riding in the same batch
+    behave token-identically to the plain path.  Cached per (module
+    config, dequant flag): arming --speculate K builds exactly ONE new
+    program for the [SLOTS, max(BS, K+1)] geometry."""
+
+    @jax.jit
+    def step(params, cache, tok, block_table, fill, n_new, cow_src,
+             cow_dst, rng, temperature, top_k):
+        if dequant_weights:
+            from apex_example_tpu.quant import weights as _qw
+            params = _qw.dequantize_tree(params)
+        paged = {"block_table": block_table, "fill": fill, "n_new": n_new,
+                 "cow_src": cow_src, "cow_dst": cow_dst}
+        logits, mut = dec.apply({"params": params, "cache": cache}, tok,
+                                train=False, paged=paged,
+                                mutable=["cache"])
+        idx = jnp.clip(n_new - 1, 0, tok.shape[1] - 1)
+        last = jnp.take_along_axis(logits, idx[:, None, None],
+                                   axis=1)[:, 0]
+        nxt = sample_tokens(rng, last, temperature, top_k)
+        finite = jnp.all(jnp.isfinite(last), axis=-1)
+        lane_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lane_finite = jnp.all(jnp.isfinite(logits), axis=-1)
+        return mut["cache"], nxt, finite, lane_greedy, lane_finite
+
+    return step
+
+
 def _current_mesh():
     """The registered parallel_state mesh, or None when serving runs
     unsharded (no mesh, or every axis trivial)."""
@@ -297,7 +343,8 @@ class ServeEngine:
                  weight_quant: str = "none", role: str = "both",
                  handoff_sink=None, slo=None,
                  slo_window_s: Optional[float] = None,
-                 slo_window_ticks: int = 0, tick_profiler=None):
+                 slo_window_ticks: int = 0, tick_profiler=None,
+                 speculate: int = 0, proposer=None):
         if weight_quant not in ("none", "int8", "fp8"):
             raise ValueError(f"weight_quant must be none|int8|fp8, got "
                              f"{weight_quant!r}")
@@ -308,9 +355,19 @@ class ServeEngine:
             raise ValueError("a prefill-role engine needs a "
                              "handoff_sink to ship finished prefills to "
                              "(serve/disagg.py transports)")
+        if speculate < 0:
+            raise ValueError(f"speculate must be >= 0, got {speculate}")
+        if speculate and role != "both":
+            raise ValueError("--speculate needs the interleaved engine "
+                             "(role 'both'); disaggregated roles keep "
+                             "their own step geometries")
+        if speculate and speculate + 1 > max_len:
+            raise ValueError(f"speculate {speculate} exceeds max_len "
+                             f"{max_len} lanes")
         self.pool = BlockPool(model, num_slots, max_len,
                               block_size=block_size,
-                              num_blocks=num_blocks, kv_quant=kv_quant)
+                              num_blocks=num_blocks, kv_quant=kv_quant,
+                              spec_slack=speculate)
         # weight_quant names the mode ``params`` ALREADY carries (the
         # caller quantized at restore time — serve.py); the engine's
         # job is to dequantize inside the compiled step.
@@ -327,6 +384,23 @@ class ServeEngine:
         self.role = role
         self.handoff_sink = handoff_sink
         self.chunk = 1 if role == "decode" else self.pool.block_size
+        # Speculation (ISSUE 18): K draft tokens per greedy slot per
+        # tick, verified in ONE dispatch.  The step stays [SLOTS, C]
+        # with C = max(block_size, K+1): prefill chunks and draft lanes
+        # share the same static geometry, so arming --speculate K adds
+        # exactly one compiled program (serve_spec_step) regardless of
+        # acceptance behavior.  speculate == 0 leaves every line of the
+        # plain path untouched.
+        self.speculate = int(speculate)
+        self.proposer = proposer
+        if self.speculate and self.proposer is None:
+            from apex_example_tpu.spec import NgramProposer
+            self.proposer = NgramProposer()
+        if self.speculate:
+            self.chunk = max(self.chunk, self.speculate + 1)
+        self.tokens_drafted = 0
+        self.tokens_accepted = 0
+        self.tokens_sampled = 0
         self.handoffs_in = 0
         self.handoff_requeued = 0
         self._handoff_bytes = 0
@@ -381,11 +455,17 @@ class ServeEngine:
         # prefill role instruments under its own name: its program is
         # [SLOTS, block_size]-wide while the decode role's is
         # [SLOTS, 1]-wide — one program per role, each compiling once.
-        self._step_fn = costmodel_lib.instrument(
-            "serve_prefill_step" if role == "prefill"
-            else "serve_decode_step",
-            _slot_step(self.pool.dec,
-                       dequant_weights=weight_quant != "none"))
+        if self.speculate:
+            self._step_fn = costmodel_lib.instrument(
+                "serve_spec_step",
+                _slot_step_spec(self.pool.dec,
+                                dequant_weights=weight_quant != "none"))
+        else:
+            self._step_fn = costmodel_lib.instrument(
+                "serve_prefill_step" if role == "prefill"
+                else "serve_decode_step",
+                _slot_step(self.pool.dec,
+                           dequant_weights=weight_quant != "none"))
         self._t0 = time.perf_counter()
         self._tokens_out = 0
         self._occupancy_sum = 0
@@ -563,13 +643,25 @@ class ServeEngine:
         cow_dst = np.full((S,), -1, np.int32)
         temps = np.zeros((S,), np.float32)
         ks = np.zeros((S,), np.int32)
+        drafts: Dict[int, List[int]] = {}
         for i in live:
             slot = pool.slots[i]
             # Chunked prefill: up to one block of prompt tokens per
             # tick; decode feeds the single previously-sampled token.
             n = min(C, slot.n_prompt - slot.cursor) if slot.prefilling \
                 else 1
-            tok[i, :n] = slot.tokens[slot.cursor:slot.cursor + n]
+            if self.speculate and not slot.prefilling \
+                    and slot.request.temperature == 0:
+                # Speculative decode lanes: the last sampled token plus
+                # up to K host-drafted candidates, verified in the same
+                # dispatch.  Sampled-temperature slots keep the plain
+                # single-lane path — speculation is greedy-only.
+                draft = self._draft_for(slot)
+                drafts[i] = draft
+                n = 1 + len(draft)
+                tok[i, :n] = [slot.tokens[slot.cursor]] + draft
+            else:
+                tok[i, :n] = slot.tokens[slot.cursor:slot.cursor + n]
             fill[i] = slot.cursor
             n_new[i] = n
             # Map/COW the blocks this slot writes this tick (draws from
@@ -585,19 +677,24 @@ class ServeEngine:
             # so this costs nothing after the first call).
             from apex_example_tpu.ops import _config as ops_config
             with ops_config.force_xla():
-                pool.cache, nxt, finite = self._step_fn(
+                outs = self._step_fn(
                     self.params, pool.cache, jnp.asarray(tok),
                     jnp.asarray(pool.table), jnp.asarray(fill),
                     jnp.asarray(n_new), jnp.asarray(cow_src),
                     jnp.asarray(cow_dst), key,
                     jnp.asarray(temps), jnp.asarray(ks))
         else:
-            pool.cache, nxt, finite = self._step_fn(
+            outs = self._step_fn(
                 self.params, pool.cache, jnp.asarray(tok),
                 jnp.asarray(pool.table), jnp.asarray(fill),
                 jnp.asarray(n_new), jnp.asarray(cow_src),
                 jnp.asarray(cow_dst), key,
                 jnp.asarray(temps), jnp.asarray(ks))
+        lane_greedy = lane_finite = None
+        if self.speculate:
+            pool.cache, nxt, finite, lane_greedy, lane_finite = outs
+        else:
+            pool.cache, nxt, finite = outs
         t_enqueue_end = t_device_end = 0.0
         if prof is not None:
             # The dispatch/device boundary ISSUE 17 exists to draw:
@@ -609,11 +706,14 @@ class ServeEngine:
             # jax dispatch is synchronous, so device_wait reads ~0 and
             # the device time hides in dispatch_enqueue; see README.)
             t_enqueue_end = time.perf_counter()
-            jax.block_until_ready((pool.cache, nxt, finite))
+            jax.block_until_ready(outs)
             t_device_end = time.perf_counter()
             self._spool_ms = 0.0
         nxt = np.asarray(nxt)          # the scheduler's host sync
         finite = np.asarray(finite)
+        if self.speculate:
+            lane_greedy = np.asarray(lane_greedy)
+            lane_finite = np.asarray(lane_finite)
         now = time.perf_counter()
         t_dispatch_end = now
         if tracer is not None:
@@ -643,6 +743,11 @@ class ServeEngine:
                        >= slots[i].n_prompt for i in live):
                     fault.take()
                     nxt = np.full_like(nxt, -1)
+                    if lane_greedy is not None:
+                        # Speculative slots harvest from the verify
+                        # lanes, not nxt — poison those too so the
+                        # drill expresses under --speculate.
+                        lane_greedy = np.full_like(lane_greedy, -1)
             elif fault.kind == "slot_fail" and fault.due(tick1):
                 fault.take()
                 fail_slot = live[0]
@@ -655,37 +760,49 @@ class ServeEngine:
                 if i == fail_slot:
                     raise FaultInjected(
                         f"injected slot_fail at tick {tick1} (slot {i})")
-                pool.commit_writes(i, int(n_new[i]))
-                if tracer is not None and was_prefilling:
-                    # Buffer the chunk window (the tick's dispatch
-                    # span) on the request; its tree is emitted whole,
-                    # in timestamp order, at terminal time.
-                    self._rtrace.setdefault(
-                        slot.request.uid, []).append(
-                        (t_admit_end, t_dispatch_end, int(n_new[i]),
-                         int(cow_dst[i]) >= 0))
-                if slot.prefilling:
-                    continue           # prompt chunk fed; output discarded
-                out = int(nxt[i])
-                if not bool(finite[i]):
-                    raise SlotFailure(
-                        f"non-finite logits in slot {i} — NaN/Inf "
-                        "reached the sampled-token path (poisoned "
-                        "params or cache row)")
-                if not 0 <= out < self.vocab_size:
-                    raise SlotFailure(
-                        f"degenerate sampled token {out} (vocab "
-                        f"{self.vocab_size}) — poisoned sampling path")
-                if slot.n_generated == 0:
-                    slot.t_first_token = now
-                slot.tokens.append(out)
-                slot.n_generated += 1
-                self._tokens_out += 1
-                req = slot.request
-                if req.eos_id is not None and out == req.eos_id:
-                    reason = "eos"
-                elif slot.n_generated >= pool.max_new_for(req):
-                    reason = "length"
+                if i in drafts:
+                    # Speculative accept/reject harvest: appends the
+                    # accepted draft prefix + the bonus token from the
+                    # first mismatching lane, and commits only lanes
+                    # with canonical KV — rollback for rejected lanes
+                    # is the cursor simply not advancing past them.
+                    reason = self._harvest_spec(
+                        i, drafts[i], lane_greedy, lane_finite,
+                        int(n_new[i]), now)
+                else:
+                    pool.commit_writes(i, int(n_new[i]))
+                    if tracer is not None and was_prefilling:
+                        # Buffer the chunk window (the tick's dispatch
+                        # span) on the request; its tree is emitted
+                        # whole, in timestamp order, at terminal time.
+                        self._rtrace.setdefault(
+                            slot.request.uid, []).append(
+                            (t_admit_end, t_dispatch_end, int(n_new[i]),
+                             int(cow_dst[i]) >= 0))
+                    if slot.prefilling:
+                        continue       # prompt chunk fed; output discarded
+                    out = int(nxt[i])
+                    if not bool(finite[i]):
+                        raise SlotFailure(
+                            f"non-finite logits in slot {i} — NaN/Inf "
+                            "reached the sampled-token path (poisoned "
+                            "params or cache row)")
+                    if not 0 <= out < self.vocab_size:
+                        raise SlotFailure(
+                            f"degenerate sampled token {out} (vocab "
+                            f"{self.vocab_size}) — poisoned sampling "
+                            "path")
+                    if slot.n_generated == 0:
+                        slot.t_first_token = now
+                    slot.tokens.append(out)
+                    slot.n_generated += 1
+                    self._tokens_out += 1
+                    self.tokens_sampled += 1
+                    req = slot.request
+                    if req.eos_id is not None and out == req.eos_id:
+                        reason = "eos"
+                    elif slot.n_generated >= pool.max_new_for(req):
+                        reason = "length"
             except Exception as e:   # noqa: BLE001 — slot-level isolation
                 # One request's failure must not take down the batch: the
                 # other slots' caches and host state are untouched, so
@@ -762,6 +879,85 @@ class ServeEngine:
             # the training loops: forensics hold the last good tick).
             fault.maybe_fire(tick1)
         return True
+
+    # ------------------------------------------------------ speculation
+
+    def _draft_for(self, slot) -> List[int]:
+        """Ask the proposer for this tick's draft, clamped so staged KV
+        writes can never outrun the slot's logical budget: at most K
+        lanes, at most chunk-1 (the program's spare lane count), and at
+        most remaining-1 — the +1 bonus token of a fully-accepted draft
+        must still fit under max_new_for.  A proposer returning junk
+        (out-of-vocab ids) is truncated at the first bad token; draft
+        QUALITY can only cost throughput, never correctness."""
+        req = slot.request
+        remaining = self.pool.max_new_for(req) - slot.n_generated
+        k = min(self.speculate, remaining - 1, self.chunk - 1)
+        if k <= 0:
+            return []
+        draft = self.proposer.propose(req.uid, req.prompt,
+                                      slot.tokens[slot.n_prompt:], k)
+        out: List[int] = []
+        for t in list(draft)[:k]:
+            t = int(t)
+            if not 0 <= t < self.vocab_size:
+                break
+            out.append(t)
+        return out
+
+    def _harvest_spec(self, i: int, draft: List[int], lane_greedy,
+                      lane_finite, n: int, now: float) -> Optional[str]:
+        """Accept/reject harvest for one speculative slot.  The fed
+        lanes were [last_sampled, d0..d_{k-1}]; lane j's logits
+        condition on everything up to and including lane j, so
+        lane_greedy[j] is the model's greedy choice for the position
+        draft[j] claims.  Accept the longest matching prefix d0..d_{m-1}
+        plus the bonus token lane_greedy[m] (the model's own pick at the
+        first mismatch — or after a fully-accepted draft), walking
+        eos/length exactly as m+1 one-token ticks would have.  Commit
+        1 + kept-draft lanes: the bonus token has no KV yet (it is next
+        tick's lane 0), and rejected lanes' stale rows sit beyond the
+        cursor where the live mask hides them until overwritten."""
+        pool = self.pool
+        slot = pool.slots[i]
+        req = slot.request
+        lanes = lane_greedy[i]
+        if not bool(lane_finite[i, :n].all()):
+            raise SlotFailure(
+                f"non-finite logits in slot {i} — NaN/Inf reached a "
+                "speculative verify lane (poisoned params or cache "
+                "row)")
+        m = 0
+        while m < len(draft) and int(lanes[m]) == draft[m]:
+            m += 1
+        bonus = int(lanes[m])
+        if not 0 <= bonus < self.vocab_size:
+            raise SlotFailure(
+                f"degenerate greedy token {bonus} (vocab "
+                f"{self.vocab_size}) — poisoned sampling path")
+        self.tokens_drafted += len(draft)
+        if slot.n_generated == 0:
+            slot.t_first_token = now
+        reason = None
+        n_keep = 0
+        budget = pool.max_new_for(req)
+        for pos, t in enumerate(draft[:m] + [bonus]):
+            slot.tokens.append(t)
+            slot.n_generated += 1
+            self._tokens_out += 1
+            n_keep += 1
+            if pos < m:
+                self.tokens_accepted += 1
+            else:
+                self.tokens_sampled += 1
+            if req.eos_id is not None and t == req.eos_id:
+                reason = "eos"
+                break
+            if slot.n_generated >= budget:
+                reason = "length"
+                break
+        pool.commit_writes(i, 1 + min(n_keep, m))
+        return reason
 
     # ------------------------------------------------------- terminals
 
@@ -1262,6 +1458,25 @@ class ServeEngine:
         if self.tickprof is not None and self.tickprof.ticks:
             rec["host_overhead_frac"] = round(
                 self.tickprof.host_overhead_frac(), 6)
+        # v16 (ISSUE 18): the speculation ledger — emitted ONLY when
+        # --speculate armed the engine, so an unarmed stream stays
+        # byte-identical to pre-v16 output.  Conservation (ci_gate
+        # --spec-stream): tokens_accepted <= tokens_drafted, and
+        # output_tokens == tokens_accepted + tokens_sampled (every
+        # emitted token is either a verified draft lane or a model
+        # sample — the bonus lane and plain/sampled-path tokens).
+        if self.speculate:
+            rec["speculate_k"] = self.speculate
+            rec["draft_kind"] = getattr(self.proposer, "name", "custom")
+            rec["tokens_drafted"] = self.tokens_drafted
+            rec["tokens_accepted"] = self.tokens_accepted
+            rec["tokens_sampled"] = self.tokens_sampled
+            rec["acceptance_rate"] = round(
+                self.tokens_accepted / self.tokens_drafted, 4) \
+                if self.tokens_drafted else 0.0
+            if self.compute_steps:
+                rec["tokens_per_tick"] = round(
+                    self._tokens_out / self.compute_steps, 4)
         if self.run_id:
             rec["run_id"] = self.run_id
         return rec

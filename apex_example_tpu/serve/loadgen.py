@@ -57,7 +57,8 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
                        deadline_steps: Optional[int] = None,
                        deadline_s: Optional[float] = None,
                        shared_prefix: int = 0,
-                       seed_substream: Optional[int] = None
+                       seed_substream: Optional[int] = None,
+                       repetitive: bool = False
                        ) -> List[Request]:
     """``n`` requests with uniform prompt/output lengths in the given
     inclusive ranges; request i arrives at virtual step
@@ -77,7 +78,15 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
     its RandomState from ``substream(seed, i)`` instead of ``seed``
     directly, so N replicas sharing one base seed serve DISJOINT yet
     individually-deterministic workloads (``--seed-substream`` on
-    serve.py)."""
+    serve.py).
+
+    ``repetitive`` (ISSUE 18): templated prompts with self-repeating
+    spans — each request draws a short motif (3–6 tokens) from the same
+    RandomState and tiles it to the sampled prompt length, the
+    structured traffic shape (boilerplate templates, copy-through
+    fields) that makes prompt-lookup speculative drafting measurable.
+    Same substream machinery, so ``--repetitive`` workloads are exactly
+    as deterministic per (seed, substream) as the uniform ones."""
     if n < 1:
         raise ValueError(f"need n >= 1 requests, got {n}")
     if prompt_len[0] < 1 or prompt_len[0] > prompt_len[1]:
@@ -100,7 +109,15 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
     for i in range(n):
         p = int(rs.randint(prompt_len[0], prompt_len[1] + 1))
         m = int(rs.randint(max_new[0], max_new[1] + 1))
-        prompt = prefix + rs.randint(0, vocab_size, size=(p,)).tolist()
+        if repetitive:
+            motif_len = int(rs.randint(3, 7))
+            motif = rs.randint(0, vocab_size,
+                               size=(motif_len,)).tolist()
+            reps = -(-p // motif_len)           # ceil division
+            body = (motif * reps)[:p]
+        else:
+            body = rs.randint(0, vocab_size, size=(p,)).tolist()
+        prompt = prefix + body
         arrival = (i // burst) * stagger if stagger else None
         # Client-side submission stamp: the request is BUILT here, then
         # handed to the queue — a --trace timeline renders the

@@ -306,11 +306,13 @@ class BlockPool:
 
     def __init__(self, model, num_slots: int, max_len: int,
                  block_size: int = 8, num_blocks: Optional[int] = None,
-                 kv_quant: bool = False):
+                 kv_quant: bool = False, spec_slack: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if spec_slack < 0:
+            raise ValueError(f"spec_slack must be >= 0, got {spec_slack}")
         if model.max_position < max_len:
             raise ValueError(f"max_len {max_len} exceeds the model's "
                              f"position table ({model.max_position})")
@@ -329,6 +331,11 @@ class BlockPool:
         # pairs and refcounts in this module are dtype-blind — only
         # the byte accounting below changes.
         self.kv_quant = bool(kv_quant)
+        # spec_slack (ISSUE 18): with speculation armed, a slot's staged
+        # write span can run up to K draft tokens ahead of its committed
+        # cursor within a tick, so the worst-case reservation must cover
+        # those in-flight positions or _alloc_for would fault mid-tick.
+        self.spec_slack = int(spec_slack)
         self.dec = model.clone(decode=True, slot_decode=True,
                                fused_attention=False,
                                kv_num_blocks=num_blocks,
@@ -406,8 +413,11 @@ class BlockPool:
         lifetime: blocks covering the clamped total sequence, minus
         fully-shared blocks (never written — a partially-overlapped
         shared block still costs its COW copy, so it is not
-        subtracted)."""
-        total = len(request.prompt) + self.max_new_for(request)
+        subtracted).  With speculation armed, ``spec_slack`` extra
+        in-flight tokens are budgeted: draft lanes stage KV writes up
+        to K positions past the cursor before the accept decision."""
+        total = len(request.prompt) + self.max_new_for(request) \
+            + self.spec_slack
         return math.ceil(total / self.block_size) \
             - shared_len // self.block_size
 

@@ -1,0 +1,28 @@
+"""Speculative decoding: host-side draft proposers for the serve engine.
+
+The serve engine's decode hot path is one token per tick per slot.  The
+speculation subsystem breaks that wall losslessly: a host-side *proposer*
+drafts up to K candidate tokens per slot per tick, the engine verifies all
+K+1 lanes in a single compiled dispatch (reusing the chunked-prefill
+multi-lane machinery), and accepts the longest prefix of the draft that
+matches the model's own greedy continuation.  Rejected lanes roll back for
+free because paged-KV fill levels are host-side — the cursor simply does
+not advance past the accepted prefix.
+
+Everything in this package is jax-free by contract: proposers run on the
+host between dispatches and must never touch device state.
+"""
+
+from apex_example_tpu.spec.proposers import (
+    DraftProposer,
+    NgramProposer,
+    NullProposer,
+    get_proposer,
+)
+
+__all__ = [
+    "DraftProposer",
+    "NgramProposer",
+    "NullProposer",
+    "get_proposer",
+]

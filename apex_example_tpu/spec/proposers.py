@@ -1,0 +1,115 @@
+"""Draft proposers: host-side token drafting for speculative decoding.
+
+Contract
+--------
+A proposer implements::
+
+    propose(uid, prompt_tokens, generated_tokens, k) -> list[int]
+
+where ``uid`` identifies the request (so stateful proposers can keep
+per-request scratch), ``prompt_tokens`` is the request's prompt,
+``generated_tokens`` is everything sampled so far, and ``k`` is the
+maximum draft length for this tick.  The return value is a list of at
+most ``k`` candidate token ids for sequence positions immediately after
+the last generated token.  Returning ``[]`` is always legal and means
+"no draft this tick" — the engine then behaves exactly like the plain
+one-token-per-tick path for that slot.
+
+Proposers are jax-free by contract: they run on the host between engine
+dispatches and must be pure Python (stdlib only).  They must also be
+deterministic — the engine's lossless-greedy guarantee does not depend
+on draft quality, but test reproducibility depends on draft stability.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class DraftProposer:
+    """Base class for draft proposers.  Subclasses override propose()."""
+
+    name = "base"
+
+    def propose(
+        self,
+        uid: str,
+        prompt_tokens: Sequence[int],
+        generated_tokens: Sequence[int],
+        k: int,
+    ) -> List[int]:
+        raise NotImplementedError
+
+
+class NullProposer(DraftProposer):
+    """The off-switch: never drafts.
+
+    With this proposer armed, every speculative tick degenerates to the
+    single-lane decode step (the engine feeds only the last sampled
+    token), so throughput and outputs match the non-speculative path
+    token for token.
+    """
+
+    name = "none"
+
+    def propose(self, uid, prompt_tokens, generated_tokens, k):
+        return []
+
+
+class NgramProposer(DraftProposer):
+    """Prompt-lookup / n-gram drafter — no second model.
+
+    Matches the last ``n`` tokens of the running sequence (prompt +
+    generated) against earlier occurrences in that same sequence and
+    proposes the continuation that followed the most recent match.  This
+    exploits self-repetition: templated prompts, copy-through spans, and
+    the short cycles greedy decoding tends to fall into.  Shorter match
+    windows are tried as fallback (n, n-1, …, 1) so a draft is produced
+    whenever *any* suffix of the context has appeared before.
+
+    The proposer is stateless across requests (the context is rebuilt
+    from the arguments each call), so eviction/retry never leaks drafts
+    between requests.
+    """
+
+    name = "ngram"
+
+    def __init__(self, n: int = 3):
+        if n < 1:
+            raise ValueError(f"ngram window must be >= 1, got {n}")
+        self.n = int(n)
+
+    def propose(self, uid, prompt_tokens, generated_tokens, k):
+        if k <= 0:
+            return []
+        ctx = list(prompt_tokens) + list(generated_tokens)
+        if len(ctx) < 2:
+            return []
+        for n in range(min(self.n, len(ctx) - 1), 0, -1):
+            suffix = ctx[-n:]
+            # Most recent earlier occurrence of the suffix (rfind over
+            # windows ending strictly before the end of the context).
+            for start in range(len(ctx) - n - 1, -1, -1):
+                if ctx[start : start + n] == suffix:
+                    cont = ctx[start + n : start + n + k]
+                    if cont:
+                        return [int(t) for t in cont]
+                    break
+        return []
+
+
+_PROPOSERS = {
+    "ngram": NgramProposer,
+    "none": NullProposer,
+}
+
+
+def get_proposer(kind: str, *, ngram: int = 3) -> DraftProposer:
+    """Build a proposer by CLI name (``--draft ngram|none``)."""
+    if kind == "ngram":
+        return NgramProposer(n=ngram)
+    if kind == "none":
+        return NullProposer()
+    raise ValueError(
+        f"unknown draft proposer {kind!r} (expected one of {sorted(_PROPOSERS)})"
+    )

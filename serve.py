@@ -158,6 +158,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefix-sharing workload: shared KV blocks are "
                         "computed once and refcounted, measurable in "
                         "serve_summary's prefix_hit_rate/cow_copies")
+    p.add_argument("--repetitive", action="store_true",
+                   help="templated workload: each prompt tiles a short "
+                        "per-request motif to its sampled length "
+                        "(deterministic per seed) — self-repeating "
+                        "spans the prompt-lookup drafter can exploit, "
+                        "the honest traffic shape for --speculate "
+                        "acceptance measurements")
     p.add_argument("--max-new", default="4:16",
                    help="output budget, N or MIN:MAX tokens")
     p.add_argument("--temperature", type=float, default=0.0,
@@ -271,6 +278,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "attention, scales copied with their blocks "
                         "under COW/prefix sharing (quant/kv.py) — "
                         "~1.9x the bf16 arena's bytes, ~3.9x fp32's")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="speculative decoding (ISSUE 18): a host-side "
+                        "proposer drafts up to K tokens per greedy slot "
+                        "per tick and the engine verifies all lanes in "
+                        "ONE [SLOTS, max(block_size, K+1)]-wide "
+                        "dispatch, accepting the longest draft prefix "
+                        "matching the model's own argmax — greedy "
+                        "outputs stay token-identical to generate() "
+                        "while tokens/tick rises above 1.0; rejected "
+                        "lanes roll back for free (the cursor simply "
+                        "does not advance).  0 = off, bit-identical to "
+                        "the plain path")
+    p.add_argument("--draft", default="ngram",
+                   choices=["ngram", "none"],
+                   help="draft proposer for --speculate: 'ngram' "
+                        "matches the last N generated tokens against "
+                        "the request's own prompt + history (no second "
+                        "model); 'none' never drafts — the off-switch "
+                        "that keeps the speculative program armed but "
+                        "degenerates every tick to single-lane decode")
+    p.add_argument("--draft-ngram", type=int, default=3, metavar="N",
+                   help="match-window length for --draft ngram "
+                        "(longest window tried first, falling back to "
+                        "shorter suffixes)")
     p.add_argument("--metrics-jsonl", default=None,
                    help="emit schema-valid serving records to this JSONL")
     p.add_argument("--trace", action="store_true",
@@ -577,6 +608,19 @@ def run_serve(args):
     if args.tick_profile_every < 1:
         raise SystemExit(f"--tick-profile-every must be >= 1, got "
                          f"{args.tick_profile_every}")
+    if args.speculate < 0:
+        raise SystemExit(f"--speculate must be >= 0, got "
+                         f"{args.speculate}")
+    if args.speculate and args.role != "both":
+        raise SystemExit("--speculate needs the interleaved engine "
+                         "(--role both): disaggregated roles keep "
+                         "their own step geometries")
+    if args.speculate and args.speculate + 1 > max_len:
+        raise SystemExit(f"--speculate {args.speculate} exceeds "
+                         f"--max-len {max_len} lanes")
+    if args.draft_ngram < 1:
+        raise SystemExit(f"--draft-ngram must be >= 1, got "
+                         f"{args.draft_ngram}")
     replica_mode = bool(args.inbox or args.outbox)
     if args.role == "decode":
         # A decode worker's intake is the --handoff-dir spool, never an
@@ -745,6 +789,10 @@ def run_serve(args):
                                 emit=sink.write if sink is not None
                                 else None,
                                 run_id=run_id)
+    proposer = None
+    if args.speculate:
+        from apex_example_tpu.spec import get_proposer
+        proposer = get_proposer(args.draft, ngram=args.draft_ngram)
     parallel_state.set_mesh(mesh)
     try:
         engine = ServeEngine(model, params, num_slots=args.slots,
@@ -763,7 +811,9 @@ def run_serve(args):
                              slo=slo_spec,
                              slo_window_s=args.slo_window_s,
                              slo_window_ticks=args.slo_window_ticks,
-                             tick_profiler=tickprof)
+                             tick_profiler=tickprof,
+                             speculate=args.speculate,
+                             proposer=proposer)
         outbox = feeder_stop = on_tick = None
         idle_wait_s = 0.0
         if replica_mode:
@@ -845,7 +895,8 @@ def run_serve(args):
                 deadline_steps=args.deadline_steps,
                 deadline_s=args.deadline_s,
                 shared_prefix=args.shared_prefix,
-                seed_substream=args.seed_substream)
+                seed_substream=args.seed_substream,
+                repetitive=args.repetitive)
             engine.queue.submit_all(requests)
             engine.queue.close()
 
@@ -972,6 +1023,13 @@ def run_serve(args):
           f"tok/s={summary['tokens_per_sec']}  "
           f"steps={summary['steps']}  "
           f"occupancy={summary.get('occupancy', 0.0)}")
+    if "speculate_k" in summary:
+        print(f"spec: K={summary['speculate_k']} "
+              f"draft={summary['draft_kind']}  "
+              f"accepted {summary['tokens_accepted']}"
+              f"/{summary['tokens_drafted']} drafted "
+              f"({summary['acceptance_rate']:.1%})  "
+              f"tokens/tick={summary.get('tokens_per_tick', 0.0)}")
     nonsuccess = {k: v for k, v in counts.items() if k != "ok" and v}
     if nonsuccess:
         print("statuses: " + "  ".join(f"{k}={v}" for k, v in

@@ -451,6 +451,41 @@ def emit(sink, t):
             (rectype, msgs)
 
 
+def test_schema_emission_picks_up_v16_spec_fields():
+    """ISSUE 18: the speculative-decoding summary fields reach the AST
+    rule — a serve_summary carrying the v16 conservation triple stays
+    quiet, and an undeclared spec-adjacent field fires statically (a
+    new speculation counter can never ship without a schema bump)."""
+    with open(os.path.join(REPO, "apex_example_tpu", "obs",
+                           "schema.py")) as fh:
+        real_schema = fh.read()
+    valid = """
+def emit(sink, t):
+    rec = {"record": "serve_summary", "time": t, "requests": 8,
+           "output_tokens": 126, "tokens_per_sec": 42.0}
+    rec["speculate_k"] = 3
+    rec["draft_kind"] = "ngram"
+    rec["tokens_drafted"] = 55
+    rec["tokens_accepted"] = 52
+    rec["tokens_sampled"] = 74
+    rec["acceptance_rate"] = 0.9455
+    rec["tokens_per_tick"] = 6.0
+    sink.write(rec)
+"""
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": real_schema,
+        "pkg/emit.py": valid})
+    assert schema_rules.check(tree) == []       # valid emitter: quiet
+    drifted = valid.replace('rec["tokens_per_tick"] = 6.0',
+                            'rec["tokens_per_draft"] = 6.0')
+    tree = tree_from_sources({
+        "apex_example_tpu/obs/schema.py": real_schema,
+        "pkg/emit.py": drifted})
+    msgs = [f.message for f in schema_rules.check(tree)]
+    assert any("'serve_summary' emits field 'tokens_per_draft'" in m
+               and "bump the schema" in m for m in msgs), msgs
+
+
 def test_schema_emission_dynamic_builders_skip_missing_check_only():
     """A ``**``-built record (bench.py shape) can't be proven complete
     statically — but its literal keys are still checked."""

@@ -1,0 +1,428 @@
+"""Speculative multi-token decoding (spec/ + --speculate; ISSUE 18).
+
+- proposer unit coverage: the n-gram/prompt-lookup drafter (suffix
+  match across prompt + history, window fallback, out-of-range k), the
+  null drafter, the CLI factory,
+- the --repetitive loadgen workload: deterministic per seed, prompts
+  actually carry a looping motif,
+- the tier-1 acceptance run: ONE module-scoped --speculate 3 engine on
+  the repetitive workload at the shared SLOTS=4/MAX_LEN=32 geometry —
+  tokens_per_tick strictly > 1.0, greedy outputs token-identical to
+  one-shot generate(), the conservation ledger holds, the stream
+  validates (schema v16), serve_report renders the SPEC line, and the
+  compile-once gate sees exactly ONE new program (serve_spec_step),
+- losslessness under adversarial drafts: a proposer that drafts WRONG
+  tokens still yields token-identical output (rollback = not
+  advancing; the rejected lanes' stale KV is masked and overwritten),
+- the degenerate modes: --draft none drafts nothing and stays
+  identical; an unarmed engine emits NO v16 fields (pre-v16 streams
+  byte-identical),
+- composition with quantization: int8 weights + int8 KV, armed vs
+  unarmed token identity,
+- the ci_gate --spec-stream conservation gate over the checked-in
+  fixture (PASS) and tampered copies (FAIL),
+- schema v16 validation: the spec summary validates, a spec-field-free
+  summary still validates (v15 compat), an undeclared field is
+  rejected, and perf_ledger's serve snapshot carries acceptance_rate.
+
+Engine tests share the session's SLOTS=4/MAX_LEN=32 geometry so the
+compiled programs stay cheap; the armed run is module-scoped and
+reused by every assertion that only needs to READ it.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_example_tpu import obs
+from apex_example_tpu.models.gpt import generate, gpt_tiny
+from apex_example_tpu.obs import schema as obs_schema
+from apex_example_tpu.serve import ServeEngine, synthetic_requests
+from apex_example_tpu.spec import (DraftProposer, NgramProposer,
+                                   NullProposer, get_proposer)
+
+pytestmark = pytest.mark.spec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEC_FIXTURE = os.path.join(REPO, "tests", "fixtures", "spec",
+                            "spec_smoke.jsonl")
+SLOTS, MAX_LEN = 4, 32
+K = 3
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------- proposers
+
+def test_ngram_proposer_prompt_lookup():
+    """The drafter finds the most recent earlier occurrence of the
+    running suffix and proposes what followed it — across the
+    prompt/history boundary, with shorter windows as fallback."""
+    p = NgramProposer(n=3)
+    # suffix [1,2,3] occurred at position 0; continuation [9,1].
+    assert p.propose("u", [1, 2, 3, 9, 1, 2, 3], [], 2) == [9, 1]
+    # same context split across prompt and generated history.
+    assert p.propose("u", [1, 2, 3, 9], [1, 2, 3], 3) == [9, 1, 2]
+    # no repeated suffix at ANY window: no draft.
+    assert p.propose("u", [1, 2, 3, 4, 5], [], 4) == []
+    # window fallback: [7,1] never recurs but [1] does (after pos 0),
+    # so the n=1 window proposes its continuation.
+    assert p.propose("u", [1, 5, 6, 7, 1], [], 2) == [5, 6]
+    # k caps the draft; k=0 is always empty.
+    assert p.propose("u", [1, 2, 1, 2, 1, 2], [], 1) == [1]
+    assert p.propose("u", [1, 2, 1, 2], [], 0) == []
+    # a period-3 cycle drafts a full period ahead; deterministic.
+    args = ("u", [1, 2, 3, 1], [2, 3, 1], 3)
+    assert p.propose(*args) == p.propose(*args) == [2, 3, 1]
+
+
+def test_null_proposer_and_factory():
+    assert NullProposer().propose("u", [1, 2, 3], [4], 4) == []
+    assert isinstance(get_proposer("none"), NullProposer)
+    ng = get_proposer("ngram", ngram=2)
+    assert isinstance(ng, NgramProposer) and ng.n == 2
+    assert get_proposer("ngram").name == "ngram"
+    with pytest.raises(ValueError):
+        get_proposer("bigmodel")
+    with pytest.raises(ValueError):
+        NgramProposer(n=0)
+
+
+# ------------------------------------------- --repetitive workload
+
+def test_repetitive_workload_deterministic_and_motif():
+    """--repetitive prompts loop a short motif (the honest demo
+    workload for prompt-lookup drafting) and the whole request list is
+    a pure function of the seed."""
+    mk = lambda: synthetic_requests(8, vocab_size=199, seed=7,
+                                    prompt_len=(6, 12), max_new=(4, 8),
+                                    stagger=2, repetitive=True)
+    a, b = mk(), mk()
+    assert [r.prompt for r in a] == [r.prompt for r in b]
+    assert [(r.max_new_tokens, r.arrival_step) for r in a] == \
+           [(r.max_new_tokens, r.arrival_step) for r in b]
+    for r in a:
+        assert any(all(t == r.prompt[i % m]
+                       for i, t in enumerate(r.prompt))
+                   for m in range(3, 7)), r.prompt
+    plain = synthetic_requests(8, vocab_size=199, seed=7,
+                               prompt_len=(6, 12), max_new=(4, 8),
+                               stagger=2)
+    assert [r.prompt for r in plain] != [r.prompt for r in a]
+
+
+# ------------------------------------- the armed tier-1 acceptance run
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _run(model, params, reqs, *, sink=None, run_id=None, registry=None,
+         speculate=0, proposer=None, kv_quant=False,
+         weight_quant="none"):
+    eng = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                      rng=jax.random.PRNGKey(0), sink=sink,
+                      run_id=run_id, registry=registry,
+                      speculate=speculate, proposer=proposer,
+                      kv_quant=kv_quant, weight_quant=weight_quant)
+    eng.queue.submit_all(reqs)
+    eng.queue.close()
+    comps = eng.run(max_steps=2000)
+    return eng, comps
+
+
+def _repetitive_reqs(model, n=8, seed=3):
+    return synthetic_requests(n, vocab_size=model.vocab_size, seed=seed,
+                              prompt_len=(6, 12), max_new=(12, 24),
+                              stagger=2, repetitive=True)
+
+
+@pytest.fixture(scope="module")
+def armed_run(model_and_params, tmp_path_factory):
+    """ONE --speculate K run with the cost model armed, shared by every
+    read-only assertion below (the suite rides tier-1: one armed
+    engine, one compiled program, one workload)."""
+    from apex_example_tpu.obs import costmodel
+    model, params = model_and_params
+    path = str(tmp_path_factory.mktemp("spec") / "spec.jsonl")
+    sink = obs.JsonlSink(path, rank=0)
+    emitter = obs.TelemetryEmitter(sink)
+    emitter.run_header(config={"slots": SLOTS, "max_len": MAX_LEN,
+                               "speculate": K}, arch="gpt_tiny")
+    costmodel.set_default(obs.CostModel(
+        sink=sink, registry=emitter.registry, run_id=emitter.run_id))
+    try:
+        reqs = _repetitive_reqs(model)
+        eng, comps = _run(model, params, reqs, sink=sink,
+                          run_id=emitter.run_id,
+                          registry=emitter.registry, speculate=K)
+    finally:
+        costmodel.set_default(None)
+    sink.write(eng.summary_record())
+    sink.close()
+    return eng, comps, reqs, path
+
+
+def test_speculation_breaks_one_token_per_tick(armed_run):
+    """The headline number: tokens_per_tick strictly > 1.0 on the
+    repetitive workload — the engine emitted MORE tokens than it ran
+    compiled steps — with the conservation ledger intact."""
+    eng, comps, reqs, _ = armed_run
+    assert len(comps) == len(reqs)
+    summary = eng.summary_record()
+    assert summary["speculate_k"] == K
+    assert summary["draft_kind"] == "ngram"
+    assert summary["tokens_per_tick"] > 1.0
+    assert summary["output_tokens"] > summary["compute_steps"]
+    # conservation: every emitted token is an accepted draft lane or a
+    # model sample (bonus lanes + plain-path ticks).
+    assert 0 < summary["tokens_accepted"] <= summary["tokens_drafted"]
+    assert summary["output_tokens"] == (summary["tokens_accepted"]
+                                        + summary["tokens_sampled"])
+    assert summary["acceptance_rate"] == pytest.approx(
+        summary["tokens_accepted"] / summary["tokens_drafted"],
+        abs=5e-4)
+
+
+def test_speculation_is_lossless_greedy_identity(armed_run,
+                                                 model_and_params):
+    """The correctness bar: every accepted token is the token greedy
+    decode would have produced — armed output is token-identical to
+    one-shot generate() on every request."""
+    model, params = model_and_params
+    _, comps, reqs, _ = armed_run
+    by_uid = {c.request.uid: c for c in comps}
+    for r in reqs:
+        c = by_uid[r.uid]
+        P, n = len(r.prompt), len(c.tokens)
+        assert n == min(r.max_new_tokens, MAX_LEN - P)
+        ref = generate(model, params, jnp.asarray([r.prompt], jnp.int32),
+                       max_len=MAX_LEN)
+        np.testing.assert_array_equal(np.asarray(ref)[0, P:P + n],
+                                      np.asarray(c.tokens, np.int32),
+                                      err_msg=r.uid)
+
+
+def test_armed_engine_compiles_exactly_one_program(armed_run,
+                                                   compile_events):
+    """The compile-once contract, armed: --speculate K adds exactly ONE
+    compiled program (serve_spec_step — prefill chunks and draft lanes
+    share the [SLOTS, C] geometry), asserted on the counter AND through
+    the cost_report --fail-on-recompile CI command."""
+    _, _, _, path = armed_run
+    records = obs.read_jsonl(path)
+    assert compile_events(records) == {"serve_spec_step": 1}
+    assert compile_events.gate(path) == 0
+
+
+def test_armed_stream_validates_and_reports(armed_run, capsys):
+    """The emitted stream is a valid v16 stream, serve_report renders
+    the SPEC line, and telemetry_report passes the ledger through."""
+    _, _, _, path = armed_run
+    records = obs.read_jsonl(path)
+    assert obs_schema.validate_stream(records) == []
+    serve_report = _load_tool("serve_report")
+    assert serve_report.report(path) == 0
+    out = capsys.readouterr().out
+    assert f"SPEC: K={K} draft=ngram" in out
+    assert "tokens/tick" in out
+    telemetry_report = _load_tool("telemetry_report")
+    assert telemetry_report.report(path) == 0
+    assert "spec K=3" in capsys.readouterr().out
+
+
+def test_wrong_drafts_are_rolled_back_losslessly(model_and_params):
+    """The mutation test for the rollback path: a proposer drafting
+    deliberately WRONG tokens must not corrupt output — rejection is
+    simply not advancing the cursor (stale lanes sit beyond it, masked
+    off and overwritten next tick).  Identity holds while the ledger
+    shows real rejections."""
+    model, params = model_and_params
+
+    class WrongProposer(DraftProposer):
+        name = "wrong"
+
+        def propose(self, uid, prompt_tokens, generated_tokens, k):
+            last = (generated_tokens[-1] if generated_tokens
+                    else prompt_tokens[-1])
+            return [(int(last) + 1 + j) % model.vocab_size
+                    for j in range(k)]
+
+    reqs = _repetitive_reqs(model, n=4, seed=5)
+    eng, comps = _run(model, params, reqs, speculate=K,
+                      proposer=WrongProposer())
+    assert len(comps) == 4
+    summary = eng.summary_record()
+    assert summary["draft_kind"] == "wrong"
+    assert summary["tokens_drafted"] > 0
+    assert summary["tokens_accepted"] < summary["tokens_drafted"]
+    assert summary["output_tokens"] == (summary["tokens_accepted"]
+                                        + summary["tokens_sampled"])
+    by_uid = {c.request.uid: c for c in comps}
+    for r in reqs:
+        c = by_uid[r.uid]
+        P, n = len(r.prompt), len(c.tokens)
+        ref = generate(model, params, jnp.asarray([r.prompt], jnp.int32),
+                       max_len=MAX_LEN)
+        np.testing.assert_array_equal(np.asarray(ref)[0, P:P + n],
+                                      np.asarray(c.tokens, np.int32),
+                                      err_msg=r.uid)
+
+
+def test_null_drafter_degenerates_to_plain_path(model_and_params):
+    """--draft none: the armed engine never receives a draft, every
+    tick feeds one real lane, and output matches generate() with a
+    zeroed ledger (the K=0-per-tick degenerate case)."""
+    model, params = model_and_params
+    reqs = _repetitive_reqs(model, n=4, seed=11)
+    eng, comps = _run(model, params, reqs, speculate=K,
+                      proposer=NullProposer())
+    summary = eng.summary_record()
+    assert summary["tokens_drafted"] == 0
+    assert summary["tokens_accepted"] == 0
+    assert summary["acceptance_rate"] == 0.0
+    assert summary["output_tokens"] == summary["tokens_sampled"]
+    by_uid = {c.request.uid: c for c in comps}
+    for r in reqs:
+        c = by_uid[r.uid]
+        P, n = len(r.prompt), len(c.tokens)
+        ref = generate(model, params, jnp.asarray([r.prompt], jnp.int32),
+                       max_len=MAX_LEN)
+        np.testing.assert_array_equal(np.asarray(ref)[0, P:P + n],
+                                      np.asarray(c.tokens, np.int32))
+
+
+def test_unarmed_summary_carries_no_spec_fields(model_and_params):
+    """--speculate 0 leaves the stream byte-identical to pre-v16
+    output: NO speculation field reaches the summary."""
+    model, params = model_and_params
+    reqs = synthetic_requests(2, vocab_size=model.vocab_size, seed=9,
+                              prompt_len=(4, 6), max_new=(3, 5))
+    eng, comps = _run(model, params, reqs)
+    assert len(comps) == 2
+    summary = eng.summary_record()
+    for field in ("speculate_k", "draft_kind", "tokens_drafted",
+                  "tokens_accepted", "tokens_sampled",
+                  "acceptance_rate", "tokens_per_tick"):
+        assert field not in summary, field
+
+
+def test_speculation_composes_with_int8_quantization(model_and_params):
+    """Speculation is lossless relative to whatever numerics the engine
+    runs: with int8 weights AND an int8 KV arena, the armed run is
+    token-identical to the unarmed run on the same quantized stack."""
+    from apex_example_tpu.quant import quantize_params
+    model, params = model_and_params
+    qp, _ = quantize_params(params, "int8")
+    reqs = _repetitive_reqs(model, n=4, seed=13)
+    eng_p, plain = _run(model, qp, reqs, kv_quant=True,
+                        weight_quant="int8")
+    eng_s, spec = _run(model, qp, reqs, speculate=K, kv_quant=True,
+                       weight_quant="int8")
+    assert len(plain) == len(spec) == 4
+    p_uid = {c.request.uid: c.tokens for c in plain}
+    s_uid = {c.request.uid: c.tokens for c in spec}
+    assert p_uid == s_uid
+    summary = eng_s.summary_record()
+    assert summary["tokens_accepted"] > 0       # actually speculated
+    assert summary["output_tokens"] == (summary["tokens_accepted"]
+                                        + summary["tokens_sampled"])
+
+
+# -------------------------------------------- ci_gate --spec-stream
+
+def test_ci_gate_spec_stream_passes_on_fixture(capsys):
+    ci_gate = _load_tool("ci_gate")
+    assert ci_gate.main(["--spec-stream", SPEC_FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert f"spec gate {SPEC_FIXTURE}: PASS" in out
+
+
+def test_ci_gate_spec_stream_fails_on_tamper(tmp_path, capsys):
+    """The conservation gate actually fires: accepted > drafted and a
+    broken output == accepted + sampled identity both FAIL."""
+    ci_gate = _load_tool("ci_gate")
+    with open(SPEC_FIXTURE) as fh:
+        lines = [json.loads(ln) for ln in fh if ln.strip()]
+
+    def tamper(edit, name):
+        recs = [dict(r) for r in lines]
+        summ = next(r for r in recs
+                    if r.get("record") == "serve_summary")
+        edit(summ)
+        path = str(tmp_path / name)
+        with open(path, "w") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+        return path
+
+    def overdraw(s):
+        s["tokens_accepted"] = s["tokens_drafted"] + 1
+
+    def leak(s):
+        s["tokens_sampled"] += 1
+
+    assert ci_gate._spec_gate(tamper(overdraw, "overdraw.jsonl")) == 1
+    assert "accepted a token nobody proposed" in capsys.readouterr().err
+    assert ci_gate._spec_gate(tamper(leak, "leak.jsonl")) == 1
+    assert "no provenance" in capsys.readouterr().err
+    # an UNARMED stream is a usage error for this gate, not a pass.
+    def disarm(s):
+        for f in ("speculate_k", "draft_kind", "tokens_drafted",
+                  "tokens_accepted", "tokens_sampled",
+                  "acceptance_rate", "tokens_per_tick"):
+            s.pop(f, None)
+    assert ci_gate._spec_gate(tamper(disarm, "unarmed.jsonl")) == 1
+
+
+# ------------------------------------------------- schema + ledger
+
+def test_schema_v16_spec_fields():
+    """The v16 contract: the fixture's armed summary validates, a
+    summary WITHOUT the spec fields still validates (strict-superset
+    back-compat), and an undeclared field is rejected."""
+    with open(SPEC_FIXTURE) as fh:
+        records = [json.loads(ln) for ln in fh if ln.strip()]
+    assert obs_schema.validate_stream(records) == []
+    summ = next(r for r in records if r["record"] == "serve_summary")
+    assert summ["speculate_k"] >= 1
+    bare = {k: v for k, v in summ.items()
+            if k not in ("speculate_k", "draft_kind", "tokens_drafted",
+                         "tokens_accepted", "tokens_sampled",
+                         "acceptance_rate", "tokens_per_tick")}
+    assert obs_schema.validate_record(bare) == []
+    typo = dict(summ)
+    typo["tokens_per_draft"] = 1.0
+    errs = obs_schema.validate_record(typo)
+    assert errs and any("tokens_per_draft" in e for e in errs)
+
+
+def test_perf_ledger_snapshot_carries_acceptance():
+    """perf_ledger folds the v16 ledger into the serve snapshot with
+    the explicit 5% noise band (small-sample acceptance counts jitter
+    more than throughput counters)."""
+    perf_ledger = _load_tool("perf_ledger")
+    records = obs.read_jsonl(os.path.join(
+        REPO, "tests", "fixtures", "perf", "serve_perf.jsonl"))
+    snap = perf_ledger.snapshot(records, "serve_perf.jsonl")
+    assert snap["kind"] == "serve"
+    assert 0.0 < snap["metrics"]["acceptance_rate"] <= 1.0
+    assert snap["metrics"]["tokens_per_tick"] > 1.0
+    assert perf_ledger.default_noise_pct("acceptance_rate") == 5.0
+    assert perf_ledger.default_noise_pct("tokens_per_tick") == 5.0

@@ -454,14 +454,14 @@ def test_reports_degrade_gracefully_on_pre_v15_streams(capsys):
     assert "data_wait" in out and "dispatch" in out
 
 
-def test_v15_validates_every_older_fixture_stream():
-    """v15 is a strict superset: every checked-in v10-v14 fixture
+def test_v16_validates_every_older_fixture_stream():
+    """v16 is a strict superset: every checked-in v10-v15 fixture
     stream still validates unchanged, and the two hard-coded jax-free
     SCHEMA constants moved in lockstep with SCHEMA_VERSION."""
-    assert obs_schema.SCHEMA_VERSION == 15
+    assert obs_schema.SCHEMA_VERSION == 16
     fixture_root = os.path.join(REPO, "tests", "fixtures")
     seen = 0
-    for sub in ("slo", "fleet", "quant", "disagg", "perf"):
+    for sub in ("slo", "fleet", "quant", "disagg", "perf", "spec"):
         d = os.path.join(fixture_root, sub)
         for name in sorted(os.listdir(d)):
             if not name.endswith(".jsonl"):

@@ -83,6 +83,17 @@ Gates:
    bands.  Run over the checked-in perf fixtures (tests/fixtures/
    perf/), this turns "host overhead stayed put" into a regression-
    tested number.
+9. **spec conservation** (per ``--spec-stream``): the speculative-
+   decoding contract over one recorded ``--speculate`` stream (schema
+   v16) — every record validates, exactly one ``serve_summary``, the
+   summary is armed (``speculate_k`` >= 1 with the drafted/accepted/
+   sampled counter triple), and tokens are CONSERVED: every output
+   token is an accepted draft token or a sampled one
+   (``output_tokens == tokens_accepted + tokens_sampled``), no token
+   was accepted that was never drafted, and ``acceptance_rate``
+   equals accepted/drafted.  Run over the checked-in spec-smoke
+   stream (tests/fixtures/spec/), this turns "speculation is
+   lossless" into a regression-tested identity.
 
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
@@ -214,6 +225,61 @@ def _quant_gate(stream: str, min_ratio: float) -> int:
               f"bf16-equivalent {bf16_equiv:.0f} / {min_ratio} — "
               f"compression {bf16 / per:.2f}x under the floor "
               f"({per} B/token vs bf16-eq {bf16})",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def _spec_gate(stream: str) -> int:
+    """The speculative-decoding gate (ISSUE 18): schema-v16
+    validation, exactly one serve_summary, an ARMED summary
+    (``speculate_k`` >= 1 with the full drafted/accepted/sampled
+    counter triple), and token CONSERVATION — every output token was
+    either an accepted draft token or a sampled one
+    (``output_tokens == tokens_accepted + tokens_sampled``), no draft
+    was accepted that was never proposed
+    (``tokens_accepted <= tokens_drafted``), and the summary's
+    ``acceptance_rate`` is the ratio it claims to be.  Returns 0/1
+    (2 is the caller's unreadable-stream path)."""
+    summ, records = _load_gated_stream(stream, "serve_summary")
+    if summ is None:
+        return 1
+    rc = 0
+    k = summ.get("speculate_k")
+    if not isinstance(k, int) or k < 1:
+        print(f"{stream}: speculate_k is {k!r} (spec stream must come "
+              "from a --speculate-armed run)", file=sys.stderr)
+        return 1
+    missing = [f for f in ("tokens_drafted", "tokens_accepted",
+                           "tokens_sampled", "acceptance_rate",
+                           "tokens_per_tick")
+               if f not in summ]
+    if missing:
+        print(f"{stream}: serve_summary lacks the v16 speculation "
+              f"field(s) {missing}", file=sys.stderr)
+        return 1
+    drafted = summ["tokens_drafted"]
+    accepted = summ["tokens_accepted"]
+    sampled = summ["tokens_sampled"]
+    out = summ.get("output_tokens")
+    if accepted > drafted:
+        print(f"{stream}: tokens_accepted {accepted} > tokens_drafted "
+              f"{drafted} — accepted a token nobody proposed",
+              file=sys.stderr)
+        rc = 1
+    if out != accepted + sampled:
+        print(f"{stream}: output_tokens {out} != tokens_accepted "
+              f"{accepted} + tokens_sampled {sampled} — a token left "
+              "the engine with no provenance", file=sys.stderr)
+        rc = 1
+    claimed = summ["acceptance_rate"]
+    actual = (accepted / drafted) if drafted else 0.0
+    if abs(claimed - actual) > 5e-4:
+        print(f"{stream}: acceptance_rate {claimed} != "
+              f"{accepted}/{drafted} = {actual:.4f}", file=sys.stderr)
+        rc = 1
+    if not 0.0 <= claimed <= 1.0:
+        print(f"{stream}: acceptance_rate {claimed} outside [0, 1]",
               file=sys.stderr)
         rc = 1
     return rc
@@ -562,6 +628,13 @@ def main(argv=None) -> int:
                          "and perf_ledger's consistency checks — "
                          "phase components sum to wall within 1%%, "
                          "gap/fraction/totals agree (repeatable)")
+    ap.add_argument("--spec-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a --speculate-armed stream to run the spec "
+                         "gate over: schema-v16 validation, exactly "
+                         "one armed serve_summary, accepted <= "
+                         "drafted, and output_tokens == accepted + "
+                         "sampled (repeatable)")
     ap.add_argument("--perf-baseline", default=None, metavar="JSON",
                     help="PERF_BASELINE.json to additionally diff "
                          "every --perf-stream snapshot against "
@@ -642,6 +715,16 @@ def main(argv=None) -> int:
             return 2
         rc = _perf_gate(stream, args.perf_baseline)
         print(f"ci_gate: perf gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
+
+    for stream in args.spec_stream:
+        if not os.path.isfile(stream):
+            print(f"ci_gate: no such stream: {stream}",
+                  file=sys.stderr)
+            return 2
+        rc = _spec_gate(stream)
+        print(f"ci_gate: spec gate {stream}: "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         worst = max(worst, rc)
 

@@ -58,6 +58,12 @@ CONTRACT_FILES = (
     "apex_example_tpu/fleet/replica.py",
     "apex_example_tpu/fleet/router.py",
     "apex_example_tpu/fleet/scenarios.py",
+    # ISSUE 18: draft proposers run on the host between ticks — the
+    # engine imports them, never the reverse (spec/__init__.py is the
+    # in-package convenience surface and, like fleet/__init__.py, is
+    # deliberately NOT listed: loading it via the package walks the
+    # jax-carrying apex_example_tpu/__init__.py edge).
+    "apex_example_tpu/spec/proposers.py",
 )
 
 _IMPORT_EXC = {"ImportError", "ModuleNotFoundError", "Exception",

@@ -68,7 +68,13 @@ per-phase sketch summaries, ``host_gap_ms`` and the
 ``host_overhead_frac`` perf_ledger gates on; ``serve_summary`` gains
 the idle-spin counters ``idle_ticks``/``idle_wait_ms`` and
 ``host_overhead_frac``, and ``replica_state`` heartbeats gain
-``host_overhead_frac``) all validate alongside v1
+``host_overhead_frac``) and v16 streams (the speculative-decoding
+stratum from --speculate runs: ``serve_summary`` gains the armed
+geometry ``speculate_k``/``draft_kind``, the conservation counters
+``tokens_drafted``/``tokens_accepted``/``tokens_sampled`` — every
+output token is an accepted draft token or a sampled one — and the
+derived ``acceptance_rate``/``tokens_per_tick`` throughput verdicts)
+all validate alongside v1
 streams — each version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a
 run_summary, so --require-summary passes on it; only an actual abort

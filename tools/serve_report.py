@@ -46,6 +46,12 @@ device_wait / harvest / spool_io / telemetry) from the stream's
 ``overhead_summary``, plus the idle-spin accounting the summary now
 carries.  Pre-v15 streams degrade gracefully (no line).
 
+Schema v16 adds the SPEC line (speculative decoding, ISSUE 18): on a
+``--speculate`` stream, the acceptance rate, drafted vs accepted vs
+sampled token totals, and tokens/tick against the 1.0
+one-token-per-tick baseline.  Pre-v16 (and unarmed) streams carry no
+``speculate_k`` and degrade silently, exactly like OVERHEAD.
+
 Thin client of the obs schema (obs/schema.py):
 
     python tools/serve_report.py serve.jsonl
@@ -367,6 +373,23 @@ def report(path: str, out=sys.stdout) -> int:
                 for name, p in phases.items() if isinstance(p, dict))
             if parts:
                 print(f"  phases (p50/p99 ms): {parts}", file=out)
+        # schema v16 SPEC line (ISSUE 18), only when the run was armed
+        # with --speculate: the speculation ledger — acceptance rate,
+        # drafted vs accepted totals and tokens/tick against the
+        # 1.0 one-token-per-tick baseline.  Pre-v16 streams carry no
+        # speculate_k and skip this block, like OVERHEAD does.
+        if "speculate_k" in summary:
+            tpt = summary.get("tokens_per_tick", 0.0)
+            print(f"SPEC: K={summary['speculate_k']} "
+                  f"draft={summary.get('draft_kind', '?')}  "
+                  f"acceptance "
+                  f"{summary.get('acceptance_rate', 0.0):.1%} "
+                  f"({summary.get('tokens_accepted', 0)} of "
+                  f"{summary.get('tokens_drafted', 0)} drafted, "
+                  f"{summary.get('tokens_sampled', 0)} sampled)  "
+                  f"tokens/tick {tpt} vs 1.0 baseline "
+                  f"({'+' if tpt > 1.0 else ''}"
+                  f"{(tpt - 1.0) * 100.0:.0f}%)", file=out)
         if "idle_ticks" in summary:
             print(f"idle: {summary['idle_ticks']} idle tick(s), "
                   f"{summary.get('idle_wait_ms', 0.0)} ms waited",

@@ -32,7 +32,11 @@ never read as healthy (tools/slo_report.py renders the window
 timeline and burn trajectory) — and the hot-path stratum (schema
 v15): an OVERHEAD line (host-overhead fraction, per-phase p50/p99
 tick decomposition) when the run was armed with ``--tick-profile``
-(tools/perf_ledger.py turns it into the regression snapshot).
+(tools/perf_ledger.py turns it into the regression snapshot) — and
+the speculation stratum (schema v16): the SERVE line carries the
+acceptance rate and tokens/tick when the run was armed with
+``--speculate`` (pre-v16 streams degrade silently; serve_report.py
+renders the full SPEC line).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -167,10 +171,20 @@ def report(path: str, out=sys.stdout) -> int:
         elif is_serve_stream:
             if serve_summaries:
                 s = serve_summaries[-1]
+                # v16 passthrough: a --speculate stream names its
+                # acceptance ledger here; pre-v16 streams carry no
+                # speculate_k and print nothing extra (the SPEC line
+                # proper lives in serve_report.py).
+                spec = ""
+                if "speculate_k" in s:
+                    spec = (f", spec K={s['speculate_k']} acceptance "
+                            f"{s.get('acceptance_rate', 0.0):.1%} "
+                            f"tokens/tick {s.get('tokens_per_tick', 0.0)}")
                 print(f"SERVE: {s.get('requests', '?')} request(s), "
                       f"role {s.get('role', 'both')}"
                       + (f", mesh {s['mesh']}" if "mesh" in s else "")
                       + f", availability {s.get('availability', '?')}"
+                      + spec +
                       "  (tools/serve_report.py for the full report)",
                       file=out)
             else:
