@@ -246,11 +246,16 @@ class ThreadReplica:
         with self._lock:
             st = self._state
             eng = self.engine
+        # v17: lane-parked requests have left queue.pending()'s view
+        # but are still backlog — a tenancy-armed engine reports both
+        # through unadmitted() (duck-typed like the gauges below).
+        pend_fn = getattr(eng, "unadmitted", None)
         out = {
             "name": self.name,
             "state": st,
             "tick": eng.step_count,
-            "pending": eng.queue.pending(),
+            "pending": pend_fn() if pend_fn is not None
+            else eng.queue.pending(),
             "blocks_live": eng.pool.blocks_live(),
             # v12: dtype-accurate bytes (int8 arenas + scales count
             # their true footprint) — what least_kv prefers, so a
@@ -278,6 +283,18 @@ class ThreadReplica:
         frac = frac_fn() if frac_fn is not None else None
         if frac is not None:
             out["host_overhead_frac"] = frac
+        # v17: the prefix-cache advertisement (--advertise-prefixes)
+        # and per-tenant admitted-token ledger (--tenants) — the
+        # prefix_affinity routing inputs and the fleet's budget
+        # accounting, both absent unarmed.
+        adv_fn = getattr(eng, "prefix_advert", None)
+        adv = adv_fn() if adv_fn is not None else None
+        if adv is not None:
+            out.update(adv)
+        ta_fn = getattr(eng, "tenant_admitted", None)
+        ta = ta_fn() if ta_fn is not None else None
+        if ta is not None:
+            out["tenant_admitted"] = ta
         return out
 
     # ------------------------------------------------------ lifecycle
@@ -358,6 +375,7 @@ class ThreadReplica:
         new = comps[self._consumed:]
         self._consumed = len(comps)
         redelivered = getattr(eng, "handoff_redelivered", ())
+        with_tenant = getattr(eng, "sched", None) is not None
         events = []
         for c in new:
             ev = {"uid": c.request.uid, "status": c.status,
@@ -372,6 +390,10 @@ class ThreadReplica:
                   else c.ttft_s * 1e3,
                   "tpot_ms": None if c.tpot_s is None
                   else c.tpot_s * 1e3}
+            if with_tenant:
+                # v17: the lane rides every terminal event so the
+                # router's per-tenant SLO windows never re-derive it.
+                ev["tenant"] = getattr(c.request, "tenant", "default")
             if c.request.uid in redelivered:
                 ev["redelivered"] = True
             events.append(ev)
@@ -394,11 +416,20 @@ class ThreadReplica:
                 with self._lock:
                     self._state = "healthy"
                 continue
-            if eng.queue.drained() and not eng.pool.any_live():
+            # v17: a tenancy-armed engine's work view spans intake AND
+            # lanes (work_drained/unadmitted); legacy engines fall back
+            # to the queue alone (duck-typed like state()'s gauges).
+            wd_fn = getattr(eng, "work_drained", None)
+            pend_fn = getattr(eng, "runnable_backlog", None)
+            if (wd_fn() if wd_fn is not None
+                    else eng.queue.drained()) \
+                    and not eng.pool.any_live():
                 with self._lock:
                     self._state = "stopped"
                 return
-            if eng.queue.pending() == 0 and not eng.pool.any_live():
+            if (pend_fn() if pend_fn is not None
+                    else eng.queue.pending()) == 0 \
+                    and not eng.pool.any_live():
                 if stopping:
                     with self._lock:
                         self._state = "stopped"
@@ -414,6 +445,9 @@ class ThreadReplica:
                 self._progress = time.perf_counter()
             except BaseException as e:  # noqa: BLE001 — a crash IS the event
                 lost = [r.uid for r in eng.queue.drain()]
+                sched = getattr(eng, "sched", None)
+                if sched is not None:
+                    lost += [r.uid for r in sched.drain()]
                 lost += [eng.pool.slots[i].request.uid
                          for i in eng.pool.live]
                 self._harvest(eng)
@@ -799,4 +833,11 @@ class ProcReplica:
         # --tick-profile child advertises.
         if "host_overhead_frac" in beat:
             out["host_overhead_frac"] = beat["host_overhead_frac"]
+        # v17: prefix-cache advertisement + per-tenant admission ledger
+        # from an --advertise-prefixes / --tenants child; absent on
+        # unarmed or pre-v17 children, never synthesized.
+        for key in ("prefix_keys", "prefix_shared_tokens",
+                    "prefix_prompt_tokens", "tenant_admitted"):
+            if key in beat:
+                out[key] = beat[key]
         return out

@@ -84,10 +84,11 @@ from typing import Any, Dict, List, Optional, Tuple
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) —
 # jax-free contract forbids importing it (same stance as the
 # supervisor's hard-coded records).
-SCHEMA = 16
+SCHEMA = 17
 TRACE_ID_ENV = "APEX_TRACE_ID"
 
-POLICIES = ("round_robin", "least_pending", "least_kv")
+POLICIES = ("round_robin", "least_pending", "least_kv",
+            "prefix_affinity")
 
 # Statuses a replica can report that end a request for good at the
 # fleet level (drained and lost are re-routed instead; "handoff" parks
@@ -112,6 +113,27 @@ def _load_slo():
         spec.loader.exec_module(mod)
         _SLO_MOD = mod
     return _SLO_MOD
+
+
+_PREFIX_MOD = None
+
+
+def _load_prefix():
+    """sched/prefix.py loaded by FILE PATH (cached), same stance as
+    ``_load_slo``: the module is stdlib self-contained by the graftlint
+    contract, so loading it never walks the jax-carrying package
+    ``__init__``.  Only a prefix_affinity router pays the import."""
+    global _PREFIX_MOD
+    if _PREFIX_MOD is None:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            os.pardir, "sched", "prefix.py")
+        spec = importlib.util.spec_from_file_location(
+            "_fleet_prefix", os.path.abspath(path))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _PREFIX_MOD = mod
+    return _PREFIX_MOD
 
 
 class _Stream:
@@ -181,6 +203,7 @@ class FleetRouter:
                  spool_timeout_s: Optional[float] = None,
                  slo=None, slo_window: int = 16,
                  slo_rollup_s: float = 2.0,
+                 tenant_specs=None, prefix_block_size: int = 8,
                  trace: bool = False, log=print):
         if policy not in POLICIES:
             raise ValueError(f"policy must be one of {POLICIES}, "
@@ -256,6 +279,22 @@ class FleetRouter:
                                  f"got {slo_window}")
             self._slo_mod = _load_slo()
             self._slo = self._slo_mod._normalize_spec(slo)
+        # Multi-tenant plane (ISSUE 19): with --tenants armed, every
+        # fleet-terminal event also folds into its tenant's ledger —
+        # per-tenant status counts plus (slo armed too) a per-tenant
+        # scored list, so fleet_summary carries per-tenant availability
+        # and SLO verdicts (the noisy_neighbor assertion surface).
+        self._tenants = dict(tenant_specs) if tenant_specs else None
+        self._tenant_counts: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
+        self._tenant_scored: Dict[str, List[Optional[bool]]] = {}  # guarded-by: _lock
+        # prefix_affinity routing state: block size must match the
+        # replicas' KV page size or the chain keys never line up.
+        if prefix_block_size < 1:
+            raise ValueError(f"prefix_block_size must be >= 1, "
+                             f"got {prefix_block_size}")
+        self.prefix_block_size = int(prefix_block_size)
+        self._prefix_mod = _load_prefix() \
+            if policy == "prefix_affinity" else None
         self.scenario: Optional[str] = None
         self.verdict: Optional[str] = None
         self._t0 = time.perf_counter()
@@ -291,6 +330,20 @@ class FleetRouter:
             # two) cannot be checked for verdict consistency.
             config["slo"] = dict(self._slo)
             config["slo_window"] = self.slo_window
+        if self._tenants is not None:
+            # Tenant-spec announcement (v17): ci_gate --tenant-stream
+            # checks the fairness ledger against the budgets declared
+            # HERE, not against out-of-band flags.
+            tcfg: Dict[str, Any] = {}
+            for name, ts in self._tenants.items():
+                ent: Dict[str, Any] = {
+                    "weight": float(getattr(ts, "weight", 1.0)),
+                    "slo_class": getattr(ts, "slo_class", "batch")}
+                budget = getattr(ts, "budget", None)
+                if budget is not None:
+                    ent["budget"] = int(budget)
+                tcfg[name] = ent
+            config["tenants"] = tcfg
         self._stream.write({
             "record": "run_header", "schema": SCHEMA, "time": time.time(),
             "run_id": self.run_id, "num_devices": 0, "process_index": 0,
@@ -330,6 +383,20 @@ class FleetRouter:
             if health.get("host_overhead_frac") is not None:
                 rec["host_overhead_frac"] = float(
                     health["host_overhead_frac"])
+            # v17: re-emit the prefix-cache advertisement and the
+            # per-tenant admission ledger an armed replica heartbeats —
+            # absent on unarmed replicas, so legacy streams are
+            # byte-shaped as before.
+            if health.get("prefix_keys") is not None:
+                rec["prefix_keys"] = list(health["prefix_keys"])
+                rec["prefix_shared_tokens"] = int(
+                    health.get("prefix_shared_tokens", 0))
+                rec["prefix_prompt_tokens"] = int(
+                    health.get("prefix_prompt_tokens", 0))
+            if health.get("tenant_admitted") is not None:
+                rec["tenant_admitted"] = {
+                    k: int(v) for k, v
+                    in health["tenant_admitted"].items()}
         if detail:
             rec["detail"] = detail
         self._stream.write(rec)
@@ -395,13 +462,16 @@ class FleetRouter:
 
     def _pick(self, metas: Dict[str, _Meta], now: float,
               avoid: Tuple[str, ...],
-              refused: Tuple[str, ...]) -> Optional[str]:
+              refused: Tuple[str, ...],
+              spec: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Policy selection over the routable set.  Caller holds
         ``_lock`` and passes the guarded ``_replicas`` map in (so the
         guarded name is only ever touched inside the lock).  ``avoid``
         is a preference (the replica a retry/requeue is leaving —
         routed back to only when it is the sole survivor); ``refused``
-        is hard (it already refused this spec in this dispatch)."""
+        is hard (it already refused this spec in this dispatch).
+        ``spec`` is the request being placed — prefix_affinity scores
+        candidates by it; the other policies ignore it."""
         names = [n for n in self._order
                  if n not in refused
                  and self._roles.get(n, "both") != "decode"
@@ -427,9 +497,33 @@ class FleetRouter:
         # and letting its absence key as 0 bytes would route every
         # request to the oldest replica no matter how loaded it is.
         # Mixed fleets degrade to the block count for everyone.
-        use_bytes = self.policy == "least_kv" and all(
-            metas[n].health.get("kv_bytes_live") is not None
-            for n in names)
+        # prefix_affinity (v17): candidates are scored by how deep the
+        # incoming prompt's block-chain keys overlap the hot-prefix
+        # keys each replica ADVERTISES in its heartbeat
+        # (replica_state.prefix_keys).  Deepest overlap wins — its KV
+        # cache already holds the shared blocks, so routing there turns
+        # the fleet's shared-prefix traffic into copy-on-write hits
+        # instead of N cold recomputes.  Zero overlap everywhere (cold
+        # keys, unarmed replicas, pre-v17 children) degrades to the
+        # least_kv load key below — never a dead end.
+        if self.policy == "prefix_affinity":
+            mod = self._prefix_mod
+            prompt = (spec or {}).get("prompt") or ()
+            hashes = mod.chain_hashes(prompt, self.prefix_block_size) \
+                if prompt else []
+
+            def aff(n: str) -> int:
+                adv = metas[n].health.get("prefix_keys")
+                if not hashes or not adv:
+                    return 0
+                return mod.overlap(hashes, adv)
+            best = max(aff(n) for n in names)
+            if best > 0:
+                names = [n for n in names if aff(n) == best]
+
+        use_bytes = self.policy in ("least_kv", "prefix_affinity") \
+            and all(metas[n].health.get("kv_bytes_live") is not None
+                    for n in names)
 
         def load_key(n: str):
             if self.policy == "least_pending":
@@ -453,7 +547,8 @@ class FleetRouter:
                 entry = self._inflight.get(uid)
                 if entry is None:
                     return None                     # already terminal
-                name = self._pick(self._replicas, now, exclude, refused)
+                name = self._pick(self._replicas, now, exclude, refused,
+                                  entry["spec"])
                 if name is None:
                     self._backlog.append(uid)
                     return None
@@ -462,6 +557,14 @@ class FleetRouter:
                 meta.inflight += 1
                 if meta.breaker == "half_open":
                     meta.probe_uid = uid
+                    # Entry-level probe stamp: meta.probe_uid is
+                    # cleared by _open_breaker when the health refresh
+                    # notices the crash/stall BEFORE the lost event is
+                    # absorbed, so the no-charge probe_loss rule needs
+                    # a marker that survives the breaker transition.
+                    entry["probe"] = name
+                else:
+                    entry.pop("probe", None)
                 entry["replica"] = name
                 attempt = entry["attempts"]
                 entry["attempts"] += 1
@@ -483,6 +586,7 @@ class FleetRouter:
                 if ent is not None:
                     ent["replica"] = None
                     ent["attempts"] -= 1
+                    ent.pop("probe", None)
             refused = refused + (name,)
 
     # --------------------------------------------------------- intake
@@ -532,6 +636,7 @@ class FleetRouter:
             meta = self._replicas.get(src or entry["replica"])
             if status in _TERMINAL:
                 self._done[uid] = status
+                self._tenant_fold(entry["spec"], status, ev)
                 del self._inflight[uid]
                 self.results[uid] = ev
                 if self._slo is not None:
@@ -605,6 +710,8 @@ class FleetRouter:
             entry["from"] = src
             entry.pop("stage", None)
             entry.pop("spooled_at", None)
+            probe_loss = status == "lost" and src is not None \
+                and entry.pop("probe", None) == src
             if meta is not None:
                 if not spool_lost:
                     meta.inflight = max(meta.inflight - 1, 0)
@@ -622,13 +729,25 @@ class FleetRouter:
                     self._router_done(self._done, self._inflight,
                                       uid, "timeout", src)
                     return
-                if entry["retries"] >= self.max_retries:
+                if probe_loss:
+                    # A half-open probe that went down WITH its target
+                    # was the ROUTER's gamble, not the request's fault:
+                    # re-opening the breaker is the whole verdict, and
+                    # the uid keeps its retry budget.  Charging it lets
+                    # a permanently wedged replica (hang drill: never
+                    # crashes, eats every probe for stall_after_s) burn
+                    # the same request's max_retries through repeated
+                    # probes until the router kills it "failed" — the
+                    # PR-16 straggler-flake root cause.
+                    action = "retry"
+                elif entry["retries"] >= self.max_retries:
                     self._router_done(self._done, self._inflight,
                                       uid, "failed", src)
                     return
-                entry["retries"] += 1
-                self._retries += 1
-                action = "retry"
+                else:
+                    entry["retries"] += 1
+                    self._retries += 1
+                    action = "retry"
         self._dispatch(uid, action,
                        exclude=(src,) if src else ())
 
@@ -639,6 +758,7 @@ class FleetRouter:
         retry budget exhausted).  The caller holds ``_lock`` and passes
         the guarded maps in."""
         done[uid] = status
+        self._tenant_fold(inflight[uid]["spec"], status, {})
         del inflight[uid]
         self._router_terminal += 1
         self.results[uid] = {"uid": uid, "status": status,
@@ -648,6 +768,32 @@ class FleetRouter:
             # exhausted) are fleet failures too — scored bad like any
             # replica-reported non-ok.
             self._slo_absorb(status, {})
+
+    # --------------------------------------------------------- tenants
+
+    def _tenant_fold(self, spec: Optional[Dict[str, Any]], status: str,
+                     ev: Dict[str, Any]) -> None:
+        """Fold one fleet-terminal event into its tenant's ledger.
+        Takes ``_lock`` (reentrant — callers already inside the absorb
+        critical section just re-enter, the _slo_absorb idiom).  No-op
+        unless --tenants armed, so legacy fleets pay nothing.  With an
+        SLO spec armed too, the event is ALSO scored into the tenant's
+        own list — the pure input the per-tenant verdicts in
+        fleet_summary are computed from (same score_windows discipline
+        as the fleet-level verdict, so two summary calls agree
+        bit-for-bit)."""
+        if self._tenants is None:
+            return
+        tenant = (spec or {}).get("tenant", "default")
+        with self._lock:
+            counts = self._tenant_counts.setdefault(tenant, {})
+            counts[status] = counts.get(status, 0) + 1
+            if self._slo is not None:
+                verdict = self._slo_mod.score_event(
+                    self._slo, status, ttft_ms=ev.get("ttft_ms"),
+                    tpot_ms=ev.get("tpot_ms"))
+                self._tenant_scored.setdefault(tenant, []).append(
+                    verdict)
 
     # ------------------------------------------------------------- slo
 
@@ -943,6 +1089,12 @@ class FleetRouter:
             in_spool = sum(1 for e in self._inflight.values()
                            if e.get("stage") == "spool")
             slo_scored = list(self._slo_scored)
+            tenant_counts = {t: dict(c) for t, c
+                             in self._tenant_counts.items()}
+            tenant_scored = {t: list(s) for t, s
+                             in self._tenant_scored.items()}
+            health_snaps = [dict(self._replicas[n].health)
+                            for n in self._order]
         ok = sum(1 for s in done.values() if s == "ok")
         terminal = len(done)
         counts = {s: sum(1 for v in done.values() if v == s)
@@ -1004,6 +1156,68 @@ class FleetRouter:
             rec["slo_worst_burn"] = wb
             if wi is not None:
                 rec["slo_worst_window"] = wi
+        if self._tenants is not None:
+            # v17 per-tenant ledger: status counts + availability per
+            # tenant, the spec's declared shape (weight/class/budget),
+            # admitted tokens folded from the replicas' heartbeat
+            # ledgers, and — SLO armed — a per-tenant verdict computed
+            # PURELY from the tenant's scored list (same score_windows
+            # discipline as the fleet verdict: two summary calls agree
+            # bit-for-bit).  This block is the noisy_neighbor
+            # assertion surface: fair keeps the victim's verdict
+            # "pass" where FIFO demonstrably breaches it.
+            admitted: Dict[str, int] = {}
+            for h in health_snaps:
+                for t, v in (h.get("tenant_admitted") or {}).items():
+                    admitted[t] = admitted.get(t, 0) + int(v)
+            tnames = list(self._tenants)
+            for extra in (tenant_counts, admitted):
+                for t in extra:
+                    if t not in tnames:
+                        tnames.append(t)
+            tenants_rec: Dict[str, Any] = {}
+            for t in tnames:
+                c = tenant_counts.get(t, {})
+                ok_t = c.get("ok", 0)
+                term_t = sum(c.values())
+                ent: Dict[str, Any] = {
+                    "counts": c,
+                    "availability": round(ok_t / term_t, 3)
+                    if term_t else 1.0}
+                ts = self._tenants.get(t)
+                if ts is not None:
+                    ent["weight"] = float(getattr(ts, "weight", 1.0))
+                    ent["slo_class"] = getattr(ts, "slo_class", "batch")
+                    budget = getattr(ts, "budget", None)
+                    if budget is not None:
+                        ent["budget"] = int(budget)
+                if t in admitted:
+                    ent["admitted_tokens"] = admitted[t]
+                if self._slo is not None:
+                    mod = self._slo_mod
+                    wins = mod.score_windows(
+                        tenant_scored.get(t, []), self.slo_window,
+                        self._slo["availability"])
+                    t_breaches = sum(1 for w in wins
+                                     if w["burn_rate"] > 1.0)
+                    ent["slo_verdict"] = "fail" if t_breaches \
+                        else "pass"
+                    ent["slo_breaches"] = t_breaches
+                tenants_rec[t] = ent
+            rec["tenants"] = tenants_rec
+        # v17 fleet-level prefix hit rate: raw reuse counters summed
+        # over every advertising replica's latest heartbeat — absent
+        # entirely on unarmed fleets (byte-stable legacy streams).
+        shared_tok = prompt_tok = 0
+        prefix_armed = False
+        for h in health_snaps:
+            if h.get("prefix_prompt_tokens") is not None:
+                prefix_armed = True
+                shared_tok += int(h.get("prefix_shared_tokens", 0))
+                prompt_tok += int(h.get("prefix_prompt_tokens", 0))
+        if prefix_armed:
+            rec["prefix_hit_rate"] = round(shared_tok / prompt_tok, 4) \
+                if prompt_tok else 0.0
         if self.scenario:
             rec["scenario"] = self.scenario
         if self.verdict:
@@ -1035,6 +1249,18 @@ class FleetRouter:
                     meta = self._replicas[name]
                     meta.health = dict(
                         meta.health, slo_sketch=snap["slo_sketch"])
+            # v17: the FINAL prefix counters / tenant ledger are what
+            # the summary's prefix_hit_rate and admitted_tokens should
+            # reflect — a short run's last heartbeat (the one with the
+            # settled totals) often lands after the last poll.
+            late = {k: snap[k] for k in
+                    ("prefix_keys", "prefix_shared_tokens",
+                     "prefix_prompt_tokens", "tenant_admitted")
+                    if k in snap}
+            if late:
+                with self._lock:
+                    meta = self._replicas[name]
+                    meta.health = dict(meta.health, **late)
             if snap.get("host_overhead_frac") is not None:
                 # v15: the cumulative fraction is only meaningful once
                 # the run is over — state transitions rarely fire late

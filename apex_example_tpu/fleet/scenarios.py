@@ -44,6 +44,34 @@ number instead of a claim.
                      handoffs (scored: zero lost, every uid exactly
                      one non-drained terminal, ``handoff_redelivered``
                      > 0 — the peer really did the work).
+``noisy_neighbor``   multi-tenant admission chaos (ISSUE 19): one
+                     tenant floods the fleet while a small interactive
+                     tenant ("the victim") carries virtual-step
+                     deadlines.  Run with fair scheduling armed the
+                     scenario passes iff the victim's per-tenant SLO
+                     verdict is "pass" at availability 1.0; run with
+                     ``expect_breach=True`` (the FIFO control arm) it
+                     passes iff the victim DEMONSTRABLY breaches —
+                     asserting both directions is what proves the DWRR
+                     lane did the work.  Deadlines are virtual engine
+                     steps, so both verdicts are bit-reproducible.
+``tenant_burst_starvation``  a bursty batch tenant lands its whole
+                     backlog ahead of a deadline-carrying tenant in
+                     submission order; weighted fair admission must
+                     still run the victim inside its deadline window —
+                     scored on the victim's per-tenant verdict and
+                     availability 1.0 with zero lost overall.
+``prefix_heavy``     prefix-affinity routing (ISSUE 19): each tenant
+                     re-sends prompts sharing its own warm prefix,
+                     wave by wave, with the per-wave submission order
+                     ROTATED so load-based policies scatter tenants
+                     across replicas while ``prefix_affinity`` follows
+                     the advertised hot-prefix keys.  Scored on zero
+                     lost at full availability with the fleet
+                     ``prefix_hit_rate`` measured (and clearing
+                     ``min_hit_rate`` when given) — the
+                     affinity-vs-least_pending strict comparison is the
+                     caller's double run over the same spec stream.
 ``none``             no chaos: route, serve, summarize (the baseline
                      the chaos scores are read against).
 
@@ -63,7 +91,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 SCENARIOS = ("none", "rolling_restart", "crash_storm", "straggler",
-             "prefill_crash", "decode_crash_midspool")
+             "prefill_crash", "decode_crash_midspool",
+             "noisy_neighbor", "tenant_burst_starvation",
+             "prefix_heavy")
 
 
 def synthetic_specs(n: int, *, vocab_size: int = 256, seed: int = 0,
@@ -71,22 +101,40 @@ def synthetic_specs(n: int, *, vocab_size: int = 256, seed: int = 0,
                     temperature: float = 0.0, top_k: int = 0,
                     eos_id: Optional[int] = None,
                     deadline_s: Optional[float] = None,
+                    deadline_step: Optional[int] = None,
+                    tenant: Optional[str] = None,
+                    shared_prefix: int = 0,
                     uid_prefix: str = "fl") -> List[Dict[str, Any]]:
     """Deterministic request specs for the router (plain dicts — the
     jax-free counterpart of serve/loadgen.synthetic_requests, which
     this module must not import).  Uids are ``<prefix>-0000``-style and
     unique per prefix; the router stamps arrival itself, so there is no
-    virtual-step staggering here — fleet arrivals are wall-clock."""
+    virtual-step staggering here — fleet arrivals are wall-clock.
+
+    v17 multi-tenant knobs: ``tenant`` stamps every spec (the replica's
+    make_request threads it onto the Request, the router folds
+    terminals into that tenant's ledger); ``shared_prefix`` prepends
+    one common N-token prefix drawn ONCE from the same stream — per
+    (seed, shared_prefix) deterministic, so two tenants with different
+    seeds get DISJOINT warm sets (what prefix_affinity routes on);
+    ``deadline_step`` is an absolute virtual-step deadline on the
+    serving engine — the bit-reproducible breach mechanism the
+    noisy_neighbor verdicts rely on (no wall clocks involved)."""
     if n < 1:
         raise ValueError(f"need n >= 1 specs, got {n}")
+    if shared_prefix < 0:
+        raise ValueError(f"shared_prefix must be >= 0, "
+                         f"got {shared_prefix}")
     rnd = random.Random(seed)
+    prefix = [rnd.randrange(vocab_size) for _ in range(shared_prefix)]
     out: List[Dict[str, Any]] = []
     for i in range(n):
         p = rnd.randint(prompt_len[0], prompt_len[1])
         m = rnd.randint(max_new[0], max_new[1])
         spec: Dict[str, Any] = {
             "uid": f"{uid_prefix}-{i:04d}",
-            "prompt": [rnd.randrange(vocab_size) for _ in range(p)],
+            "prompt": prefix + [rnd.randrange(vocab_size)
+                                for _ in range(p)],
             "max_new_tokens": m,
             "temperature": temperature,
             "top_k": top_k,
@@ -95,6 +143,10 @@ def synthetic_specs(n: int, *, vocab_size: int = 256, seed: int = 0,
             spec["eos_id"] = eos_id
         if deadline_s is not None:
             spec["deadline_s"] = deadline_s
+        if deadline_step is not None:
+            spec["deadline_step"] = int(deadline_step)
+        if tenant is not None:
+            spec["tenant"] = tenant
         out.append(spec)
     return out
 
@@ -135,14 +187,16 @@ def _wait_restarted(router, replica, restarts_before: int,
 
 def _finish(router, name: str, *, availability_min: float,
             checks: Optional[Dict[str, bool]] = None,
-            summary_checks: Optional[Dict[str, Any]] = None
-            ) -> Dict[str, Any]:
+            summary_checks: Optional[Dict[str, Any]] = None,
+            slo_gate: bool = True) -> Dict[str, Any]:
     """Score the run: verdict "pass" iff nothing was lost, fleet
     availability clears the bar, and every scenario-specific check
     held.  ``summary_checks`` maps check names to predicates over the
     summary record (for invariants only computable at close, like the
-    disagg redelivery count).  Writes the fleet_summary and closes the
-    router stream."""
+    disagg redelivery count).  ``slo_gate=False`` drops the global SLO
+    verdict from the score — the noisy_neighbor CONTROL arm expects a
+    breach, so the fleet-level fail is the point, not a defect.
+    Writes the fleet_summary and closes the router stream."""
     summary = router.summary_record()
     checks = dict(checks or {})
     for key, predicate in (summary_checks or {}).items():
@@ -153,7 +207,7 @@ def _finish(router, name: str, *, availability_min: float,
     # unarmed scenarios score exactly as before).
     ok = (summary["lost"] == 0
           and summary["availability"] >= availability_min
-          and summary.get("slo_verdict") != "fail"
+          and (not slo_gate or summary.get("slo_verdict") != "fail")
           and all((checks or {}).values()))
     router.scenario = name
     router.verdict = "pass" if ok else "fail"
@@ -407,6 +461,147 @@ def run_decode_crash_midspool(router, replicas, specs, *,
                            lambda s: s.get("in_spool", 0) == 0})
 
 
+def _tenant_entry(summary: Dict[str, Any],
+                  tenant: str) -> Dict[str, Any]:
+    return (summary.get("tenants") or {}).get(tenant) or {}
+
+
+def run_noisy_neighbor(router, replicas, specs, *,
+                       victim: str,
+                       expect_breach: bool = False,
+                       timeout_s: float = 120.0,
+                       availability_min: float = 1.0
+                       ) -> Dict[str, Any]:
+    """Multi-tenant admission chaos (ISSUE 19): the spec stream puts a
+    flooding tenant's whole backlog AHEAD of a small interactive
+    tenant whose requests carry virtual-step deadlines.  Everything is
+    pre-submitted before ``start()`` (the crash_storm discipline), so
+    which victim requests expire is a pure function of the stream —
+    both arms of the verdict are bit-reproducible.
+
+    Fair arm (default): the replicas run with --tenants armed; DWRR
+    admits the interactive victim ahead of the flood and the scenario
+    passes iff the victim's per-tenant SLO verdict is "pass" at
+    per-tenant availability 1.0 (zero lost, fleet availability >=
+    ``availability_min``).
+
+    Control arm (``expect_breach=True``): same stream, FIFO replicas
+    (no --tenants on the engine; the ROUTER keeps tenant_specs so the
+    per-tenant ledger still folds).  The scenario passes iff the
+    victim DEMONSTRABLY breaches — verdict "fail" with per-tenant
+    availability < 1.0.  Asserting both arms is what proves fair
+    admission, not workload slack, saved the victim."""
+    t0 = time.perf_counter()
+    for spec in specs:
+        router.submit(spec)
+    for replica in replicas:
+        replica.start()                 # idempotent on both transports
+    done = _drive(router, router.done, timeout_s)
+    router.trace_event("X", "scenario:noisy_neighbor", ts=t0,
+                       dur=time.perf_counter() - t0)
+    if expect_breach:
+        return _finish(
+            router, "noisy_neighbor",
+            availability_min=0.0, slo_gate=False,
+            checks={"completed_in_time": done},
+            summary_checks={
+                "victim_breached": lambda s:
+                    _tenant_entry(s, victim).get("slo_verdict")
+                    == "fail",
+                "victim_impacted": lambda s:
+                    _tenant_entry(s, victim).get("availability", 1.0)
+                    < 1.0})
+    return _finish(
+        router, "noisy_neighbor",
+        availability_min=availability_min,
+        checks={"completed_in_time": done},
+        summary_checks={
+            "victim_slo_pass": lambda s:
+                _tenant_entry(s, victim).get("slo_verdict") == "pass",
+            "victim_available": lambda s:
+                _tenant_entry(s, victim).get("availability") == 1.0})
+
+
+def run_tenant_burst_starvation(router, replicas, specs, *,
+                                victim: str,
+                                timeout_s: float = 120.0,
+                                availability_min: float = 1.0
+                                ) -> Dict[str, Any]:
+    """A bursty batch tenant lands its whole backlog ahead of the
+    deadline-carrying ``victim`` in submission order (the caller
+    builds the stream that way); weighted fair admission must still
+    run the victim inside its virtual deadline window.  Scored on the
+    victim's per-tenant SLO verdict and availability 1.0, zero lost
+    overall — pre-submitted stream, so bit-reproducible like
+    noisy_neighbor's fair arm."""
+    t0 = time.perf_counter()
+    for spec in specs:
+        router.submit(spec)
+    for replica in replicas:
+        replica.start()                 # idempotent on both transports
+    done = _drive(router, router.done, timeout_s)
+    router.trace_event("X", "scenario:tenant_burst_starvation", ts=t0,
+                       dur=time.perf_counter() - t0)
+    return _finish(
+        router, "tenant_burst_starvation",
+        availability_min=availability_min,
+        checks={"completed_in_time": done},
+        summary_checks={
+            "victim_slo_pass": lambda s:
+                _tenant_entry(s, victim).get("slo_verdict") == "pass",
+            "victim_available": lambda s:
+                _tenant_entry(s, victim).get("availability") == 1.0})
+
+
+def run_prefix_heavy(router, replicas, specs, *,
+                     timeout_s: float = 120.0,
+                     availability_min: float = 1.0,
+                     min_hit_rate: Optional[float] = None
+                     ) -> Dict[str, Any]:
+    """Prefix-affinity routing drill (ISSUE 19): specs are partitioned
+    by tenant and submitted WAVE BY WAVE — one spec per tenant per
+    wave, drive to done between waves so every replica's hot-prefix
+    advertisement is settled before the next wave routes.  The
+    per-wave submission order rotates by wave index: a load-based
+    policy (least_pending tie-breaks on live bookings) scatters each
+    tenant across replicas wave over wave, while ``prefix_affinity``
+    follows the advertised chain keys and keeps every tenant's warm
+    set on one replica.  Scored on zero lost at full availability
+    with the fleet ``prefix_hit_rate`` measured (and clearing
+    ``min_hit_rate`` when given); the strict affinity-beats-
+    least_pending comparison is the caller's double run over the SAME
+    spec stream — same waves, same rotation, only the policy
+    differs."""
+    t0 = time.perf_counter()
+    for replica in replicas:
+        replica.start()                 # idempotent on both transports
+    by_tenant: Dict[str, List[Dict[str, Any]]] = {}
+    for spec in specs:
+        by_tenant.setdefault(
+            spec.get("tenant", "default"), []).append(spec)
+    tnames = list(by_tenant)
+    waves_done = True
+    wave = 0
+    while any(by_tenant.values()):
+        pivot = wave % len(tnames)
+        for name in tnames[pivot:] + tnames[:pivot]:
+            if by_tenant[name]:
+                router.submit(by_tenant[name].pop(0))
+        waves_done &= _drive(router, router.done, timeout_s)
+        wave += 1
+    router.trace_event("X", "scenario:prefix_heavy", ts=t0,
+                       dur=time.perf_counter() - t0)
+    summary_checks: Dict[str, Any] = {
+        "hit_rate_measured": lambda s: "prefix_hit_rate" in s}
+    if min_hit_rate is not None:
+        summary_checks["hit_rate_cleared"] = \
+            lambda s: s.get("prefix_hit_rate", 0.0) >= min_hit_rate
+    return _finish(router, "prefix_heavy",
+                   availability_min=availability_min,
+                   checks={"completed_in_time": waves_done},
+                   summary_checks=summary_checks)
+
+
 def run_scenario(name: str, router, replicas, specs,
                  **kw) -> Dict[str, Any]:
     """Dispatch by scenario name (the ``fleet.py --scenario`` surface)."""
@@ -418,5 +613,8 @@ def run_scenario(name: str, router, replicas, specs,
           "crash_storm": run_crash_storm,
           "straggler": run_straggler,
           "prefill_crash": run_prefill_crash,
-          "decode_crash_midspool": run_decode_crash_midspool}[name]
+          "decode_crash_midspool": run_decode_crash_midspool,
+          "noisy_neighbor": run_noisy_neighbor,
+          "tenant_burst_starvation": run_tenant_burst_starvation,
+          "prefix_heavy": run_prefix_heavy}[name]
     return fn(router, replicas, specs, **kw)

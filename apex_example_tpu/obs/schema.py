@@ -343,6 +343,33 @@ and ``output_tokens == tokens_accepted + tokens_sampled`` (ci_gate
 unarmed run's stream is byte-identical to v15 output, and v16 is once
 more a strict superset: every v1–v15 stream validates unchanged.
 
+Version 17 adds the multi-tenant scheduling stratum
+(apex_example_tpu/sched/; ``--tenants`` on serve.py / fleet.py —
+README "Multi-tenant scheduling & prefix-affinity routing"):
+
+- ``tenant`` on ``request_complete`` / ``request_failed`` / ``shed``
+  names the lane the request was filed under;
+- ``tenants`` on ``serve_summary`` is the engine's per-tenant
+  scheduling ledger (weight, slo_class, admitted_tokens, budget,
+  per-status counts), and on ``fleet_summary`` the router's
+  per-tenant verdict block (per-status counts, availability, an
+  ``slo_verdict`` per tenant with an SLO spec, admitted_tokens /
+  budget folded from replica heartbeats);
+- ``prefix_keys`` / ``prefix_shared_tokens`` / ``prefix_prompt_tokens``
+  on ``replica_state`` advertise the replica's hottest prefix
+  chain-key hashes (sched/prefix.py digests, top-N by block refcount)
+  and its raw prefix-reuse counters (``--advertise-prefixes``), the
+  inputs to the ``prefix_affinity`` router policy;
+- ``tenant_admitted`` on ``replica_state`` carries the engine's
+  per-tenant admitted-token totals so the router can account budgets
+  fleet-wide;
+- ``prefix_hit_rate`` on ``fleet_summary`` is the exact fleet-level
+  ratio (sum of advertised shared tokens / sum of prompt tokens).
+
+All emitted ONLY when tenancy / prefix advertisement is armed — an
+unarmed run's stream is byte-identical to v16 output, and v17 is once
+more a strict superset: every v1–v16 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -354,7 +381,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 16
+SCHEMA_VERSION = 17
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -651,6 +678,7 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "finished_step": int,   #   audits key on these)
         "temperature": _NUM,
         "top_k": int,
+        "tenant": str,          # v17: the scheduling lane (--tenants)
     },
     "serve_summary": {
         "run_id": str,
@@ -733,6 +761,12 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
                                     #   + plain/sampled-path tokens)
         "acceptance_rate": _NUM,    # accepted / drafted (0.0 if none)
         "tokens_per_tick": _NUM,    # output_tokens / compute_steps
+        # v17: the per-tenant scheduling ledger (sched/; --tenants).
+        # Absent unless tenancy armed — unarmed streams stay
+        # byte-identical to v16.
+        "tenants": dict,            # name -> {weight, slo_class,
+                                    #   admitted_tokens, budget?,
+                                    #   per-status counts}
     },
     "preemption": {
         "run_id": str,
@@ -763,12 +797,14 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "queue_wait_ms": _NUM,
         "e2e_ms": _NUM,
         "error": str,            # traceback digest (status "failed")
+        "tenant": str,           # v17: the scheduling lane (--tenants)
     },
     "shed": {
         "run_id": str,
         "step": int,             # engine tick of the rejection
         "pending": int,          # ARRIVED backlog after the shed (what
         "max_pending": int,      #   the tripped bound actually counts)
+        "tenant": str,           # v17: the scheduling lane (--tenants)
     },
     "serve_drain": {
         "run_id": str,
@@ -855,6 +891,16 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
                                      #   host-overhead fraction
                                      #   (--tick-profile armed) —
                                      #   fleet_report ranks these
+        # v17: prefix-cache advertisement (--advertise-prefixes) — the
+        # hot chain-key digests prefix_affinity routing scores against,
+        # plus the raw reuse counters the fleet hit rate is exact over.
+        "prefix_keys": list,         # top-N sched/prefix.py digests,
+                                     #   hottest (highest refcount) first
+        "prefix_shared_tokens": int,  # prompt tokens served from the
+                                      #   prefix index, cumulative
+        "prefix_prompt_tokens": int,  # prompt tokens admitted, cumulative
+        "tenant_admitted": dict,      # v17: tenant -> admitted tokens
+                                      #   (--tenants armed)
     },
     # --- schema v11: quantization records (apex_example_tpu/quant/) ---
     "quant_event": {
@@ -928,6 +974,14 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "slo_breaches": int,      # windows with burn_rate > 1.0
         "slo_worst_burn": _NUM,   # max window burn rate
         "slo_worst_window": int,  # its 0-based index (first on ties)
+        # v17 (ISSUE 19): the multi-tenant verdict block + fleet-level
+        # prefix reuse.  Absent unless tenancy / prefix advertisement
+        # is armed.
+        "tenants": dict,          # name -> {per-status counts,
+                                  #   availability, slo_verdict?,
+                                  #   admitted_tokens?, budget?}
+        "prefix_hit_rate": _NUM,  # sum advertised shared / prompt
+                                  #   tokens across replicas
     },
     # --- schema v14: streaming SLO records (obs/slo.py; --slo) ---
     "slo_window": {

@@ -1,0 +1,20 @@
+"""sched/ — the multi-tenant scheduling stratum (ISSUE 19).
+
+In-package convenience surface.  Like fleet/__init__.py and
+spec/__init__.py this module is deliberately NOT on the graftlint
+jax-free contract: importing it via the package walks the jax-carrying
+apex_example_tpu/__init__.py edge.  Jax-free callers (fleet router,
+tools) load sched/prefix.py and sched/tenants.py by FILE PATH.
+"""
+
+from .fair import DEFAULT_QUANTUM, FairScheduler, request_cost
+from .prefix import chain_hashes, hash_prefix, overlap
+from .tenants import (DEFAULT_SPEC, DEFAULT_TENANT, SLO_CLASSES,
+                      TenantSpec, parse_tenants, tenant_names)
+
+__all__ = [
+    "DEFAULT_QUANTUM", "FairScheduler", "request_cost",
+    "chain_hashes", "hash_prefix", "overlap",
+    "DEFAULT_SPEC", "DEFAULT_TENANT", "SLO_CLASSES",
+    "TenantSpec", "parse_tenants", "tenant_names",
+]

@@ -1,0 +1,81 @@
+"""Canonical prefix chain hashes — the fleet-routable view of the
+block-paged prefix index (ISSUE 19).
+
+Pure stdlib ON PURPOSE (jax-free by the graftlint contract, like
+fleet/ and obs/slo.py): the fleet router loads this module by FILE
+PATH to hash an incoming prompt's chain keys, and it must keep doing
+so on hosts where the replicas' jax is the thing that died.
+
+The serve-side prefix index (serve/slots.py BlockAllocator) keys full
+blocks on the recursive chain key ``(parent_key, tokens)`` — a block's
+key encodes every token before it.  That structure cannot travel in a
+heartbeat (it is a nest of tuples holding the raw tokens).  What CAN
+travel is a short stable hash of the *cumulative token prefix* each
+indexed block covers: block i of a prompt hashes
+``prompt[0:(i + 1) * block_size]``.  Both sides of the fence compute
+the same digest:
+
+- a serve replica advertises ``hash_prefix()`` digests of its hottest
+  indexed blocks (``BlockPool.hot_prefix_hashes``, ranked by refcount)
+  in ``replica_state`` heartbeats;
+- the router computes ``chain_hashes()`` of an incoming prompt and
+  scores candidates by overlap (fleet/router.py policy
+  ``prefix_affinity``).
+
+The per-prompt chain mirrors ``BlockAllocator.match_prefix``'s cap:
+only blocks fully contained in ``prompt[:-1]`` are useful (the last
+prompt token is always re-fed to produce the first sampled token's
+logits), so ``chain_hashes`` stops at ``(len(prompt) - 1) //
+block_size`` full blocks.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Sequence
+
+# Digest namespace version: bump if the hashing scheme ever changes so
+# a mixed fleet's stale advertisements can never false-match.
+_TAG = b"apex-prefix-v1:"
+
+
+def hash_prefix(tokens: Sequence[int]) -> str:
+    """Stable 8-hex-digit digest of one cumulative token prefix.
+
+    crc32 over the decimal-rendered token ids — stdlib, byte-order
+    free, and identical however the caller stores its tokens (list,
+    tuple, numpy scalars that stringify as ints)."""
+    payload = _TAG + ",".join(str(int(t)) for t in tokens).encode()
+    return f"{zlib.crc32(payload) & 0xFFFFFFFF:08x}"
+
+
+def chain_hashes(tokens: Sequence[int], block_size: int) -> List[str]:
+    """The prompt's chain-key digests, one per USEFUL full block:
+    entry i hashes ``tokens[0:(i + 1) * block_size]`` — exactly the
+    cumulative prefix an indexed serve-side block at depth i covers.
+    Capped at ``(len(tokens) - 1) // block_size`` (match_prefix re-feeds
+    the last prompt token, so a block ending exactly at the prompt
+    boundary is never shareable)."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    toks = [int(t) for t in tokens]
+    n_blocks = max(len(toks) - 1, 0) // block_size
+    return [hash_prefix(toks[:(i + 1) * block_size])
+            for i in range(n_blocks)]
+
+
+def overlap(prompt_hashes: Sequence[str],
+            advertised: Sequence[str]) -> int:
+    """Affinity score: the DEPTH of the advertised chain along the
+    prompt — chain hashes are cumulative, so the score counts leading
+    entries of ``prompt_hashes`` present in ``advertised`` and stops at
+    the first miss (a replica holding block 3 but not block 2 of this
+    prompt cannot actually serve block 3 from cache; counting it would
+    overpromise)."""
+    adv = set(advertised)
+    depth = 0
+    for h in prompt_hashes:
+        if h not in adv:
+            break
+        depth += 1
+    return depth

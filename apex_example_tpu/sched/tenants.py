@@ -1,0 +1,140 @@
+"""Tenant specs: who is asking, what they may spend, how they are
+judged (ISSUE 19).
+
+Pure stdlib (jax-free by the graftlint contract): tenant specs are
+parsed by serve.py AND by the fleet router/loadgen side, which must
+run on hosts whose jax is the thing that died.
+
+A ``--tenants`` spec is a ``;``-separated list of tenant clauses::
+
+    name[:key=value[,key=value...]]
+
+with keys
+
+    weight=FLOAT   DWRR weight (relative admission share), default 1
+    budget=INT     total token budget (prompt + max_new per admitted
+                   request); omitted = unlimited
+    class=STR      SLO class: ``interactive`` (TTFT-critical lane,
+                   preempts batch admission) or ``batch`` (default)
+    mix=FLOAT      loadgen arrival share (relative), default 1
+    burst=INT      loadgen burst size for this tenant, default 1
+    shared_prefix=INT
+                   loadgen per-tenant shared warm prefix length,
+                   default 0
+
+e.g. ``--tenants "prod:weight=4,class=interactive;scraper:weight=1,budget=400"``.
+
+Unknown tenants encountered at admission auto-lane with DEFAULT_SPEC
+semantics (weight 1, no budget, batch) — a fleet never drops a request
+because a replica's spec list lagged the router's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+DEFAULT_TENANT = "default"
+
+SLO_CLASSES = ("interactive", "batch")
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    name: str
+    weight: float = 1.0
+    budget: Optional[int] = None     # total tokens; None = unlimited
+    slo_class: str = "batch"
+    # loadgen-only shaping knobs (ignored by the scheduler):
+    mix: float = 1.0
+    burst: int = 1
+    shared_prefix: int = 0
+
+
+DEFAULT_SPEC = TenantSpec(name=DEFAULT_TENANT)
+
+_KEYS = ("weight", "budget", "class", "mix", "burst", "shared_prefix")
+
+
+def parse_tenants(spec: str) -> Dict[str, TenantSpec]:
+    """Parse a ``--tenants`` spec into an ordered name->TenantSpec map.
+
+    Raises ValueError with a pointed message on malformed input —
+    serve.py/fleet.py turn that into a SystemExit at flag-validation
+    time, before any engine spins up.
+    """
+    out: Dict[str, TenantSpec] = {}
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        name, _, body = clause.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"--tenants: empty tenant name in {clause!r}")
+        if name in out:
+            raise ValueError(f"--tenants: duplicate tenant {name!r}")
+        kw: Dict[str, object] = {}
+        if body:
+            for item in body.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                key, eq, val = item.partition("=")
+                key = key.strip()
+                val = val.strip()
+                if not eq or not val:
+                    raise ValueError(
+                        f"--tenants: expected key=value, got {item!r} "
+                        f"in tenant {name!r}")
+                if key not in _KEYS:
+                    raise ValueError(
+                        f"--tenants: unknown key {key!r} in tenant "
+                        f"{name!r} (known: {', '.join(_KEYS)})")
+                if key == "weight":
+                    kw["weight"] = float(val)
+                    if kw["weight"] <= 0:
+                        raise ValueError(
+                            f"--tenants: weight must be > 0 in tenant "
+                            f"{name!r}, got {val}")
+                elif key == "budget":
+                    kw["budget"] = int(val)
+                    if kw["budget"] < 0:
+                        raise ValueError(
+                            f"--tenants: budget must be >= 0 in tenant "
+                            f"{name!r}, got {val}")
+                elif key == "class":
+                    if val not in SLO_CLASSES:
+                        raise ValueError(
+                            f"--tenants: class must be one of "
+                            f"{'|'.join(SLO_CLASSES)} in tenant "
+                            f"{name!r}, got {val!r}")
+                    kw["slo_class"] = val
+                elif key == "mix":
+                    kw["mix"] = float(val)
+                    if kw["mix"] <= 0:
+                        raise ValueError(
+                            f"--tenants: mix must be > 0 in tenant "
+                            f"{name!r}, got {val}")
+                elif key == "burst":
+                    kw["burst"] = int(val)
+                    if kw["burst"] < 1:
+                        raise ValueError(
+                            f"--tenants: burst must be >= 1 in tenant "
+                            f"{name!r}, got {val}")
+                elif key == "shared_prefix":
+                    kw["shared_prefix"] = int(val)
+                    if kw["shared_prefix"] < 0:
+                        raise ValueError(
+                            f"--tenants: shared_prefix must be >= 0 in "
+                            f"tenant {name!r}, got {val}")
+        out[name] = TenantSpec(name=name, **kw)
+    if not out:
+        raise ValueError("--tenants: no tenants in spec")
+    return out
+
+
+def tenant_names(specs: Dict[str, TenantSpec]) -> List[str]:
+    """Spec order = lane visit order (and loadgen substream index
+    order) — insertion-ordered dicts make this deterministic."""
+    return list(specs)

@@ -43,7 +43,8 @@ from apex_example_tpu.serve.engine import (ServeEngine, SlotFailure,
                                            request_complete_record,
                                            request_failed_record)
 from apex_example_tpu.serve.loadgen import (parse_range, substream,
-                                            synthetic_requests)
+                                            synthetic_requests,
+                                            tenant_requests)
 from apex_example_tpu.serve.queue import (STATUSES, Completion, Request,
                                           RequestQueue)
 from apex_example_tpu.serve.slots import BlockAllocator, BlockPool, Slot
@@ -54,5 +55,5 @@ __all__ = [
     "RequestQueue", "STATUSES", "ServeEngine", "Slot", "SlotFailure",
     "parse_range", "request_complete_record", "request_failed_record",
     "run_decode_role", "run_disagg", "run_prefill_role", "substream",
-    "synthetic_requests",
+    "synthetic_requests", "tenant_requests",
 ]

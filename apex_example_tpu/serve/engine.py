@@ -268,8 +268,11 @@ class SlotFailure(RuntimeError):
 
 
 def request_complete_record(comp: Completion,
-                            run_id: Optional[str] = None) -> Dict[str, Any]:
-    """The schema-v3 ``request_complete`` record for one ok completion."""
+                            run_id: Optional[str] = None, *,
+                            with_tenant: bool = False) -> Dict[str, Any]:
+    """The schema-v3 ``request_complete`` record for one ok completion.
+    ``with_tenant`` (v17) stamps the scheduling lane — only set when
+    tenancy is armed, so legacy streams stay byte-identical."""
     rec: Dict[str, Any] = {
         "record": "request_complete",
         "time": _wall(),
@@ -287,13 +290,16 @@ def request_complete_record(comp: Completion,
         "temperature": float(comp.request.temperature),
         "top_k": int(comp.request.top_k),
     }
+    if with_tenant:
+        rec["tenant"] = getattr(comp.request, "tenant", "default")
     if run_id:
         rec["run_id"] = run_id
     return rec
 
 
 def request_failed_record(comp: Completion,
-                          run_id: Optional[str] = None) -> Dict[str, Any]:
+                          run_id: Optional[str] = None, *,
+                          with_tenant: bool = False) -> Dict[str, Any]:
     """The schema-v5 ``request_failed`` record for a timeout / cancelled
     / failed completion (drained requests ride the ``serve_drain``
     record instead — they are requeued, not failed)."""
@@ -314,6 +320,8 @@ def request_failed_record(comp: Completion,
     rec["e2e_ms"] = round(comp.e2e_s * 1e3, 3)
     if comp.error:
         rec["error"] = comp.error
+    if with_tenant:
+        rec["tenant"] = getattr(comp.request, "tenant", "default")
     if run_id:
         rec["run_id"] = run_id
     return rec
@@ -344,7 +352,9 @@ class ServeEngine:
                  handoff_sink=None, slo=None,
                  slo_window_s: Optional[float] = None,
                  slo_window_ticks: int = 0, tick_profiler=None,
-                 speculate: int = 0, proposer=None):
+                 speculate: int = 0, proposer=None,
+                 tenants=None, tag_tenants: bool = False,
+                 advertise_prefixes: int = 0):
         if weight_quant not in ("none", "int8", "fp8"):
             raise ValueError(f"weight_quant must be none|int8|fp8, got "
                              f"{weight_quant!r}")
@@ -516,6 +526,32 @@ class ServeEngine:
         self.idle_ticks = 0
         self.idle_wait_ms = 0.0
         self._spool_ms = 0.0
+        # --tenants (sched/, ISSUE 19): deficit-weighted round-robin
+        # admission over per-tenant lanes.  The intake RequestQueue
+        # stays exactly as-is (arrival gating, shed_overflow, queued
+        # cancellation); matured pops drain into the scheduler's lanes
+        # and the admit loop draws from DWRR order instead of FIFO.
+        # Unarmed (tenants=None) the admit path is UNTOUCHED — streams
+        # stay byte-identical to pre-v17 output.  Zero device work
+        # either way: scheduling is pure host bookkeeping.
+        self.sched = None
+        if tenants is not None:
+            from apex_example_tpu.sched import FairScheduler
+            self.sched = FairScheduler(tenants)
+        # Tenant stamps on terminal records normally ride with the
+        # fair scheduler, but a FIFO control arm (tenancy measured,
+        # fairness dropped) still needs them — its stream feeds the
+        # same ci_gate --tenant-stream conservation ledger.
+        self.tag_tenants = bool(tag_tenants) or self.sched is not None
+        # --advertise-prefixes N (ISSUE 19): publish the N hottest
+        # prefix chain-key digests + raw reuse counters in replica
+        # heartbeats so the fleet router can route on KV CONTENT
+        # (policy prefix_affinity).  Opt-in to keep unarmed heartbeats
+        # byte-identical.
+        if advertise_prefixes < 0:
+            raise ValueError(f"advertise_prefixes must be >= 0, got "
+                             f"{advertise_prefixes}")
+        self.advertise_prefixes = int(advertise_prefixes)
 
     # ---------------------------------------------------------- intake
 
@@ -529,6 +565,8 @@ class ServeEngine:
         or already terminal.  Call from the engine thread (queued-side
         cancellation alone is thread-safe via the queue's lock)."""
         req = self.queue.cancel(uid)
+        if req is None and self.sched is not None:
+            req = self.sched.cancel(uid)
         if req is not None:
             self._terminal_unadmitted(req, "cancelled")
             return True
@@ -572,8 +610,27 @@ class ServeEngine:
             if pool.slots[i].request.expired(step, now):
                 self._terminal_slot(i, "timeout", now)
         if not self.draining:
+            sched = self.sched
+            if sched is not None:
+                # Tenancy armed (ISSUE 19): drain every matured intake
+                # pop into the per-tenant lanes, sweep lane deadlines
+                # the same tick the intake queue sweeps its own, and —
+                # once intake is closed — finalize budget-parked heads
+                # that can provably never admit (budgets never
+                # replenish) so the run loop terminates.
+                while True:
+                    q_req = self.queue.pop(step)
+                    if q_req is None:
+                        break
+                    sched.enqueue(q_req)
+                for req in sched.expire(step, now):
+                    self._terminal_unadmitted(req, "timeout")
+                if self.queue.drained():
+                    for req in sched.reject_overbudget_heads():
+                        self._terminal_unadmitted(req, "rejected")
             while pool.free_count:
-                req = self.queue.pop(step)
+                req = sched.next() if sched is not None \
+                    else self.queue.pop(step)
                 if req is None:
                     break
                 if not pool.fits(req):
@@ -583,6 +640,8 @@ class ServeEngine:
                     # arena — used to occupy a slot and terminate with
                     # ZERO generated tokens.  It can never be served
                     # here; reject it first-class at admission.
+                    if sched is not None:
+                        sched.refund(req)   # unservable ≠ tenant spend
                     self._terminal_unadmitted(req, "rejected")
                     continue
                 if not pool.can_admit(req):
@@ -590,8 +649,12 @@ class ServeEngine:
                     # queueing — the head waits at the queue front
                     # until evictions free its worst-case budget (FIFO
                     # preserved; bounded, since every live slot
-                    # finishes within max_len ticks).
-                    self.queue.push_front(req)
+                    # finishes within max_len ticks).  The scheduler's
+                    # push_front also refunds the budget debit.
+                    if sched is not None:
+                        sched.push_front(req)
+                    else:
+                        self.queue.push_front(req)
                     break
                 pool.admit(req, step)
                 if self._tracer is not None:
@@ -1017,7 +1080,8 @@ class ServeEngine:
             # (the request is continuing elsewhere, not failing here).
             record = request_complete_record if status == "ok" \
                 else request_failed_record
-            self.sink.write(record(comp, self.run_id))
+            self.sink.write(record(comp, self.run_id,
+                                   with_tenant=self.tag_tenants))
 
     def _terminal_unadmitted(self, req: Request, status: str,
                              pending: Optional[int] = None) -> None:
@@ -1050,11 +1114,15 @@ class ServeEngine:
                 else self.queue.arrived_pending(self.step_count)}
             if self.queue.max_pending is not None:
                 rec["max_pending"] = self.queue.max_pending
+            if self.tag_tenants:
+                rec["tenant"] = getattr(req, "tenant", "default")
             if self.run_id:
                 rec["run_id"] = self.run_id
             self.sink.write(rec)
         elif status in ("timeout", "cancelled", "failed", "rejected"):
-            self.sink.write(request_failed_record(comp, self.run_id))
+            self.sink.write(request_failed_record(
+                comp, self.run_id,
+                with_tenant=self.tag_tenants))
         # "drained": accounted by the serve_drain record, not per-request.
 
     # --------------------------------------------------------- handoff
@@ -1270,7 +1338,7 @@ class ServeEngine:
         while max_steps is None or self.step_count < max_steps:
             if stop is not None and stop():
                 break
-            if self.queue.drained() and not self.pool.any_live():
+            if self.work_drained() and not self.pool.any_live():
                 break
             ran = self.step()
             if on_tick is not None:
@@ -1303,7 +1371,12 @@ class ServeEngine:
                                args={"signal": str(signal_name),
                                      "tick": drain_step})
         before = dict(self.counts)
-        requeued = self.queue.drain()
+        requeued = []
+        if self.sched is not None:
+            # Lane-parked requests drained the intake earlier, so they
+            # arrived first — requeue them ahead of the intake backlog.
+            requeued.extend(self.sched.drain())
+        requeued.extend(self.queue.drain())
         for req in requeued:
             self._terminal_unadmitted(req, "drained")
         in_flight = len(self.pool.live)
@@ -1477,6 +1550,24 @@ class ServeEngine:
             if self.compute_steps:
                 rec["tokens_per_tick"] = round(
                     self._tokens_out / self.compute_steps, 4)
+        # v17 (ISSUE 19): the per-tenant scheduling ledger — emitted
+        # ONLY when --tenants armed the fair scheduler, so an unarmed
+        # stream stays byte-identical to pre-v17 output.  Each block
+        # carries the DWRR config (weight / slo_class / budget), the
+        # admitted-token debit total and the per-status terminal counts
+        # (what ci_gate --tenant-stream conserves against the stream's
+        # per-request records).
+        if self.sched is not None:
+            tenants = self.sched.summary()
+            for c in comps:
+                name = getattr(c.request, "tenant", "default")
+                blk = tenants.setdefault(name, {
+                    "weight": float(self.sched.spec(name).weight),
+                    "slo_class": self.sched.spec(name).slo_class,
+                    "admitted_tokens": 0, "queued": 0})
+                counts = blk.setdefault("counts", {})
+                counts[c.status] = counts.get(c.status, 0) + 1
+            rec["tenants"] = tenants
         if self.run_id:
             rec["run_id"] = self.run_id
         return rec
@@ -1493,3 +1584,62 @@ class ServeEngine:
         if self.tickprof is None or not self.tickprof.ticks:
             return None
         return self.tickprof.host_overhead_frac()
+
+    # ---------------------------------------- scheduler-aware work view
+
+    def unadmitted(self) -> int:
+        """Requests waiting anywhere before admission: the intake queue
+        PLUS the scheduler's lanes (v17 — with tenancy armed, lane
+        residents have left ``queue.pending()``'s view but are very
+        much still work)."""
+        n = self.queue.pending()
+        if self.sched is not None:
+            n += self.sched.pending()
+        return n
+
+    def work_drained(self) -> bool:
+        """True once no request can ever arrive or admit again: intake
+        closed and empty, and (tenancy armed) every lane empty.  The
+        run-loop exit test — ``queue.drained()`` alone would strand
+        lane residents."""
+        if not self.queue.drained():
+            return False
+        return self.sched is None or self.sched.pending() == 0
+
+    def runnable_backlog(self) -> int:
+        """Backlog that needs engine ticks RIGHT NOW: intake pops plus
+        admissible lane work.  Budget-parked lanes count only once the
+        intake is drained (a tick then finalizes them ``rejected``);
+        behind an open intake they are NOT runnable — a drive loop
+        with only parked work must idle-wait, not spin virtual time
+        forward (which would race their virtual deadlines against
+        host speed)."""
+        n = self.queue.pending()
+        if self.sched is not None:
+            n += (self.sched.pending() if self.queue.drained()
+                  else self.sched.admissible_pending())
+        return n
+
+    def tenant_admitted(self) -> Optional[Dict[str, int]]:
+        """Per-tenant admitted-token totals for a replica heartbeat
+        (``replica_state.tenant_admitted``); None unless tenancy is
+        armed — unarmed heartbeats stay byte-identical."""
+        if self.sched is None:
+            return None
+        return {name: tok
+                for name, tok in self.sched.admitted_tokens.items()
+                if tok}
+
+    def prefix_advert(self) -> Optional[Dict[str, Any]]:
+        """The prefix-cache advertisement for a replica heartbeat
+        (``replica_state.prefix_keys`` + raw reuse counters); None
+        unless ``--advertise-prefixes`` armed it."""
+        if not self.advertise_prefixes:
+            return None
+        shared, total = self.pool.prefix_counters()
+        return {
+            "prefix_keys": self.pool.hot_prefix_hashes(
+                self.advertise_prefixes),
+            "prefix_shared_tokens": int(shared),
+            "prefix_prompt_tokens": int(total),
+        }

@@ -137,6 +137,77 @@ def synthetic_requests(n: int, *, vocab_size: int, seed: int = 0,
     return out
 
 
+def tenant_requests(n: int, specs, *, vocab_size: int, seed: int = 0,
+                    prompt_len: Tuple[int, int] = (4, 12),
+                    max_new: Tuple[int, int] = (4, 16),
+                    temperature: float = 0.0, top_k: int = 0,
+                    eos_id: Optional[int] = None,
+                    stagger: int = 0,
+                    deadline_steps: Optional[int] = None,
+                    deadline_s: Optional[float] = None,
+                    seed_substream: Optional[int] = None,
+                    repetitive: bool = False) -> List[Request]:
+    """Multi-tenant workload (ISSUE 19): ``n`` total requests split
+    across the ``--tenants`` specs proportionally to each tenant's
+    ``mix`` (largest-remainder apportionment — deterministic, sums to
+    ``n``, every tenant with mix > 0 gets at least one request when
+    n >= len(specs)).
+
+    Tenant i draws from ``substream(seed, i)`` (i = spec order), so
+    per-tenant streams are DISJOINT yet individually deterministic —
+    the same derivation replicas use for fleet workloads, composed:
+    under ``seed_substream`` (replica r) tenant i draws from
+    ``substream(substream(seed, r), i)``, keeping tenants disjoint
+    across replicas too.  ``shared_prefix`` becomes PER-TENANT: each
+    tenant's spec-declared prefix length draws from its own substream,
+    so prefix-heavy traffic has a distinct warm set per tenant
+    (prefix_affinity routing has something to route ON).  ``burst`` is
+    per-tenant as well; arrivals from all tenants merge stably by
+    arrival step (ties keep spec order).
+
+    ``specs`` is an ordered name -> spec map; specs are duck-typed
+    (``mix`` / ``burst`` / ``shared_prefix`` attributes, as on
+    sched/tenants.py TenantSpec)."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 requests, got {n}")
+    if not specs:
+        raise ValueError("tenant_requests needs at least one tenant")
+    base = seed if seed_substream is None \
+        else substream(seed, seed_substream)
+    names = list(specs)
+    mixes = [float(getattr(specs[name], "mix", 1.0)) for name in names]
+    total_mix = sum(mixes)
+    # Largest-remainder apportionment of n across tenants.
+    raw = [n * m / total_mix for m in mixes]
+    alloc = [int(r) for r in raw]
+    for _ in range(n - sum(alloc)):
+        rems = [(raw[i] - alloc[i], -i) for i in range(len(names))]
+        i = -max(rems)[1]
+        alloc[i] += 1
+    out: List[Request] = []
+    for idx, name in enumerate(names):
+        if not alloc[idx]:
+            continue
+        spec = specs[name]
+        reqs = synthetic_requests(
+            alloc[idx], vocab_size=vocab_size, seed=base,
+            prompt_len=prompt_len, max_new=max_new,
+            temperature=temperature, top_k=top_k, eos_id=eos_id,
+            stagger=stagger,
+            burst=int(getattr(spec, "burst", 1)),
+            deadline_steps=deadline_steps, deadline_s=deadline_s,
+            shared_prefix=int(getattr(spec, "shared_prefix", 0)),
+            seed_substream=idx, repetitive=repetitive)
+        for req in reqs:
+            req.tenant = name
+        out.extend(reqs)
+    # Stable merge on arrival step: within a step, spec order then
+    # per-tenant FIFO — the order a FIFO engine would see, which is
+    # exactly what the fair-vs-FIFO chaos comparisons key on.
+    out.sort(key=lambda r: r.arrival_step or 0)
+    return out
+
+
 def parse_range(spec: str, name: str) -> Tuple[int, int]:
     """CLI range syntax: "8" (fixed) or "4:12" (inclusive range)."""
     parts = spec.split(":")

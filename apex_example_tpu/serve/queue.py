@@ -80,6 +80,13 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0
     eos_id: Optional[int] = None
+    # Multi-tenant identity (ISSUE 19): which lane the fair scheduler
+    # files this under, and an intra-lane priority bump (higher admits
+    # first within the tenant, stable among equals).  Defaults keep
+    # legacy single-tenant construction — and its emitted records —
+    # byte-identical.
+    tenant: str = "default"
+    priority: int = 0
     uid: str = field(default_factory=_next_uid)
     # Virtual-time admission gate (None = admissible immediately).
     arrival_step: Optional[int] = None

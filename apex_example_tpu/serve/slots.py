@@ -255,6 +255,30 @@ class BlockAllocator:
         n_mapped = math.ceil(shared / BS) if shared else 0
         return shared, bids[:n_mapped], keys[:n_mapped]
 
+    def hot_prefixes(self, top_n: int) -> List[Tuple[int, ...]]:
+        """The hottest indexed blocks' CUMULATIVE token prefixes,
+        hottest first (ISSUE 19): rank every indexed block by live
+        refcount (ties to lower bid — allocation order, deterministic)
+        and unwind each chain key back to the full token prefix it
+        covers.  Zero-ref blocks parked in the reusable cache rank
+        last but still advertise — their KV is warm and a prefix hit
+        revives them."""
+        if top_n < 1:
+            return []
+        ranked = sorted(self._nodes.values(),
+                        key=lambda n: (-self.refcount[n.bid], n.bid))
+        out: List[Tuple[int, ...]] = []
+        for node in ranked[:top_n]:
+            parts: List[Tuple[int, ...]] = []
+            key: Optional[Tuple] = node.key
+            while key is not None:
+                parent, toks = key
+                parts.append(toks)
+                key = parent
+            out.append(tuple(t for toks in reversed(parts)
+                             for t in toks))
+        return out
+
 
 @dataclass
 class Slot:
@@ -745,3 +769,18 @@ class BlockPool:
         if not self._prompt_tokens:
             return 0.0
         return self._shared_tokens / self._prompt_tokens
+
+    def prefix_counters(self) -> Tuple[int, int]:
+        """Raw ``(shared_tokens, prompt_tokens)`` behind the hit rate —
+        what replicas advertise so the router can compute the EXACT
+        fleet-level ratio (a mean of per-replica ratios would weight a
+        one-request replica like a thousand-request one)."""
+        return self._shared_tokens, self._prompt_tokens
+
+    def hot_prefix_hashes(self, top_n: int) -> List[str]:
+        """sched/prefix.py digests of the hottest indexed cumulative
+        prefixes (ISSUE 19) — the replica_state advertisement the
+        ``prefix_affinity`` router policy scores against."""
+        from ..sched.prefix import hash_prefix
+        return [hash_prefix(toks)
+                for toks in self.alloc.hot_prefixes(top_n)]

@@ -73,6 +73,24 @@ restart while spooled requests keep decoding).
     python fleet.py --replicas 3 --decode-replicas 2 \\
         --transport proc --scenario decode_crash_midspool \\
         --requests 10 --handoff-lease 1.0 --metrics-jsonl fleet.jsonl
+
+Multi-tenant fleets (ISSUE 19): ``--tenants`` arms DWRR fair admission
+on every replica engine and per-tenant ledgers on the router (schema
+v17: ``tenant`` on terminal events, a ``tenants`` block + per-tenant
+SLO verdicts in ``fleet_summary``).  ``--policy prefix_affinity``
+routes each prompt to the replica advertising the deepest hot-prefix
+chain-key overlap (``--advertise-prefixes`` arms the heartbeat
+advertisement; falls back to least_kv on zero overlap), and
+``fleet_summary`` gains a fleet-level ``prefix_hit_rate``.  Three
+scored scenarios ride the machinery: ``noisy_neighbor`` (flooding
+tenant vs deadline-carrying interactive victim; ``--expect-breach``
+runs the FIFO control arm that must demonstrably breach),
+``tenant_burst_starvation`` and ``prefix_heavy``:
+
+    # fair keeps the victim inside its virtual deadline:
+    python fleet.py --replicas 1 --scenario noisy_neighbor \\
+        --tenants 'noisy:mix=6;victim:class=interactive,mix=1' \\
+        --requests 14 --metrics-jsonl fleet.jsonl
 """
 
 from __future__ import annotations
@@ -98,6 +116,20 @@ def _load_fleet(name: str):
     return mod
 
 
+def _load_sched(name: str):
+    """Same file-path stance for the sched/ stratum (jax-free by the
+    graftlint contract): --tenants parsing must not pull the package."""
+    path = os.path.join(REPO, "apex_example_tpu", "sched", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"apex_sched_{name}",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    # Registered BEFORE exec: tenants.py defines dataclasses, and the
+    # dataclass machinery resolves cls.__module__ through sys.modules.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="route a workload over N serve replicas, "
@@ -111,16 +143,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "subprocesses over file inbox/outbox (jax-free "
                         "router path)")
     p.add_argument("--policy", default="round_robin",
-                   choices=["round_robin", "least_pending", "least_kv"],
-                   help="dispatch policy (fleet/router.py)")
+                   choices=["round_robin", "least_pending", "least_kv",
+                            "prefix_affinity"],
+                   help="dispatch policy (fleet/router.py); "
+                        "prefix_affinity follows the hot-prefix keys "
+                        "replicas advertise (--advertise-prefixes) and "
+                        "falls back to least_kv on zero overlap")
     p.add_argument("--scenario", default="none",
                    choices=["none", "rolling_restart", "crash_storm",
                             "straggler", "prefill_crash",
-                            "decode_crash_midspool"],
+                            "decode_crash_midspool", "noisy_neighbor",
+                            "tenant_burst_starvation", "prefix_heavy"],
                    help="scripted chaos scenario, scored into "
                         "fleet_summary (fleet/scenarios.py; the "
                         "*_crash* disagg scenarios need "
-                        "--decode-replicas)")
+                        "--decode-replicas, the tenant scenarios need "
+                        "--tenants)")
     p.add_argument("--decode-replicas", type=int, default=0,
                    metavar="K",
                    help="disaggregated fleet (ISSUE 15): the LAST K "
@@ -135,6 +173,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "spool files — a dead worker's claims are "
                         "reclaimed by peers after S seconds "
                         "(default 2)")
+    p.add_argument("--spool-timeout", type=float, default=None,
+                   metavar="S",
+                   help="disagg fleet: a uid parked on the spool "
+                        "longer than S seconds is presumed eaten by a "
+                        "worker that died after acking its claim and "
+                        "is re-routed through prefill from scratch "
+                        "(default max(4*lease, 5); raise it when the "
+                        "rig is slow enough that honest spool dwell — "
+                        "a restarting decode child recompiling — can "
+                        "cross the sweep threshold)")
     p.add_argument("--requests", type=int, default=16,
                    help="workload size (synthetic specs)")
     p.add_argument("--prompt-len", default="3:8",
@@ -208,6 +256,34 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="proc children's tick_profile sampling period "
                         "(default 16)")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant fleet (ISSUE 19): "
+                        "'name[:key=value,...];...' with keys weight/"
+                        "budget/class/mix/burst/shared_prefix "
+                        "(sched/tenants.py).  Arms DWRR fair admission "
+                        "on every replica engine and per-tenant "
+                        "ledgers + SLO verdicts on the router")
+    p.add_argument("--advertise-prefixes", type=int, default=0,
+                   metavar="N",
+                   help="replicas advertise their top-N hot prefix "
+                        "chain keys in heartbeats (what "
+                        "--policy prefix_affinity routes on; "
+                        "0 = off, auto-armed to 4 under "
+                        "--scenario prefix_heavy)")
+    p.add_argument("--deadline-step", type=int, default=None,
+                   metavar="N",
+                   help="virtual-step deadline stamped on INTERACTIVE "
+                        "tenants' requests in the tenant scenarios "
+                        "(default 20 there; virtual steps make the "
+                        "noisy_neighbor breach bit-reproducible)")
+    p.add_argument("--expect-breach", action="store_true",
+                   help="noisy_neighbor control arm: replicas run "
+                        "FIFO admission (no fair scheduler) and the "
+                        "scenario passes only when the victim tenant "
+                        "DEMONSTRABLY breaches its SLO")
+    p.add_argument("--min-hit-rate", type=float, default=None,
+                   help="prefix_heavy: fleet prefix_hit_rate the "
+                        "verdict requires (default: just measured)")
     p.add_argument("--workdir", default=None,
                    help="proc transport scratch dir (inbox/outbox/"
                         "metrics per replica; default: alongside "
@@ -272,6 +348,54 @@ def run_fleet(args):
         raise SystemExit(f"--tick-profile-every must be >= 1, got "
                          f"{args.tick_profile_every}")
 
+    # Multi-tenant plane (ISSUE 19): parse the spec via the jax-free
+    # sched stratum, pick the victim (first interactive tenant) for
+    # the tenant scenarios, and auto-arm what those scenarios need.
+    tenant_scenarios = ("noisy_neighbor", "tenant_burst_starvation",
+                        "prefix_heavy")
+    tenant_specs = None
+    if args.tenants:
+        try:
+            tenant_specs = _load_sched("tenants").parse_tenants(
+                args.tenants)
+        except ValueError as e:
+            raise SystemExit(f"--tenants: {e}")
+    if args.scenario in tenant_scenarios and tenant_specs is None:
+        raise SystemExit(f"--scenario {args.scenario} needs --tenants")
+    if args.expect_breach and args.scenario != "noisy_neighbor":
+        raise SystemExit("--expect-breach only applies to "
+                         "--scenario noisy_neighbor")
+    if args.advertise_prefixes < 0:
+        raise SystemExit(f"--advertise-prefixes must be >= 0, got "
+                         f"{args.advertise_prefixes}")
+    advertise = args.advertise_prefixes
+    if not advertise and args.scenario == "prefix_heavy":
+        advertise = 4                   # the hit rate must be measured
+    victim_name = None
+    deadline_step = args.deadline_step
+    if args.scenario in ("noisy_neighbor", "tenant_burst_starvation"):
+        interactive = [n for n, t in tenant_specs.items()
+                       if t.slo_class == "interactive"]
+        batch = [n for n, t in tenant_specs.items()
+                 if t.slo_class != "interactive"]
+        if not interactive or not batch:
+            raise SystemExit(f"--scenario {args.scenario} needs at "
+                             "least one interactive tenant (the "
+                             "victim) and one batch tenant (the "
+                             "noisy one) in --tenants")
+        victim_name = interactive[0]
+        if deadline_step is None:
+            deadline_step = 20
+        if slo_spec is None:
+            # Availability-only spec: per-tenant verdicts need scoring
+            # armed, and a latency target would make the verdict ride
+            # wall clocks instead of the virtual-step deadlines.
+            slo_spec = {"availability": 0.9}
+    # FIFO control arm: the ENGINES drop fair admission, the router
+    # keeps the per-tenant ledger (that is where the breach shows).
+    engine_tenants = tenant_specs \
+        if not args.expect_breach else None
+
     def lohi(spec, name):
         parts = spec.split(":")
         try:
@@ -318,6 +442,7 @@ def run_fleet(args):
     if spool:
         os.makedirs(spool, exist_ok=True)
 
+    fleet_stream = None     # thread+tenants: shared router/engine tee
     if args.transport == "proc":
         replicas = []
         for name in names:
@@ -327,16 +452,24 @@ def run_fleet(args):
                 serve_args += ["--max-len", str(args.max_len)]
             if args.trace:
                 serve_args += ["--trace"]
-            if slo_spec is not None:
+            if args.slo:
                 # Children score their own windows (wall-clock mode)
                 # and heartbeat cumulative sketches the router's
-                # fleet_rollup merges.
+                # fleet_rollup merges.  (An AUTO-armed tenant-scenario
+                # spec stays router-only: it has no latency target to
+                # hand a child's --slo parser.)
                 serve_args += ["--slo", args.slo]
             if args.tick_profile:
                 # Children decompose their own ticks (v15 records in
                 # their streams) and heartbeat host_overhead_frac.
                 serve_args += ["--tick-profile", "--tick-profile-every",
                                str(args.tick_profile_every)]
+            if engine_tenants is not None:
+                # Children run DWRR fair admission and heartbeat their
+                # per-tenant admitted-token ledgers (v17).
+                serve_args += ["--tenants", args.tenants]
+            if advertise:
+                serve_args += ["--advertise-prefixes", str(advertise)]
             if roles[name] == "decode":
                 serve_args += ["--handoff-lease",
                                str(args.handoff_lease)]
@@ -388,6 +521,28 @@ def run_fleet(args):
             return TickProfiler(kind="serve",
                                 sample_every=args.tick_profile_every)
 
+        tee_sink = None
+        if tenant_specs is not None:
+            # --tenants arms ci_gate --tenant-stream, whose
+            # conservation ledger needs every routed uid to reach a
+            # terminal record IN THE SAME STREAM.  The router only
+            # writes route/fleet records, so tee the engines' terminal
+            # request records into the router's own locked writer —
+            # one self-contained stream, terminals interleaved with
+            # routes.  Everything else an engine-side sink would emit
+            # (run_header, serve_summary, slo windows) is dropped
+            # here: the router owns the fleet stream.  Unarmed fleets
+            # keep sink=None so their streams stay byte-identical.
+            fleet_stream = router_mod._Stream(args.metrics_jsonl)
+
+            class _TerminalTee:
+                def write(self, rec):
+                    if rec.get("record") in ("request_complete",
+                                             "request_failed", "shed"):
+                        fleet_stream.write(rec)
+
+            tee_sink = _TerminalTee()
+
         def factory():
             # Every replica's engine clones the same module config, so
             # the jitted decode step is built ONCE and shared.  With
@@ -400,6 +555,10 @@ def run_fleet(args):
                                block_size=args.block_size,
                                rng=jax.random.PRNGKey(args.seed),
                                slo=slo_spec,
+                               tenants=engine_tenants,
+                               tag_tenants=tenant_specs is not None,
+                               advertise_prefixes=advertise,
+                               sink=tee_sink,
                                tick_profiler=make_profiler())
 
         def role_factories(name):
@@ -442,6 +601,9 @@ def run_fleet(args):
                            top_k=int(spec.get("top_k", 0)),
                            eos_id=spec.get("eos_id"),
                            deadline_s=spec.get("deadline_s"),
+                           deadline_step=spec.get("deadline_step"),
+                           tenant=spec.get("tenant", "default"),
+                           priority=int(spec.get("priority", 0)),
                            uid=spec["uid"])
 
         replicas = []
@@ -471,24 +633,71 @@ def run_fleet(args):
                         name, dec, fault=fault, role="decode",
                         transport_factory=tx_factory))
 
-    specs = scen_mod.synthetic_specs(
-        args.requests, vocab_size=vocab, seed=args.seed,
-        prompt_len=prompt_len, max_new=max_new,
-        deadline_s=args.deadline_s)
+    if tenant_specs is not None:
+        # Per-tenant spec streams: requests apportioned by mix
+        # (largest remainder), each tenant drawing from its own
+        # crc32-derived substream (the loadgen discipline, stdlib
+        # here) with its spec-declared shared prefix.  For the
+        # starvation scenarios the batch tenants' whole backlog is
+        # ordered AHEAD of the interactive tenants' deadline-carrying
+        # requests — the worst case fair admission must beat.
+        import zlib
+        tnames = list(tenant_specs)
+        mixes = [float(tenant_specs[t].mix) for t in tnames]
+        total_mix = sum(mixes)
+        raw = [args.requests * m / total_mix for m in mixes]
+        alloc = [int(r) for r in raw]
+        for _ in range(args.requests - sum(alloc)):
+            rems = [(raw[i] - alloc[i], -i) for i in range(len(tnames))]
+            alloc[-max(rems)[1]] += 1
+        per_tenant = {}
+        for i, tname in enumerate(tnames):
+            if not alloc[i]:
+                continue
+            ts = tenant_specs[tname]
+            dstep = deadline_step \
+                if (victim_name is not None
+                    and ts.slo_class == "interactive") else None
+            per_tenant[tname] = scen_mod.synthetic_specs(
+                alloc[i], vocab_size=vocab,
+                seed=zlib.crc32(f"{args.seed}/{i}".encode())
+                & 0x7FFFFFFF,
+                prompt_len=prompt_len, max_new=max_new,
+                deadline_s=args.deadline_s, deadline_step=dstep,
+                tenant=tname, shared_prefix=int(ts.shared_prefix),
+                uid_prefix=f"fl-{tname}")
+        if victim_name is not None:
+            order = [t for t in tnames
+                     if tenant_specs[t].slo_class != "interactive"] \
+                + [t for t in tnames
+                   if tenant_specs[t].slo_class == "interactive"]
+        else:
+            order = tnames
+        specs = [s for t in order for s in per_tenant.get(t, ())]
+    else:
+        specs = scen_mod.synthetic_specs(
+            args.requests, vocab_size=vocab, seed=args.seed,
+            prompt_len=prompt_len, max_new=max_new,
+            deadline_s=args.deadline_s)
 
     router = router_mod.FleetRouter(
         replicas, policy=args.policy,
         metrics_jsonl=args.metrics_jsonl,
+        sink=fleet_stream,
         max_retries=args.max_retries,
         breaker_backoff_s=args.breaker_backoff,
         stall_after_s=stall_after,
         default_deadline_s=args.deadline_s,
         # Disagg self-healing: well past the lease, so live
         # redelivery always gets first go at a dead worker's claims.
-        spool_timeout_s=max(4.0 * args.handoff_lease, 5.0)
+        spool_timeout_s=(args.spool_timeout
+                         if args.spool_timeout is not None
+                         else max(4.0 * args.handoff_lease, 5.0))
         if n_decode else None,
         slo=slo_spec, slo_window=args.slo_window,
         slo_rollup_s=args.slo_rollup_s,
+        tenant_specs=tenant_specs,
+        prefix_block_size=args.block_size,
         trace=args.trace)
     print(f"fleet: {args.replicas} x {args.transport} replica(s)  "
           f"policy={args.policy}  scenario={args.scenario}  "
@@ -506,6 +715,12 @@ def run_fleet(args):
         kw["restart_crashed"] = args.transport == "thread"
     elif args.scenario == "decode_crash_midspool":
         kw["crashed_name"] = crashed_names[0]
+    elif args.scenario in ("noisy_neighbor", "tenant_burst_starvation"):
+        kw["victim"] = victim_name
+        if args.scenario == "noisy_neighbor":
+            kw["expect_breach"] = args.expect_breach
+    elif args.scenario == "prefix_heavy":
+        kw["min_hit_rate"] = args.min_hit_rate
     try:
         summary = scen_mod.run_scenario(args.scenario, router, replicas,
                                         specs, **kw)
@@ -535,6 +750,24 @@ def run_fleet(args):
           f"skew={summary['routing']['balance_skew']}"
           + (f"  verdict={summary['verdict']}"
              if "verdict" in summary else ""))
+    if summary.get("tenants"):
+        tl = summary["tenants"]
+        starved = min(tl, key=lambda t: (tl[t]["availability"], t))
+        noisiest = max(tl, key=lambda t:
+                       (tl[t].get("admitted_tokens", 0),
+                        sum(tl[t]["counts"].values()), t))
+        for tname, ent in tl.items():
+            print(f"  tenant {tname}: counts={ent['counts']}  "
+                  f"availability={ent['availability']}"
+                  + (f"  slo_verdict={ent['slo_verdict']}"
+                     if "slo_verdict" in ent else ""))
+        print(f"tenants: starved={starved} "
+              f"(availability={tl[starved]['availability']})  "
+              f"noisiest={noisiest} "
+              f"(admitted_tokens="
+              f"{tl[noisiest].get('admitted_tokens', 0)})")
+    if "prefix_hit_rate" in summary:
+        print(f"prefix: fleet hit_rate={summary['prefix_hit_rate']}")
     if "slo_verdict" in summary:
         print(f"slo: verdict={summary['slo_verdict']}  "
               f"windows={summary['slo_windows']}  "

@@ -181,6 +181,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="arrivals per wave: B requests land together "
                         "every --stagger ticks (deterministic overload "
                         "mode; 1 = the classic one-by-one stagger)")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant scheduling (ISSUE 19): arm "
+                        "deficit-weighted round-robin admission over "
+                        "per-tenant lanes instead of FIFO.  SPEC is "
+                        "';'-separated clauses "
+                        "name[:weight=W,budget=TOKENS,class="
+                        "interactive|batch,mix=M,burst=B,"
+                        "shared_prefix=P] — weight shapes the DWRR "
+                        "share, budget caps admitted tokens (over-"
+                        "budget requests park, then reject at drain), "
+                        "interactive lanes preempt batch admission; "
+                        "mix/burst/shared_prefix shape the synthetic "
+                        "workload per tenant (sched/tenants.py)")
+    p.add_argument("--advertise-prefixes", type=int, default=0,
+                   metavar="N",
+                   help="replica mode: advertise the N hottest prefix "
+                        "chain-key digests + raw prefix-reuse counters "
+                        "in replica_state heartbeats (schema v17) — "
+                        "what the fleet router's prefix_affinity "
+                        "policy routes on (0 = off, heartbeats "
+                        "unchanged)")
     p.add_argument("--max-pending", type=int, default=None,
                    help="admission control: bound on the arrived request "
                         "backlog; overflow is shed deterministically "
@@ -448,6 +469,7 @@ class _Outbox:
         # router's disagg accounting keys on which terminals came from
         # a redelivered handoff admission.
         redelivered = getattr(engine, "handoff_redelivered", ())
+        with_tenant = getattr(engine, "sched", None) is not None
         for c in comps[self._consumed:]:
             ev = {"uid": c.request.uid, "status": c.status,
                   "finish_reason": c.finish_reason,
@@ -457,6 +479,8 @@ class _Outbox:
                   else c.ttft_s * 1e3,
                   "tpot_ms": None if c.tpot_s is None
                   else c.tpot_s * 1e3}
+            if with_tenant:
+                ev["tenant"] = getattr(c.request, "tenant", "default")
             if c.request.uid in redelivered:
                 ev["redelivered"] = True
             self._fh.write(json.dumps(ev, separators=(",", ":")) + "\n")
@@ -506,6 +530,8 @@ def _feed_inbox(path, queue, outbox, stop_event, request_cls):
                 temperature=float(spec.get("temperature", 0.0)),
                 top_k=int(spec.get("top_k", 0)),
                 eos_id=spec.get("eos_id"),
+                tenant=spec.get("tenant", "default"),
+                priority=int(spec.get("priority", 0)),
                 deadline_s=spec.get("deadline_s"),
                 deadline_step=spec.get("deadline_step"),
                 uid=uid)
@@ -621,6 +647,26 @@ def run_serve(args):
     if args.draft_ngram < 1:
         raise SystemExit(f"--draft-ngram must be >= 1, got "
                          f"{args.draft_ngram}")
+    tenant_specs = None
+    if args.tenants:
+        from apex_example_tpu.sched.tenants import parse_tenants
+        try:
+            tenant_specs = parse_tenants(args.tenants)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        if args.shared_prefix or args.burst != 1:
+            raise SystemExit("--tenants makes --shared-prefix/--burst "
+                             "per-tenant (spec keys shared_prefix= / "
+                             "burst=); drop the global flags")
+        for tsp in tenant_specs.values():
+            if prompt_len[1] + tsp.shared_prefix >= max_len:
+                raise SystemExit(
+                    f"--prompt-len max {prompt_len[1]} plus tenant "
+                    f"{tsp.name!r} shared_prefix {tsp.shared_prefix} "
+                    f"must be < --max-len {max_len}")
+    if args.advertise_prefixes < 0:
+        raise SystemExit(f"--advertise-prefixes must be >= 0, got "
+                         f"{args.advertise_prefixes}")
     replica_mode = bool(args.inbox or args.outbox)
     if args.role == "decode":
         # A decode worker's intake is the --handoff-dir spool, never an
@@ -813,7 +859,9 @@ def run_serve(args):
                              slo_window_ticks=args.slo_window_ticks,
                              tick_profiler=tickprof,
                              speculate=args.speculate,
-                             proposer=proposer)
+                             proposer=proposer,
+                             tenants=tenant_specs,
+                             advertise_prefixes=args.advertise_prefixes)
         outbox = feeder_stop = on_tick = None
         idle_wait_s = 0.0
         if replica_mode:
@@ -848,7 +896,7 @@ def run_serve(args):
                        "replica": args.replica_id, "state": state,
                        "role": args.role,
                        "tick": engine.step_count,
-                       "pending": engine.queue.pending(),
+                       "pending": engine.unadmitted(),
                        "blocks_live": engine.pool.blocks_live(),
                        "kv_bytes_live": engine.pool.kv_bytes_live(),
                        "pid": os.getpid(), "run_id": run_id}
@@ -865,6 +913,18 @@ def run_serve(args):
                 frac = engine.host_overhead_frac()
                 if frac is not None:
                     rec["host_overhead_frac"] = round(frac, 6)
+                # v17: with --advertise-prefixes the hot chain-key
+                # digests + raw reuse counters ride along (the
+                # prefix_affinity routing inputs); with --tenants the
+                # per-tenant admitted-token totals do (fleet budget
+                # accounting).  Both absent unarmed — heartbeats stay
+                # byte-identical.
+                adv = engine.prefix_advert()
+                if adv is not None:
+                    rec.update(adv)
+                ta = engine.tenant_admitted()
+                if ta is not None:
+                    rec["tenant_admitted"] = ta
                 sink.write(rec)
 
             last_beat = [0.0]
@@ -887,16 +947,31 @@ def run_serve(args):
         elif args.role != "decode":
             # A decode-role engine's intake is the handoff transport, not a
             # workload of its own (run_decode_role closes the queue).
-            requests = synthetic_requests(
-                args.requests, vocab_size=model.vocab_size, seed=args.seed,
-                prompt_len=prompt_len, max_new=max_new,
-                temperature=args.temperature, top_k=args.top_k,
-                eos_id=args.eos_id, stagger=args.stagger, burst=args.burst,
-                deadline_steps=args.deadline_steps,
-                deadline_s=args.deadline_s,
-                shared_prefix=args.shared_prefix,
-                seed_substream=args.seed_substream,
-                repetitive=args.repetitive)
+            if tenant_specs is not None:
+                from apex_example_tpu.serve.loadgen import tenant_requests
+                requests = tenant_requests(
+                    args.requests, tenant_specs,
+                    vocab_size=model.vocab_size, seed=args.seed,
+                    prompt_len=prompt_len, max_new=max_new,
+                    temperature=args.temperature, top_k=args.top_k,
+                    eos_id=args.eos_id, stagger=args.stagger,
+                    deadline_steps=args.deadline_steps,
+                    deadline_s=args.deadline_s,
+                    seed_substream=args.seed_substream,
+                    repetitive=args.repetitive)
+            else:
+                requests = synthetic_requests(
+                    args.requests, vocab_size=model.vocab_size,
+                    seed=args.seed,
+                    prompt_len=prompt_len, max_new=max_new,
+                    temperature=args.temperature, top_k=args.top_k,
+                    eos_id=args.eos_id, stagger=args.stagger,
+                    burst=args.burst,
+                    deadline_steps=args.deadline_steps,
+                    deadline_s=args.deadline_s,
+                    shared_prefix=args.shared_prefix,
+                    seed_substream=args.seed_substream,
+                    repetitive=args.repetitive)
             engine.queue.submit_all(requests)
             engine.queue.close()
 

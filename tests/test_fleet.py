@@ -247,6 +247,51 @@ def test_circuit_breaker_opens_half_opens_and_closes():
     assert summary["completed"] == 4 and summary["lost"] == 0
 
 
+def test_probe_loss_does_not_charge_the_request_retry_budget():
+    """Regression guard (the PR-16 straggler flake): a half-open probe
+    that goes down WITH its target replica was the ROUTER's gamble —
+    re-opening the breaker is the whole verdict, and the probed uid
+    keeps its retry budget.  Without the probe_loss rule a permanently
+    wedged replica (hang drill: never crashes, eats every probe for
+    stall_after_s) burns the same request's max_retries through
+    repeated probes until the router kills it "failed"."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    # max_retries=0: ANY charged loss is instantly terminal — the
+    # sharpest possible detector for an unwanted charge.
+    router = FleetRouter([a, b], max_retries=0,
+                         breaker_backoff_s=0.01, log=None)
+    # open a's breaker without involving any request
+    a.set_state(state="crashed")
+    router.poll()
+    assert router._replicas["a"].breaker == "open"
+    a.set_state(state="healthy")
+    time.sleep(0.02)
+    router.poll()
+    # the next dispatch is the half-open probe — and the probe target
+    # wedges again, surfacing the probed uid as lost
+    router.submit(_spec("u1"))
+    assert [s["uid"] for s in a.specs] == ["u1"]    # u1 IS the probe
+    a.set_state(state="crashed")
+    a.report("u1", "lost")
+    router.poll()
+    # probe loss: breaker re-opens, u1 re-routes UNCHARGED (with
+    # max_retries=0 any charge would have killed it "failed" here)
+    assert router._replicas["a"].breaker == "open"
+    assert [s["uid"] for s in b.specs] == ["u1"]
+    b.report("u1", "ok")
+    router.poll()
+    assert router.results["u1"]["status"] == "ok"
+    # a plain (non-probe) loss still charges: u2 dies on its first loss
+    router.submit(_spec("u2"))
+    b.report("u2", "lost")
+    router.poll()
+    assert router.results["u2"]["status"] == "failed"
+    summary = router.close()
+    assert summary["completed"] == 1 and summary["failed"] == 1
+    assert summary["lost"] == 0
+    assert summary["retries"] == 0      # the probe bounce never counted
+
+
 def test_deadline_aware_retry_and_budget():
     a = FakeReplica("a")
     router = FleetRouter([a], max_retries=1, log=None)
@@ -686,10 +731,35 @@ def test_straggler_inprocess_stall_rescue(model_and_params):
     crashes; the router's stall detector must open its breaker and
     rescue its requests onto siblings — availability stays 1.0."""
     model, params = model_and_params
+    # Warm the shared decode-step program BEFORE arming the stall
+    # clock: a cold jit compile (seconds on this rig) freezes the
+    # healthy siblings' first tick past any sane stall_after_s, so a
+    # fresh-process run (`pytest -k straggler`) would false-trip them
+    # and charge rescues before the hang drill even fires.
+    warm = ServeEngine(model, params, num_slots=SLOTS, max_len=MAX_LEN,
+                       rng=jax.random.PRNGKey(0))
+    warm.queue.submit_all([Request(prompt=[1, 2, 3],
+                                   max_new_tokens=2, uid="warm")])
+    warm.queue.close()
+    warm.run(max_steps=50)
     faults = {"r0": FaultPlan("hang", 3, kinds=SERVE_KINDS)}
     replicas = _thread_fleet(model, params, 3, faults)
     sink = ListSink()
-    router = FleetRouter(replicas, stall_after_s=0.4,
+    # Regression guard (PR-16 acceptance flake).  Two margins at once:
+    # (a) stall_after_s must stay well above the worst-case tick gap
+    # of a HEALTHY loaded sibling — at 0.4s a single-core rig under
+    # full-suite contention can stretch a healthy replica's jitted
+    # tick past the threshold, falsely breakering it and charging a
+    # retry to every uid it holds.  The genuinely hung replica is
+    # detected at ANY threshold (its progress age grows without
+    # bound), so widening only removes false positives.  (b) The wide
+    # threshold also keeps the half-open PROBE path hot: r0 never
+    # crashes, so after each rescue its breaker half-opens and a live
+    # uid probes the wedge, parking there for a full stall_after_s per
+    # cycle.  Probe losses must not charge the probed uid's retry
+    # budget (router probe_loss rule) or this scenario dies "failed"
+    # nondeterministically — exactly the flake this pins.  Keep 2.0s.
+    router = FleetRouter(replicas, stall_after_s=2.0,
                          breaker_backoff_s=0.1, sink=sink, log=None)
     specs = synthetic_specs(12, vocab_size=model.vocab_size, seed=5,
                             prompt_len=(3, 6), max_new=(3, 8))
@@ -1173,11 +1243,21 @@ def test_disagg_proc_decode_crash_e2e(tmp_path, capsys):
 
     fleet_jsonl = str(tmp_path / "fleet.jsonl")
     workdir = str(tmp_path / "work")
+    # Regression guard (the PR-16 acceptance flake): this e2e proves
+    # LEASE redelivery, not the stale sweep — at the derived
+    # spool_timeout (max(4*lease, 5) = 5s here) a loaded single-core
+    # rig can park honest spool dwell past the threshold (the
+    # restarted decode child pays python+jax startup plus a recompile
+    # before its first claim), the sweep re-routes the uids through
+    # prefill a second time, and handoffs lands at 20 != 10.  The
+    # sweep path has its own dedicated unit test
+    # (test_router_spool_stale_sweep_reroutes_through_prefill); here
+    # it is pushed far out of the hot path.
     argv = ["--replicas", "3", "--decode-replicas", "2",
             "--transport", "proc",
             "--scenario", "decode_crash_midspool",
             "--requests", "10", "--slots", "2", "--max-len", "16",
-            "--handoff-lease", "1.0",
+            "--handoff-lease", "1.0", "--spool-timeout", "120",
             "--metrics-jsonl", fleet_jsonl, "--workdir", workdir,
             "--timeout", "150"]
     rc = fleet_cli.main(argv)

@@ -454,14 +454,15 @@ def test_reports_degrade_gracefully_on_pre_v15_streams(capsys):
     assert "data_wait" in out and "dispatch" in out
 
 
-def test_v16_validates_every_older_fixture_stream():
-    """v16 is a strict superset: every checked-in v10-v15 fixture
+def test_v17_validates_every_older_fixture_stream():
+    """v17 is a strict superset: every checked-in v10-v16 fixture
     stream still validates unchanged, and the two hard-coded jax-free
     SCHEMA constants moved in lockstep with SCHEMA_VERSION."""
-    assert obs_schema.SCHEMA_VERSION == 16
+    assert obs_schema.SCHEMA_VERSION == 17
     fixture_root = os.path.join(REPO, "tests", "fixtures")
     seen = 0
-    for sub in ("slo", "fleet", "quant", "disagg", "perf", "spec"):
+    for sub in ("slo", "fleet", "quant", "disagg", "perf", "spec",
+                "sched"):
         d = os.path.join(fixture_root, sub)
         for name in sorted(os.listdir(d)):
             if not name.endswith(".jsonl"):
@@ -469,7 +470,7 @@ def test_v16_validates_every_older_fixture_stream():
             records = _fixture_records(os.path.join(d, name))
             assert obs_schema.validate_stream(records) == [], name
             seen += 1
-    assert seen >= 6            # the older strata are actually covered
+    assert seen >= 7            # the older strata are actually covered
     sup = _load_tool_pkg("apex_example_tpu/resilience/supervisor.py",
                          "_sup")
     router = _load_tool_pkg("apex_example_tpu/fleet/router.py",

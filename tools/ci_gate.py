@@ -95,6 +95,19 @@ Gates:
    stream (tests/fixtures/spec/), this turns "speculation is
    lossless" into a regression-tested identity.
 
+10. **tenant conservation** (per ``--tenant-stream``): the multi-
+    tenant fairness contract over one recorded tenancy-armed fleet
+    stream (schema v17) — every record validates, exactly one
+    ``fleet_summary`` with a per-tenant verdict block, every routed
+    request reaches EXACTLY one terminal record (a parked over-budget
+    request may wait, never vanish), the summary's per-tenant status
+    counts equal the counts recomputed from the stream's terminal
+    records, and per-tenant admitted tokens respect the announced
+    budget (every heartbeat ledger <= budget; fleet total <= budget x
+    replicas).  Run over the checked-in noisy-neighbor stream
+    (tests/fixtures/sched/), this turns "the DWRR scheduler is fair
+    and lossless" into a regression-tested ledger.
+
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
 jax import, direct or transitive — this must run on the bare CI host
@@ -374,6 +387,129 @@ def _disagg_gate(streams) -> int:
     return rc
 
 
+def _tenant_gate(stream: str) -> int:
+    """The multi-tenant fairness gate (ISSUE 19) over one recorded
+    tenancy-armed fleet stream (the router's records interleaved with
+    the replica engines' terminal records): schema-v17 validation,
+    exactly one ``fleet_summary`` carrying the per-tenant verdict
+    block, and CONSERVATION of the fair scheduler's ledger —
+
+    - every routed request reaches EXACTLY one terminal record
+      (``request_complete`` / ``request_failed`` / ``shed``): a parked
+      over-budget request may wait, but it may not vanish, and it may
+      not finish twice;
+    - the per-tenant status counts in ``fleet_summary.tenants`` equal
+      the counts recomputed from the stream's terminal records (an
+      edited summary — the tamper fixture — fails here);
+    - per-tenant admitted tokens respect the announced budget: every
+      ``replica_state`` heartbeat's ledger stays at or below it, and
+      the fleet total stays below budget x replicas.
+
+    Returns 0/1 (2 is the caller's unreadable-stream path)."""
+    summ, records = _load_gated_stream(stream, "fleet_summary")
+    if summ is None:
+        return 1
+    rc = 0
+    tenants = summ.get("tenants")
+    if not isinstance(tenants, dict) or not tenants:
+        print(f"{stream}: fleet_summary carries no tenants block "
+              "(tenant stream must come from a --tenants-armed run)",
+              file=sys.stderr)
+        return 1
+    budgets = {}
+    for r in records:
+        if r.get("record") == "run_header" \
+                and isinstance(r.get("config"), dict) \
+                and isinstance(r["config"].get("tenants"), dict):
+            for name, spec in r["config"]["tenants"].items():
+                if isinstance(spec, dict) \
+                        and spec.get("budget") is not None:
+                    budgets[name] = spec["budget"]
+    for name, block in tenants.items():
+        if budgets.get(name) is None \
+                and isinstance(block, dict) \
+                and block.get("budget") is not None:
+            budgets[name] = block["budget"]
+
+    # Exactly-once terminal conservation over the routed uid set.
+    _STATUS = {"request_complete": "ok"}
+    routed = set()
+    terminal = {}               # uid -> [(tenant, status)]
+    replicas = set()
+    for r in records:
+        rec = r.get("record")
+        if rec == "route":
+            routed.add(r.get("request_id", "?"))
+        elif rec in ("request_complete", "request_failed", "shed"):
+            uid = r.get("request_id", "?")
+            status = _STATUS.get(rec) or r.get("status") or rec
+            terminal.setdefault(uid, []).append(
+                (r.get("tenant", "default"), status))
+        elif rec == "replica_state":
+            replicas.add(r.get("replica", "?"))
+            admitted = r.get("tenant_admitted")
+            if isinstance(admitted, dict):
+                for name, tok in admitted.items():
+                    cap = budgets.get(name)
+                    if cap is not None and tok > cap:
+                        print(f"{stream}: replica "
+                              f"{r.get('replica', '?')} admitted {tok} "
+                              f"token(s) for tenant {name!r} over its "
+                              f"budget {cap}", file=sys.stderr)
+                        rc = 1
+    never = sorted(u for u in routed if u not in terminal)
+    multi = sorted(u for u, evs in terminal.items() if len(evs) > 1)
+    orphans = sorted(u for u in terminal if u not in routed)
+    for uid in never[:10]:
+        print(f"{stream}: request {uid} was routed but never reached "
+              "a terminal record — a parked request vanished",
+              file=sys.stderr)
+    for uid in multi[:10]:
+        print(f"{stream}: request {uid} reached "
+              f"{len(terminal[uid])} terminal records — exactly-once "
+              "violated", file=sys.stderr)
+    for uid in orphans[:10]:
+        print(f"{stream}: terminal record for {uid} with no route "
+              "record — the router never dispatched it",
+              file=sys.stderr)
+    if never or multi or orphans:
+        rc = 1
+
+    # Per-tenant summary counts vs the stream's own terminal records.
+    recounted = {}
+    for evs in terminal.values():
+        for name, status in evs:
+            recounted.setdefault(name, {})
+            recounted[name][status] = \
+                recounted[name].get(status, 0) + 1
+    for name, block in tenants.items():
+        claimed = (block or {}).get("counts", {})
+        actual = recounted.get(name, {})
+        if claimed != actual:
+            print(f"{stream}: fleet_summary tenant {name!r} counts "
+                  f"{claimed} != {actual} recomputed from the "
+                  "stream's terminal records", file=sys.stderr)
+            rc = 1
+    extra = sorted(n for n in recounted if n not in tenants)
+    for name in extra[:10]:
+        print(f"{stream}: tenant {name!r} has terminal records but no "
+              "fleet_summary entry", file=sys.stderr)
+    if extra:
+        rc = 1
+
+    # Fleet-total budget: each engine debits its own ledger, so the
+    # fleet-wide ceiling is budget x participating replicas.
+    n_rep = max(1, len(replicas))
+    for name, cap in sorted(budgets.items()):
+        got = (tenants.get(name) or {}).get("admitted_tokens", 0)
+        if got > cap * n_rep:
+            print(f"{stream}: tenant {name!r} admitted {got} token(s) "
+                  f"fleet-wide over budget {cap} x {n_rep} "
+                  "replica(s)", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def _slo_gate(stream: str) -> int:
     """The streaming-SLO gate (ISSUE 16) over one recorded ``--slo``
     stream — a serve.py replica stream (``serve_summary`` with its
@@ -635,6 +771,14 @@ def main(argv=None) -> int:
                          "one armed serve_summary, accepted <= "
                          "drafted, and output_tokens == accepted + "
                          "sampled (repeatable)")
+    ap.add_argument("--tenant-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a tenancy-armed fleet stream to run the "
+                         "tenant gate over: schema-v17 validation, "
+                         "exactly one fleet_summary with a tenants "
+                         "block, exactly-once terminal conservation, "
+                         "summary counts == recomputed counts, and "
+                         "admitted tokens within budget (repeatable)")
     ap.add_argument("--perf-baseline", default=None, metavar="JSON",
                     help="PERF_BASELINE.json to additionally diff "
                          "every --perf-stream snapshot against "
@@ -715,6 +859,16 @@ def main(argv=None) -> int:
             return 2
         rc = _perf_gate(stream, args.perf_baseline)
         print(f"ci_gate: perf gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
+
+    for stream in args.tenant_stream:
+        if not os.path.isfile(stream):
+            print(f"ci_gate: no such stream: {stream}",
+                  file=sys.stderr)
+            return 2
+        rc = _tenant_gate(stream)
+        print(f"ci_gate: tenant gate {stream}: "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         worst = max(worst, rc)
 

@@ -34,8 +34,12 @@ mean — ``--skew-factor`` flags imbalance), replica lifecycle anomalies
 scenario verdict line, and — on a v13 disaggregated fleet — the DISAGG
 line (prefill/decode topology, handoff count, redelivered admissions,
 uids stuck in the spool at close: a spool leak is flagged as its own
-anomaly).  Still jax-free — same thin-client contract, proved by
-graftlint's import rule.
+anomaly).  On a v17 multi-tenant fleet (ISSUE 19) the TENANT lines
+name the starved tenant (lowest availability) and the noisiest one
+(most admitted tokens), flag failing per-tenant SLO verdicts outside
+chaos scenarios, and report the fleet prefix-affinity hit rate when
+the replicas advertised prefix keys.  Still jax-free — same
+thin-client contract, proved by graftlint's import rule.
 
 Train-rank checks:
 - per-rank status: aborted (crash_dump / aborted summary / no summary),
@@ -317,6 +321,41 @@ def analyze_fleet(records: List[dict], skew_factor: float,
         print(f"ROUTING IMBALANCE: max dispatches = {skew}x the mean "
               f"(> {skew_factor}x) — one replica is soaking the "
               "fleet", file=out)
+
+    # v17 multi-tenant scheduling (ISSUE 19): a --tenants-armed router
+    # folds one verdict block per scheduling lane into fleet_summary.
+    # Name the starved tenant (lowest availability) and the noisiest
+    # one (most admitted tokens) — the two ends of the fairness story.
+    # A failing per-tenant verdict is an anomaly outside chaos
+    # scenarios (which EXPECT a victim to breach), same rule as the
+    # DOWN transitions below.  Pre-v17 streams skip the block.
+    tenants = summary.get("tenants")
+    if isinstance(tenants, dict) and tenants:
+        rows = []
+        for name, blk in tenants.items():
+            blk = blk or {}
+            owned = sum((blk.get("counts") or {}).values())
+            rows.append((name, owned, blk.get("availability", 1.0),
+                         blk.get("slo_verdict"),
+                         blk.get("admitted_tokens", 0)))
+            if blk.get("slo_verdict") == "fail" \
+                    and scenario in ("none", None):
+                anomalies += 1
+                print(f"TENANT SLO: {name} failed its per-tenant "
+                      "windows", file=out)
+        starved = min(rows, key=lambda r: (r[2], r[0]))
+        noisiest = max(rows, key=lambda r: (r[4], r[1], r[0]))
+        detail = "  ".join(
+            f"{name} x{owned} avail={avail}"
+            + (f" slo={verdict}" if verdict else "")
+            for name, owned, avail, verdict, _ in rows)
+        print(f"TENANT: {detail}", file=out)
+        print(f"TENANT: starved={starved[0]} "
+              f"(availability={starved[2]})  noisiest={noisiest[0]} "
+              f"(admitted_tokens={noisiest[4]})", file=out)
+    if "prefix_hit_rate" in summary:
+        print(f"prefix affinity: fleet hit_rate "
+              f"{summary['prefix_hit_rate']}", file=out)
 
     # v15 hot-path attribution (ISSUE 17): replicas armed with
     # --tick-profile advertise their host-overhead fraction on every

@@ -64,6 +64,15 @@ CONTRACT_FILES = (
     # deliberately NOT listed: loading it via the package walks the
     # jax-carrying apex_example_tpu/__init__.py edge).
     "apex_example_tpu/spec/proposers.py",
+    # ISSUE 19: the scheduling stratum — tenant specs and prefix chain
+    # hashes are loaded by FILE PATH on the router side (which must
+    # keep routing while a replica's jax is the thing that died), and
+    # the fair scheduler duck-types Request rather than import
+    # serve.queue (sched/__init__.py is, as above, deliberately NOT
+    # listed).
+    "apex_example_tpu/sched/tenants.py",
+    "apex_example_tpu/sched/fair.py",
+    "apex_example_tpu/sched/prefix.py",
 )
 
 _IMPORT_EXC = {"ImportError", "ModuleNotFoundError", "Exception",

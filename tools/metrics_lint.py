@@ -74,6 +74,15 @@ geometry ``speculate_k``/``draft_kind``, the conservation counters
 ``tokens_drafted``/``tokens_accepted``/``tokens_sampled`` — every
 output token is an accepted draft token or a sampled one — and the
 derived ``acceptance_rate``/``tokens_per_tick`` throughput verdicts)
+and v17 streams (the multi-tenant scheduling stratum from --tenants
+runs: ``request_complete``/``request_failed``/``shed`` gain the
+``tenant`` lane stamp, ``serve_summary``/``fleet_summary`` gain the
+per-tenant ``tenants`` block — counts, availability, weight/class/
+budget, admitted tokens, per-tenant SLO verdicts — ``replica_state``
+heartbeats gain the prefix-affinity advertisement ``prefix_keys``/
+``prefix_shared_tokens``/``prefix_prompt_tokens`` and the
+``tenant_admitted`` ledger, and ``fleet_summary`` gains the fleet
+``prefix_hit_rate``)
 all validate alongside v1
 streams — each version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a

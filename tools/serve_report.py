@@ -52,6 +52,14 @@ sampled token totals, and tokens/tick against the 1.0
 one-token-per-tick baseline.  Pre-v16 (and unarmed) streams carry no
 ``speculate_k`` and degrade silently, exactly like OVERHEAD.
 
+Schema v17 adds the TENANT table (multi-tenant scheduling, ISSUE 19):
+on a ``--tenants`` stream, one row per scheduling lane — request
+count, availability, TTFT/TPOT p50/p99 recomputed from that lane's
+``request_complete`` records, and budget utilization (admitted tokens
+over the lane's token budget, from the summary's ``tenants`` block).
+Pre-v17 (and unarmed) streams carry no ``tenant`` fields and degrade
+silently.
+
 Thin client of the obs schema (obs/schema.py):
 
     python tools/serve_report.py serve.jsonl
@@ -132,6 +140,59 @@ def critical_path(records):
 
 
 _COMPONENTS = ("queue_ms", "prefill_ms", "decode_ms", "stall_ms")
+
+
+def _print_tenants(out, records, summary):
+    """Schema v17 (ISSUE 19): the per-tenant table, only when the run
+    was armed with --tenants — per-lane counts/latencies recomputed
+    from the tenant-stamped request records, budget utilization from
+    the summary's ``tenants`` block.  Unarmed streams carry neither
+    and print nothing."""
+    blocks = (summary or {}).get("tenants")
+    blocks = blocks if isinstance(blocks, dict) else {}
+    by = {}
+    for r in records:
+        t = r.get("tenant")
+        if t is None or r.get("record") not in (
+                "request_complete", "request_failed", "shed"):
+            continue
+        d = by.setdefault(t, {"ok": [], "counts": {}})
+        status = "ok" if r["record"] == "request_complete" \
+            else r.get("status", "shed")
+        d["counts"][status] = d["counts"].get(status, 0) + 1
+        if r["record"] == "request_complete" \
+                and "ttft_ms" in r and "tpot_ms" in r:
+            d["ok"].append(r)
+    if not by and not blocks:
+        return
+    names = list(blocks)
+    names += [t for t in sorted(by) if t not in names]
+    print("TENANT         reqs  avail   ttft p50/p99      "
+          "tpot p50/p99      budget", file=out)
+    for t in names:
+        blk = blocks.get(t) or {}
+        d = by.get(t, {"ok": [], "counts": {}})
+        counts = d["counts"]
+        owned = sum(counts.values())
+        avail = f"{counts.get('ok', 0) / owned:.3f}" if owned else "-"
+        ttfts = sorted(r["ttft_ms"] for r in d["ok"])
+        tpots = sorted(r["tpot_ms"] for r in d["ok"])
+        if ttfts:
+            lat = (f"{_pct(ttfts, 50):7.1f}/{_pct(ttfts, 99):<9.1f} "
+                   f"{_pct(tpots, 50):7.1f}/{_pct(tpots, 99):<9.1f}")
+        else:
+            lat = f"{'-':>7}/{'-':<9} {'-':>7}/{'-':<9}"
+        admitted = blk.get("admitted_tokens")
+        cap = blk.get("budget")
+        if cap:
+            budget = (f"{admitted or 0}/{cap} "
+                      f"({100.0 * (admitted or 0) / cap:.0f}%)")
+        elif admitted is not None:
+            budget = f"{admitted} (unbounded)"
+        else:
+            budget = "-"
+        print(f"{t:<14} {owned:<5} {avail:<7} {lat} {budget}",
+              file=out)
 
 
 def _print_critical_path(out, rows):
@@ -223,6 +284,8 @@ def report(path: str, out=sys.stdout) -> int:
         print(f"availability {statuses.get('ok', 0) / owned:.3f}  "
               f"(ok / every status the server owned; drained requests "
               f"are requeued elsewhere)", file=out)
+
+    _print_tenants(out, records, summary)
 
     out_tokens = sum(r["output_tokens"] for r in reqs)
     prompt_tokens = sum(r.get("prompt_tokens", 0) for r in reqs)

@@ -23,6 +23,15 @@ spends a window's error budget exactly, anything past it is a breach.
 A stream that ENDS on a breach is reported as failing even without a
 summary record (a killed run's last window must not read as healthy).
 
+Schema v17 (ISSUE 19) adds the per-tenant table: a ``--tenants``-armed
+fleet_summary carries one verdict block per scheduling lane
+(availability, per-tenant SLO verdict and breach count, budget
+utilization), and lanes whose ``request_complete`` records ride the
+same stream get their TTFT percentiles recomputed per tenant.  A
+failing tenant verdict fails the report even when the fleet-level
+verdict passes — that asymmetry IS the noisy-neighbor story.  Pre-v17
+streams carry no tenants block and degrade silently.
+
 jax-free by the thin-client contract (graftlint's import rule proves
 it).  Exit codes: 0 = armed and passing, 1 = breaches / fail verdict /
 schema errors, 2 = unusable input (no SLO records in the stream).
@@ -38,7 +47,8 @@ from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from metrics_lint import validate_stream  # noqa: E402  (sibling import)
+from metrics_lint import pct as _pct  # noqa: E402  (sibling import)
+from metrics_lint import validate_stream  # noqa: E402
 
 
 def load_records(path: str) -> List[Dict[str, Any]]:
@@ -157,6 +167,46 @@ def report(path: str, out=sys.stdout) -> int:
                       f"{r.get('skew', 0.0)}x the fleet median "
                       "(rollup)", file=out)
                 break
+
+    # ---- per-tenant verdicts (schema v17, ISSUE 19) -----------------
+    # A --tenants-armed fleet_summary folds one verdict block per
+    # scheduling lane; TTFT/TPOT percentiles are recomputed from the
+    # lane's own request records when the stream interleaves them.
+    # Unarmed (pre-v17) streams carry no tenants block and skip this.
+    tenants = next((s.get("tenants") for s in (fleet_summary,
+                                               serve_summary)
+                    if isinstance((s or {}).get("tenants"), dict)),
+                   None)
+    if tenants:
+        by: Dict[str, List[Dict[str, Any]]] = {}
+        for r in records:
+            if r.get("record") == "request_complete" \
+                    and "tenant" in r and "ttft_ms" in r:
+                by.setdefault(r["tenant"], []).append(r)
+        print("tenant         avail   verdict  breaches  "
+              "ttft p50/p99      budget", file=out)
+        for name, blk in tenants.items():
+            blk = blk or {}
+            verdict = blk.get("slo_verdict", "-")
+            ttfts = sorted(r["ttft_ms"] for r in by.get(name, ()))
+            lat = (f"{_pct(ttfts, 50):7.1f}/{_pct(ttfts, 99):<9.1f}"
+                   if ttfts else f"{'-':>7}/{'-':<9}")
+            admitted = blk.get("admitted_tokens")
+            cap = blk.get("budget")
+            if cap:
+                budget = (f"{admitted or 0}/{cap} "
+                          f"({100.0 * (admitted or 0) / cap:.0f}%)")
+            elif admitted is not None:
+                budget = f"{admitted} (unbounded)"
+            else:
+                budget = "-"
+            print(f"{name:<14} {blk.get('availability', '-'):<7} "
+                  f"{verdict:<8} {blk.get('slo_breaches', 0):<9} "
+                  f"{lat} {budget}", file=out)
+            if verdict == "fail":
+                rc = 1
+                print(f"TENANT BREACH: {name} failed its per-tenant "
+                      "SLO windows", file=out)
 
     # ---- verdict ----------------------------------------------------
     slo = (serve_summary or {}).get("slo")

@@ -36,7 +36,11 @@ tick decomposition) when the run was armed with ``--tick-profile``
 the speculation stratum (schema v16): the SERVE line carries the
 acceptance rate and tokens/tick when the run was armed with
 ``--speculate`` (pre-v16 streams degrade silently; serve_report.py
-renders the full SPEC line).
+renders the full SPEC line) — and the multi-tenant stratum (schema
+v17): the FLEET line carries the tenant-lane count, any breached
+per-tenant SLO verdict and the fleet prefix-affinity hit rate when
+the run was armed with ``--tenants`` (pre-v17 streams degrade
+silently; fleet_report.py renders the full TENANT table).
 
 Thin client of the obs JSONL schema (obs/schema.py) — it replaces the
 eyeball-the-stdout-meters workflow for perf PRs: run train.py with
@@ -140,6 +144,21 @@ def report(path: str, out=sys.stdout) -> int:
                   + (f"  scenario {fs['scenario']}="
                      f"{fs.get('verdict', '?')}"
                      if "scenario" in fs else "")
+                  # v17 passthrough: a --tenants fleet names its lane
+                  # count and any failing per-tenant verdict here
+                  # (fleet_report.py renders the full TENANT table);
+                  # pre-v17 streams carry no tenants block and print
+                  # nothing extra, like the spec passthrough below.
+                  + (f"  {len(fs['tenants'])} tenant lane(s)"
+                     + (lambda bad: f" ({', '.join(bad)} BREACHED)"
+                        if bad else "")(
+                         sorted(n for n, b in fs["tenants"].items()
+                                if (b or {}).get("slo_verdict")
+                                == "fail"))
+                     if isinstance(fs.get("tenants"), dict)
+                     and fs["tenants"] else "")
+                  + (f"  prefix_hit_rate {fs['prefix_hit_rate']}"
+                     if "prefix_hit_rate" in fs else "")
                   + "  (tools/fleet_report.py for the breakdown)",
                   file=out)
         else:
