@@ -175,7 +175,9 @@ class ThreadReplica:
                  make_request: Optional[Callable[[Dict[str, Any]],
                                                  Any]] = None,
                  fault=None, role: str = "both",
-                 transport_factory: Optional[Callable[[], Any]] = None):
+                 transport_factory: Optional[Callable[[], Any]] = None,
+                 migrate_factory: Optional[Callable[[], Any]] = None,
+                 migrate_intake: bool = True):
         if role not in ("both", "prefill", "decode"):
             raise ValueError(f"role must be both|prefill|decode, "
                              f"got {role!r}")
@@ -183,6 +185,10 @@ class ThreadReplica:
             raise ValueError("a decode-role ThreadReplica needs a "
                              "transport_factory (its intake is the "
                              "handoff spool, not the queue)")
+        if migrate_factory is not None and role != "both":
+            raise ValueError("live migration (ISSUE 20) needs the "
+                             "interleaved engine: only a both-role "
+                             "ThreadReplica takes a migrate_factory")
         self.name = name
         self.role = role
         self._factory = engine_factory
@@ -196,15 +202,30 @@ class ThreadReplica:
         # score a chaos run that never happened (the serve.py stance).
         handoff_kind = str(getattr(fault, "kind", "")).startswith(
             ("handoff_", "sentinel_"))
-        if handoff_kind and (role != "decode"
-                             or fault.kind != "handoff_crash_preack"):
+        # handoff_crash_preack is the only drill expressible here, in
+        # two intake loops: a decode replica's spool intake, and (ISSUE
+        # 20) a both-role replica's MIGRATION intake — the destination
+        # dying between admit_migrated and ack, the lease-redelivery
+        # window migrate_under_crash_storm scores.
+        preack_ok = getattr(fault, "kind", "") == "handoff_crash_preack" \
+            and (role == "decode"
+                 or (role == "both" and migrate_factory is not None))
+        if handoff_kind and not preack_ok:
             raise ValueError(
                 f"{name}: ThreadReplica cannot express the "
-                f"{fault.kind!r} drill (decode replicas take "
-                "handoff_crash_preack; arm producer-side drills on "
-                "the transport inside the engine factory)")
+                f"{fault.kind!r} drill (decode replicas and migration-"
+                "armed both replicas take handoff_crash_preack; arm "
+                "producer-side drills on the transport inside the "
+                "engine factory)")
         self._fault = None if handoff_kind else fault
         self._handoff_fault = fault if handoff_kind else None
+        self._migrate_factory = migrate_factory
+        # migrate_intake=False makes the replica OUTBOUND-only on the
+        # migration spool: it can ship (interrupt(mode="migrate") /
+        # migrate(n)) but never claims — the shape for a source being
+        # permanently retired, and for deterministic chaos scripts that
+        # must control which peer claims.
+        self.migrate_intake = bool(migrate_intake)
         self.restarts = 0
         self._lock = threading.Lock()
         self._state = "starting"                # guarded-by: _lock
@@ -212,12 +233,20 @@ class ThreadReplica:
         self._consumed = 0
         self._stopping = False                  # guarded-by: _lock
         self._interrupted = False               # guarded-by: _lock
+        self._interrupt_mode = "drain"          # guarded-by: _lock
+        self._migrate_ask = 0                   # guarded-by: _lock
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._progress = time.perf_counter()
         self.engine = engine_factory()
         self.transport = transport_factory() \
             if transport_factory is not None else None
+        # The live-migration spool (ISSUE 20): outbound on
+        # interrupt(mode="migrate") / migrate(n), inbound every drive
+        # iteration — the same leased claim/ack machinery as the
+        # handoff spool, under this replica's worker id.
+        self.migrate_tx = migrate_factory() \
+            if migrate_factory is not None else None
         if self._fault is not None:
             self.engine.fault = self._fault
 
@@ -313,15 +342,47 @@ class ThreadReplica:
         self._thread.start()
         return self
 
-    def interrupt(self) -> None:
+    def interrupt(self, mode: str = "drain") -> None:
         """The rolling-restart action: drain (queued requests come back
         as status "drained" for the router to requeue on siblings),
         then rebuild the engine and return to "healthy" — the
         in-process equivalent of SIGTERM -> exit 75 -> supervised
-        restart."""
+        restart.
+
+        ``mode="migrate"`` (ISSUE 20) is drain WITHOUT eviction: live
+        slots ship to the migration spool (status "migrated") for a
+        peer to resume token-identically, instead of finishing here or
+        deadline-evicting — the rolling restart that kills no request.
+        Needs a ``migrate_factory``."""
+        if mode not in ("drain", "migrate"):
+            raise ValueError(f"interrupt mode must be drain|migrate, "
+                             f"got {mode!r}")
+        if mode == "migrate" and self.migrate_tx is None:
+            raise ValueError(f"{self.name}: interrupt(mode='migrate') "
+                             "needs a migrate_factory (the live-"
+                             "migration spool)")
         with self._lock:
             self._interrupted = True
+            self._interrupt_mode = mode
             self._state = "draining"    # stop routing to us NOW
+        self._wake.set()
+
+    def migrate(self, n: int = 1) -> None:
+        """Router-driven rebalance (ISSUE 20): ask the drive thread to
+        ship up to ``n`` live requests — deepest fill first (the most
+        KV relief per payload), index tie-break — to the migration
+        spool at the next tick boundary.  Asynchronous by design: the
+        engine is only touched from its own thread, so the effect
+        lands as a "migrated" terminal event plus a kv_bytes_live drop
+        in a later state() snapshot."""
+        if self.migrate_tx is None:
+            raise ValueError(f"{self.name}: migrate() needs a "
+                             "migrate_factory (the live-migration "
+                             "spool)")
+        if n < 1:
+            raise ValueError(f"migrate n must be >= 1, got {n}")
+        with self._lock:
+            self._migrate_ask += n
         self._wake.set()
 
     def restart(self) -> None:
@@ -355,14 +416,20 @@ class ThreadReplica:
             eng.fault = self._fault     # already-fired plans stay inert
         transport = self._transport_factory() \
             if self._transport_factory is not None else None
+        migrate_tx = self._migrate_factory() \
+            if self._migrate_factory is not None else None
         with self._lock:
             self.engine = eng
             # A fresh transport under the SAME worker id adopts this
             # replica's pre-crash claims on its first poll — the
-            # restarted-worker redelivery path.
+            # restarted-worker redelivery path (migration spool
+            # included).
             self.transport = transport
+            self.migrate_tx = migrate_tx
             self._consumed = 0
             self._interrupted = False
+            self._interrupt_mode = "drain"
+            self._migrate_ask = 0
         self.restarts += 1
 
     def _emit(self, events: List[Dict[str, Any]]) -> None:
@@ -375,6 +442,7 @@ class ThreadReplica:
         new = comps[self._consumed:]
         self._consumed = len(comps)
         redelivered = getattr(eng, "handoff_redelivered", ())
+        mig_redelivered = getattr(eng, "migration_redelivered", ())
         with_tenant = getattr(eng, "sched", None) is not None
         events = []
         for c in new:
@@ -394,7 +462,8 @@ class ThreadReplica:
                 # v17: the lane rides every terminal event so the
                 # router's per-tenant SLO windows never re-derive it.
                 ev["tenant"] = getattr(c.request, "tenant", "default")
-            if c.request.uid in redelivered:
+            if c.request.uid in redelivered \
+                    or c.request.uid in mig_redelivered:
                 ev["redelivered"] = True
             events.append(ev)
         self._emit(events)
@@ -404,43 +473,103 @@ class ThreadReplica:
             self._drive_decode()
             return
         eng = self.engine
+        mig_pending: List[Any] = []
+        mig_unacked: set = set()        # admitted, claim still on disk
+        mig_admits = 0
         while True:
             with self._lock:
                 stopping = self._stopping
                 interrupted = self._interrupted
+                mode = self._interrupt_mode
+                ask, self._migrate_ask = self._migrate_ask, 0
             if interrupted:
-                eng.drain("fleet-interrupt")
-                self._harvest(eng)      # drained statuses included
+                if mode == "migrate" and self.migrate_tx is not None:
+                    # Drain WITHOUT eviction (ISSUE 20): live slots
+                    # ship to the migration spool for a peer to resume
+                    # token-identically; only the un-admitted queue
+                    # requeues as "drained".  Deferred inbound claims
+                    # stay on disk for the fresh transport / a peer.
+                    eng.drain("fleet-interrupt",
+                              migrate=self.migrate_tx.send)
+                else:
+                    eng.drain("fleet-interrupt")
+                self._harvest(eng)      # drained/migrated included
                 self._rebuild()
                 eng = self.engine
+                mig_pending = []
+                mig_unacked = set()
                 with self._lock:
                     self._state = "healthy"
                 continue
-            # v17: a tenancy-armed engine's work view spans intake AND
-            # lanes (work_drained/unadmitted); legacy engines fall back
-            # to the queue alone (duck-typed like state()'s gauges).
-            wd_fn = getattr(eng, "work_drained", None)
-            pend_fn = getattr(eng, "runnable_backlog", None)
-            if (wd_fn() if wd_fn is not None
-                    else eng.queue.drained()) \
-                    and not eng.pool.any_live():
-                with self._lock:
-                    self._state = "stopped"
-                return
-            if (pend_fn() if pend_fn is not None
-                    else eng.queue.pending()) == 0 \
-                    and not eng.pool.any_live():
-                if stopping:
+            mig_tx = self.migrate_tx
+            try:
+                if mig_tx is not None and self.migrate_intake \
+                        and not stopping:
+                    # (A stopping — e.g. autoscale-retired — replica
+                    # never claims NEW work; payloads it already holds
+                    # still drain below.)
+                    # Inbound migrations ride the drive loop like the
+                    # decode role's handoff intake: poll/claim, renew
+                    # deferred admissions (a full pool must not forfeit
+                    # a live request to a peer), admit in order, ack.
+                    mig_pending.extend(mig_tx.poll())
+                    if mig_pending:
+                        renew = getattr(mig_tx, "renew", None)
+                        if renew is not None:
+                            renew(mig_pending)
+                    while mig_pending \
+                            and eng.admit_handoff(mig_pending[0]):
+                        h = mig_pending.pop(0)
+                        mig_admits += 1
+                        fault = self._handoff_fault
+                        if fault is not None \
+                                and fault.kind == "handoff_crash_preack" \
+                                and fault.due(mig_admits):
+                            fault.take()
+                            mig_unacked.add(h.uid)
+                            raise RuntimeError(
+                                f"injected handoff_crash_preack at "
+                                f"migration admit {mig_admits} (uid "
+                                f"{h.uid} admitted, never acked)")
+                        mig_tx.ack(h)
+                if mig_tx is not None and ask:
+                    # Rebalance ask: ship the deepest-fill live slots
+                    # (most KV relief per payload; index tie-break
+                    # keeps it deterministic).
+                    live = sorted(
+                        eng.pool.live,
+                        key=lambda j: (-eng.pool.slots[j].cursor, j))
+                    for i in live[:ask]:
+                        h = eng.extract_live(
+                            eng.pool.slots[i].request.uid)
+                        if h is not None:
+                            mig_tx.send(h)
+                    self._harvest(eng)  # the "migrated" terminals
+                # v17: a tenancy-armed engine's work view spans intake
+                # AND lanes (work_drained/unadmitted); legacy engines
+                # fall back to the queue alone (duck-typed like
+                # state()'s gauges).
+                wd_fn = getattr(eng, "work_drained", None)
+                pend_fn = getattr(eng, "runnable_backlog", None)
+                if (wd_fn() if wd_fn is not None
+                        else eng.queue.drained()) \
+                        and not eng.pool.any_live() and not mig_pending:
                     with self._lock:
                         self._state = "stopped"
                     return
-                # Idle: wait for work WITHOUT ticking — virtual time
-                # must not advance, or tick-armed drills would fire at
-                # host-speed-dependent points.
-                self._wake.wait(0.005)
-                self._wake.clear()
-                continue
-            try:
+                if (pend_fn() if pend_fn is not None
+                        else eng.queue.pending()) == 0 \
+                        and not eng.pool.any_live():
+                    if stopping and not mig_pending:
+                        with self._lock:
+                            self._state = "stopped"
+                        return
+                    # Idle: wait for work WITHOUT ticking — virtual
+                    # time must not advance, or tick-armed drills would
+                    # fire at host-speed-dependent points.
+                    self._wake.wait(0.005)
+                    self._wake.clear()
+                    continue
                 eng.step()
                 self._progress = time.perf_counter()
             except BaseException as e:  # noqa: BLE001 — a crash IS the event
@@ -451,10 +580,16 @@ class ThreadReplica:
                 lost += [eng.pool.slots[i].request.uid
                          for i in eng.pool.live]
                 self._harvest(eng)
+                done = {c.request.uid for c in eng.completions}
+                # Migration claims that were never acked survive on
+                # disk — the lease expires and a peer redelivers them,
+                # so reporting those uids lost would double-count
+                # (mirror of the decode role's acked-only rule).
                 self._emit([{"uid": u, "status": "lost",
                              "replica": self.name,
                              "error": f"{type(e).__name__}: {e}"}
-                            for u in lost])
+                            for u in lost
+                            if u not in mig_unacked and u not in done])
                 with self._lock:
                     self._state = "crashed"
                 return
@@ -729,19 +864,40 @@ class ProcReplica:
         return int(beats[-1]["pid"]) if beats and "pid" in beats[-1] \
             else None
 
-    def interrupt(self) -> Optional[int]:
+    def interrupt(self, mode: str = "drain") -> Optional[int]:
         """The rolling-restart action: SIGTERM the serve CHILD (not the
         supervisor) — it drains, exits 75, and the supervisor restarts
         it promptly with the metrics stream rotated.  Returns the pid
         signalled (the caller waits for a heartbeat from a DIFFERENT
-        pid to confirm the restart landed)."""
-        pid = self.child_pid()
+        pid to confirm the restart landed).
+
+        ``mode`` keeps the ThreadReplica contract shape; for a
+        subprocess the drain behavior is decided by the CHILD's
+        ``--migrate-dir`` flag (armed at spawn), so both modes send the
+        same SIGTERM — a child with a migration spool already drains
+        without eviction.
+
+        Idempotent across the restart window (ISSUE 20 satellite): the
+        newest heartbeat keeps advertising the OLD attempt's pid until
+        the restarted child speaks, so a second interrupt() during an
+        in-progress drain or restart would re-SIGTERM a stale — and
+        possibly recycled — pid.  The attempt-generation check is
+        state()'s draining/restarting detection (last restart record
+        newer than the last heartbeat); while it holds, this is a
+        no-op returning None."""
+        if mode not in ("drain", "migrate"):
+            raise ValueError(f"interrupt mode must be drain|migrate, "
+                             f"got {mode!r}")
+        st = self.state()
+        if st["state"] != "healthy":
+            return None                 # drain/restart already in flight
+        pid = st.get("pid")
         if pid is not None:
             try:
-                os.kill(pid, signal.SIGTERM)
+                os.kill(int(pid), signal.SIGTERM)
             except OSError:  # pragma: no cover — raced a crash
                 return None
-        return pid
+        return pid if pid is None else int(pid)
 
     # ------------------------------------------------------- contract
 

@@ -84,7 +84,7 @@ from typing import Any, Dict, List, Optional, Tuple
 # Keep in sync with apex_example_tpu/obs/schema.py (SCHEMA_VERSION) —
 # jax-free contract forbids importing it (same stance as the
 # supervisor's hard-coded records).
-SCHEMA = 17
+SCHEMA = 18
 TRACE_ID_ENV = "APEX_TRACE_ID"
 
 POLICIES = ("round_robin", "least_pending", "least_kv",
@@ -92,7 +92,10 @@ POLICIES = ("round_robin", "least_pending", "least_kv",
 
 # Statuses a replica can report that end a request for good at the
 # fleet level (drained and lost are re-routed instead; "handoff" parks
-# the uid on the KV spool — a decode replica's outbox finishes it).
+# the uid on the KV spool — a decode replica's outbox finishes it;
+# "migrated" (ISSUE 20) parks the same way on the live-migration spool
+# — a PEER resumes the mid-flight request token-identically and its
+# events finish the uid).
 _TERMINAL = ("ok", "timeout", "shed", "cancelled", "failed", "rejected")
 
 _SLO_MOD = None
@@ -204,6 +207,8 @@ class FleetRouter:
                  slo=None, slo_window: int = 16,
                  slo_rollup_s: float = 2.0,
                  tenant_specs=None, prefix_block_size: int = 8,
+                 rebalance_kv_ratio: Optional[float] = None,
+                 rebalance_cooldown_s: float = 1.0,
                  trace: bool = False, log=print):
         if policy not in POLICIES:
             raise ValueError(f"policy must be one of {POLICIES}, "
@@ -258,6 +263,28 @@ class FleetRouter:
         self._handoffs = 0            # uids parked on the KV spool
         self._handoff_redelivered = 0  # terminals from redelivered
         #                                handoff admissions (v13)
+        # Live migration + elasticity (ISSUE 20, all guarded-by _lock):
+        self._migrations = 0          # uids shipped mid-flight
+        self._migration_completed = 0  # ...that reached a terminal
+        self._migration_redelivered = 0  # terminals from redelivered
+        #                                  migration admissions
+        self._rebalance_migrations = 0  # migrations THIS router asked
+        self._scale_up = 0            # autoscale events (note_autoscale)
+        self._scale_down = 0
+        self._retired: set = set()    # names out of the routable set
+        # KV-pressure rebalance: when the hottest both-role replica's
+        # kv_bytes_live exceeds rebalance_kv_ratio x the fleet mean,
+        # ask it to migrate one live request to the spool (cooldown
+        # hysteresis between asks).  None = off.
+        if rebalance_kv_ratio is not None and rebalance_kv_ratio <= 1.0:
+            raise ValueError(f"rebalance_kv_ratio must be > 1.0, "
+                             f"got {rebalance_kv_ratio}")
+        if rebalance_cooldown_s < 0:
+            raise ValueError(f"rebalance_cooldown_s must be >= 0, "
+                             f"got {rebalance_cooldown_s}")
+        self.rebalance_kv_ratio = rebalance_kv_ratio
+        self.rebalance_cooldown_s = float(rebalance_cooldown_s)
+        self._last_rebalance = 0.0
         self.results: Dict[str, Dict[str, Any]] = {}    # uid -> final event
         # SLO plane (ISSUE 16): with a spec armed, every fleet-terminal
         # event is scored good/bad; verdicts accumulate in _slo_scored
@@ -474,6 +501,7 @@ class FleetRouter:
         candidates by it; the other policies ignore it."""
         names = [n for n in self._order
                  if n not in refused
+                 and n not in self._retired
                  and self._roles.get(n, "both") != "decode"
                  and self._routable(metas[n], now)]
         preferred = [n for n in names if n not in avoid]
@@ -607,6 +635,127 @@ class FleetRouter:
             self._submitted += 1
         self._dispatch(uid, "dispatch")
 
+    # ----------------------------------------------------- elasticity
+
+    def add_replica(self, handle) -> None:
+        """Join a replica to the fleet mid-run (ISSUE 20: the elastic
+        pool's scale-up action).  Routable immediately in state
+        "starting" — the inbox/queue buffers until it speaks."""
+        with self._lock:
+            if handle.name in self._replicas:
+                raise ValueError(f"duplicate replica {handle.name!r}")
+            self._order.append(handle.name)
+            self._roles[handle.name] = getattr(handle, "role", "both")
+            self._replicas[handle.name] = _Meta(handle)
+            self._retired.discard(handle.name)
+        self._state_rec(handle.name, "starting")
+
+    def retire_replica(self, name: str) -> None:
+        """Remove a replica from the ROUTABLE set (scale-down).  It is
+        still polled and harvested — late terminals, drain requeues and
+        migrated events must keep landing — the caller owns the actual
+        wind-down (typically ``interrupt(mode="migrate")`` so its live
+        work ships to peers, then ``stop()``)."""
+        with self._lock:
+            if name not in self._replicas:
+                raise ValueError(f"unknown replica {name!r}")
+            self._retired.add(name)
+        self._state_rec(name, "draining", detail="retired")
+
+    def note_autoscale(self, direction: str, replica: str,
+                       reason: str = "") -> None:
+        """Record one elastic-pool action (ISSUE 20): the controller
+        calls this alongside add_replica/retire_replica so the
+        fleet_summary's scale_up_events/scale_down_events ledger — the
+        autoscale_flap oscillation bound — reflects every decision."""
+        if direction not in ("up", "down"):
+            raise ValueError(f"autoscale direction must be up|down, "
+                             f"got {direction!r}")
+        with self._lock:
+            if direction == "up":
+                self._scale_up += 1
+            else:
+                self._scale_down += 1
+        if self.log:
+            self.log(f"fleet: autoscale {direction} -> {replica}"
+                     + (f" ({reason})" if reason else ""))
+
+    def backlog(self) -> int:
+        """Work submitted but not yet admitted to a slot anywhere: the
+        router's parked backlog plus every routable replica's reported
+        ``pending`` gauge.  The elastic pool's primary scale signal
+        (spool depth)."""
+        with self._lock:
+            return len(self._backlog) + sum(
+                int(self._replicas[n].health.get("pending", 0) or 0)
+                for n in self._order if n not in self._retired)
+
+    def ttft_p50_ms(self) -> Optional[float]:
+        """Fleet-wide TTFT p50 merged from the replicas' heartbeat
+        sketches, or None when the SLO plane is unarmed / no sketch has
+        samples yet.  The elastic pool's latency scale signal."""
+        mod = self._slo_mod
+        if mod is None:
+            return None
+        with self._lock:
+            snaps = [self._replicas[n].health.get("slo_sketch")
+                     for n in self._order]
+        merged = None
+        for snap in snaps:
+            s = (snap or {}).get("ttft_ms")
+            if not isinstance(s, dict) or not s.get("count"):
+                continue
+            if merged is not None and merged.get("alpha") != s.get("alpha"):
+                continue                # mixed-resolution fleet: skip
+            merged = mod.sketch_merge(merged, s) if merged is not None \
+                else dict(s, buckets=dict(s["buckets"]))
+        if merged is None:
+            return None
+        return float(mod.sketch_percentile(merged, 50))
+
+    def _maybe_rebalance(self) -> None:
+        """KV-pressure rebalance (ISSUE 20): when the hottest routable
+        both-role replica's dtype-accurate ``kv_bytes_live`` gauge
+        exceeds ``rebalance_kv_ratio`` x the fleet mean, ask its handle
+        to migrate ONE live request to the spool (``migrate(1)``,
+        asynchronous — the effect lands as a "migrated" event).  One
+        ask per ``rebalance_cooldown_s``: hysteresis against chasing a
+        gauge that is already moving."""
+        now = time.time()
+        if now - self._last_rebalance < self.rebalance_cooldown_s:
+            return
+        with self._lock:
+            gauges = [(n, self._replicas[n].health.get("kv_bytes_live"))
+                      for n in self._order
+                      if n not in self._retired
+                      and self._roles.get(n, "both") == "both"
+                      and self._replicas[n].health.get("state")
+                      == "healthy"]
+        gauges = [(n, g) for n, g in gauges if g is not None]
+        if len(gauges) < 2:
+            return
+        mean = sum(g for _, g in gauges) / len(gauges)
+        if mean <= 0:
+            return
+        hot_name, hot = max(gauges, key=lambda t: (t[1], t[0]))
+        if hot / mean < self.rebalance_kv_ratio:
+            return
+        with self._lock:
+            handle = self._replicas[hot_name].handle
+        migrate = getattr(handle, "migrate", None)
+        if migrate is None:
+            return
+        try:
+            migrate(1)
+        except ValueError:
+            return                      # no migration spool on it
+        self._last_rebalance = now
+        with self._lock:
+            self._rebalance_migrations += 1
+        if self.log:
+            self.log(f"fleet: rebalance — migrating 1 from {hot_name} "
+                     f"(kv skew {hot / mean:.2f}x mean)")
+
     # --------------------------------------------------------- absorb
 
     def _absorb(self, ev: Dict[str, Any]) -> None:
@@ -641,11 +790,19 @@ class FleetRouter:
                 self.results[uid] = ev
                 if self._slo is not None:
                     self._slo_absorb(status, ev)
+                if entry.get("migrated"):
+                    # v18: a request that was live-migrated at least
+                    # once reached its terminal — the conservation
+                    # counter drain_zero_evictions scores on.
+                    self._migration_completed += 1
                 if ev.get("redelivered"):
-                    # v13: this terminal came from a REDELIVERED
-                    # handoff admission — the crash-safe spool finished
+                    # v13/v18: this terminal came from a REDELIVERED
+                    # spool admission — the crash-safe lease finished
                     # a request its first consumer dropped.
-                    self._handoff_redelivered += 1
+                    if entry.get("migrated"):
+                        self._migration_redelivered += 1
+                    else:
+                        self._handoff_redelivered += 1
                 if meta is not None:
                     meta.bump(status)
                     if entry["replica"] == src:
@@ -690,6 +847,34 @@ class FleetRouter:
                         meta.fail_streak = 0
                         meta.probe_uid = None
                 return
+            if status == "migrated":
+                # Live migration (ISSUE 20): the source shipped the
+                # MID-FLIGHT request — KV blocks, generated tokens,
+                # sampler state — to the migration spool.  Its booking
+                # releases but nothing re-routes: a peer's leased claim
+                # resumes it token-identically and that peer's events
+                # finish the uid (the handoff parking shape, plus a
+                # sticky "migrated" mark so the terminal counts into
+                # the migration conservation ledger).
+                if src is not None and entry["replica"] != src:
+                    self._duplicates += 1
+                    return
+                entry["replica"] = None
+                entry["from"] = src
+                entry["stage"] = "spool"
+                entry["spooled_at"] = time.time()
+                entry["migrated"] = True
+                self._migrations += 1
+                if meta is not None:
+                    meta.inflight = max(meta.inflight - 1, 0)
+                    meta.bump("migrated")
+                    if meta.probe_uid == uid:
+                        # Shipping its live work IS forward progress;
+                        # the breaker closes on migrate like on ok.
+                        meta.breaker = "closed"
+                        meta.fail_streak = 0
+                        meta.probe_uid = None
+                return
             # drained / lost: the uid lives on — but only the replica
             # that currently holds it may hand it back (exactly-once
             # per drain: duplicate reports find the entry already
@@ -710,6 +895,10 @@ class FleetRouter:
             entry["from"] = src
             entry.pop("stage", None)
             entry.pop("spooled_at", None)
+            # A spool-lost migrated uid re-serves from scratch: its
+            # migration never completed, so the sticky mark must not
+            # count the re-serve's terminal into the migration ledger.
+            entry.pop("migrated", None)
             probe_loss = status == "lost" and src is not None \
                 and entry.pop("probe", None) == src
             if meta is not None:
@@ -981,6 +1170,8 @@ class FleetRouter:
         self._refresh_health()
         if self._slo is not None:
             self._slo_rollup()
+        if self.rebalance_kv_ratio is not None:
+            self._maybe_rebalance()
         with self._lock:
             handles = [(n, self._replicas[n].handle)
                        for n in self._order]
@@ -1067,11 +1258,13 @@ class FleetRouter:
                 per_replica[name] = dict(meta.counts)
                 per_replica[name]["dispatches"] = meta.dispatches
                 ok_r = meta.counts.get("ok", 0)
-                # A handed-off request continues on a decode replica —
-                # like a drain it leaves this replica's availability
-                # denominator (the decode side owns the outcome).
+                # A handed-off request continues on a decode replica,
+                # and a migrated one on a peer — like a drain they
+                # leave this replica's availability denominator (the
+                # destination owns the outcome).
                 owned = sum(v for k, v in meta.counts.items()
-                            if k not in ("drained", "lost", "handoff"))
+                            if k not in ("drained", "lost", "handoff",
+                                         "migrated"))
                 per_replica[name]["availability"] = round(
                     ok_r / owned, 3) if owned else 1.0
                 per_replica[name]["state"] = \
@@ -1088,6 +1281,12 @@ class FleetRouter:
             redelivered = self._handoff_redelivered
             in_spool = sum(1 for e in self._inflight.values()
                            if e.get("stage") == "spool")
+            migrations = self._migrations
+            migration_completed = self._migration_completed
+            migration_redelivered = self._migration_redelivered
+            rebalanced = self._rebalance_migrations
+            scale_up = self._scale_up
+            scale_down = self._scale_down
             slo_scored = list(self._slo_scored)
             tenant_counts = {t: dict(c) for t, c
                              in self._tenant_counts.items()}
@@ -1140,6 +1339,22 @@ class FleetRouter:
             rec["handoffs"] = handoffs
             rec["handoff_redelivered"] = redelivered
             rec["in_spool"] = in_spool
+        if migrations:
+            # v18 migration conservation ledger: only a fleet that
+            # actually live-migrated carries these, so legacy streams
+            # stay byte-stable.
+            rec["migrations"] = migrations
+            rec["migration_completed"] = migration_completed
+            rec["migration_redelivered"] = migration_redelivered
+            if rebalanced:
+                rec["rebalance_migrations"] = rebalanced
+            if "in_spool" not in rec:
+                rec["in_spool"] = in_spool
+        if scale_up or scale_down:
+            # v18 autoscale ledger (the autoscale_flap oscillation
+            # bound) — absent on fixed-size fleets.
+            rec["scale_up_events"] = scale_up
+            rec["scale_down_events"] = scale_down
         if self._slo is not None:
             # v14 SLO verdict: computed PURELY from the scored-event
             # list (score_windows chunks it exactly as the emission

@@ -72,6 +72,35 @@ number instead of a claim.
                      ``min_hit_rate`` when given) — the
                      affinity-vs-least_pending strict comparison is the
                      caller's double run over the same spec stream.
+``drain_zero_evictions``  live migration (ISSUE 20): rolling restart
+                     where every ``interrupt(mode="migrate")`` ships
+                     the replica's LIVE slots to the migration spool
+                     for a peer to resume token-identically — nothing
+                     is evicted, nothing re-prefills from scratch.
+                     Scored on zero lost at availability 1.0 (an
+                     eviction would surface as a non-ok terminal) with
+                     migrations actually flowing, landing as terminals,
+                     and the spool drained at close.
+``migrate_under_crash_storm``  live migration (ISSUE 20): the
+                     DESTINATION dies in the ack-crash window — a
+                     drained source ships mid-flight requests, the
+                     armed peer claims and crashes between
+                     ``admit_migrated`` and ack (the
+                     ``handoff_crash_preack`` drill on its migration
+                     intake), nobody restarts it, and the surviving
+                     peers must reclaim the expired leases and finish
+                     the redelivered payloads exactly once.  Staged
+                     deterministically: only the source runs at drain
+                     time (outbound-only spool, so it cannot reclaim
+                     its own payloads), only the doomed destination
+                     polls at claim time.
+``autoscale_flap``   elastic pools (ISSUE 20): bursty load with idle
+                     gaps against an elastic controller stepping in
+                     the drive loop.  The controller must track the
+                     bursts (>= 1 scale-up) without oscillating past
+                     the hysteresis bound — total scale events stay
+                     under the cap, the pool ends inside [min, max],
+                     and retiring a replica never kills its work.
 ``none``             no chaos: route, serve, summarize (the baseline
                      the chaos scores are read against).
 
@@ -93,7 +122,8 @@ from typing import Any, Dict, List, Optional
 SCENARIOS = ("none", "rolling_restart", "crash_storm", "straggler",
              "prefill_crash", "decode_crash_midspool",
              "noisy_neighbor", "tenant_burst_starvation",
-             "prefix_heavy")
+             "prefix_heavy", "drain_zero_evictions",
+             "migrate_under_crash_storm", "autoscale_flap")
 
 
 def synthetic_specs(n: int, *, vocab_size: int = 256, seed: int = 0,
@@ -172,6 +202,18 @@ def _wait_up(router, replica, timeout_s: float) -> bool:
         return st.get("state") == "healthy" \
             and st.get("pid") is not None
     return _drive(router, up, timeout_s)
+
+
+def _wait_live(router, replica, timeout_s: float) -> bool:
+    """Poll the router until ``replica`` is healthy and actually HOLDS
+    mid-flight work (live KV blocks) — the precondition for a
+    migrate-mode interrupt to ship anything.  Interrupting an idle
+    replica is a valid drain but a vacuous migration test."""
+    def live():
+        st = replica.state()
+        return st.get("state") == "healthy" \
+            and st.get("blocks_live", 0) > 0
+    return _drive(router, live, timeout_s)
 
 
 def _wait_restarted(router, replica, restarts_before: int,
@@ -602,6 +644,200 @@ def run_prefix_heavy(router, replicas, specs, *,
                    summary_checks=summary_checks)
 
 
+def run_drain_zero_evictions(router, replicas, specs, *,
+                             timeout_s: float = 120.0,
+                             settle_timeout_s: float = 60.0,
+                             availability_min: float = 1.0
+                             ) -> Dict[str, Any]:
+    """Rolling restart WITHOUT killing a single request (ISSUE 20):
+    every replica is interrupted in turn in ``mode="migrate"`` — its
+    live slots ship to the migration spool (storage-dtype-exact KV +
+    cursor + sampler state) and a peer, or the rebuilt replica itself,
+    resumes them token-identically; only the un-admitted queue requeues
+    as "drained".  Each interrupt waits for the replica to actually
+    hold live work first, so the migration path provably runs.  Scored
+    on zero lost at availability 1.0 (an eviction would surface as a
+    non-ok terminal), migrations flowing AND landing as terminals, and
+    the spool drained at close."""
+    t0 = time.perf_counter()
+    for replica in replicas:
+        replica.start()                 # idempotent on both transports
+    waves = len(replicas) + 2
+    per = max(len(specs) // waves, 1)
+    chunks = [specs[i * per:(i + 1) * per] for i in range(waves - 1)]
+    chunks.append(specs[(waves - 1) * per:])
+    for spec in chunks[0]:
+        router.submit(spec)
+    cycled_all = True
+    for i, replica in enumerate(replicas):
+        for spec in chunks[i + 1]:
+            router.submit(spec)
+        cycled_all &= _wait_up(router, replica, settle_timeout_s)
+        cycled_all &= _wait_live(router, replica, settle_timeout_s)
+        before = replica.state().get("restarts", 0)
+        router.trace_event("i", "interrupt_migrate",
+                           args={"replica": replica.name})
+        replica.interrupt(mode="migrate")
+        cycled_all &= _wait_restarted(router, replica, before,
+                                      settle_timeout_s)
+    for spec in chunks[-1]:
+        router.submit(spec)
+    done = _drive(router, router.done, timeout_s)
+    router.trace_event("X", "scenario:drain_zero_evictions", ts=t0,
+                       dur=time.perf_counter() - t0)
+    return _finish(router, "drain_zero_evictions",
+                   availability_min=availability_min,
+                   checks={"completed_in_time": done,
+                           "every_replica_cycled": cycled_all},
+                   summary_checks={
+                       "migrations_flowed":
+                           lambda s: s.get("migrations", 0) > 0,
+                       "migrations_landed":
+                           lambda s: s.get("migration_completed",
+                                           0) > 0,
+                       "spool_drained":
+                           lambda s: s.get("in_spool", 0) == 0})
+
+
+def run_migrate_under_crash_storm(router, replicas, specs, *,
+                                  source_name: str,
+                                  crashed_name: str,
+                                  timeout_s: float = 120.0,
+                                  settle_timeout_s: float = 60.0,
+                                  availability_min: float = 1.0
+                                  ) -> Dict[str, Any]:
+    """Live-migration chaos (ISSUE 20): the migration DESTINATION dies
+    in the ack-crash window and the payloads must still land exactly
+    once.  Deterministically staged so the doomed replica provably
+    claims first:
+
+    1. Everything is pre-submitted, then only ``source_name`` starts.
+       The caller built it OUTBOUND-only on the migration spool
+       (``migrate_intake=False``) so it can never reclaim its own
+       payloads after the drain.
+    2. Once the source holds live slots it is interrupted in
+       ``mode="migrate"`` — mid-flight requests ship to the spool.
+    3. ``crashed_name`` starts next, the ONLY polling peer.  The
+       caller armed ``handoff_crash_preack`` on it: it claims, admits
+       the first payload, and dies before the ack — the claim (and
+       any others it held) survive on disk under its lease.
+    4. Nobody restarts it.  The remaining peers start last and must
+       wait out the lease, reclaim, and finish the redelivered
+       payloads — scored on zero lost, availability 1.0, migrations
+       flowing, ``migration_redelivered`` > 0 (a peer provably did
+       reclaimed work), and the spool drained."""
+    t0 = time.perf_counter()
+    source = next(r for r in replicas if r.name == source_name)
+    dest = next(r for r in replicas if r.name == crashed_name)
+    rest = [r for r in replicas
+            if r.name not in (source_name, crashed_name)]
+    for spec in specs:
+        router.submit(spec)
+    source.start()
+    staged = _wait_up(router, source, settle_timeout_s)
+    staged &= _wait_live(router, source, settle_timeout_s)
+    before = source.state().get("restarts", 0)
+    router.trace_event("i", "interrupt_migrate",
+                       args={"replica": source_name})
+    source.interrupt(mode="migrate")
+    staged &= _wait_restarted(router, source, before, settle_timeout_s)
+    dest.start()
+    observed: set = set()
+
+    def crash_seen():
+        if crashed_name not in observed:
+            st = dest.state()
+            if st.get("state") == "crashed" \
+                    or st.get("classification") in ("crashed",
+                                                    "stall_killed"):
+                observed.add(crashed_name)
+        return crashed_name in observed
+
+    staged &= _drive(router, crash_seen, settle_timeout_s)
+    for replica in rest:
+        replica.start()
+    done = _drive(router, router.done, timeout_s)
+    router.trace_event("X", "scenario:migrate_under_crash_storm",
+                       ts=t0, dur=time.perf_counter() - t0)
+    return _finish(router, "migrate_under_crash_storm",
+                   availability_min=availability_min,
+                   checks={"completed_in_time": done,
+                           "staged_in_order": staged,
+                           "crash_observed": crashed_name in observed},
+                   summary_checks={
+                       "migrations_flowed":
+                           lambda s: s.get("migrations", 0) > 0,
+                       "peer_redelivered":
+                           lambda s: s.get("migration_redelivered",
+                                           0) > 0,
+                       "spool_drained":
+                           lambda s: s.get("in_spool", 0) == 0})
+
+
+def run_autoscale_flap(router, replicas, specs, *, pool,
+                       bursts: int = 3,
+                       gap_s: float = 0.5,
+                       max_scale_events: Optional[int] = None,
+                       timeout_s: float = 120.0,
+                       availability_min: float = 1.0
+                       ) -> Dict[str, Any]:
+    """Elastic-pool hysteresis drill (ISSUE 20): the workload arrives
+    in ``bursts`` separated by idle gaps — the classic flap inducer.
+    ``pool`` is the duck-typed elastic controller (fleet.py's
+    ElasticPool): ``pool.step()`` interleaves with every router poll,
+    exactly the fleet drive loop's cadence.  The controller must track
+    the bursts (>= 1 scale-up over the run) WITHOUT oscillating past
+    the hysteresis bound: total scale events (up + down) stay <=
+    ``max_scale_events`` (default ``2 * bursts`` — at most one
+    up/down pair per burst), and the pool ends inside its [min, max]
+    bounds.  Scored at availability 1.0 with zero lost — retiring a
+    replica must never kill its work (migrate-drain or graceful
+    stop)."""
+    t0 = time.perf_counter()
+    if max_scale_events is None:
+        max_scale_events = 2 * bursts
+    for replica in replicas:
+        replica.start()                 # idempotent on both transports
+    per = max(len(specs) // bursts, 1)
+    chunks = [specs[i * per:(i + 1) * per] for i in range(bursts - 1)]
+    chunks.append(specs[(bursts - 1) * per:])
+
+    def drive_pool(until, budget_s):
+        t = time.time()
+        while True:
+            router.poll()
+            pool.step()
+            if until():
+                return True
+            if time.time() - t >= budget_s:
+                return False
+            time.sleep(0.02)
+
+    done = True
+    for i, chunk in enumerate(chunks):
+        for spec in chunk:
+            router.submit(spec)
+        done &= drive_pool(router.done, timeout_s)
+        if i < len(chunks) - 1:
+            # Idle gap: the scale-down side of the hysteresis gets its
+            # chance to fire (and to flap — which the bound punishes).
+            drive_pool(lambda: False, gap_s)
+    bounds_ok = pool.within_bounds()
+    router.trace_event("X", "scenario:autoscale_flap", ts=t0,
+                       dur=time.perf_counter() - t0)
+    return _finish(router, "autoscale_flap",
+                   availability_min=availability_min,
+                   checks={"completed_in_time": done,
+                           "pool_within_bounds": bounds_ok},
+                   summary_checks={
+                       "scaled_up":
+                           lambda s: s.get("scale_up_events", 0) >= 1,
+                       "no_flap":
+                           lambda s: s.get("scale_up_events", 0)
+                           + s.get("scale_down_events", 0)
+                           <= max_scale_events})
+
+
 def run_scenario(name: str, router, replicas, specs,
                  **kw) -> Dict[str, Any]:
     """Dispatch by scenario name (the ``fleet.py --scenario`` surface)."""
@@ -616,5 +852,8 @@ def run_scenario(name: str, router, replicas, specs,
           "decode_crash_midspool": run_decode_crash_midspool,
           "noisy_neighbor": run_noisy_neighbor,
           "tenant_burst_starvation": run_tenant_burst_starvation,
-          "prefix_heavy": run_prefix_heavy}[name]
+          "prefix_heavy": run_prefix_heavy,
+          "drain_zero_evictions": run_drain_zero_evictions,
+          "migrate_under_crash_storm": run_migrate_under_crash_storm,
+          "autoscale_flap": run_autoscale_flap}[name]
     return fn(router, replicas, specs, **kw)

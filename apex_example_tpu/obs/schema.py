@@ -370,6 +370,34 @@ All emitted ONLY when tenancy / prefix advertisement is armed — an
 unarmed run's stream is byte-identical to v16 output, and v17 is once
 more a strict superset: every v1–v16 stream validates unchanged.
 
+Version 18 adds the live-migration + elastic-pool stratum (ISSUE 20 —
+``ServeEngine.extract_live``/``admit_migrated``, drain-without-eviction
+and the fleet autoscaler):
+
+``kv_migration``  one per live-migration side: the source engine that
+                  snapshotted a MID-FLIGHT request (arena blocks at the
+                  committed cursor, generated tokens, sampler state)
+                  emits ``direction: "out"`` with ``tokens_generated``;
+                  the destination that scattered the payload and
+                  resumed decoding emits ``direction: "in"`` (with
+                  ``migration_ms`` transit, ``requeued`` deferral
+                  episodes, and the same ``redelivered``/``duplicate``
+                  lease-crash provenance ``kv_handoff`` carries — the
+                  payloads ride the identical leased spool protocol).
+
+plus the migration ledger on ``serve_summary`` (``migrations_out`` /
+``migrations_in`` / ``migration_requeued`` / ``migration_duplicates``
+/ ``migration_redelivered`` / ``migration_bytes`` / ``migration_ms``
+percentiles), ``migrated`` on ``serve_drain`` (a migrating drain ships
+its in-flight slots instead of ticking them out — evictions stay 0),
+and the fleet-side counters on ``fleet_summary`` (``migrations`` /
+``migration_completed`` — uids shipped mid-flight and their eventual
+terminals — and ``scale_up_events`` / ``scale_down_events`` from the
+elastic pool controller).  All emitted ONLY when migration/autoscale
+traffic actually happened — a migration-free run's stream is
+byte-identical to v17 output, and v18 is once more a strict superset:
+every v1–v17 stream validates unchanged.
+
 ``validate_record`` is the single source of truth consumed by
 ``tools/metrics_lint.py`` and the tier-1 smoke test; extending the schema
 means extending the tables here, nowhere else.  (The supervisor carries
@@ -381,7 +409,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 17
+SCHEMA_VERSION = 18
 
 _NUM = (int, float)
 # v6 cost fields degrade to null where a backend omits the analysis —
@@ -556,6 +584,16 @@ REQUIRED: Dict[str, Dict[str, Any]] = {
         "request_id": str,
         "direction": str,       # out (prefill -> transport) | in
         "fill": int,            # tokens of KV in the payload
+        "blocks": int,          # arena blocks in the payload
+        "payload_bytes": int,   # payload + scale bytes, dtype-accurate
+    },
+    # --- schema v18: live-migration records (ISSUE 20) ---
+    "kv_migration": {
+        "record": str,
+        "time": _NUM,
+        "request_id": str,
+        "direction": str,       # out (source -> transport) | in
+        "fill": int,            # tokens of committed KV in the payload
         "blocks": int,          # arena blocks in the payload
         "payload_bytes": int,   # payload + scale bytes, dtype-accurate
     },
@@ -767,6 +805,17 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "tenants": dict,            # name -> {weight, slo_class,
                                     #   admitted_tokens, budget?,
                                     #   per-status counts}
+        # v18: the live-migration ledger (ISSUE 20).  Every field gated
+        # on actual migration traffic — migration-free streams stay
+        # byte-identical to v17.
+        "migrations_out": int,      # live slots shipped mid-flight
+        "migrations_in": int,       # migrated requests resumed here
+        "migration_requeued": int,  # deferred-admission episodes
+        "migration_duplicates": int,   # idempotent re-admissions acked
+        "migration_redelivered": int,  # uids admitted from a reclaimed
+                                       #   or adopted lease
+        "migration_bytes": int,     # payload bytes moved, both sides
+        "migration_ms": dict,       # in side: transit percentiles
     },
     "preemption": {
         "run_id": str,
@@ -814,6 +863,8 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "evicted": int,          # in-flight deadline-evicted/failed
         "requeued": int,         # queued handed back (status "drained")
         "requeued_ids": list,
+        "migrated": int,         # v18: in-flight shipped mid-flight by
+                                 #   a migrating drain (evictions == 0)
     },
     "compile_event": {
         "run_id": str,
@@ -982,6 +1033,17 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
                                   #   admitted_tokens?, budget?}
         "prefix_hit_rate": _NUM,  # sum advertised shared / prompt
                                   #   tokens across replicas
+        # v18 (ISSUE 20): live migration + elastic pools.  Absent
+        # unless migrations/autoscaling actually happened.
+        "migrations": int,        # uids shipped mid-flight (out events)
+        "migration_completed": int,  # migrated uids that reached a
+                                     #   terminal status afterwards
+        "migration_redelivered": int,  # terminals from redelivered
+                                       #   migration admissions
+        "rebalance_migrations": int,  # migrations the router's
+                                      #   KV-pressure policy asked for
+        "scale_up_events": int,   # elastic-pool replica spawns
+        "scale_down_events": int,  # elastic-pool replica retirements
     },
     # --- schema v14: streaming SLO records (obs/slo.py; --slo) ---
     "slo_window": {
@@ -1008,6 +1070,26 @@ OPTIONAL: Dict[str, Dict[str, Any]] = {
         "per_replica": dict,     # name -> {count, p50}
         "skew": _NUM,            # max p50 / median p50 (>= 2 replicas)
         "straggler": str,        # the max-p50 replica's name
+    },
+    # --- schema v18: live-migration records (ISSUE 20) ---
+    "kv_migration": {
+        "run_id": str,
+        "kv_dtype": str,         # arena payload dtype in the payload
+        "prompt_tokens": int,
+        "tokens_generated": int,  # generated tokens riding the payload
+                                  #   (0: a mid-prefill migration)
+        "src": str,              # role/replica ids, when known
+        "dst": str,
+        "migration_ms": _NUM,    # in only: out-stamp -> admission wall
+        "requeued": int,         # in only: deferred-admission count
+        "redelivered": int,      # in only: delivery came from a
+                                 #   reclaimed/adopted lease
+        "duplicate": bool,       # in only: uid already admitted — the
+                                 #   ack-crash window closing (acked,
+                                 #   nothing scattered twice)
+        "tenant": str,           # the scheduling lane, when tagged
+        "spool_file": str,       # quarantine only: the parked payload
+        "error": str,            # quarantine only: why it failed
     },
     # --- schema v15: hot-path overhead records (obs/tickprof.py) ---
     "tick_profile": {

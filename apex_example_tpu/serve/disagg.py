@@ -107,6 +107,14 @@ class KvHandoff:
     payload_bytes: int
     t_out_wall: float
     src: str = ""
+    # Payload kind (ISSUE 20): "handoff" is the one-shot prefill ->
+    # decode transfer (fill == prompt length, first token sampled);
+    # "migration" is a LIVE mid-flight snapshot (fill == cursor, any
+    # number of generated tokens, possibly still mid-prefill) shipped
+    # by ServeEngine.extract_live / drain(migrate=...).  Same wire
+    # format, same lease/ack/redelivery protocol; the destination
+    # engine keys its record type and counters on it.
+    kind: str = "handoff"
     requeued: int = 0       # deferred-admission episodes, decode side
     # Delivery provenance (ISSUE 15): nonzero when this delivery came
     # from a reclaimed/adopted lease rather than a fresh spool file —
@@ -246,6 +254,7 @@ class FileTransport:
             "payload_bytes": handoff.payload_bytes,
             "t_out_wall": handoff.t_out_wall,
             "src": handoff.src,
+            "kind": handoff.kind,
             "keys": list(handoff.payload.keys()),
             "request": {
                 "prompt": [int(t) for t in req.prompt],
@@ -253,6 +262,14 @@ class FileTransport:
                 "temperature": req.temperature,
                 "top_k": req.top_k,
                 "eos_id": req.eos_id,
+                # Migration round-trip (ISSUE 20): a live request's
+                # scheduling identity must survive the wire — the
+                # destination keeps honoring the tenant lane and both
+                # deadline domains.
+                "tenant": getattr(req, "tenant", "default"),
+                "priority": getattr(req, "priority", 0),
+                "deadline_s": req.deadline_s,
+                "deadline_step": req.deadline_step,
             },
         }
         arrays = {f"a{i}": handoff.payload[k].view(np.uint8)
@@ -448,6 +465,10 @@ class FileTransport:
                       temperature=float(spec.get("temperature", 0.0)),
                       top_k=int(spec.get("top_k", 0)),
                       eos_id=spec.get("eos_id"),
+                      tenant=spec.get("tenant", "default"),
+                      priority=int(spec.get("priority", 0)),
+                      deadline_s=spec.get("deadline_s"),
+                      deadline_step=spec.get("deadline_step"),
                       uid=meta["uid"])
         return KvHandoff(
             uid=meta["uid"], request=req, tokens=meta["tokens"],
@@ -456,7 +477,8 @@ class FileTransport:
             payload=payload,
             payload_bytes=int(meta["payload_bytes"]),
             t_out_wall=float(meta["t_out_wall"]),
-            src=meta.get("src", ""))
+            src=meta.get("src", ""),
+            kind=meta.get("kind", "handoff"))
 
     def finished(self) -> bool:
         """No more handoffs will ever arrive for ANY worker: the

@@ -425,6 +425,19 @@ class ServeEngine:
         self.handoff_redelivered: set = set()   # uids admitted from a
         #                                         reclaimed/adopted lease
         self.handoff_duplicates = 0
+        # Live migration (ISSUE 20): MID-FLIGHT requests shipped whole —
+        # KV blocks, generated tokens and sampler state — to a peer that
+        # resumes them (extract_live / admit_migrated).  Same transport,
+        # same idempotence set (handoff_seen keys on uid, and a uid is
+        # admitted here at most once regardless of payload kind), its
+        # own counters so the v18 summary can tell a drain-without-
+        # eviction from a prefill->decode pipeline.
+        self.migrations_in = 0
+        self.migration_requeued = 0
+        self.migration_duplicates = 0
+        self.migration_redelivered: set = set()
+        self._migration_bytes = 0
+        self._migration_ms: List[float] = []
         # Mesh awareness: under a registered parallel_state mesh the
         # weights and per-layer KV arenas shard over heads on the
         # 'model' axis (the bert/gpt constraint points from the TP
@@ -1062,9 +1075,10 @@ class ServeEngine:
             error=digest)
         self.completions.append(comp)
         self.counts[status] += 1
-        if self.slo is not None and status != "handoff":
-            # A handoff continues elsewhere — the decode side owns its
-            # terminal; scoring it here would double-count the uid.
+        if self.slo is not None and status not in ("handoff", "migrated"):
+            # A handoff/migration continues elsewhere — the destination
+            # owns its terminal; scoring it here would double-count the
+            # uid.
             self.slo.observe_request(
                 status,
                 ttft_ms=None if comp.ttft_s is None
@@ -1075,9 +1089,10 @@ class ServeEngine:
                 else comp.queue_wait_s * 1e3)
         self._trace_request(comp, slot_blocks=slot.n_mapped)
         self.pool.evict(idx)
-        if self.sink is not None and status != "handoff":
-            # A handoff's record is the kv_handoff _handoff_slot wrote
-            # (the request is continuing elsewhere, not failing here).
+        if self.sink is not None and status not in ("handoff", "migrated"):
+            # A handoff's record is the kv_handoff _handoff_slot wrote,
+            # a migration's the kv_migration extract_live wrote (the
+            # request is continuing elsewhere, not failing here).
             record = request_complete_record if status == "ok" \
                 else request_failed_record
             self.sink.write(record(comp, self.run_id,
@@ -1194,6 +1209,11 @@ class ServeEngine:
         acked, or a duplicate delivery — is consumed idempotently: a
         ``kv_handoff`` record with ``duplicate: true`` lands, nothing
         is scattered, and True tells the caller to ack it."""
+        if getattr(handoff, "kind", "handoff") == "migration":
+            # Live-migration payloads (ISSUE 20) ride the same spool
+            # and the same drive loops; dispatch here so every existing
+            # poll -> admit -> ack caller works unchanged.
+            return self.admit_migrated(handoff)
         req = handoff.request
         if req.uid in self.handoff_seen:
             # The ack-crash window closes here: admitted before, so the
@@ -1264,6 +1284,179 @@ class ServeEngine:
                 rec["redelivered"] = int(handoff.redelivered)
             if handoff.src:
                 rec["src"] = handoff.src
+            if self.run_id:
+                rec["run_id"] = self.run_id
+            self.sink.write(rec)
+        return True
+
+    # ------------------------------------------------------- migration
+
+    def extract_live(self, uid: str):
+        """Snapshot a MID-FLIGHT request into a migration payload
+        (ISSUE 20): its arena blocks (storage-dtype-exact via
+        extract_blocks — int8 payload + scales ship as-is), cursor,
+        full token list, and sampler state (temperature / top_k ride
+        the Request itself), evicting the slot with status "migrated"
+        (outside the availability denominator — the destination owns
+        the terminal).  Returns the :class:`KvHandoff` with
+        ``kind="migration"`` for the caller to ship, or None when the
+        uid holds no live slot.  Works at any point in the lifecycle:
+        mid-prefill (fill < prompt length, zero generated tokens —
+        the destination resumes the chunked prefill) as well as deep
+        into decode.  ``admit_migrated`` resumes it token-identically
+        under greedy sampling (temperature 0): the arena rows are
+        bit-exact copies and argmax needs no RNG; sampled-temperature
+        requests resume with the destination's stream."""
+        for i in self.pool.live:
+            if self.pool.slots[i].request.uid == uid:
+                return self._migrate_slot(i, time.perf_counter())
+        return None
+
+    def _migrate_slot(self, idx: int, now: float):
+        """Build one live slot's migration payload and evict it with
+        status "migrated" — the live-migration counterpart of
+        _handoff_slot.  Returns the payload; the CALLER ships it (drain
+        passes its ``migrate`` callable; router-driven rebalance pushes
+        straight into a transport)."""
+        from apex_example_tpu.serve.disagg import KvHandoff
+        pool = self.pool
+        slot = pool.slots[idx]
+        req = slot.request
+        fill, n_mapped, payload = pool.extract_blocks(idx)
+        BS = pool.block_size
+        # The satellite bugfix (ISSUE 20): under --speculate,
+        # stage_writes maps blocks for draft lanes the accept decision
+        # then REJECTS — their rows are unverified garbage past the
+        # committed cursor, and the cursor-rollback invariant (stale
+        # rows hidden by the live mask until overwritten) only holds
+        # inside this engine.  Ship exactly the blocks the cursor
+        # covers; admit_prefilled allocates ceil(fill/BS) on the
+        # destination and rejects a longer payload as malformed.
+        n_ship = (fill + BS - 1) // BS
+        if n_ship < n_mapped:
+            payload = {k: v[:n_ship] for k, v in payload.items()}
+        # Same invariant on the token list: everything past tokens[fill]
+        # (the one pending next-feed token of a decoding slot) was never
+        # verified against committed KV and must not resume elsewhere.
+        tokens = [int(t) for t in slot.tokens]
+        if not slot.prefilling:
+            tokens = tokens[:fill + 1]
+        payload_bytes = sum(int(a.nbytes) for a in payload.values())
+        handoff = KvHandoff(
+            uid=req.uid, request=req, tokens=tokens,
+            fill=fill, block_size=BS,
+            kv_dtype=pool.kv_dtype, payload=payload,
+            payload_bytes=payload_bytes, t_out_wall=_wall(),
+            src=self.role, kind="migration")
+        self._migration_bytes += payload_bytes
+        if self.sink is not None:
+            rec: Dict[str, Any] = {
+                "record": "kv_migration", "time": _wall(),
+                "request_id": req.uid, "direction": "out",
+                "fill": fill, "blocks": n_ship,
+                "payload_bytes": payload_bytes,
+                "kv_dtype": pool.kv_dtype,
+                "prompt_tokens": len(req.prompt),
+                "tokens_generated": slot.n_generated,
+                "src": self.role}
+            if self.tag_tenants:
+                rec["tenant"] = getattr(req, "tenant", "default")
+            if self.run_id:
+                rec["run_id"] = self.run_id
+            self.sink.write(rec)
+        self._evict_terminal(idx, "migrated", "migrated", now)
+        # The uid has LEFT this engine: a future payload for it (the
+        # rebalance ping-pong, A -> B -> A) is a NEW incarnation, not a
+        # duplicate delivery — suppression must forget it, or the
+        # second visit would be acked-and-dropped (a lost request).
+        self.handoff_seen.discard(req.uid)
+        self.migration_redelivered.discard(req.uid)
+        return handoff
+
+    def admit_migrated(self, handoff) -> bool:
+        """Resume a migrated mid-flight request (ISSUE 20): the intake
+        twin of admit_handoff with the same contract — False with NO
+        state left behind when a slot or the block budget is missing
+        (the caller requeues and retries), True when consumed (admitted,
+        rejected-as-unservable, or suppressed as a duplicate of a uid
+        this engine already admitted).  Differences from the one-shot
+        handoff path: the slot resumes with ``n_generated`` tokens
+        already emitted (possibly zero — a mid-prefill migration keeps
+        prefilling here), ``t_first_token`` is stamped only when the
+        first token truly happened elsewhere, and the stream records
+        are ``kv_migration``."""
+        req = handoff.request
+        if req.uid in self.handoff_seen:
+            self.migration_duplicates += 1
+            if self.sink is not None:
+                rec: Dict[str, Any] = {
+                    "record": "kv_migration", "time": _wall(),
+                    "request_id": req.uid, "direction": "in",
+                    "fill": handoff.fill, "blocks": 0,
+                    "payload_bytes": handoff.payload_bytes,
+                    "kv_dtype": self.pool.kv_dtype,
+                    "duplicate": True,
+                    "redelivered": int(handoff.redelivered),
+                    "dst": self.role}
+                if self.run_id:
+                    rec["run_id"] = self.run_id
+                self.sink.write(rec)
+            return True
+        if self.draining:
+            return False             # drain stopped admission (requeue)
+        if handoff.block_size != self.pool.block_size:
+            raise ValueError(
+                f"migration block_size {handoff.block_size} vs engine "
+                f"{self.pool.block_size} — source and destination must "
+                "share the arena geometry")
+        if not self.pool.fits(req):
+            self._terminal_unadmitted(req, "rejected")
+            return True
+        if not self.pool.can_admit_prefilled(req):
+            if not handoff.requeued:
+                handoff.requeued = 1
+                self.migration_requeued += 1
+            return False
+        now = time.perf_counter()
+        idx = self.pool.admit_prefilled(req, self.step_count,
+                                        handoff.fill, handoff.payload,
+                                        handoff.tokens)
+        slot = self.pool.slots[idx]
+        slot.n_generated = len(handoff.tokens) - len(req.prompt)
+        if slot.n_generated > 0:
+            # The first token was sampled on the SOURCE; stamping it at
+            # admission keeps TTFT finite in this engine's clock domain
+            # (the cross-domain truth rides the out record).  A
+            # mid-prefill migration leaves it None — the first token
+            # genuinely happens here.
+            slot.t_first_token = now
+        self.migrations_in += 1
+        self.handoff_seen.add(req.uid)
+        if handoff.redelivered:
+            self.migration_redelivered.add(req.uid)
+        self._migration_bytes += handoff.payload_bytes
+        transit_ms = max((_wall() - handoff.t_out_wall) * 1e3, 0.0)
+        self._migration_ms.append(transit_ms)
+        if self._tracer is not None:
+            self._rtrace[req.uid] = []
+        if self.sink is not None:
+            rec = {
+                "record": "kv_migration", "time": _wall(),
+                "request_id": req.uid, "direction": "in",
+                "fill": handoff.fill, "blocks": slot.n_mapped,
+                "payload_bytes": handoff.payload_bytes,
+                "kv_dtype": self.pool.kv_dtype,
+                "prompt_tokens": len(req.prompt),
+                "tokens_generated": slot.n_generated,
+                "migration_ms": round(transit_ms, 3),
+                "requeued": handoff.requeued,
+                "dst": self.role}
+            if handoff.redelivered:
+                rec["redelivered"] = int(handoff.redelivered)
+            if handoff.src:
+                rec["src"] = handoff.src
+            if self.tag_tenants:
+                rec["tenant"] = getattr(req, "tenant", "default")
             if self.run_id:
                 rec["run_id"] = self.run_id
             self.sink.write(rec)
@@ -1354,13 +1547,22 @@ class ServeEngine:
 
     # ----------------------------------------------------------- drain
 
-    def drain(self, signal_name: str = "SIGTERM") -> Dict[str, Any]:
+    def drain(self, signal_name: str = "SIGTERM",
+              migrate=None) -> Dict[str, Any]:
         """Graceful drain: stop admission, hand every still-queued
         request back with status "drained" (requeue-able elsewhere),
         then keep ticking until the in-flight slots finish or deadline-
         evict.  Returns (and emits, with a sink) the ``serve_drain``
         record; the caller then writes the normal, un-aborted
-        ``serve_summary`` and exits ``EX_TEMPFAIL``."""
+        ``serve_summary`` and exits ``EX_TEMPFAIL``.
+
+        ``migrate`` (ISSUE 20) turns drain into drain-WITHOUT-eviction:
+        a callable (typically ``transport.send``) each live slot's
+        extract_live payload is pushed through instead of ticking the
+        slot to completion — in-flight work leaves as "migrated"
+        (resumed token-identically on a peer), zero ticks spent, zero
+        deadline evictions, and the serve_drain record carries the
+        ``migrated`` count."""
         self.draining = True
         drain_step = self.step_count
         if self._tracer is not None:
@@ -1380,6 +1582,14 @@ class ServeEngine:
         for req in requeued:
             self._terminal_unadmitted(req, "drained")
         in_flight = len(self.pool.live)
+        if migrate is not None:
+            # Drain-without-eviction: ship every live slot MID-FLIGHT.
+            # The loop below then sees no live slots — a migrating
+            # drain spends zero decode ticks and can never deadline-
+            # evict what it was asked to preserve.
+            now = time.perf_counter()
+            for i in list(self.pool.live):
+                migrate(self._migrate_slot(i, now))
         # Bounded by construction: every live slot finishes within
         # max_len ticks (length cap) — the slack covers prefill already
         # under way.  A wedge here would be a bug, not load.
@@ -1398,6 +1608,11 @@ class ServeEngine:
             "requeued": len(requeued),
             "requeued_ids": [r.uid for r in requeued],
         }
+        if migrate is not None:
+            # Gated on the migrating drain (v18): a classic drain's
+            # record stays byte-identical to pre-v18 output.
+            rec["migrated"] = self.counts["migrated"] \
+                - before["migrated"]
         if self.run_id:
             rec["run_id"] = self.run_id
         if self._tracer is not None:
@@ -1425,10 +1640,10 @@ class ServeEngine:
         duration = time.perf_counter() - self._t0
         comps = self.completions
         ok = [c for c in comps if c.status == "ok"]
-        # Drained AND handed-off requests continue elsewhere — both sit
-        # outside the availability denominator (v12).
+        # Drained, handed-off AND migrated requests continue elsewhere —
+        # all three sit outside the availability denominator (v12/v18).
         owned = len(comps) - self.counts["drained"] \
-            - self.counts["handoff"]
+            - self.counts["handoff"] - self.counts["migrated"]
         pool = self.pool
         rec: Dict[str, Any] = {
             "record": "serve_summary",
@@ -1486,6 +1701,23 @@ class ServeEngine:
             rec["handoff_bytes"] = self._handoff_bytes
         if self._handoff_ms:
             rec["handoff_ms"] = _pct_dict(self._handoff_ms)
+        # v18 (ISSUE 20): the live-migration ledger — every field gated
+        # on actual migration traffic, so a migration-free stream stays
+        # byte-identical to pre-v18 output.
+        if self.counts["migrated"]:
+            rec["migrations_out"] = self.counts["migrated"]
+        if self.migrations_in:
+            rec["migrations_in"] = self.migrations_in
+        if self.migration_requeued:
+            rec["migration_requeued"] = self.migration_requeued
+        if self.migration_duplicates:
+            rec["migration_duplicates"] = self.migration_duplicates
+        if self.migration_redelivered:
+            rec["migration_redelivered"] = len(self.migration_redelivered)
+        if self._migration_bytes:
+            rec["migration_bytes"] = self._migration_bytes
+        if self._migration_ms:
+            rec["migration_ms"] = _pct_dict(self._migration_ms)
         if self.compute_steps:
             rec["occupancy"] = round(
                 self._occupancy_sum / (self.compute_steps

@@ -61,8 +61,13 @@ _uid = itertools.count()
 # the first token and shipped the request's KV blocks to a decode
 # worker (serve/disagg.py) — like "drained", the request continues
 # elsewhere, so it sits outside the availability denominator.
+# "migrated" (ISSUE 20) is the live-migration counterpart: a MID-FLIGHT
+# request whose KV blocks, generated tokens and sampler state were
+# snapshotted (ServeEngine.extract_live) and shipped to a peer that
+# resumes it token-identically — again outside the availability
+# denominator (the destination owns the terminal).
 STATUSES = ("ok", "timeout", "shed", "cancelled", "failed", "drained",
-            "rejected", "handoff")
+            "rejected", "handoff", "migrated")
 
 
 def _next_uid() -> str:

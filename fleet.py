@@ -91,6 +91,30 @@ runs the FIFO control arm that must demonstrably breach),
     python fleet.py --replicas 1 --scenario noisy_neighbor \\
         --tenants 'noisy:mix=6;victim:class=interactive,mix=1' \\
         --requests 14 --metrics-jsonl fleet.jsonl
+
+Live migration + elastic pools (ISSUE 20): three scenarios ride the
+mid-flight KV migration spool (``ServeEngine.extract_live`` ->
+leased FileTransport -> ``admit_migrated``, token-identical).
+``drain_zero_evictions`` is the rolling restart that kills no
+request: every ``interrupt(mode="migrate")`` ships live slots to the
+spool and a peer resumes them (zero evictions at availability 1.0).
+``migrate_under_crash_storm`` kills the migration DESTINATION between
+``admit_migrated`` and ack — the surviving peers must reclaim the
+expired leases and finish the redelivered payloads exactly once
+(thread transport; the drill rides the migration intake).
+``autoscale_flap`` drives bursty load against the ``ElasticPool``
+controller (``--autoscale MIN:MAX``), which spawns/retires thread
+replicas off the router's backlog + TTFT gauges under cooldown
+hysteresis — retirement drains without eviction.  Outside the
+scenarios, ``--rebalance-kv-ratio`` arms continuous KV-pressure
+rebalancing: the router asks the hottest replica (by the
+dtype-accurate ``kv_bytes_live`` gauge) to migrate one live request
+whenever it exceeds the ratio x the fleet mean.
+
+    # rolling restart, zero evictions, migrations scored:
+    python fleet.py --replicas 3 --transport thread \\
+        --scenario drain_zero_evictions --requests 18 \\
+        --max-new 10:14 --metrics-jsonl fleet.jsonl
 """
 
 from __future__ import annotations
@@ -100,6 +124,7 @@ import importlib.util
 import os
 import sys
 import tempfile
+import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -130,6 +155,105 @@ def _load_sched(name: str):
     return mod
 
 
+class ElasticPool:
+    """Elastic replica pool controller (ISSUE 20): scales a fleet
+    between ``min_replicas`` and ``max_replicas`` off two router
+    gauges — spool depth (``router.backlog()``: parked work plus every
+    routable replica's pending) and, when armed, the fleet TTFT p50
+    (``router.ttft_p50_ms()``) — with cooldown hysteresis: at most one
+    scale action per ``cooldown_s``, scale-up above ``up_backlog``,
+    scale-down only at or below ``down_backlog`` (strictly less than
+    ``up_backlog``, so the two thresholds can never chase each other).
+
+    Stdlib-only and duck-typed like the rest of the fleet stratum:
+    ``spawn(i)`` returns an UNSTARTED replica handle; retirement goes
+    through ``router.retire_replica`` (unroutable but still polled, so
+    late terminals land) and then drains the handle WITHOUT eviction —
+    ``interrupt(mode="migrate")`` when it has a migration spool, a
+    graceful non-blocking ``stop`` otherwise.  Every action is
+    ledgered via ``router.note_autoscale`` (schema v18
+    ``scale_up_events``/``scale_down_events``) and appended to
+    ``self.events`` for the scenario score."""
+
+    def __init__(self, router, spawn, *, min_replicas: int = 1,
+                 max_replicas: int = 4, up_backlog: int = 4,
+                 down_backlog: int = 0, cooldown_s: float = 0.5,
+                 ttft_p50_ms=None, initial=()):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(f"need 1 <= min <= max, got "
+                             f"{min_replicas}:{max_replicas}")
+        if down_backlog >= up_backlog:
+            raise ValueError(f"hysteresis needs down_backlog < "
+                             f"up_backlog, got {down_backlog} >= "
+                             f"{up_backlog}")
+        if cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {cooldown_s}")
+        self.router = router
+        self._spawn = spawn
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_backlog = int(up_backlog)
+        self.down_backlog = int(down_backlog)
+        self.cooldown_s = float(cooldown_s)
+        self.ttft_p50_ms = ttft_p50_ms
+        self.active = list(initial)
+        self.retired = []
+        self.events = []
+        self._spawned = 0
+        self._last_action = 0.0         # epoch 0: first decision free
+
+    def size(self) -> int:
+        return len(self.active)
+
+    def within_bounds(self) -> bool:
+        return self.min_replicas <= len(self.active) <= self.max_replicas
+
+    def step(self):
+        """One control decision (call from the drive loop, router-poll
+        cadence).  Returns ("up"|"down", replica_name) when an action
+        fired, else None."""
+        now = time.time()
+        if now - self._last_action < self.cooldown_s:
+            return None
+        backlog = self.router.backlog()
+        ttft = self.router.ttft_p50_ms() \
+            if self.ttft_p50_ms is not None else None
+        hot = backlog > self.up_backlog \
+            or (ttft is not None and ttft > self.ttft_p50_ms)
+        if hot and len(self.active) < self.max_replicas:
+            handle = self._spawn(self._spawned)
+            self._spawned += 1
+            handle.start()
+            self.router.add_replica(handle)
+            self.active.append(handle)
+            reason = (f"backlog {backlog} > {self.up_backlog}"
+                      if backlog > self.up_backlog
+                      else f"ttft_p50 {ttft:.0f}ms > "
+                           f"{self.ttft_p50_ms:.0f}ms")
+            self.router.note_autoscale("up", handle.name, reason)
+            self.events.append(("up", handle.name, reason))
+            self._last_action = now
+            return ("up", handle.name)
+        if not hot and backlog <= self.down_backlog \
+                and len(self.active) > self.min_replicas:
+            handle = self.active.pop()  # LIFO: newest spawned first
+            self.router.retire_replica(handle.name)
+            # Drain WITHOUT eviction when the handle can migrate; a
+            # non-blocking graceful stop either way (the drive thread
+            # finishes held work, and a stopping replica never claims
+            # new spool payloads).
+            if getattr(handle, "migrate_tx", None) is not None:
+                handle.interrupt(mode="migrate")
+            handle.stop(timeout_s=0.0)
+            self.retired.append(handle)
+            reason = f"backlog {backlog} <= {self.down_backlog}"
+            self.router.note_autoscale("down", handle.name, reason)
+            self.events.append(("down", handle.name, reason))
+            self._last_action = now
+            return ("down", handle.name)
+        return None
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="route a workload over N serve replicas, "
@@ -153,12 +277,16 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["none", "rolling_restart", "crash_storm",
                             "straggler", "prefill_crash",
                             "decode_crash_midspool", "noisy_neighbor",
-                            "tenant_burst_starvation", "prefix_heavy"],
+                            "tenant_burst_starvation", "prefix_heavy",
+                            "drain_zero_evictions",
+                            "migrate_under_crash_storm",
+                            "autoscale_flap"],
                    help="scripted chaos scenario, scored into "
                         "fleet_summary (fleet/scenarios.py; the "
                         "*_crash* disagg scenarios need "
                         "--decode-replicas, the tenant scenarios need "
-                        "--tenants)")
+                        "--tenants, the migration/autoscale scenarios "
+                        "need the homogeneous both-role fleet)")
     p.add_argument("--decode-replicas", type=int, default=0,
                    metavar="K",
                    help="disaggregated fleet (ISSUE 15): the LAST K "
@@ -284,6 +412,48 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-hit-rate", type=float, default=None,
                    help="prefix_heavy: fleet prefix_hit_rate the "
                         "verdict requires (default: just measured)")
+    p.add_argument("--rebalance-kv-ratio", type=float, default=None,
+                   metavar="R",
+                   help="live KV-pressure rebalance (ISSUE 20): when "
+                        "the hottest both-role replica's kv_bytes_live "
+                        "exceeds R x the fleet mean, the router asks it "
+                        "to migrate one live request to the migration "
+                        "spool (R > 1.0; default: off)")
+    p.add_argument("--rebalance-cooldown", type=float, default=1.0,
+                   metavar="S",
+                   help="min seconds between rebalance asks "
+                        "(default 1.0)")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                   help="elastic pool (ISSUE 20, thread transport): "
+                        "start with --replicas handles and let the "
+                        "ElasticPool controller spawn/retire between "
+                        "MIN and MAX replicas off the router's backlog "
+                        "+ TTFT gauges (auto-armed by --scenario "
+                        "autoscale_flap)")
+    p.add_argument("--autoscale-up-backlog", type=int, default=4,
+                   metavar="N",
+                   help="scale up when router backlog exceeds N "
+                        "(default 4)")
+    p.add_argument("--autoscale-down-backlog", type=int, default=0,
+                   metavar="N",
+                   help="scale down only at backlog <= N (must be < "
+                        "the up threshold — the hysteresis band; "
+                        "default 0)")
+    p.add_argument("--autoscale-cooldown", type=float, default=0.5,
+                   metavar="S",
+                   help="min seconds between scale actions "
+                        "(default 0.5)")
+    p.add_argument("--autoscale-ttft-ms", type=float, default=None,
+                   metavar="MS",
+                   help="also scale up when the fleet TTFT p50 (merged "
+                        "replica sketches; needs --slo) exceeds MS "
+                        "(default: backlog gauge only)")
+    p.add_argument("--bursts", type=int, default=3,
+                   help="autoscale_flap: number of load bursts "
+                        "(default 3)")
+    p.add_argument("--burst-gap", type=float, default=0.5, metavar="S",
+                   help="autoscale_flap: idle gap between bursts — the "
+                        "scale-down side's chance to fire (default 0.5)")
     p.add_argument("--workdir", default=None,
                    help="proc transport scratch dir (inbox/outbox/"
                         "metrics per replica; default: alongside "
@@ -327,6 +497,62 @@ def run_fleet(args):
             and args.decode_replicas < 2:
         raise SystemExit("decode_crash_midspool needs a surviving peer "
                          "decode worker: set --decode-replicas >= 2")
+    migration_scenarios = ("drain_zero_evictions",
+                           "migrate_under_crash_storm")
+    if args.scenario in migration_scenarios + ("autoscale_flap",) \
+            and args.decode_replicas:
+        raise SystemExit(f"--scenario {args.scenario} needs the "
+                         "homogeneous both-role fleet (extract_live "
+                         "lives on the interleaved engine); drop "
+                         "--decode-replicas")
+    if args.scenario in ("migrate_under_crash_storm",
+                         "autoscale_flap") \
+            and args.transport != "thread":
+        raise SystemExit(f"--scenario {args.scenario} is thread-"
+                         "transport only (the preack drill rides the "
+                         "in-process migration intake; the elastic "
+                         "pool spawns in-process handles)")
+    if args.scenario == "migrate_under_crash_storm" \
+            and args.replicas < 3:
+        raise SystemExit("migrate_under_crash_storm needs >= 3 "
+                         "replicas: source, doomed destination, and a "
+                         "surviving peer")
+    if args.rebalance_kv_ratio is not None \
+            and args.rebalance_kv_ratio <= 1.0:
+        raise SystemExit(f"--rebalance-kv-ratio must be > 1.0, got "
+                         f"{args.rebalance_kv_ratio}")
+    if args.autoscale and args.scenario != "autoscale_flap":
+        raise SystemExit("--autoscale only applies to --scenario "
+                         "autoscale_flap (the scenario steps the "
+                         "controller)")
+    scale_bounds = None
+    if args.scenario == "autoscale_flap":
+        autoscale = args.autoscale \
+            or f"{args.replicas}:{args.replicas + 2}"
+        try:
+            lo, hi = (int(x) for x in autoscale.split(":"))
+        except ValueError:
+            raise SystemExit(f"--autoscale wants MIN:MAX, got "
+                             f"{autoscale!r}")
+        if not 1 <= lo <= hi:
+            raise SystemExit(f"--autoscale: need 1 <= MIN <= MAX, got "
+                             f"{autoscale!r}")
+        if args.autoscale_down_backlog >= args.autoscale_up_backlog:
+            raise SystemExit("--autoscale-down-backlog must be < "
+                             "--autoscale-up-backlog (the hysteresis "
+                             "band)")
+        if args.autoscale_ttft_ms is not None and not args.slo:
+            raise SystemExit("--autoscale-ttft-ms needs --slo (the "
+                             "TTFT sketches ride the SLO plane)")
+        if args.bursts < 1:
+            raise SystemExit(f"--bursts must be >= 1, got {args.bursts}")
+        scale_bounds = (lo, hi)
+    # Migration spool: armed by the migration scenarios, by continuous
+    # rebalancing, and by the elastic pool (retirement drains without
+    # eviction through it).
+    mig_armed = (args.scenario in migration_scenarios
+                 or args.rebalance_kv_ratio is not None
+                 or scale_bounds is not None)
     stall_after = args.stall_after
     if stall_after is None and args.scenario == "straggler":
         stall_after = 0.75
@@ -429,11 +655,18 @@ def run_fleet(args):
     elif args.scenario == "decode_crash_midspool":
         crashed_names = [decode_names[0]]
     straggler_name = names[0] if args.scenario == "straggler" else None
+    mig_source_name = mig_crashed_name = None
+    if args.scenario == "migrate_under_crash_storm":
+        # Deterministic staging: r0 drains outbound-only, r1 claims
+        # first and dies in the ack window, the rest reclaim.
+        mig_source_name, mig_crashed_name = names[0], names[1]
+        crashed_names = [mig_crashed_name]
 
     # Lazy: only the proc transport and a disagg spool need scratch
     # space — a plain thread fleet must not litter /tmp.
     workdir = args.workdir
-    if workdir is None and (n_decode or args.transport == "proc"):
+    if workdir is None and (n_decode or mig_armed
+                            or args.transport == "proc"):
         workdir = (os.path.join(os.path.dirname(args.metrics_jsonl)
                                 or ".", "fleet_work")
                    if args.metrics_jsonl
@@ -441,8 +674,12 @@ def run_fleet(args):
     spool = os.path.join(workdir, "spool") if n_decode else None
     if spool:
         os.makedirs(spool, exist_ok=True)
+    mig_spool = os.path.join(workdir, "migrate") if mig_armed else None
+    if mig_spool:
+        os.makedirs(mig_spool, exist_ok=True)
 
     fleet_stream = None     # thread+tenants: shared router/engine tee
+    elastic_spawn = None    # set by the thread branch (pool spawns)
     if args.transport == "proc":
         replicas = []
         for name in names:
@@ -473,6 +710,13 @@ def run_fleet(args):
             if roles[name] == "decode":
                 serve_args += ["--handoff-lease",
                                str(args.handoff_lease)]
+            if roles[name] == "both" and mig_spool:
+                # Children on the shared migration spool: SIGTERM now
+                # drains without eviction, the tick loop claims peers'
+                # payloads (serve.py --migrate-dir).
+                serve_args += ["--migrate-dir", mig_spool,
+                               "--handoff-lease",
+                               str(args.handoff_lease)]
             if name in crashed_names:
                 drill = f"crash@{args.fault_tick}"
                 if args.scenario == "decode_crash_midspool":
@@ -502,7 +746,8 @@ def run_fleet(args):
         from apex_example_tpu.models.gpt import gpt_tiny
         from apex_example_tpu.resilience.faults import (SERVE_KINDS,
                                                         FaultPlan)
-        from apex_example_tpu.serve import Request, ServeEngine
+        from apex_example_tpu.serve import (FileTransport, Request,
+                                            ServeEngine)
 
         model = gpt_tiny()
         params = model.init(jax.random.PRNGKey(args.seed),
@@ -522,23 +767,33 @@ def run_fleet(args):
                                 sample_every=args.tick_profile_every)
 
         tee_sink = None
+        tee_kinds = set()
         if tenant_specs is not None:
             # --tenants arms ci_gate --tenant-stream, whose
             # conservation ledger needs every routed uid to reach a
-            # terminal record IN THE SAME STREAM.  The router only
-            # writes route/fleet records, so tee the engines' terminal
-            # request records into the router's own locked writer —
-            # one self-contained stream, terminals interleaved with
-            # routes.  Everything else an engine-side sink would emit
-            # (run_header, serve_summary, slo windows) is dropped
-            # here: the router owns the fleet stream.  Unarmed fleets
-            # keep sink=None so their streams stay byte-identical.
+            # terminal record IN THE SAME STREAM.
+            tee_kinds |= {"request_complete", "request_failed", "shed"}
+        if mig_armed:
+            # Migration arms ci_gate --migrate-stream, whose ledger
+            # matches every kv_migration "out" against its admission
+            # and terminal record, and checks serve_drain evictions —
+            # all of which the engines emit, not the router.
+            tee_kinds |= {"request_complete", "request_failed", "shed",
+                          "kv_migration", "serve_drain"}
+        if tee_kinds:
+            # The router only writes route/fleet records, so tee the
+            # engines' gate-relevant records into the router's own
+            # locked writer — one self-contained stream, engine records
+            # interleaved with routes.  Everything else an engine-side
+            # sink would emit (run_header, serve_summary, slo windows)
+            # is dropped here: the router owns the fleet stream.
+            # Unarmed fleets keep sink=None so their streams stay
+            # byte-identical.
             fleet_stream = router_mod._Stream(args.metrics_jsonl)
 
             class _TerminalTee:
                 def write(self, rec):
-                    if rec.get("record") in ("request_complete",
-                                             "request_failed", "shed"):
+                    if rec.get("record") in tee_kinds:
                         fleet_stream.write(rec)
 
             tee_sink = _TerminalTee()
@@ -606,12 +861,31 @@ def run_fleet(args):
                            priority=int(spec.get("priority", 0)),
                            uid=spec["uid"])
 
+        def mig_factory(name):
+            # One consumer transport per replica NAME (not instance):
+            # a rebuilt replica adopts its own pre-crash claims, a
+            # peer adopts them only after the lease expires.
+            if mig_spool is None:
+                return None
+            return lambda: FileTransport(mig_spool, worker=name,
+                                         lease_s=args.handoff_lease)
+
+        def spawn_elastic(i):
+            # ElasticPool spawn: same engine factory, so the scaled-up
+            # replica reuses the fleet's one compiled decode program.
+            nm = f"r{args.replicas + i}"
+            return replica_mod.ThreadReplica(
+                nm, factory, make_request,
+                migrate_factory=mig_factory(nm))
+
+        elastic_spawn = spawn_elastic
         replicas = []
         for name in names:
             fault = None
             if name in crashed_names:
                 kind = "handoff_crash_preack" \
-                    if args.scenario == "decode_crash_midspool" \
+                    if args.scenario in ("decode_crash_midspool",
+                                         "migrate_under_crash_storm") \
                     else "crash"
                 tick = 1 if kind == "handoff_crash_preack" \
                     else args.fault_tick
@@ -621,7 +895,9 @@ def run_fleet(args):
                                   kinds=SERVE_KINDS)
             if roles[name] == "both":
                 replicas.append(replica_mod.ThreadReplica(
-                    name, factory, make_request, fault=fault))
+                    name, factory, make_request, fault=fault,
+                    migrate_factory=mig_factory(name),
+                    migrate_intake=name != mig_source_name))
             else:
                 pre, dec, tx_factory = role_factories(name)
                 if roles[name] == "prefill":
@@ -698,6 +974,8 @@ def run_fleet(args):
         slo_rollup_s=args.slo_rollup_s,
         tenant_specs=tenant_specs,
         prefix_block_size=args.block_size,
+        rebalance_kv_ratio=args.rebalance_kv_ratio,
+        rebalance_cooldown_s=args.rebalance_cooldown,
         trace=args.trace)
     print(f"fleet: {args.replicas} x {args.transport} replica(s)  "
           f"policy={args.policy}  scenario={args.scenario}  "
@@ -721,22 +999,46 @@ def run_fleet(args):
             kw["expect_breach"] = args.expect_breach
     elif args.scenario == "prefix_heavy":
         kw["min_hit_rate"] = args.min_hit_rate
+    elif args.scenario == "migrate_under_crash_storm":
+        kw["source_name"] = mig_source_name
+        kw["crashed_name"] = mig_crashed_name
+    pool = None
+    if scale_bounds is not None:
+        try:
+            pool = ElasticPool(
+                router, elastic_spawn,
+                min_replicas=scale_bounds[0],
+                max_replicas=scale_bounds[1],
+                up_backlog=args.autoscale_up_backlog,
+                down_backlog=args.autoscale_down_backlog,
+                cooldown_s=args.autoscale_cooldown,
+                ttft_p50_ms=args.autoscale_ttft_ms,
+                initial=replicas)
+        except ValueError as e:
+            raise SystemExit(f"--autoscale: {e}")
+        kw["pool"] = pool
+        kw["bursts"] = args.bursts
+        kw["gap_s"] = args.burst_gap
     try:
         summary = scen_mod.run_scenario(args.scenario, router, replicas,
                                         specs, **kw)
     finally:
-        for r in replicas:
+        handles = list(replicas)
+        if pool is not None:
+            handles += [h for h in pool.active + pool.retired
+                        if h not in handles]
+        for r in handles:
             if args.transport == "proc":
                 r.close()
             elif router.replica_state(r.name) not in ("stalled",):
                 r.stop(timeout_s=5.0)
         if args.transport == "proc":
-            for r in replicas:
+            for r in handles:
                 if r.wait(30.0) is None:
                     r.terminate()
 
     per = summary.get("per_replica", {})
-    for name in names:
+    for name in names + sorted(set(per) - set(names)):
         stats = per.get(name, {})
         print(f"  {name}: dispatches={stats.get('dispatches', 0)}  "
               f"ok={stats.get('ok', 0)}  "

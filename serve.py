@@ -95,6 +95,18 @@ closing ``serve_summary`` (throughput, latency percentiles, per-status
 counts, availability).  The stream passes tools/metrics_lint.py like
 every other obs stream.
 
+Live migration (ISSUE 20; README "Live migration & elastic
+pools"): ``--migrate-dir`` arms a second leased spool for MID-FLIGHT
+requests.  A SIGTERM drain then ships every live slot — KV blocks
+(storage-dtype-exact, int8 + scales included), cursor/fill, generated
+tokens and sampler state — to the spool instead of evicting or
+requeueing it (status "migrated", outside the availability
+denominator), and every tick the engine polls the spool and resumes
+any peer's shipped request token-identically (``admit_migrated``
+rides the same claim/ack/redelivery/duplicate machinery as the
+prefill handoff).  The spool is shared and long-lived: no close
+sentinel is ever written, so replicas can come and go.
+
 Fleet replica mode (ISSUE 12; README "Fleet serving & chaos
 scenarios"): ``--inbox``/``--outbox`` replace the synthetic workload
 with the file-based fleet protocol — a router (fleet.py /
@@ -121,6 +133,7 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -283,6 +296,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "the spool never closes (the producer died "
                         "before writing the sentinel) instead of "
                         "waiting forever (default: wait)")
+    p.add_argument("--migrate-dir", default=None, metavar="DIR",
+                   help="live-migration spool (ISSUE 20; --role both "
+                        "only): a SIGTERM drain ships every in-flight "
+                        "request's KV blocks + cursor + generated "
+                        "tokens here instead of evicting it, and every "
+                        "tick this replica polls the spool and resumes "
+                        "peers' shipped requests token-identically "
+                        "(leased claim/ack/redelivery, same protocol "
+                        "as --handoff-dir; --handoff-lease sets the "
+                        "lease).  Shared + long-lived: no close "
+                        "sentinel is written")
     p.add_argument("--weight-quant", default="none",
                    choices=["none", "int8", "fp8"],
                    help="quantize the restored weights for serving "
@@ -689,6 +713,10 @@ def run_serve(args):
     if args.handoff_lease <= 0:
         raise SystemExit(f"--handoff-lease must be > 0, got "
                          f"{args.handoff_lease}")
+    if args.migrate_dir and args.role != "both":
+        raise SystemExit("--migrate-dir needs the interleaved engine "
+                         "(--role both): disaggregated roles keep the "
+                         "prefill->decode spool as their only transport")
     if args.heartbeat_s <= 0:
         raise SystemExit(f"--heartbeat-s must be > 0, got "
                          f"{args.heartbeat_s}")
@@ -819,6 +847,27 @@ def run_serve(args):
             fault=handoff_fault if args.role == "prefill" else None,
             on_quarantine=on_quarantine if args.role == "decode"
             else None)
+
+    def on_mig_quarantine(uid, spool_name, error, nbytes):
+        # Same disposition as a corrupt handoff, recorded on the v18
+        # kv_migration stream: park, warn, keep serving.
+        print(f"WARNING: quarantined corrupt migration {uid} "
+              f"({spool_name}): {error}", file=sys.stderr)
+        if sink is None:
+            return
+        sink.write({"record": "kv_migration", "time": time.time(),
+                    "request_id": uid, "direction": "quarantine",
+                    "fill": 0, "blocks": 0,
+                    "payload_bytes": int(nbytes),
+                    "spool_file": spool_name,
+                    "error": str(error)[:500], "run_id": run_id})
+
+    mig_transport = None
+    if args.migrate_dir:
+        mig_transport = FileTransport(
+            args.migrate_dir, worker=args.replica_id,
+            lease_s=args.handoff_lease,
+            on_quarantine=on_mig_quarantine)
     # The mesh registers BEFORE the engine builds (construction shards
     # the restored — possibly quantized — params and the paged arenas
     # against it) and must STAY registered through the run: the TP
@@ -975,6 +1024,25 @@ def run_serve(args):
             engine.queue.submit_all(requests)
             engine.queue.close()
 
+        if mig_transport is not None:
+            # Migration intake rides on_tick (same poll/renew/admit/ack
+            # shape as run_decode_role's drive loop): deferred
+            # admissions keep their claims renewed — a full pool must
+            # not silently forfeit a live request to a peer.
+            mig_pending: deque = deque()
+            inner_on_tick = on_tick
+
+            def on_tick(eng, _inner=inner_on_tick):
+                polled = mig_transport.poll()
+                if polled:
+                    mig_pending.extend(polled)
+                if mig_pending:
+                    mig_transport.renew(mig_pending)
+                while mig_pending and eng.admit_handoff(mig_pending[0]):
+                    mig_transport.ack(mig_pending.popleft())
+                if _inner is not None:
+                    _inner(eng)
+
         pool = engine.pool
         if args.role == "decode":
             workload = f"decode role (handoffs from {args.handoff_dir})"
@@ -1011,14 +1079,18 @@ def run_serve(args):
                 feeder_stop.set()
             if replica_mode:
                 _beat("draining")       # the router sees the drain start
-            drain = engine.drain(preempt.signal_name)
+            drain = engine.drain(preempt.signal_name,
+                                 migrate=mig_transport.send
+                                 if mig_transport is not None else None)
             completions = engine.completions
+            migrated = (f"  migrated={drain['migrated']}"
+                        if "migrated" in drain else "")
             print(f"drain ({drain['signal']}): admission stopped at tick "
                   f"{drain['step']}  in_flight={drain['in_flight']}  "
                   f"completed={drain['completed']}  "
                   f"evicted={drain['evicted']}  "
-                  f"requeued={drain['requeued']}; exiting {EX_TEMPFAIL} "
-                  f"(resumable)")
+                  f"requeued={drain['requeued']}{migrated}; exiting "
+                  f"{EX_TEMPFAIL} (resumable)")
             rc = EX_TEMPFAIL
         if args.role == "prefill" and rc == 0:
             # Close AFTER any drain: the drain's in-flight slots finish

@@ -108,6 +108,23 @@ Gates:
     (tests/fixtures/sched/), this turns "the DWRR scheduler is fair
     and lossless" into a regression-tested ledger.
 
+11. **migration conservation** (per ``--migrate-stream``): the live-
+    migration contract over one recorded migration-armed fleet stream
+    (schema v18: the router's records with the engines' kv_migration
+    / serve_drain / terminal records teed in) — every record
+    validates, exactly one ``fleet_summary`` from an armed run
+    (``migrations`` >= 1), zero lost requests, an empty migration
+    spool at exit, every migrating ``serve_drain`` evicted EXACTLY
+    zero slots (drain-without-eviction), and the per-uid ledger
+    conserved across any number of hops: every ``kv_migration`` out
+    leg was admitted or quarantined, extra admissions carry
+    redelivered/duplicate provenance (the leased ack-crash window),
+    every migrated uid reaches exactly one terminal record, and the
+    summary's ``migration_completed`` matches the recomputed count.
+    Run over the checked-in rolling-drain stream (tests/fixtures/
+    migrate/), this turns "a restart never kills a request" into a
+    regression-tested ledger.
+
 Exit 0 only when every gate passes; 1 when any gate fails; 2 on usage
 errors (unreadable stream, bad baseline).  Thin-client contract: NO
 jax import, direct or transitive — this must run on the bare CI host
@@ -510,6 +527,122 @@ def _tenant_gate(stream: str) -> int:
     return rc
 
 
+def _migrate_gate(stream: str) -> int:
+    """The live-migration gate (ISSUE 20) over one migration-armed
+    fleet stream (the router's records with the engines' kv_migration
+    / serve_drain / terminal records teed in): schema-v18 validation,
+    exactly one ``fleet_summary`` from an ARMED run (``migrations`` >=
+    1, zero lost, empty spool at exit), drain-WITHOUT-eviction (every
+    migrating ``serve_drain`` evicted exactly 0 — a drain that killed
+    what it was asked to preserve fails here), and the migration
+    ledger CONSERVED per uid across any number of hops —
+
+    - every ``kv_migration`` "out" leg was admitted ("in") or
+      quarantined: at least as many non-duplicate admissions as out
+      legs (a leased ack-crash redelivery adds admissions, never
+      subtracts);
+    - no admission from nowhere: at most one FIRST-delivery admission
+      (no ``redelivered``/``duplicate`` provenance) per out leg —
+      anything beyond that is two workers silently double-claiming;
+    - every migrated uid reaches EXACTLY one terminal request record:
+      it finished once, somewhere, after every hop (zero is a lost
+      request, two is a double-serve);
+    - the summary's ``migration_completed`` equals the count of
+      migrated uids with a terminal record recomputed from the stream
+      (an edited summary fails here).
+
+    Returns 0/1 (2 is the caller's unreadable-stream path)."""
+    summ, records = _load_gated_stream(stream, "fleet_summary")
+    if summ is None:
+        return 1
+    rc = 0
+    migs = summ.get("migrations")
+    if not isinstance(migs, int) or migs < 1:
+        print(f"{stream}: migrations is {migs!r} (migrate stream must "
+              "come from a migration-armed run)", file=sys.stderr)
+        return 1
+    if summ.get("lost", 0) != 0:
+        print(f"{stream}: {summ['lost']} request(s) LOST",
+              file=sys.stderr)
+        rc = 1
+    if summ.get("in_spool", 0) != 0:
+        print(f"{stream}: {summ['in_spool']} migration payload(s) "
+              "still parked in the spool at exit", file=sys.stderr)
+        rc = 1
+    outs = {}                    # uid -> out-leg count
+    in_events = {}               # uid -> [in records]
+    quarantined = set()
+    terminal = {}                # uid -> terminal-record count
+    for r in records:
+        rec = r.get("record")
+        if rec == "kv_migration":
+            uid = r.get("request_id", "?")
+            d = r.get("direction")
+            if d == "out":
+                outs[uid] = outs.get(uid, 0) + 1
+            elif d == "quarantine":
+                quarantined.add(uid)
+            else:
+                in_events.setdefault(uid, []).append(r)
+        elif rec in ("request_complete", "request_failed"):
+            uid = r.get("request_id", "?")
+            terminal[uid] = terminal.get(uid, 0) + 1
+        elif rec == "serve_drain" and "migrated" in r:
+            if r.get("evicted", 0) != 0:
+                print(f"{stream}: migrating serve_drain evicted "
+                      f"{r['evicted']} slot(s) — drain-without-"
+                      "eviction violated", file=sys.stderr)
+                rc = 1
+    if not outs:
+        print(f"{stream}: no kv_migration records (nothing migrated)",
+              file=sys.stderr)
+        return 1
+    lost_legs = []               # uid shipped, never landed anywhere
+    over_fresh = []              # admissions with no provenance > legs
+    for uid, n_out in sorted(outs.items()):
+        if uid in quarantined:
+            continue
+        evs = in_events.get(uid, [])
+        non_dup = [e for e in evs if not e.get("duplicate")]
+        fresh = [e for e in non_dup if not e.get("redelivered")]
+        if len(non_dup) < n_out:
+            lost_legs.append((uid, n_out, len(non_dup)))
+        if len(fresh) > n_out:
+            over_fresh.append((uid, n_out, len(fresh)))
+    never_terminal = sorted(u for u in outs
+                            if terminal.get(u, 0) == 0
+                            and u not in quarantined)
+    multi_terminal = sorted(u for u in outs
+                            if terminal.get(u, 0) > 1)
+    for uid, n_out, n_in in lost_legs[:10]:
+        print(f"{stream}: uid {uid} migrated out {n_out} time(s) but "
+              f"was admitted only {n_in} — a payload vanished in "
+              "transit", file=sys.stderr)
+    for uid, n_out, n_fresh in over_fresh[:10]:
+        print(f"{stream}: uid {uid} has {n_fresh} first-delivery "
+              f"admission(s) for {n_out} out leg(s) with no "
+              "redelivered/duplicate provenance — double-claimed",
+              file=sys.stderr)
+    for uid in never_terminal[:10]:
+        print(f"{stream}: migrated uid {uid} never reached a terminal "
+              "request record — LOST", file=sys.stderr)
+    for uid in multi_terminal[:10]:
+        print(f"{stream}: migrated uid {uid} reached "
+              f"{terminal[uid]} terminal records — exactly-once "
+              "violated (double-served)", file=sys.stderr)
+    if lost_legs or over_fresh or never_terminal or multi_terminal:
+        rc = 1
+    done = len([u for u in outs if terminal.get(u, 0) > 0])
+    if "migration_completed" in summ \
+            and summ["migration_completed"] != done:
+        print(f"{stream}: fleet_summary migration_completed "
+              f"{summ['migration_completed']} != {done} migrated "
+              "uid(s) with a terminal record recomputed from the "
+              "stream", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _slo_gate(stream: str) -> int:
     """The streaming-SLO gate (ISSUE 16) over one recorded ``--slo``
     stream — a serve.py replica stream (``serve_summary`` with its
@@ -779,6 +912,14 @@ def main(argv=None) -> int:
                          "block, exactly-once terminal conservation, "
                          "summary counts == recomputed counts, and "
                          "admitted tokens within budget (repeatable)")
+    ap.add_argument("--migrate-stream", action="append", default=[],
+                    metavar="JSONL",
+                    help="a migration-armed fleet stream to run the "
+                         "migrate gate over: schema-v18 validation, "
+                         "exactly one armed fleet_summary, zero lost, "
+                         "empty spool, zero drain evictions, and the "
+                         "per-uid out/in/terminal conservation ledger "
+                         "(repeatable)")
     ap.add_argument("--perf-baseline", default=None, metavar="JSON",
                     help="PERF_BASELINE.json to additionally diff "
                          "every --perf-stream snapshot against "
@@ -869,6 +1010,16 @@ def main(argv=None) -> int:
             return 2
         rc = _tenant_gate(stream)
         print(f"ci_gate: tenant gate {stream}: "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        worst = max(worst, rc)
+
+    for stream in args.migrate_stream:
+        if not os.path.isfile(stream):
+            print(f"ci_gate: no such stream: {stream}",
+                  file=sys.stderr)
+            return 2
+        rc = _migrate_gate(stream)
+        print(f"ci_gate: migrate gate {stream}: "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         worst = max(worst, rc)
 

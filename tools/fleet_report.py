@@ -34,7 +34,13 @@ mean — ``--skew-factor`` flags imbalance), replica lifecycle anomalies
 scenario verdict line, and — on a v13 disaggregated fleet — the DISAGG
 line (prefill/decode topology, handoff count, redelivered admissions,
 uids stuck in the spool at close: a spool leak is flagged as its own
-anomaly).  On a v17 multi-tenant fleet (ISSUE 19) the TENANT lines
+anomaly).  On a v18 migration-armed fleet (ISSUE 20) the MIGRATION
+line reports mid-flight transfers (shipped vs completed, peer
+redeliveries, rebalance asks, transit percentiles recomputed from the
+teed ``kv_migration`` records) — a migrated uid that never completed
+is flagged — and the AUTOSCALE line reports the elastic-pool
+scale-up/scale-down events.  On a v17 multi-tenant fleet (ISSUE 19)
+the TENANT lines
 name the starved tenant (lowest availability) and the noisiest one
 (most admitted tokens), flag failing per-tenant SLO verdicts outside
 chaos scenarios, and report the fleet prefix-affinity hit rate when
@@ -415,6 +421,41 @@ def analyze_fleet(records: List[dict], skew_factor: float,
             print(f"SPOOL LEAK: {summary['in_spool']} uid(s) still on "
                   "the KV spool at close — no decode worker finished "
                   "them", file=out)
+
+    # v18 live migration (ISSUE 20): a migration-armed fleet reports
+    # its mid-flight transfer story — uids shipped with their KV vs
+    # uids that reached a terminal afterwards (a gap is a lost
+    # request, flagged even though the lost counter caught it too:
+    # naming migration points at the right subsystem), peer
+    # redeliveries (the leased ack-crash protocol firing), rebalance
+    # asks, and transit percentiles recomputed from the teed
+    # kv_migration records.  Pre-v18 streams carry none of these
+    # fields and skip the block silently.
+    if "migrations" in summary:
+        migs = summary.get("migrations", 0)
+        done = summary.get("migration_completed", 0)
+        line = (f"MIGRATION: {migs} uid(s) shipped mid-flight  "
+                f"{done} completed after migration  "
+                f"{summary.get('migration_redelivered', 0)} "
+                f"peer-redelivered")
+        if summary.get("rebalance_migrations"):
+            line += (f"  {summary['rebalance_migrations']} "
+                     "rebalance ask(s)")
+        lats = sorted(r["migration_ms"] for r in records
+                      if r.get("record") == "kv_migration"
+                      and "migration_ms" in r)
+        if lats:
+            line += (f"  transit p50 {_pct(lats, 50):.1f} "
+                     f"p99 {_pct(lats, 99):.1f} (ms)")
+        print(line, file=out)
+        if done < migs:
+            anomalies += 1
+            print(f"MIGRATION LOSS: {migs - done} migrated uid(s) "
+                  "never reached a terminal status", file=out)
+    if "scale_up_events" in summary or "scale_down_events" in summary:
+        print(f"AUTOSCALE: {summary.get('scale_up_events', 0)} "
+              f"scale-up(s), {summary.get('scale_down_events', 0)} "
+              "scale-down(s)", file=out)
 
     avail = summary["availability"]
     verdict = summary.get("verdict")

@@ -82,7 +82,20 @@ budget, admitted tokens, per-tenant SLO verdicts — ``replica_state``
 heartbeats gain the prefix-affinity advertisement ``prefix_keys``/
 ``prefix_shared_tokens``/``prefix_prompt_tokens`` and the
 ``tenant_admitted`` ledger, and ``fleet_summary`` gains the fleet
-``prefix_hit_rate``)
+``prefix_hit_rate``) and v18 streams (the live-migration + elastic-
+pool stratum from migration-armed runs: ``kv_migration`` records —
+one per side of a mid-flight extract_live -> admit_migrated transfer,
+with the committed-KV fill/block/byte accounting, the generated-token
+count riding the payload, the destination's ``migration_ms`` transit
+and the same ``redelivered``/``duplicate``/``requeued`` leased-spool
+provenance ``kv_handoff`` carries — ``serve_summary`` gains the
+``migrations_out``/``migrations_in``/``migration_requeued``/
+``migration_duplicates``/``migration_redelivered``/``migration_bytes``
+ledger plus ``migration_ms`` percentiles, a migrating ``serve_drain``
+gains its ``migrated`` count, and ``fleet_summary`` gains
+``migrations``/``migration_completed``/``migration_redelivered``/
+``rebalance_migrations`` and the autoscaler's ``scale_up_events``/
+``scale_down_events``)
 all validate alongside v1
 streams — each version's tables are a strict superset of the last.
 A gracefully preempted run (train.py --preempt-grace) DOES close with a

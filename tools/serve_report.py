@@ -60,6 +60,17 @@ over the lane's token budget, from the summary's ``tenants`` block).
 Pre-v17 (and unarmed) streams carry no ``tenant`` fields and degrade
 silently.
 
+Schema v18 adds the MIGRATION line (live KV migration, ISSUE 20): on
+a migration-armed stream, the mid-flight transfer ledger — out/in
+counts, blocks and bytes moved, transit percentiles
+(``kv_migration.migration_ms``: out-stamp -> admission), deferred
+admissions, plus the same redelivered/duplicate/quarantine
+crash-safety accounting HANDOFF gets; a migrating ``serve_drain``
+additionally shows its ``migrated`` count.  A migrated-out request
+resumes on another replica, so like "handoff" it sits outside this
+server's availability denominator.  Pre-v18 (and unarmed) streams
+carry no ``kv_migration`` records and degrade silently.
+
 Thin client of the obs schema (obs/schema.py):
 
     python tools/serve_report.py serve.jsonl
@@ -241,6 +252,8 @@ def report(path: str, out=sys.stdout) -> int:
     summary = next((r for r in records
                     if r.get("record") == "serve_summary"), None)
     handoffs = [r for r in records if r.get("record") == "kv_handoff"]
+    migrations = [r for r in records
+                  if r.get("record") == "kv_migration"]
     reqs = [r for r in records if r.get("record") == "request_complete"
             and all(k in r for k in ("ttft_ms", "tpot_ms",
                                      "output_tokens"))]
@@ -255,7 +268,7 @@ def report(path: str, out=sys.stdout) -> int:
               f"slots={cfg.get('slots', '?')}  "
               f"max_len={cfg.get('max_len', '?')}", file=out)
     if not reqs and not failed and not shed and not drains \
-            and not handoffs:
+            and not handoffs and not migrations:
         print("no request records", file=out)
         return 1
 
@@ -274,12 +287,17 @@ def report(path: str, out=sys.stdout) -> int:
     handed_off = sum(1 for h in handoffs if h.get("direction") == "out")
     if handed_off:
         statuses["handoff"] = handed_off
+    migrated_out = sum(1 for m in migrations
+                       if m.get("direction") == "out")
+    if migrated_out:
+        statuses["migrated"] = migrated_out
     print("status: " + ", ".join(f"{k} x{v}" for k, v in
                                  sorted(statuses.items())), file=out)
-    # drained AND handed-off requests continue on another replica/role —
-    # neither belongs in this server's availability denominator.
+    # drained, handed-off AND migrated requests continue on another
+    # replica/role — none belongs in this server's availability
+    # denominator.
     owned = sum(v for k, v in statuses.items()
-                if k not in ("drained", "handoff"))
+                if k not in ("drained", "handoff", "migrated"))
     if owned and len(statuses) > 1:
         print(f"availability {statuses.get('ok', 0) / owned:.3f}  "
               f"(ok / every status the server owned; drained requests "
@@ -365,11 +383,65 @@ def report(path: str, out=sys.stdout) -> int:
             print(f"handoff ttft_ms (prefill-side)  p50 "
                   f"{_pct(ttfts, 50):8.1f}  p99 {_pct(ttfts, 99):8.1f}  "
                   f"max {ttfts[-1]:8.1f}  (ms)", file=out)
+    if migrations:
+        # Schema v18 (live migration, ISSUE 20): the mid-flight
+        # transfer ledger, same shape as HANDOFF — transit latency
+        # only exists on "in" records (the destination stamps
+        # out-wall -> admission); a source-only stream reports count
+        # and bytes alone.  The leased-spool crash-safety provenance
+        # (redelivered / duplicate / quarantine) rides along exactly
+        # as it does for handoffs.
+        n_out = sum(1 for m in migrations
+                    if m.get("direction") == "out")
+        n_in = sum(1 for m in migrations if m.get("direction") == "in"
+                   and not m.get("duplicate"))
+        moved = sum(m.get("payload_bytes", 0) for m in migrations
+                    if m.get("direction") != "quarantine")
+        blocks = sum(m.get("blocks", 0) for m in migrations)
+        line = (f"MIGRATION: {n_out} out / {n_in} in  "
+                f"{blocks} block(s), {moved / 1024:.1f} KiB moved")
+        lats = sorted(m["migration_ms"] for m in migrations
+                      if "migration_ms" in m)
+        if lats:
+            line += (f"  transit p50 {_pct(lats, 50):.1f}  "
+                     f"p99 {_pct(lats, 99):.1f}  max {lats[-1]:.1f} (ms)")
+        requeued = sum(m.get("requeued", 0) for m in migrations)
+        if requeued:
+            line += f"  requeued {requeued}"
+        gen = sorted(m.get("tokens_generated", 0) for m in migrations
+                     if m.get("direction") == "out")
+        if gen:
+            line += (f"  tokens riding p50 {_pct(gen, 50):.0f} "
+                     f"max {gen[-1]}")
+        print(line, file=out)
+        n_redeliv = sum(1 for m in migrations
+                        if m.get("direction") == "in"
+                        and m.get("redelivered")
+                        and not m.get("duplicate"))
+        n_dup = sum(1 for m in migrations if m.get("duplicate"))
+        n_quar = sum(1 for m in migrations
+                     if m.get("direction") == "quarantine")
+        if n_redeliv or n_dup or n_quar:
+            print(f"  redelivery: {n_redeliv} redelivered "
+                  f"admission(s)  {n_dup} duplicate(s) acked without "
+                  f"scatter  {n_quar} payload(s) quarantined", file=out)
+            for m in migrations:
+                if m.get("direction") == "quarantine":
+                    print(f"  quarantined {m.get('request_id', '?')} "
+                          f"({m.get('spool_file', '?')}): "
+                          f"{m.get('error', '?')}", file=out)
     for d in drains:
-        print(f"DRAIN: {d.get('signal', '?')} at step {d.get('step', '?')}"
-              f" — in_flight {d.get('in_flight', '?')}, completed "
-              f"{d.get('completed', '?')}, evicted {d.get('evicted', '?')}"
-              f", requeued {d.get('requeued', '?')}", file=out)
+        line = (f"DRAIN: {d.get('signal', '?')} at step "
+                f"{d.get('step', '?')}"
+                f" — in_flight {d.get('in_flight', '?')}, completed "
+                f"{d.get('completed', '?')}, evicted "
+                f"{d.get('evicted', '?')}"
+                f", requeued {d.get('requeued', '?')}")
+        if "migrated" in d:
+            # v18: a migrating drain ships its live slots instead of
+            # ticking them out — show what it preserved.
+            line += f", migrated {d['migrated']}"
+        print(line, file=out)
     if summary:
         print(f"serve_summary: {summary['requests']} request(s)  "
               f"{summary['output_tokens']} token(s)  "
